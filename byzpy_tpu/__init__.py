@@ -1,0 +1,12 @@
+"""byzpy_tpu — TPU-native Byzantine-robust distributed learning framework.
+
+Capability-parity rebuild of the ByzPy reference (see SURVEY.md) designed
+for JAX/XLA: aggregation math is jit-compiled and mesh-shardable
+(``byzpy_tpu.ops``), operators schedule on an asyncio actor runtime
+(``byzpy_tpu.engine``), and training orchestration (parameter-server and
+peer-to-peer) lowers gradient movement onto XLA collectives.
+"""
+
+from .version import __version__
+
+__all__ = ["__version__"]

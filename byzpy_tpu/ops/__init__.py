@@ -1,0 +1,3 @@
+from . import attack_ops, preagg, robust
+
+__all__ = ["robust", "preagg", "attack_ops"]

@@ -1,0 +1,96 @@
+"""Byzantine attack primitives as pure JAX functions.
+
+Each takes honest gradient information and emits one malicious ``(d,)``
+vector. Randomness is explicit ``jax.random`` keys (the reference seeds
+numpy/torch generators; explicit keys are the jit-safe equivalent).
+Formulas mirror ``byzpy/attacks/*`` (cited per function); parity pinned in
+``tests/test_ops_attacks.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtri
+
+Array = jnp.ndarray
+
+
+@jax.jit
+def sign_flip(base_grad: Array, *, scale: float = -1.0) -> Array:
+    """``scale * base_grad`` (ref: ``attacks/sign_flip.py:22``)."""
+    return scale * base_grad
+
+
+@jax.jit
+def empire(honest: Array, *, scale: float = -1.0) -> Array:
+    """``scale * mean(honest)`` (ref: ``attacks/empire.py:23``)."""
+    return scale * jnp.mean(honest, axis=0)
+
+
+@partial(jax.jit, static_argnames=("f", "n_total"))
+def little(honest: Array, *, f: int, n_total: int) -> Array:
+    """'A Little Is Enough' (Baruch et al. 2019): ``mu + z_max * sigma`` with
+    ``s = floor(N/2) + 1 - f`` and ``z_max = ndtri((N - s) / N)``
+    (ref: ``attacks/little.py:81-139``; the reference hand-rolls an inverse
+    normal CDF — ``jax.scipy.special.ndtri`` is exact on TPU).
+    """
+    if n_total < f:
+        raise ValueError(f"N must be >= f (got N={n_total}, f={f})")
+    s = n_total // 2 + 1 - f
+    p = (n_total - s) / float(n_total)
+    p = min(max(p, 1e-12), 1.0 - 1e-12)
+    z = ndtri(p)
+    mu = jnp.mean(honest, axis=0)
+    sigma = jnp.sqrt(jnp.mean((honest - mu[None, :]) ** 2, axis=0))
+    return (mu + z * sigma).astype(honest.dtype)
+
+
+def gaussian(key: jax.Array, shape, dtype=jnp.float32, *, mu: float = 0.0, sigma: float = 1.0) -> Array:
+    """IID ``N(mu, sigma^2)`` coordinates (ref: ``attacks/gaussian.py:38``)."""
+    return mu + sigma * jax.random.normal(key, shape, dtype=dtype)
+
+
+def inf_vector(shape, dtype=jnp.float32) -> Array:
+    """``+inf``-filled vector (ref: ``attacks/inf.py:35``)."""
+    return jnp.full(shape, jnp.inf, dtype=dtype)
+
+
+@partial(jax.jit, static_argnames=("epsilon",))
+def mimic(honest: Array, *, epsilon: int = 0) -> Array:
+    """Copy honest worker ``epsilon``'s vector (ref: ``attacks/mimic.py:35``)."""
+    if not 0 <= epsilon < honest.shape[0]:
+        raise ValueError(
+            f"epsilon must index an honest worker in [0, {honest.shape[0]}) (got {epsilon})"
+        )
+    return honest[epsilon]
+
+
+def label_flip_grad(grad_fn, params, x: Array, y: Array, *, num_classes: int | None = None,
+                    mapping: Array | None = None) -> Array:
+    """Gradient of the loss on flipped labels (ref: ``attacks/label_flip.py:35``).
+
+    ``grad_fn(params, x, y) -> grad pytree`` is supplied by the caller (e.g.
+    ``jax.grad`` of a flax loss); labels flip via an explicit ``mapping``
+    lookup table or the default ``num_classes - 1 - y``.
+    """
+    if mapping is not None:
+        flipped = jnp.asarray(mapping)[y]
+    elif num_classes is not None:
+        flipped = num_classes - 1 - y
+    else:
+        raise ValueError("label_flip_grad requires num_classes or mapping")
+    return grad_fn(params, x, flipped)
+
+
+__all__ = [
+    "sign_flip",
+    "empire",
+    "little",
+    "gaussian",
+    "inf_vector",
+    "mimic",
+    "label_flip_grad",
+]

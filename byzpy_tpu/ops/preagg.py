@@ -1,0 +1,96 @@
+"""Pre-aggregation primitives (clip / bucket / mix) as pure JAX functions.
+
+Operate on the stacked ``(n, d)`` gradient matrix; return a transformed
+matrix (possibly with fewer rows). TPU notes: row-norm computations contract
+the feature axis, so under feature-axis sharding they are local partial
+reductions + an ``(n,)``-sized psum; NNM's neighbor mixing is a mask matmul
+that rides the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .robust import gram_matrix, pairwise_sq_dists
+
+Array = jnp.ndarray
+
+
+@jax.jit
+def clip_rows(x: Array, *, threshold: float) -> Array:
+    """Static L2-norm clipping of each row to ``threshold``
+    (ref: ``byzpy/pre_aggregators/clipping.py``).
+    """
+    norms = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    factors = jnp.minimum(1.0, threshold / jnp.maximum(norms, 1e-12))
+    return x * factors
+
+
+@partial(jax.jit, static_argnames=("bucket_size",))
+def bucket_means(x: Array, perm: Array, *, bucket_size: int) -> Array:
+    """Bucketing (Karimireddy et al.): permute rows, split into buckets of
+    ``bucket_size`` (last bucket may be smaller), return per-bucket means
+    (ref: ``byzpy/pre_aggregators/bucketing.py:101-120``).
+
+    ``perm`` is an explicit permutation of ``range(n)`` so randomness stays
+    in caller-owned ``jax.random`` keys (reproducible under jit). Out-of-range
+    indices in a traced ``perm`` follow JAX gather clamping semantics; pass a
+    real permutation (e.g. ``jax.random.permutation``).
+    """
+    n = x.shape[0]
+    if perm.shape != (n,):
+        raise ValueError(f"perm must have shape ({n},); got {perm.shape}")
+    nb = math.ceil(n / bucket_size)
+    padded_len = nb * bucket_size
+    xp = x[perm]
+    # Pad with zero rows + a weight mask so the ragged final bucket averages
+    # only its real members — keeps shapes static for XLA.
+    pad = padded_len - n
+    xp = jnp.pad(xp, ((0, pad), (0, 0)))
+    weights = jnp.pad(jnp.ones((n,), x.dtype), (0, pad))
+    xb = xp.reshape(nb, bucket_size, -1)
+    wb = weights.reshape(nb, bucket_size)
+    return jnp.sum(xb * wb[:, :, None], axis=1) / jnp.sum(wb, axis=1, keepdims=True)
+
+
+@partial(jax.jit, static_argnames=("f",))
+def nnm(x: Array, *, f: int) -> Array:
+    """Nearest-Neighbor Mixing: replace each row by the mean of its
+    ``k = n - f`` nearest neighbors (self included)
+    (ref: ``byzpy/pre_aggregators/nnm.py:50-95``).
+    """
+    n = x.shape[0]
+    if not 0 <= f < n:
+        raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
+    k = n - f
+    d2 = pairwise_sq_dists(x)
+    # k-nearest mask per row, then one (n,n)@(n,d) matmul does the mixing.
+    idx = jnp.argsort(d2, axis=1)[:, :k]
+    mask = jnp.zeros_like(d2).at[jnp.arange(n)[:, None], idx].set(1.0)
+    return (mask @ x) / k
+
+
+@partial(jax.jit, static_argnames=("f",))
+def arc_clip(x: Array, *, f: int) -> Array:
+    """Adaptive Robust Clipping: clip the ``floor(2f/n * (n-f))`` largest-norm
+    rows to the norm of the next-largest remaining row
+    (ref: ``byzpy/pre_aggregators/arc.py:36-51``).
+    """
+    n = x.shape[0]
+    if f > n:
+        raise ValueError(f"f must be <= n (got f={f}, n={n})")
+    nb_clipped = int(math.floor((2.0 * f / n) * (n - f)))
+    nb_clipped = max(0, min(nb_clipped, n - 1))
+    cut_off = n - nb_clipped
+    norms = jnp.sqrt(jnp.sum(x * x, axis=1))
+    threshold = jnp.sort(norms)[max(0, cut_off - 1)]
+    factors = jnp.minimum(1.0, threshold / jnp.maximum(norms, 1e-12))
+    return x * factors[:, None]
+
+
+__all__ = ["clip_rows", "bucket_means", "nnm", "arc_clip"]

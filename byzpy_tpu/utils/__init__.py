@@ -1,0 +1,3 @@
+from .trees import stack_gradients, unstack_rows
+
+__all__ = ["stack_gradients", "unstack_rows"]

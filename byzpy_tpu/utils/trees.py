@@ -1,0 +1,68 @@
+"""Gradient <-> matrix conversion utilities.
+
+The reference framework flattens lists of per-node gradient tensors into an
+``(n, d)`` matrix backed by POSIX shared memory before fanning work out to
+pool workers (ref: ``byzpy/aggregators/coordinate_wise/_tiling.py:18-38``,
+``byzpy/engine/storage/shared_store.py``).  On TPU there is no host-side
+shared-memory dance: gradients are JAX pytrees (or arrays) and the stacked
+matrix is a single device array that jitted aggregation kernels consume
+directly — sharding it over a mesh replaces chunking it over workers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def stack_gradients(
+    gradients: Sequence[Any] | jnp.ndarray,
+) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], Any]]:
+    """Stack a sequence of gradient pytrees/arrays into an ``(n, d)`` matrix.
+
+    Accepts:
+
+    * a sequence of same-structure pytrees (dicts/lists of arrays, flax
+      parameter trees, plain arrays of any rank), or
+    * an already-stacked 2-D array (returned unchanged).
+
+    Returns ``(matrix, unravel)`` where ``unravel(row)`` maps a flat ``(d,)``
+    vector back to the structure/shape of a single input gradient.
+    """
+    if isinstance(gradients, jnp.ndarray) or hasattr(gradients, "ndim"):
+        arr = jnp.asarray(gradients)
+        if arr.ndim != 2:
+            raise ValueError(
+                f"stacked gradient array must be 2-D (n, d); got shape {arr.shape}"
+            )
+        return arr, lambda row: row
+    if len(gradients) == 0:
+        raise ValueError("gradients must be a non-empty sequence")
+
+    flat0, unravel = ravel_pytree(gradients[0])
+    d = flat0.shape[0]
+    rows = [flat0]
+    for g in gradients[1:]:
+        flat, _ = ravel_pytree(g)
+        if flat.shape[0] != d:
+            raise ValueError(
+                f"all gradients must flatten to the same length (got {flat.shape[0]} != {d})"
+            )
+        rows.append(flat)
+    matrix = jnp.stack(rows, axis=0)
+    if not jnp.issubdtype(matrix.dtype, jnp.floating):
+        matrix = matrix.astype(jnp.float32)
+    return matrix, unravel
+
+
+def unstack_rows(matrix: jnp.ndarray, unravel: Callable[[jnp.ndarray], Any]) -> List[Any]:
+    """Split an ``(n, d)`` matrix back into a list of per-node gradients."""
+    return [unravel(matrix[i]) for i in range(matrix.shape[0])]
+
+
+def tree_size(tree: Any) -> int:
+    """Total number of elements across all leaves of a pytree."""
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))

@@ -1,0 +1,33 @@
+"""Test configuration: run everything on a simulated 8-device CPU mesh.
+
+Multi-chip sharding logic is validated without TPU hardware by forcing the
+host platform to expose 8 virtual devices (the reference validates its
+multi-node logic analogously with an in-process cluster registry, ref:
+``byzpy/engine/node/context.py:56-123``).
+
+Note: the session environment pins ``JAX_PLATFORMS=axon`` (real TPU) and a
+sitecustomize imports jax at interpreter start, so the platform must be
+overridden via ``jax.config`` (env vars are too late for JAX_PLATFORMS and
+just-in-time for XLA_FLAGS, which is read at first backend init).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,33 @@
+"""Inverse normal CDF oracle for attack tests: scipy if present, else the
+Acklam rational approximation (the same family the reference hand-rolls)."""
+
+import math
+
+try:
+    from scipy.special import ndtri as ndtri_oracle  # type: ignore
+except Exception:  # pragma: no cover - environment-dependent
+
+    def ndtri_oracle(p: float) -> float:
+        eps = 1e-12
+        p = min(max(p, eps), 1.0 - eps)
+        a = [-3.969683028665376e01, 2.209460984245205e02, -2.759285104469687e02,
+             1.383577518672690e02, -3.066479806614716e01, 2.506628277459239e00]
+        b = [-5.447609879822406e01, 1.615858368580409e02, -1.556989798598866e02,
+             6.680131188771972e01, -1.328068155288572e01]
+        c = [-7.784894002430293e-03, -3.223964580411365e-01, -2.400758277161838e00,
+             -2.549732539343734e00, 4.374664141464968e00, 2.938163982698783e00]
+        d = [7.784695709041462e-03, 3.224671290700398e-01, 2.445134137142996e00,
+             3.754408661907416e00]
+        plow, phigh = 0.02425, 1.0 - 0.02425
+        if p < plow:
+            q = math.sqrt(-2.0 * math.log(p))
+            return ((((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q + c[5])
+                    / ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1.0))
+        if p > phigh:
+            q = math.sqrt(-2.0 * math.log(1.0 - p))
+            return -((((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q + c[5])
+                     / ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1.0))
+        q = p - 0.5
+        r = q * q
+        return ((((((a[0] * r + a[1]) * r + a[2]) * r + a[3]) * r + a[4]) * r + a[5]) * q
+                / (((((b[0] * r + b[1]) * r + b[2]) * r + b[3]) * r + b[4]) * r + 1.0))

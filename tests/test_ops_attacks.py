@@ -1,0 +1,78 @@
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from scipy_stub import ndtri_oracle  # noqa: F401  (defined below if scipy absent)
+
+from byzpy_tpu.ops import attack_ops
+
+
+def randx(n=8, d=15, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+def test_sign_flip():
+    g = randx(1, 10)[0]
+    got = np.asarray(attack_ops.sign_flip(jnp.asarray(g)))
+    np.testing.assert_allclose(got, -g, rtol=1e-6)
+    got2 = np.asarray(attack_ops.sign_flip(jnp.asarray(g), scale=2.5))
+    np.testing.assert_allclose(got2, 2.5 * g, rtol=1e-6)
+
+
+def test_empire():
+    h = randx(6, 9)
+    got = np.asarray(attack_ops.empire(jnp.asarray(h)))
+    np.testing.assert_allclose(got, -h.mean(0), rtol=1e-5, atol=1e-6)
+
+
+def test_little_formula():
+    h = randx(9, 14, seed=1)
+    f, n_total = 2, 11  # 9 honest + 2 byzantine
+    got = np.asarray(attack_ops.little(jnp.asarray(h), f=f, n_total=n_total))
+    s = n_total // 2 + 1 - f
+    p = (n_total - s) / n_total
+    z = ndtri_oracle(p)
+    mu = h.mean(0)
+    sigma = h.std(0)  # ddof=0, matching reference var = mean((x-mu)^2)
+    np.testing.assert_allclose(got, mu + z * sigma, rtol=1e-4, atol=1e-4)
+
+
+def test_gaussian_seeded_reproducible():
+    key = jax.random.PRNGKey(42)
+    a = np.asarray(attack_ops.gaussian(key, (100,), mu=1.0, sigma=2.0))
+    b = np.asarray(attack_ops.gaussian(key, (100,), mu=1.0, sigma=2.0))
+    np.testing.assert_array_equal(a, b)
+    assert abs(a.mean() - 1.0) < 1.0
+
+
+def test_inf_vector():
+    v = np.asarray(attack_ops.inf_vector((7,)))
+    assert np.all(np.isposinf(v))
+
+
+def test_mimic():
+    h = randx(5, 8, seed=2)
+    got = np.asarray(attack_ops.mimic(jnp.asarray(h), epsilon=3))
+    np.testing.assert_array_equal(got, h[3])
+
+
+def test_label_flip_grad():
+    # linear softmax model; flipping labels must change the gradient
+    w = jnp.zeros((4, 3))
+    x = jnp.asarray(randx(6, 4, seed=3))
+    y = jnp.asarray(np.array([0, 1, 2, 0, 1, 2]))
+
+    def loss(params, xb, yb):
+        logits = xb @ params
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(logp[jnp.arange(xb.shape[0]), yb])
+
+    grad_fn = jax.grad(loss)
+    g_flip = attack_ops.label_flip_grad(grad_fn, w, x, y, num_classes=3)
+    g_true = grad_fn(w, x, y)
+    assert not np.allclose(np.asarray(g_flip), np.asarray(g_true))
+    # mapping route: identity mapping == honest gradient
+    ident = jnp.asarray(np.arange(3))
+    g_ident = attack_ops.label_flip_grad(grad_fn, w, x, y, mapping=ident)
+    np.testing.assert_allclose(np.asarray(g_ident), np.asarray(g_true), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,71 @@
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byzpy_tpu.ops import preagg
+
+
+def randx(n=10, d=21, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+def test_clip_rows():
+    x = randx()
+    t = 1.5
+    got = np.asarray(preagg.clip_rows(jnp.asarray(x), threshold=t))
+    norms = np.linalg.norm(x, axis=1, keepdims=True)
+    want = x * np.minimum(1.0, t / np.maximum(norms, 1e-12))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert np.all(np.linalg.norm(got, axis=1) <= t + 1e-4)
+
+
+def test_bucket_means_ragged_last_bucket():
+    x = randx(10, 7)
+    perm = np.arange(10)  # identity permutation -> deterministic oracle
+    got = np.asarray(preagg.bucket_means(jnp.asarray(x), jnp.asarray(perm), bucket_size=4))
+    assert got.shape == (3, 7)  # ceil(10/4)
+    np.testing.assert_allclose(got[0], x[0:4].mean(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got[1], x[4:8].mean(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got[2], x[8:10].mean(0), rtol=1e-5, atol=1e-6)
+
+
+def test_bucket_means_respects_permutation():
+    x = randx(6, 5, seed=1)
+    perm = np.array([5, 4, 3, 2, 1, 0])
+    got = np.asarray(preagg.bucket_means(jnp.asarray(x), jnp.asarray(perm), bucket_size=3))
+    np.testing.assert_allclose(got[0], x[[5, 4, 3]].mean(0), rtol=1e-5, atol=1e-6)
+
+
+def test_nnm():
+    x = randx(8, 12, seed=2)
+    f = 2
+    got = np.asarray(preagg.nnm(jnp.asarray(x), f=f))
+    k = 8 - f
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    idx = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    want = np.stack([x[idx[i]].mean(0) for i in range(8)])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_arc_clip():
+    x = randx(10, 9, seed=3)
+    x[7] *= 30  # large-norm outlier must get clipped
+    f = 3
+    got = np.asarray(preagg.arc_clip(jnp.asarray(x), f=f))
+    n = 10
+    nb_clipped = min(max(int(math.floor((2.0 * f / n) * (n - f))), 0), n - 1)
+    cut_off = n - nb_clipped
+    norms = np.linalg.norm(x, axis=1)
+    threshold = np.sort(norms)[max(0, cut_off - 1)]
+    want = x * np.minimum(1.0, threshold / np.maximum(norms, 1e-12))[:, None]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert np.linalg.norm(got[7]) <= threshold + 1e-3
+
+
+def test_arc_f0_identity():
+    x = randx(5, 6, seed=4)
+    got = np.asarray(preagg.arc_clip(jnp.asarray(x), f=0))
+    np.testing.assert_allclose(got, x, rtol=1e-6, atol=1e-6)
