@@ -5,8 +5,17 @@ for JAX/XLA: aggregation math is jit-compiled and mesh-shardable
 (``byzpy_tpu.ops``), operators schedule on an asyncio actor runtime
 (``byzpy_tpu.engine``), and training orchestration (parameter-server and
 peer-to-peer) lowers gradient movement onto XLA collectives.
+
+Front door (ref: ``byzpy/__init__.py:1-4``)::
+
+    import asyncio
+    from byzpy_tpu import run_operator
+    from byzpy_tpu.aggregators import CoordinateWiseMedian
+
+    result = asyncio.run(run_operator(CoordinateWiseMedian(), gradients))
 """
 
+from .engine.graph.executor import OperatorExecutor, run_operator
 from .version import __version__
 
-__all__ = ["__version__"]
+__all__ = ["__version__", "OperatorExecutor", "run_operator"]

@@ -1,0 +1,5 @@
+from .base import ActorBackend, ActorRef
+from .channels import ChannelRef, Endpoint, open_channel
+from .factory import resolve_backend
+
+__all__ = ["ActorBackend", "ActorRef", "ChannelRef", "Endpoint", "open_channel", "resolve_backend"]

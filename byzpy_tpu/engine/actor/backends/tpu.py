@@ -1,0 +1,133 @@
+"""TPU actor backend: one actor pinned to one chip (jax device).
+
+This is the TPU-native replacement for the reference's CUDA ``GPUActorBackend``
+(ref: ``byzpy/engine/actor/backends/gpu.py:23-204``). Instead of cupy streams
+and UCX device-to-device copies:
+
+* ``construct`` instantiates the actor with ``jax.default_device`` pinned to
+  its chip, so every array the actor creates lives in that chip's HBM;
+* ``call`` runs methods on the actor's dedicated thread under the same device
+  context — jitted functions compile for and execute on that chip;
+* channel payloads are passed **by reference** in-process: a ``jax.Array``
+  enqueued to a peer on the same host is zero-copy; actual cross-chip data
+  movement belongs to collectives (``byzpy_tpu.parallel``), never mailboxes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import itertools
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import jax
+
+from ..channels import Endpoint
+from ..router import channel_router
+
+_counter = itertools.count()
+
+
+class TpuActorBackend:
+    scheme = "tpu"
+
+    def __init__(
+        self, *, device_index: int = 0, actor_id: str | None = None
+    ) -> None:
+        devices = jax.devices()
+        if not 0 <= device_index < len(devices):
+            raise ValueError(
+                f"device_index {device_index} out of range; {len(devices)} devices visible"
+            )
+        self.device = devices[device_index]
+        self.device_index = device_index
+        self.actor_id = actor_id or f"tpu{device_index}-{next(_counter)}-{uuid.uuid4().hex[:6]}"
+        self._executor: ThreadPoolExecutor | None = None
+        self._obj: Any = None
+        self._mailboxes: Dict[str, asyncio.Queue] = {}
+        self._started = False
+
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"tpu-actor-{self.actor_id}"
+        )
+        channel_router.register(self.get_endpoint(), self)
+        self._started = True
+
+    async def construct(self, target: Any, /, *args: Any, **kwargs: Any) -> None:
+        self._ensure_started()
+
+        def build():
+            with jax.default_device(self.device):
+                return target(*args, **kwargs)
+
+        loop = asyncio.get_running_loop()
+        self._obj = await loop.run_in_executor(self._executor, build)
+
+    async def call(self, method: str, /, *args: Any, **kwargs: Any) -> Any:
+        self._ensure_started()
+        if self._obj is None:
+            raise RuntimeError("actor not constructed")
+        fn = getattr(self._obj, method)
+
+        def run():
+            with jax.default_device(self.device):
+                if inspect.iscoroutinefunction(fn):
+                    # complete the coroutine on the actor thread so the device
+                    # pin covers async methods too
+                    return asyncio.run(fn(*args, **kwargs))
+                return fn(*args, **kwargs)
+
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(self._executor, run)
+        if inspect.isawaitable(result):
+            result = await result
+        return result
+
+    async def close(self) -> None:
+        if not self._started:
+            return
+        channel_router.unregister(self.get_endpoint())
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+        self._obj = None
+        self._started = False
+
+    def get_endpoint(self) -> Endpoint:
+        return Endpoint(self.scheme, f"tpu:{self.device_index}", self.actor_id)
+
+    async def chan_open(self, name: str) -> None:
+        self._mailboxes.setdefault(name, asyncio.Queue())
+
+    async def deliver_local(self, name: str, payload: Any) -> None:
+        await self._mailboxes.setdefault(name, asyncio.Queue()).put(payload)
+
+    async def chan_put(
+        self, name: str, payload: Any, *, endpoint: Optional[Endpoint] = None
+    ) -> None:
+        if endpoint is None or endpoint == self.get_endpoint():
+            await self.deliver_local(name, payload)
+            return
+        if await channel_router.deliver(endpoint, name, payload):
+            return
+        if endpoint.scheme == "tcp":
+            from ..transports import tcp
+
+            await tcp.chan_put(endpoint, name, payload)
+            return
+        raise LookupError(f"no route to endpoint {endpoint}")
+
+    async def chan_get(self, name: str) -> Any:
+        return await self._mailboxes.setdefault(name, asyncio.Queue()).get()
+
+    def _ensure_started(self) -> None:
+        if not self._started:
+            raise RuntimeError("backend not started; call start() first")
+
+
+__all__ = ["TpuActorBackend"]

@@ -1,0 +1,52 @@
+"""Named mailbox channels and endpoints (ref: ``byzpy/engine/actor/channels.py``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from .base import ActorBackend
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    """Addressable location of an actor: transport scheme + address + id.
+
+    Examples: ``Endpoint("thread", "local", "a1")``,
+    ``Endpoint("tpu", "tpu:0", "worker-3")``,
+    ``Endpoint("tcp", "10.0.0.2:7777", "node-b")``.
+    """
+
+    scheme: str
+    address: str
+    actor_id: str
+
+
+class ChannelRef:
+    """A named channel bound to one actor's mailbox.
+
+    ``send(payload, to=endpoint)`` delivers into the *target* actor's mailbox
+    of the same name (local or remote); ``recv()`` pops from this actor's own
+    mailbox.
+    """
+
+    __slots__ = ("_backend", "name")
+
+    def __init__(self, backend: "ActorBackend", name: str) -> None:
+        self._backend = backend
+        self.name = name
+
+    async def send(self, payload: Any, *, to: Endpoint | None = None) -> None:
+        await self._backend.chan_put(self.name, payload, endpoint=to)
+
+    async def recv(self) -> Any:
+        return await self._backend.chan_get(self.name)
+
+
+async def open_channel(backend: "ActorBackend", name: str) -> ChannelRef:
+    await backend.chan_open(name)
+    return ChannelRef(backend, name)
+
+
+__all__ = ["Endpoint", "ChannelRef", "open_channel"]

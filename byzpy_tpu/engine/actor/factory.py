@@ -1,0 +1,42 @@
+"""Backend spec resolution (ref: ``byzpy/engine/actor/factory.py:14-67``).
+
+Specs:
+
+* ``"thread"`` — dedicated-thread actor in this process (default);
+* ``"process"`` — spawned child process actor;
+* ``"tpu"`` / ``"tpu:N"`` — actor pinned to local chip N (the TPU-native
+  replacement for the reference's ``"gpu"`` scheme);
+* ``"tcp://host:port"`` — actor hosted on a remote ``RemoteActorServer``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .backends.process import ProcessActorBackend
+from .backends.remote import RemoteActorBackend
+from .backends.thread import ThreadActorBackend
+from .backends.tpu import TpuActorBackend
+
+
+def resolve_backend(spec: str = "thread", **kwargs: Any):
+    if not isinstance(spec, str) or not spec:
+        raise ValueError(f"invalid backend spec {spec!r}")
+    if spec == "thread":
+        return ThreadActorBackend(**kwargs)
+    if spec == "process":
+        return ProcessActorBackend(**kwargs)
+    if spec == "tpu":
+        return TpuActorBackend(**kwargs)
+    if spec.startswith("tpu:"):
+        return TpuActorBackend(device_index=int(spec.split(":", 1)[1]), **kwargs)
+    if spec.startswith("tcp://"):
+        addr = spec[len("tcp://") :]
+        host, _, port = addr.rpartition(":")
+        if not host or not port:
+            raise ValueError(f"tcp spec must be tcp://host:port (got {spec!r})")
+        return RemoteActorBackend(host, int(port), **kwargs)
+    raise ValueError(f"unknown actor backend spec {spec!r}")
+
+
+__all__ = ["resolve_backend"]

@@ -1,0 +1,54 @@
+"""Connection-per-request TCP channel transport.
+
+Used when a local backend must deliver a channel payload to an actor hosted
+on a remote ``RemoteActorServer`` and no persistent client connection exists
+(ref: ``byzpy/engine/actor/transports/tcp.py:27-67``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from .. import wire
+from ..channels import Endpoint
+
+
+def _split(address: str) -> tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    return host, int(port)
+
+
+async def _roundtrip(address: str, msg: dict) -> Any:
+    host, port = _split(address)
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        await wire.send_obj(writer, {**msg, "req_id": 0})
+        reply = await wire.recv_obj(reader)
+        if not reply["ok"]:
+            name, text, tb = reply["result"]
+            raise RuntimeError(f"{name} on remote server: {text}\n{tb}")
+        return reply["result"]
+    finally:
+        writer.close()
+
+
+async def chan_put(endpoint: Endpoint, name: str, payload: Any) -> None:
+    await _roundtrip(
+        endpoint.address,
+        {
+            "op": "chan_put",
+            "actor_id": endpoint.actor_id,
+            "name": name,
+            "payload": wire.host_view(payload),
+        },
+    )
+
+
+async def chan_get(endpoint: Endpoint, name: str) -> Any:
+    return await _roundtrip(
+        endpoint.address, {"op": "chan_get", "actor_id": endpoint.actor_id, "name": name}
+    )
+
+
+__all__ = ["chan_put", "chan_get"]

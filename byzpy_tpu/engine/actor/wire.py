@@ -1,0 +1,60 @@
+"""Length-prefixed binary frames over asyncio streams.
+
+Control-plane wire format (ref: ``byzpy/engine/actor/_wire.py:8-18``): a
+4-byte big-endian length followed by a cloudpickle body. Device arrays are
+converted to numpy on serialization — bulk tensor movement between chips
+never goes through this wire; it rides XLA collectives (see
+``byzpy_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import cloudpickle
+
+_HEADER = struct.Struct(">I")
+MAX_FRAME = 1 << 31
+
+
+def encode(obj: Any) -> bytes:
+    body = cloudpickle.dumps(obj)
+    return _HEADER.pack(len(body)) + body
+
+
+def decode(body: bytes) -> Any:
+    return cloudpickle.loads(body)
+
+
+def host_view(obj: Any) -> Any:
+    """Convert any jax.Arrays in a payload pytree to numpy before it crosses
+    a process or network boundary (device buffers don't pickle portably and
+    must never transit the control plane anyway)."""
+    import jax
+    import numpy as np
+
+    def conv(leaf: Any) -> Any:
+        if isinstance(leaf, jax.Array):
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(conv, obj)
+
+
+async def send_obj(writer: asyncio.StreamWriter, obj: Any) -> None:
+    writer.write(encode(obj))
+    await writer.drain()
+
+
+async def recv_obj(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_HEADER.size)
+    (length,) = _HEADER.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return decode(body)
+
+
+__all__ = ["send_obj", "recv_obj", "encode", "decode", "host_view"]

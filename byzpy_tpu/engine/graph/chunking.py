@@ -1,0 +1,53 @@
+"""Adaptive chunk sizing for subtask fan-out.
+
+Same contract as the reference heuristic
+(ref: ``byzpy/aggregators/_chunking.py:41-72``): keep at least
+``min_per_worker`` chunks per pool worker so the window pipeline stays full,
+but never shrink the configured chunk below ``configured / max_shrink``.
+Env overrides: ``BYZPY_TPU_CHUNK_MIN_PER_WORKER``,
+``BYZPY_TPU_CHUNK_MAX_SHRINK``, ``BYZPY_TPU_CHUNK_TARGET_FACTOR``.
+
+On TPU, chunking matters mainly for *host-side* subtasks (combinatorial
+enumeration, data loading): device-side aggregation is one jitted program,
+not many small chunks.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def select_adaptive_chunk_size(
+    total: int,
+    configured: int,
+    *,
+    pool_size: int = 0,
+    min_per_worker: int | None = None,
+    max_shrink: int | None = None,
+    target_factor: int | None = None,
+) -> int:
+    """Pick a chunk size for splitting ``total`` items across a pool."""
+    if total <= 0 or configured <= 0:
+        return max(1, configured)
+    if pool_size <= 1:
+        return configured
+
+    min_per_worker = min_per_worker or _env_int("BYZPY_TPU_CHUNK_MIN_PER_WORKER", 4)
+    max_shrink = max_shrink or _env_int("BYZPY_TPU_CHUNK_MAX_SHRINK", 8)
+    target_factor = target_factor or _env_int("BYZPY_TPU_CHUNK_TARGET_FACTOR", 1)
+
+    target_chunks = pool_size * min_per_worker * max(1, target_factor)
+    ideal = max(1, math.ceil(total / target_chunks))
+    floor = max(1, configured // max(1, max_shrink))
+    return max(floor, min(configured, ideal))
+
+
+__all__ = ["select_adaptive_chunk_size"]

@@ -1,0 +1,24 @@
+"""Schedulable unit of work (ref: ``byzpy/engine/graph/subtask.py:7-18``).
+
+On TPU the typical subtask ``fn`` is a jit-compiled shard computation;
+``affinity`` names a capability (``"tpu"``/``"cpu"``) so the pool can place
+device work on device actors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class SubTask:
+    fn: Callable[..., Any]
+    args: Sequence[Any] = field(default_factory=tuple)
+    kwargs: Mapping[str, Any] = field(default_factory=dict)
+    name: Optional[str] = None
+    affinity: Optional[str] = None
+    max_retries: int = 0
+
+
+__all__ = ["SubTask"]

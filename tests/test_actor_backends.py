@@ -1,0 +1,176 @@
+"""Actor runtime tests: thread / tpu / process / remote-TCP backends.
+
+pytest-asyncio is not available in this environment; tests drive their own
+event loop with asyncio.run().
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.engine.actor import resolve_backend
+from byzpy_tpu.engine.actor.base import ActorRef, spawn_actor
+from byzpy_tpu.engine.actor.backends.remote import RemoteActorServer
+from byzpy_tpu.engine.actor.channels import Endpoint
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    async def async_incr(self, by=1):
+        await asyncio.sleep(0)
+        self.value += by
+        return self.value
+
+    def boom(self):
+        raise ValueError("kaboom")
+
+    def echo_array(self, arr):
+        return arr * 2
+
+
+def test_thread_backend_rpc_and_errors():
+    async def main():
+        ref = await spawn_actor(resolve_backend("thread"), Counter, 10)
+        assert await ref.incr() == 11
+        assert await ref.incr(by=5) == 16
+        assert await ref.async_incr() == 17
+        with pytest.raises(ValueError, match="kaboom"):
+            await ref.boom()
+        await ref.backend.close()
+
+    asyncio.run(main())
+
+
+def test_thread_backend_channels_cross_actor():
+    async def main():
+        a = resolve_backend("thread")
+        b = resolve_backend("thread")
+        ra = await spawn_actor(a, Counter)
+        rb = await spawn_actor(b, Counter)
+        await a.chan_open("gossip")
+        await b.chan_open("gossip")
+        # a sends into b's mailbox via the router
+        await a.chan_put("gossip", {"v": 42}, endpoint=b.get_endpoint())
+        got = await b.chan_get("gossip")
+        assert got == {"v": 42}
+        # send to an unknown endpoint errors
+        with pytest.raises(LookupError):
+            await a.chan_put("gossip", 1, endpoint=Endpoint("thread", "local", "nope"))
+        await a.close()
+        await b.close()
+
+    asyncio.run(main())
+
+
+def test_tpu_backend_pins_device():
+    import jax
+
+    async def main():
+        backend = resolve_backend("tpu:3")
+        ref = await spawn_actor(backend, Counter)
+
+        # method that creates a device array must land on the pinned device
+        class Maker:
+            def make(self):
+                import jax.numpy as jnp
+
+                return jnp.ones((4,))
+
+        mk = resolve_backend("tpu:3")
+        mref = await spawn_actor(mk, Maker)
+        arr = await mref.make()
+        assert list(arr.devices())[0] == jax.devices()[3]
+        assert await ref.incr() == 1
+        await backend.close()
+        await mk.close()
+
+    asyncio.run(main())
+
+
+def test_process_backend_rpc_channels_and_errors():
+    async def main():
+        backend = resolve_backend("process")
+        ref = await spawn_actor(backend, Counter, 100)
+        assert await ref.incr(by=2) == 102
+        # numpy payload round-trip
+        out = await ref.echo_array(np.arange(4.0))
+        np.testing.assert_allclose(out, np.arange(4.0) * 2)
+        # concurrent chan_get + call must not deadlock (req-id protocol)
+        await backend.chan_open("inbox")
+        getter = asyncio.ensure_future(backend.chan_get("inbox"))
+        await asyncio.sleep(0.05)
+        assert await ref.incr() == 103  # call completes while chan_get blocked
+        await backend.chan_put("inbox", "hello")
+        assert await getter == "hello"
+        with pytest.raises(RuntimeError, match="kaboom"):
+            await ref.boom()
+        await backend.close()
+
+    asyncio.run(main())
+
+
+def test_remote_tcp_backend():
+    async def main():
+        server = RemoteActorServer("127.0.0.1", 0)
+        await server.start()
+        try:
+            spec = f"tcp://127.0.0.1:{server.port}"
+            backend = resolve_backend(spec)
+            ref = await spawn_actor(backend, Counter, 5)
+            assert await ref.incr() == 6
+            out = await ref.echo_array(np.ones(3))
+            np.testing.assert_allclose(out, 2 * np.ones(3))
+            # channels on the server-hosted actor
+            await backend.chan_open("c")
+            getter = asyncio.ensure_future(backend.chan_get("c"))
+            await asyncio.sleep(0.05)
+            assert await ref.incr() == 7  # interleaved call while get pending
+            await backend.chan_put("c", {"x": 1})
+            assert await getter == {"x": 1}
+            with pytest.raises(RuntimeError, match="kaboom"):
+                await ref.boom()
+            await backend.close()
+        finally:
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_remote_server_close_with_live_connections():
+    """Server close must not hang while clients are connected (py3.12
+    Server.wait_closed waits on handlers) and must fail pending requests."""
+
+    async def main():
+        server = RemoteActorServer("127.0.0.1", 0)
+        await server.start()
+        backend = resolve_backend(f"tcp://127.0.0.1:{server.port}")
+        ref = await spawn_actor(backend, Counter)
+        assert await ref.incr() == 1
+        pending = asyncio.ensure_future(backend.chan_get("never"))
+        await asyncio.sleep(0.05)
+        await asyncio.wait_for(server.close(), timeout=5)  # must not hang
+        with pytest.raises((ConnectionError, asyncio.TimeoutError)):
+            await asyncio.wait_for(pending, 5)
+        await backend.close()
+
+    asyncio.run(main())
+
+
+def test_factory_specs():
+    assert resolve_backend("thread").scheme == "thread"
+    assert resolve_backend("process").scheme == "process"
+    assert resolve_backend("tpu").scheme == "tpu"
+    assert resolve_backend("tpu:1").device_index == 1
+    b = resolve_backend("tcp://h:1234")
+    assert (b.host, b.port) == ("h", 1234)
+    with pytest.raises(ValueError):
+        resolve_backend("gpu")
+    with pytest.raises(ValueError):
+        resolve_backend("tcp://missingport")

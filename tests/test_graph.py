@@ -1,0 +1,305 @@
+"""Graph structure, operator dispatch, schedulers, lazy builder, session."""
+
+import asyncio
+
+import pytest
+
+from byzpy_tpu.engine.graph import (
+    ActorPool,
+    ActorPoolConfig,
+    CallableOp,
+    ComputationGraph,
+    ExecutionSession,
+    GraphBuilder,
+    GraphInput,
+    GraphNode,
+    MessageAwareNodeScheduler,
+    MessageSource,
+    NodeScheduler,
+    OpContext,
+    Operator,
+    ParallelScheduler,
+    RemoteCallableOp,
+    SubTask,
+    run_operator,
+    select_adaptive_chunk_size,
+)
+
+
+class AddOp(Operator):
+    name = "add"
+
+    def __init__(self, amount):
+        self.amount = amount
+
+    def compute(self, inputs, *, context):
+        return inputs["value"] + self.amount
+
+
+class SumSubtasksOp(Operator):
+    """Fan out one subtask per item, reduce by summing."""
+
+    name = "sum-subtasks"
+    supports_subtasks = True
+
+    def compute(self, inputs, *, context):
+        return sum(inputs["items"])
+
+    def create_subtasks(self, inputs, *, context):
+        for i, item in enumerate(inputs["items"]):
+            yield SubTask(fn=lambda x: x * 10, args=(item,), name=f"st{i}")
+
+    def reduce_subtasks(self, partials, inputs, *, context):
+        return sum(partials)
+
+
+# ---------------------------------------------------------------------------
+# graph structure
+# ---------------------------------------------------------------------------
+
+
+def test_topo_order_and_outputs():
+    g = ComputationGraph(
+        [
+            GraphNode("c", AddOp(1), {"value": "b"}),
+            GraphNode("a", AddOp(1), {"value": GraphInput("x")}),
+            GraphNode("b", AddOp(1), {"value": "a"}),
+        ]
+    )
+    order = [n.name for n in g.nodes_in_order()]
+    assert order.index("a") < order.index("b") < order.index("c")
+    assert g.outputs == ["c"]  # last topo node is the default output
+    assert g.required_inputs() == {"x"}
+
+
+def test_cycle_detection_and_duplicates():
+    with pytest.raises(ValueError, match="cycle"):
+        ComputationGraph(
+            [
+                GraphNode("a", AddOp(1), {"value": "b"}),
+                GraphNode("b", AddOp(1), {"value": "a"}),
+            ]
+        )
+    with pytest.raises(ValueError, match="duplicate"):
+        ComputationGraph([GraphNode("a", AddOp(1)), GraphNode("a", AddOp(2))])
+
+
+def test_unknown_reference_caught():
+    g = ComputationGraph([GraphNode("a", AddOp(1), {"value": "ghost"})])
+    with pytest.raises(ValueError, match="ghost"):
+        g.required_inputs()
+
+
+# ---------------------------------------------------------------------------
+# schedulers
+# ---------------------------------------------------------------------------
+
+
+def test_node_scheduler_chain():
+    g = ComputationGraph(
+        [
+            GraphNode("a", AddOp(1), {"value": GraphInput("x")}),
+            GraphNode("b", AddOp(10), {"value": "a"}),
+        ],
+        outputs=["a", "b"],
+    )
+    out = asyncio.run(NodeScheduler(g).run({"x": 5}))
+    assert out == {"a": 6, "b": 16}
+
+
+def test_node_scheduler_missing_input():
+    g = ComputationGraph([GraphNode("a", AddOp(1), {"value": GraphInput("x")})])
+    with pytest.raises(KeyError, match="x"):
+        asyncio.run(NodeScheduler(g).run({}))
+
+
+def test_parallel_scheduler_diamond():
+    order = []
+
+    def track(name, delay):
+        async def fn(value):
+            order.append(f"{name}+")
+            await asyncio.sleep(delay)
+            order.append(f"{name}-")
+            return value + 1
+
+        return fn
+
+    g = ComputationGraph(
+        [
+            GraphNode("src", CallableOp(track("src", 0.0)), {"value": GraphInput("x")}),
+            GraphNode("l", CallableOp(track("l", 0.05)), {"value": "src"}),
+            GraphNode("r", CallableOp(track("r", 0.05)), {"value": "src"}),
+            GraphNode(
+                "join",
+                CallableOp(lambda l, r: l + r),
+                {"l": "l", "r": "r"},
+            ),
+        ]
+    )
+    out = asyncio.run(ParallelScheduler(g).run({"x": 0}))
+    assert out == {"join": 4}
+    # l and r must have overlapped (parallel execution)
+    assert order.index("r+") < order.index("l-")
+
+
+def test_message_aware_scheduler():
+    async def main():
+        g = ComputationGraph(
+            [
+                GraphNode(
+                    "a",
+                    AddOp(1),
+                    {"value": MessageSource(message_type="grad", field="v")},
+                )
+            ]
+        )
+        sched = MessageAwareNodeScheduler(g)
+        run = asyncio.ensure_future(sched.run({}))
+        await asyncio.sleep(0.02)
+        await sched.deliver_message("grad", {"v": 41})
+        out = await run
+        assert out == {"a": 42}
+        # cached messages are consumed FIFO by later waits
+        await sched.deliver_message("grad", {"v": 1})
+        await sched.deliver_message("grad", {"v": 2})
+        assert (await sched.wait_for_message("grad"))["v"] == 1
+        assert sched.pending_message_count("grad") == 1
+        with pytest.raises(TimeoutError):
+            await sched.wait_for_message("never", timeout=0.01)
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# pool + subtasks
+# ---------------------------------------------------------------------------
+
+
+def test_pool_subtask_fanout_thread_backend():
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=3)) as pool:
+            op = SumSubtasksOp()
+            result = await op.run(
+                {"items": list(range(8))}, context=OpContext("n"), pool=pool
+            )
+            assert result == sum(i * 10 for i in range(8))
+
+    asyncio.run(main())
+
+
+def test_pool_retry_and_affinity():
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            out = await pool.run_subtask(SubTask(fn=flaky, max_retries=3))
+            assert out == "ok"
+            assert attempts["n"] == 3
+            # exhausted retries raise the last error
+            with pytest.raises(ZeroDivisionError):
+                await pool.run_subtask(SubTask(fn=lambda: 1 / 0, max_retries=1))
+            # affinity for a capability nobody has falls back to any worker
+            assert await pool.run_subtask(SubTask(fn=lambda: 7, affinity="tpu")) == 7
+
+    asyncio.run(main())
+
+
+def test_pool_channel():
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            chan = await pool.open_channel("bus")
+            names = pool.worker_names
+            await chan.send(names[0], names[1], {"hello": 1})
+            msg = await chan.recv(names[1])
+            assert msg == {"sender": names[0], "payload": {"hello": 1}}
+
+    asyncio.run(main())
+
+
+def test_run_operator_front_door():
+    assert asyncio.run(run_operator(AddOp(5), {"value": 1})) == 6
+    # bare value + explicit input key
+    assert asyncio.run(run_operator(AddOp(5), 2, input_key="value")) == 7
+
+
+def test_remote_callable_op_runs_on_pool():
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            op = RemoteCallableOp(lambda value: value * 3)
+            out = await op.run({"value": 4}, context=OpContext("n"), pool=pool)
+            assert out == 12
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# lazy builder + session
+# ---------------------------------------------------------------------------
+
+
+def test_lazy_builder_chain():
+    b = GraphBuilder()
+    out = (
+        b.input("x")
+        .apply(AddOp(1), input_key="value", name="inc")
+        .apply(AddOp(10), input_key="value")
+    )
+    g = b.build(out)
+    results = asyncio.run(NodeScheduler(g).run({"x": 0}))
+    assert list(results.values()) == [11]
+
+
+def test_session_caches_intermediates():
+    calls = {"n": 0}
+
+    class CountingOp(Operator):
+        name = "counting"
+
+        def compute(self, inputs, *, context):
+            calls["n"] += 1
+            return inputs["value"] * 2
+
+    async def main():
+        g = ComputationGraph(
+            [
+                GraphNode("a", CountingOp(), {"value": GraphInput("x")}),
+                GraphNode("b", AddOp(1), {"value": "a"}),
+            ],
+            outputs=["b"],
+        )
+        session = ExecutionSession()
+        out1 = await session.execute(g, {"x": 3})
+        assert out1 == {"b": 7}
+        assert calls["n"] == 1
+        # second execution: 'a' (and 'b') served from cache
+        out2 = await session.execute(g, {"x": 3})
+        assert out2 == {"b": 7}
+        assert calls["n"] == 1
+        session.invalidate(["a", "b"])
+        await session.execute(g, {"x": 5})
+        assert calls["n"] == 2
+        # async future API
+        session.invalidate()
+        fut = session.execute_async(g, {"x": 1})
+        assert not fut.done()
+        res = await fut.result()
+        assert res == {"b": 3}
+        assert fut.done()
+
+    asyncio.run(main())
+
+
+def test_chunking_heuristic():
+    # small pool: keep configured
+    assert select_adaptive_chunk_size(1000, 100, pool_size=1) == 100
+    # big pool: shrink to keep >=4 chunks/worker, capped at 8x shrink
+    c = select_adaptive_chunk_size(1000, 800, pool_size=8)
+    assert c <= 800 and c >= 100
+    assert select_adaptive_chunk_size(0, 64) == 64
