@@ -1,0 +1,27 @@
+from .base import Aggregator
+from .coordinate_wise import CoordinateWiseMedian, CoordinateWiseTrimmedMean, MeanOfMedians
+from .geometric_wise import (
+    GeometricMedian,
+    Krum,
+    MinimumDiameterAveraging,
+    MoNNA,
+    MultiKrum,
+    SMEA,
+)
+from .norm_wise import CAF, CenteredClipping, ComparativeGradientElimination
+
+__all__ = [
+    "Aggregator",
+    "CoordinateWiseMedian",
+    "CoordinateWiseTrimmedMean",
+    "MeanOfMedians",
+    "MultiKrum",
+    "Krum",
+    "GeometricMedian",
+    "MinimumDiameterAveraging",
+    "MoNNA",
+    "SMEA",
+    "CenteredClipping",
+    "CAF",
+    "ComparativeGradientElimination",
+]

@@ -1,0 +1,57 @@
+"""Aggregator base class (API parity: ``byzpy/aggregators/base.py:11-103``).
+
+An aggregator reduces a sequence of per-node gradients (pytrees, arrays, or
+an already-stacked ``(n, d)`` matrix) to a single aggregated gradient with
+the structure of one input. Subclasses implement ``_aggregate_matrix`` — a
+pure function on the stacked matrix that jit-compiles and shards over a
+device mesh (see ``byzpy_tpu.ops.robust``).
+
+Unlike the reference, parallelism does not require host-side chunking: the
+matrix computation is one XLA program. Chunked ``create_subtasks`` paths are
+still provided by the mixins in ``chunked.py`` for running on heterogeneous
+actor pools (the reference's shm-chunk pattern, minus the shm).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Mapping, Sequence
+
+import jax.numpy as jnp
+
+from ..engine.graph.operator import OpContext, Operator
+from ..utils.trees import stack_gradients
+
+
+class Aggregator(Operator, ABC):
+    name = "aggregator"
+    input_key = "gradients"
+
+    def compute(self, inputs: Mapping[str, Any], *, context: OpContext) -> Any:
+        if self.input_key not in inputs:
+            raise KeyError(f"{self.name} expects input key {self.input_key!r}")
+        gradients = inputs[self.input_key]
+        if not isinstance(gradients, Sequence) and not hasattr(gradients, "ndim"):
+            raise TypeError(f"{self.name} expects a sequence at {self.input_key!r}")
+        return self.aggregate(gradients)
+
+    def aggregate(self, gradients: Sequence[Any]) -> Any:
+        """Reduce a sequence of gradients to one aggregated gradient."""
+        matrix, unravel = stack_gradients(gradients)
+        self.validate_n(matrix.shape[0])
+        return unravel(self._aggregate_matrix(matrix))
+
+    def validate_n(self, n: int) -> None:
+        """Hook for subclasses to validate hyperparameters against n."""
+
+    @abstractmethod
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Aggregate the stacked ``(n, d)`` matrix to a ``(d,)`` vector."""
+
+    def matrix_fn(self) -> Callable[[jnp.ndarray], jnp.ndarray]:
+        """The bare matrix->vector function, for embedding in jitted
+        training steps (SPMD parameter server, gossip loops)."""
+        return self._aggregate_matrix
+
+
+__all__ = ["Aggregator"]

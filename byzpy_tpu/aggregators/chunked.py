@@ -1,0 +1,124 @@
+"""Subtask-chunking mixins for aggregators on actor pools.
+
+The reference parallelizes aggregators by slicing the stacked gradient
+matrix into shared-memory chunks fanned out to pool workers (feature chunks
+for coordinate-wise ops, ``median.py:108-134``; row/score chunks for
+geometric ops, ``krum.py:371-475``). On TPU the preferred path is a single
+jitted (optionally mesh-sharded) program, but the chunked path is kept for
+heterogeneous pools (e.g. CPU process workers assisting a host) and for
+behavioral parity with the reference's scheduler integration.
+
+Chunk functions are module-level so process/remote workers can unpickle
+them; they use jax.numpy, which runs on whatever platform the worker has.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..engine.graph.chunking import select_adaptive_chunk_size
+from ..engine.graph.operator import OpContext
+from ..engine.graph.subtask import SubTask
+from ..utils.trees import stack_gradients
+
+
+def _pool_size(context: OpContext) -> int:
+    metadata = getattr(context, "metadata", None) or {}
+    return int(metadata.get("pool_size") or 0)
+
+
+class FeatureChunkedAggregator:
+    """Mixin: fan out column (feature) chunks; concatenate partial vectors.
+
+    Subclasses set ``_chunk_fn`` to a module-level ``fn(chunk, **params)``
+    returning the aggregated vector for those coordinates, and
+    ``_chunk_params()`` for its kwargs.
+    """
+
+    supports_subtasks = True
+    chunk_size = 8192
+    _chunk_fn: Any = None
+
+    def _chunk_params(self) -> Mapping[str, Any]:
+        return {}
+
+    def create_subtasks(self, inputs, *, context: OpContext) -> Iterable[SubTask]:
+        # Stateless across create/reduce: reduce re-derives the unravel from
+        # `inputs`, so one instance can run at multiple concurrent graph nodes.
+        gradients = inputs.get(self.input_key)
+        matrix, _ = stack_gradients(gradients)
+        self.validate_n(matrix.shape[0])
+        host = np.asarray(matrix)
+        d = host.shape[1]
+        chunk = select_adaptive_chunk_size(
+            d, self.chunk_size, pool_size=_pool_size(context)
+        )
+        params = dict(self._chunk_params())
+        fn = type(self)._chunk_fn
+
+        def gen():
+            for start in range(0, d, chunk):
+                end = min(d, start + chunk)
+                yield SubTask(
+                    fn=fn,
+                    args=(host[:, start:end],),
+                    kwargs=params,
+                    name=f"{self.name}-feat[{start}:{end}]",
+                )
+
+        return gen()
+
+    def reduce_subtasks(self, partials, inputs, *, context: OpContext) -> Any:
+        vec = jnp.concatenate([jnp.asarray(p) for p in partials])
+        _, unravel = stack_gradients(inputs.get(self.input_key))
+        return unravel(vec)
+
+
+class RowScoredAggregator:
+    """Mixin: fan out row-range scoring against the full matrix, then select
+    rows centrally (the Krum/MoNNA/CGE pattern)."""
+
+    supports_subtasks = True
+    chunk_size = 32
+    _score_fn: Any = None
+
+    def _score_params(self) -> Mapping[str, Any]:
+        return {}
+
+    def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def create_subtasks(self, inputs, *, context: OpContext) -> Iterable[SubTask]:
+        gradients = inputs.get(self.input_key)
+        matrix, _ = stack_gradients(gradients)
+        self.validate_n(matrix.shape[0])
+        host = np.asarray(matrix)
+        n = host.shape[0]
+        chunk = select_adaptive_chunk_size(
+            n, self.chunk_size, pool_size=_pool_size(context)
+        )
+        params = dict(self._score_params())
+        fn = type(self)._score_fn
+
+        def gen():
+            for start in range(0, n, chunk):
+                end = min(n, start + chunk)
+                yield SubTask(
+                    fn=fn,
+                    args=(host, start, end),
+                    kwargs=params,
+                    name=f"{self.name}-rows[{start}:{end}]",
+                )
+
+        return gen()
+
+    def reduce_subtasks(self, partials, inputs, *, context: OpContext) -> Any:
+        scores = jnp.concatenate([jnp.asarray(p) for p in partials])
+        matrix, unravel = stack_gradients(inputs.get(self.input_key))
+        return unravel(self._select_from_scores(scores, matrix))
+
+
+__all__ = ["FeatureChunkedAggregator", "RowScoredAggregator"]

@@ -1,0 +1,42 @@
+"""MeaMed: per-coordinate mean of the ``n - f`` values nearest the median
+(behavioral parity: ``byzpy/aggregators/coordinate_wise/mean_of_medians.py:28-162``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...ops import robust
+from ..base import Aggregator
+from ..chunked import FeatureChunkedAggregator
+
+
+def _meamed_chunk(chunk: np.ndarray, *, f: int) -> jnp.ndarray:
+    return robust.mean_of_medians(jnp.asarray(chunk), f=f)
+
+
+class MeanOfMedians(FeatureChunkedAggregator, Aggregator):
+    name = "mean-of-medians"
+    _chunk_fn = staticmethod(_meamed_chunk)
+
+    def __init__(self, f: int, *, chunk_size: int = 8192) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be > 0")
+        self.f = int(f)
+        self.chunk_size = int(chunk_size)
+
+    def validate_n(self, n: int) -> None:
+        if self.f >= n:
+            raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={self.f})")
+
+    def _chunk_params(self):
+        return {"f": self.f}
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return robust.mean_of_medians(x, f=self.f)
+
+
+__all__ = ["MeanOfMedians"]

@@ -1,0 +1,44 @@
+"""Coordinate-wise trimmed mean (Yin et al. 2018)
+(behavioral parity: ``byzpy/aggregators/coordinate_wise/trimmed_mean.py:27-211``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...ops import robust
+from ..base import Aggregator
+from ..chunked import FeatureChunkedAggregator
+
+
+def _trimmed_mean_chunk(chunk: np.ndarray, *, f: int) -> jnp.ndarray:
+    return robust.trimmed_mean(jnp.asarray(chunk), f=f)
+
+
+class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
+    name = "coordinate-wise-trimmed-mean"
+    _chunk_fn = staticmethod(_trimmed_mean_chunk)
+
+    def __init__(self, f: int, *, chunk_size: int = 8192) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be > 0")
+        self.f = int(f)
+        self.chunk_size = int(chunk_size)
+
+    def validate_n(self, n: int) -> None:
+        if 2 * self.f >= n:
+            raise ValueError(
+                f"trim parameter f must satisfy 0 <= 2f < n (got n={n}, f={self.f})"
+            )
+
+    def _chunk_params(self):
+        return {"f": self.f}
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return robust.trimmed_mean(x, f=self.f)
+
+
+__all__ = ["CoordinateWiseTrimmedMean"]

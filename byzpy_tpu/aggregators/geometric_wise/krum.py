@@ -1,0 +1,87 @@
+"""Krum / Multi-Krum (Blanchard et al. 2017)
+(behavioral parity: ``byzpy/aggregators/geometric_wise/krum.py:82-475``).
+
+TPU execution: the pairwise squared distances come from one Gram matmul
+(MXU work); with the matrix feature-sharded, each chip computes a partial
+Gram and XLA psums the tiny ``(n, n)`` block — O(n^2) bytes over ICI
+instead of the reference's O(n*d) shm traffic per chunk. Selection is a
+replicated top-q over an ``(n,)`` score vector.
+
+The pool-chunked path scores row ranges against the full matrix, the
+reference's subtask layout (``krum.py:371-475``) without the shm handles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...ops import robust
+from ..base import Aggregator
+from ..chunked import RowScoredAggregator
+
+
+def _krum_score_rows(host: np.ndarray, start: int, end: int, *, f: int) -> jnp.ndarray:
+    """Scores for rows [start, end): sum of the n-f-1 smallest squared
+    distances to other rows."""
+    x = jnp.asarray(host)
+    block = x[start:end]
+    n = x.shape[0]
+    d2 = (
+        jnp.sum(block * block, axis=1, keepdims=True)
+        + jnp.sum(x * x, axis=1)[None, :]
+        - 2.0 * block @ x.T
+    )
+    d2 = jnp.maximum(d2, 0.0)
+    # mask self-distance per row, then sum the n-f-1 smallest
+    rows = jnp.arange(start, end)
+    d2 = d2.at[jnp.arange(end - start), rows].set(jnp.inf)
+    sortd = jnp.sort(d2, axis=1)
+    return jnp.sum(sortd[:, : n - f - 1], axis=1)
+
+
+class MultiKrum(RowScoredAggregator, Aggregator):
+    name = "multi-krum"
+    _score_fn = staticmethod(_krum_score_rows)
+
+    def __init__(self, f: int, q: int, *, chunk_size: int = 32) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if q < 1:
+            raise ValueError("q must be >= 1")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be > 0")
+        self.f = int(f)
+        self.q = int(q)
+        self.chunk_size = int(chunk_size)
+
+    def validate_n(self, n: int) -> None:
+        if self.f >= n - 1:
+            raise ValueError(f"f must satisfy 0 <= f < n-1 (got n={n}, f={self.f})")
+        if self.q > n - self.f:
+            raise ValueError(
+                f"q must satisfy 1 <= q <= n - f (got n={n}, f={self.f}, q={self.q})"
+            )
+
+    def _score_params(self):
+        return {"f": self.f}
+
+    def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
+        sel = jnp.argsort(scores)[: self.q]
+        return jnp.mean(matrix[sel], axis=0)
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return robust.multi_krum(x, f=self.f, q=self.q)
+
+
+class Krum(MultiKrum):
+    """Classic Krum: the single lowest-score gradient (Multi-Krum q=1;
+    ref: ``krum.py:302-368``)."""
+
+    name = "krum"
+
+    def __init__(self, f: int, *, chunk_size: int = 32) -> None:
+        super().__init__(f, 1, chunk_size=chunk_size)
+
+
+__all__ = ["MultiKrum", "Krum"]

@@ -1,0 +1,126 @@
+"""Minimum Diameter Averaging: exact search over ``(n - f)``-subsets
+(behavioral parity: ``byzpy/aggregators/geometric_wise/minimum_diameter_average.py:80-444``).
+
+Subset enumeration is combinatorial and stays on the host (as in the
+reference); scoring is batched on device: the ``(n, n)`` distance matrix is
+computed once, then ``vmap``-gathered diameters for combination batches.
+The pool path fans combination ranges out to workers.
+"""
+
+from __future__ import annotations
+
+import math
+from itertools import islice
+from typing import Iterable
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...engine.graph.chunking import select_adaptive_chunk_size
+from ...engine.graph.operator import OpContext
+from ...engine.graph.subtask import SubTask
+from ...ops import robust
+from ...utils.combinatorics import iter_combinations
+from ...utils.trees import stack_gradients
+from ..base import Aggregator
+
+_DEVICE_BATCH = 4096
+
+
+def _combo_batches(n: int, m: int, batch: int) -> Iterable[np.ndarray]:
+    it = iter_combinations(n, m)
+    while True:
+        block = list(islice(it, batch))
+        if not block:
+            return
+        yield np.asarray(block, dtype=np.int32)
+
+
+def _score_combo_range(
+    host_d2: np.ndarray, n: int, m: int, start: int, count: int
+) -> tuple[float, np.ndarray]:
+    """Best (min-diameter) combo among combinations [start, start+count)."""
+    d2 = jnp.asarray(host_d2)
+    it = islice(iter_combinations(n, m, start), count)
+    best_score = math.inf
+    best_combo: np.ndarray | None = None
+    while True:
+        block = list(islice(it, _DEVICE_BATCH))
+        if not block:
+            break
+        combos = jnp.asarray(np.asarray(block, dtype=np.int32))
+        scores = robust.subset_diameters(d2, combos)
+        i = int(jnp.argmin(scores))
+        score = float(scores[i])
+        if score < best_score:
+            best_score = score
+            best_combo = np.asarray(combos[i])
+    assert best_combo is not None
+    return best_score, best_combo
+
+
+class MinimumDiameterAveraging(Aggregator):
+    name = "minimum-diameter-averaging"
+    supports_subtasks = True
+
+    def __init__(self, f: int, *, chunk_size: int = 20000) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be > 0")
+        self.f = int(f)
+        self.chunk_size = int(chunk_size)
+
+    def validate_n(self, n: int) -> None:
+        if self.f >= n:
+            raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={self.f})")
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        n = x.shape[0]
+        m = n - self.f
+        d2 = robust.pairwise_sq_dists(x)
+        best_score = math.inf
+        best_combo: jnp.ndarray | None = None
+        for combos in _combo_batches(n, m, _DEVICE_BATCH):
+            scores = robust.subset_diameters(d2, jnp.asarray(combos))
+            i = int(jnp.argmin(scores))
+            score = float(scores[i])
+            if score < best_score:
+                best_score = score
+                best_combo = jnp.asarray(combos[i])
+        assert best_combo is not None
+        return robust.subset_mean(x, best_combo)
+
+    # -- pool path ----------------------------------------------------------
+
+    def create_subtasks(self, inputs, *, context: OpContext):
+        gradients = inputs.get(self.input_key)
+        matrix, _ = stack_gradients(gradients)
+        self.validate_n(matrix.shape[0])
+        n = matrix.shape[0]
+        m = n - self.f
+        total = math.comb(n, m)
+        host_d2 = np.asarray(robust.pairwise_sq_dists(matrix))
+        metadata = getattr(context, "metadata", None) or {}
+        chunk = select_adaptive_chunk_size(
+            total, self.chunk_size, pool_size=int(metadata.get("pool_size") or 0)
+        )
+
+        def gen():
+            for start in range(0, total, chunk):
+                count = min(chunk, total - start)
+                yield SubTask(
+                    fn=_score_combo_range,
+                    args=(host_d2, n, m, start, count),
+                    name=f"mda-combos[{start}:{start + count}]",
+                )
+
+        return gen()
+
+    def reduce_subtasks(self, partials, inputs, *, context: OpContext):
+        best_score, best_combo = min(partials, key=lambda p: p[0])
+        matrix, unravel = stack_gradients(inputs.get(self.input_key))
+        return unravel(robust.subset_mean(matrix, jnp.asarray(best_combo)))
+
+
+__all__ = ["MinimumDiameterAveraging"]

@@ -1,0 +1,54 @@
+"""MoNNA: mean of the ``n - f`` nearest neighbors of a trusted reference
+(behavioral parity: ``byzpy/aggregators/geometric_wise/monna.py:36-178``)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...ops import robust
+from ..base import Aggregator
+from ..chunked import RowScoredAggregator
+
+
+def _monna_dist_rows(host: np.ndarray, start: int, end: int, *, reference_index: int) -> jnp.ndarray:
+    x = jnp.asarray(host)
+    diff = x[start:end] - x[reference_index][None, :]
+    return jnp.sum(diff * diff, axis=1)
+
+
+class MoNNA(RowScoredAggregator, Aggregator):
+    name = "monna"
+    _score_fn = staticmethod(_monna_dist_rows)
+
+    def __init__(self, f: int, *, reference_index: int = 0, chunk_size: int = 32) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if reference_index < 0:
+            raise ValueError("reference_index must be >= 0")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be > 0")
+        self.f = int(f)
+        self.reference_index = int(reference_index)
+        self.chunk_size = int(chunk_size)
+
+    def validate_n(self, n: int) -> None:
+        if 2 * self.f >= n:
+            raise ValueError(f"Cannot tolerate 2f >= n (got n={n}, f={self.f})")
+        if not 0 <= self.reference_index < n:
+            raise ValueError(
+                f"reference_index must be between 0 and {n - 1} (got {self.reference_index})"
+            )
+
+    def _score_params(self):
+        return {"reference_index": self.reference_index}
+
+    def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
+        sel = jnp.argsort(scores)[: matrix.shape[0] - self.f]
+        return jnp.mean(matrix[sel], axis=0)
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return robust.monna(x, f=self.f, reference_index=self.reference_index)
+
+
+__all__ = ["MoNNA"]

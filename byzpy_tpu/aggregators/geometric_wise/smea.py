@@ -1,0 +1,106 @@
+"""SMEA: Smallest Maximum Eigenvalue Averaging
+(behavioral parity: ``byzpy/aggregators/geometric_wise/smea.py:110-228``).
+
+Enumerates ``(n - f)``-subsets on the host, scores batches on device: each
+subset's score is the top eigenvalue of its centered ``m x m`` Gram block
+(``jnp.linalg.eigvalsh`` vmapped over the batch), the winner's rows are
+averaged.
+"""
+
+from __future__ import annotations
+
+import math
+from itertools import islice
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...engine.graph.chunking import select_adaptive_chunk_size
+from ...engine.graph.operator import OpContext
+from ...engine.graph.subtask import SubTask
+from ...ops import robust
+from ...utils.combinatorics import iter_combinations
+from ...utils.trees import stack_gradients
+from ..base import Aggregator
+
+_DEVICE_BATCH = 2048
+
+
+def _score_combo_range_smea(
+    host_gram: np.ndarray, n: int, m: int, start: int, count: int
+) -> tuple[float, np.ndarray]:
+    gram = jnp.asarray(host_gram)
+    it = islice(iter_combinations(n, m, start), count)
+    best_score = math.inf
+    best_combo: np.ndarray | None = None
+    while True:
+        block = list(islice(it, _DEVICE_BATCH))
+        if not block:
+            break
+        combos = jnp.asarray(np.asarray(block, dtype=np.int32))
+        scores = robust.subset_max_eigvals(gram, combos)
+        i = int(jnp.argmin(scores))
+        score = float(scores[i])
+        if score < best_score:
+            best_score = score
+            best_combo = np.asarray(combos[i])
+    assert best_combo is not None
+    return best_score, best_combo
+
+
+class SMEA(Aggregator):
+    name = "smea"
+    supports_subtasks = True
+
+    def __init__(self, f: int, *, chunk_size: int = 4096) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be > 0")
+        self.f = int(f)
+        self.chunk_size = int(chunk_size)
+
+    def validate_n(self, n: int) -> None:
+        if 2 * self.f >= n:
+            raise ValueError(f"2f must be < n (got n={n}, f={self.f})")
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        n = x.shape[0]
+        m = n - self.f
+        gram = robust.gram_matrix(x)
+        best_score, best_combo = _score_combo_range_smea(
+            np.asarray(gram), n, m, 0, math.comb(n, m)
+        )
+        return robust.subset_mean(x, jnp.asarray(best_combo))
+
+    def create_subtasks(self, inputs, *, context: OpContext):
+        gradients = inputs.get(self.input_key)
+        matrix, _ = stack_gradients(gradients)
+        self.validate_n(matrix.shape[0])
+        n = matrix.shape[0]
+        m = n - self.f
+        total = math.comb(n, m)
+        host_gram = np.asarray(robust.gram_matrix(matrix))
+        metadata = getattr(context, "metadata", None) or {}
+        chunk = select_adaptive_chunk_size(
+            total, self.chunk_size, pool_size=int(metadata.get("pool_size") or 0)
+        )
+
+        def gen():
+            for start in range(0, total, chunk):
+                count = min(chunk, total - start)
+                yield SubTask(
+                    fn=_score_combo_range_smea,
+                    args=(host_gram, n, m, start, count),
+                    name=f"smea-combos[{start}:{start + count}]",
+                )
+
+        return gen()
+
+    def reduce_subtasks(self, partials, inputs, *, context: OpContext):
+        best_score, best_combo = min(partials, key=lambda p: p[0])
+        matrix, unravel = stack_gradients(inputs.get(self.input_key))
+        return unravel(robust.subset_mean(matrix, jnp.asarray(best_combo)))
+
+
+__all__ = ["SMEA"]

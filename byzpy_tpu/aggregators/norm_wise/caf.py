@@ -1,0 +1,38 @@
+"""CAF: Covariance-bound Agnostic Filter
+(behavioral parity: ``byzpy/aggregators/norm_wise/caf.py:36-185``).
+
+The data-dependent filtering loop (down-weight along the dominant residual
+direction until <= n - 2f weight remains) runs as a ``lax.while_loop`` with
+the power iteration inside — one compiled program instead of the
+reference's host loop over shm chunk fetches.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops import robust
+from ..base import Aggregator
+
+
+class CAF(Aggregator):
+    name = "caf"
+
+    def __init__(self, f: int, *, power_iters: int = 3, seed: int = 0) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if power_iters <= 0:
+            raise ValueError("power_iters must be > 0")
+        self.f = int(f)
+        self.power_iters = int(power_iters)
+        self.seed = int(seed)
+
+    def validate_n(self, n: int) -> None:
+        if 2 * self.f >= n:
+            raise ValueError(f"Cannot tolerate 2f >= n (got n={n}, f={self.f})")
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return robust.caf(x, f=self.f, power_iters=self.power_iters, seed=self.seed)
+
+
+__all__ = ["CAF"]

@@ -1,0 +1,48 @@
+"""Centered Clipping (Karimireddy et al. 2021, ICML)
+(behavioral parity: ``byzpy/aggregators/norm_wise/center_clipping.py:29-269``).
+
+The reference iterates with barriered subtasks writing per-chunk
+contribution slots into shm; here the M clipping iterations are a
+``lax.fori_loop`` inside one compiled program (per-iteration distance
+reductions shard over the mesh as psums).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops import robust
+from ..base import Aggregator
+
+
+class CenteredClipping(Aggregator):
+    name = "centered-clipping"
+
+    def __init__(
+        self,
+        *,
+        c_tau: float,
+        M: int = 10,
+        eps: float = 1e-12,
+        init: str = "mean",
+    ) -> None:
+        if c_tau < 0:
+            raise ValueError("c_tau must be >= 0")
+        if M <= 0:
+            raise ValueError("M must be >= 1")
+        if eps <= 0:
+            raise ValueError("eps must be > 0")
+        if init not in {"mean", "median", "zero"}:
+            raise ValueError("init must be one of {'mean','median','zero'}")
+        self.c_tau = float(c_tau)
+        self.M = int(M)
+        self.eps = float(eps)
+        self.init = init
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return robust.centered_clipping(
+            x, c_tau=self.c_tau, M=self.M, eps=self.eps, init=self.init
+        )
+
+
+__all__ = ["CenteredClipping"]

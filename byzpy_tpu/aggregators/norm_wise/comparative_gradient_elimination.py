@@ -1,0 +1,45 @@
+"""CGE: drop the ``f`` largest-L2-norm gradients, average the rest
+(behavioral parity:
+``byzpy/aggregators/norm_wise/comparative_gradient_elimination.py:28-154``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...ops import robust
+from ..base import Aggregator
+from ..chunked import RowScoredAggregator
+
+
+def _sq_norm_rows(host: np.ndarray, start: int, end: int) -> jnp.ndarray:
+    block = jnp.asarray(host[start:end])
+    return jnp.sum(block * block, axis=1)
+
+
+class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
+    name = "comparative-gradient-elimination"
+    _score_fn = staticmethod(_sq_norm_rows)
+
+    def __init__(self, f: int, *, chunk_size: int = 32) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be > 0")
+        self.f = int(f)
+        self.chunk_size = int(chunk_size)
+
+    def validate_n(self, n: int) -> None:
+        if self.f >= n:
+            raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={self.f})")
+
+    def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
+        keep = jnp.argsort(scores)[: matrix.shape[0] - self.f]
+        return jnp.mean(matrix[keep], axis=0)
+
+    def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return robust.cge(x, f=self.f)
+
+
+__all__ = ["ComparativeGradientElimination"]

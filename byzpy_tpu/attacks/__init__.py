@@ -1,0 +1,19 @@
+from .base import Attack
+from .empire import EmpireAttack
+from .gaussian import GaussianAttack
+from .inf import InfAttack
+from .label_flip import LabelFlipAttack
+from .little import LittleAttack
+from .mimic import MimicAttack
+from .sign_flip import SignFlipAttack
+
+__all__ = [
+    "Attack",
+    "SignFlipAttack",
+    "EmpireAttack",
+    "LittleAttack",
+    "GaussianAttack",
+    "InfAttack",
+    "MimicAttack",
+    "LabelFlipAttack",
+]

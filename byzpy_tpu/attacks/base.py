@@ -1,0 +1,71 @@
+"""Attack base class (API parity: ``byzpy/attacks/base.py:12-119``).
+
+Attacks simulate Byzantine behavior by generating adversarial gradients.
+Subclasses declare needs via flags — ``uses_base_grad`` (own honest
+gradient), ``uses_model_batch`` (model + batch for gradient computation),
+``uses_honest_grads`` (other nodes' gradients) — and implement ``apply``.
+
+TPU note: an attack is a pure function of its inputs, so inside an SPMD
+training step byzantine nodes are a ``jnp.where`` on a byzantine mask over
+vmapped per-node gradients (see ``byzpy_tpu.parallel``) rather than a
+separate code path; this class layer serves the actor/graph orchestration
+mode, matching the reference's scheduling semantics.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, List, Mapping, Optional
+
+from ..engine.graph.operator import OpContext, Operator
+
+
+class Attack(Operator, ABC):
+    uses_base_grad: bool = False
+    uses_model_batch: bool = False
+    uses_honest_grads: bool = False
+
+    name = "attack"
+
+    def compute(self, inputs: Mapping[str, Any], *, context: OpContext) -> Any:
+        return self.apply(**self._collect_inputs(inputs))
+
+    @abstractmethod
+    def apply(
+        self,
+        *,
+        model: Any = None,
+        x: Any = None,
+        y: Any = None,
+        honest_grads: Optional[List[Any]] = None,
+        base_grad: Any = None,
+    ) -> Any:
+        """Return one malicious gradient shaped like the honest ones.
+
+        ``model`` is a :class:`byzpy_tpu.models.ModelBundle` (or anything
+        with ``loss_fn(params, x, y)`` and ``params``) for
+        ``uses_model_batch`` attacks — the JAX-native stand-in for the
+        reference's ``nn.Module``.
+        """
+
+    def _collect_inputs(self, inputs: Mapping[str, Any]) -> Dict[str, Any]:
+        kwargs: Dict[str, Any] = {}
+        if self.uses_model_batch:
+            for key in ("model", "x", "y"):
+                if key not in inputs:
+                    raise KeyError(f"Attack requires input {key!r}")
+            kwargs["model"] = inputs["model"]
+            kwargs["x"] = inputs["x"]
+            kwargs["y"] = inputs["y"]
+        if self.uses_honest_grads:
+            if "honest_grads" not in inputs:
+                raise KeyError("Attack requires 'honest_grads'")
+            kwargs["honest_grads"] = inputs["honest_grads"]
+        if self.uses_base_grad:
+            if "base_grad" not in inputs:
+                raise KeyError("Attack requires 'base_grad'")
+            kwargs["base_grad"] = inputs["base_grad"]
+        return kwargs
+
+
+__all__ = ["Attack"]

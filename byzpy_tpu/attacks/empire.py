@@ -1,0 +1,28 @@
+"""Empire attack: ``scale * mean(honest_grads)``, default scale -1
+(behavioral parity: ``byzpy/attacks/empire.py:23-187``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..ops import attack_ops
+from ..utils.trees import stack_gradients
+from .base import Attack
+
+
+class EmpireAttack(Attack):
+    name = "empire"
+    uses_honest_grads = True
+
+    def __init__(self, *, scale: float = -1.0) -> None:
+        self.scale = float(scale)
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
+        if not honest_grads:
+            raise ValueError("EmpireAttack requires honest_grads")
+        matrix, unravel = stack_gradients(honest_grads)
+        return unravel(attack_ops.empire(matrix, scale=self.scale))
+
+
+__all__ = ["EmpireAttack"]

@@ -1,0 +1,41 @@
+"""Gaussian attack: iid ``N(mu, sigma^2)`` coordinates, seedable
+(behavioral parity: ``byzpy/attacks/gaussian.py:38-139``). Randomness uses
+an explicit jax.random key chain so repeated applies draw fresh noise
+reproducibly."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from ..ops import attack_ops
+from ..utils.trees import stack_gradients
+from .base import Attack
+
+
+class GaussianAttack(Attack):
+    name = "gaussian"
+    uses_honest_grads = True
+
+    def __init__(self, *, mu: float = 0.0, sigma: float = 1.0, seed: int = 0,
+                 key: Optional[jax.Array] = None) -> None:
+        if sigma < 0:
+            raise ValueError("sigma must be >= 0")
+        self.mu = float(mu)
+        self.sigma = float(sigma)
+        self._key = key if key is not None else jax.random.PRNGKey(seed)
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
+        if not honest_grads:
+            raise ValueError("GaussianAttack requires honest_grads")
+        matrix, unravel = stack_gradients(honest_grads)
+        self._key, sub = jax.random.split(self._key)
+        noise = attack_ops.gaussian(
+            sub, (matrix.shape[1],), dtype=matrix.dtype, mu=self.mu, sigma=self.sigma
+        )
+        return unravel(noise)
+
+
+__all__ = ["GaussianAttack"]

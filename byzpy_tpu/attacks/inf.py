@@ -1,0 +1,25 @@
+"""Inf attack: ``+inf``-filled vector shaped like the gradients
+(behavioral parity: ``byzpy/attacks/inf.py:35-119``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..ops import attack_ops
+from ..utils.trees import stack_gradients
+from .base import Attack
+
+
+class InfAttack(Attack):
+    name = "inf"
+    uses_honest_grads = True
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
+        if not honest_grads:
+            raise ValueError("InfAttack requires honest_grads")
+        matrix, unravel = stack_gradients(honest_grads)
+        return unravel(attack_ops.inf_vector((matrix.shape[1],), dtype=matrix.dtype))
+
+
+__all__ = ["InfAttack"]

@@ -1,0 +1,45 @@
+"""Label-flip attack: gradient of the loss on flipped labels
+(behavioral parity: ``byzpy/attacks/label_flip.py:35-91``): labels map
+through an explicit lookup table or the default ``num_classes - 1 - y``.
+
+``model`` is a :class:`byzpy_tpu.models.ModelBundle` (pure ``loss_fn`` +
+params) instead of the reference's torch module.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import Attack
+
+
+class LabelFlipAttack(Attack):
+    name = "label-flip"
+    uses_model_batch = True
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        mapping: Optional[Sequence[int]] = None,
+    ) -> None:
+        if num_classes is None and mapping is None:
+            raise ValueError("LabelFlipAttack requires num_classes or mapping")
+        self.num_classes = num_classes
+        self.mapping = None if mapping is None else jnp.asarray(mapping)
+
+    def apply(self, *, model: Any = None, x: Any = None, y: Any = None,
+              honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
+        if model is None or x is None or y is None:
+            raise ValueError("LabelFlipAttack requires model, x, and y")
+        if self.mapping is not None:
+            flipped = self.mapping[y]
+        else:
+            flipped = self.num_classes - 1 - y
+        return jax.grad(model.loss_fn)(model.params, x, flipped)
+
+
+__all__ = ["LabelFlipAttack"]

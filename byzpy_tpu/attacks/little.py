@@ -1,0 +1,37 @@
+"""'A Little Is Enough' attack (Baruch et al. 2019)
+(behavioral parity: ``byzpy/attacks/little.py:81-150``):
+``mu + z_max * sigma`` with ``s = floor(N/2) + 1 - f``,
+``z_max = ndtri((N - s) / N)``. ``N`` defaults to
+``len(honest_grads) + f`` as in the reference."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..ops import attack_ops
+from ..utils.trees import stack_gradients
+from .base import Attack
+
+
+class LittleAttack(Attack):
+    name = "little"
+    uses_honest_grads = True
+
+    def __init__(self, f: int, N: Optional[int] = None) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        self.f = int(f)
+        self.N = None if N is None else int(N)
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
+        if not honest_grads:
+            raise ValueError("LittleAttack requires honest_grads")
+        matrix, unravel = stack_gradients(honest_grads)
+        total = self.N if self.N is not None else matrix.shape[0] + self.f
+        if total < self.f:
+            raise ValueError(f"N must be >= f (got N={total}, f={self.f})")
+        return unravel(attack_ops.little(matrix, f=self.f, n_total=total))
+
+
+__all__ = ["LittleAttack"]

@@ -1,0 +1,36 @@
+"""Mimic attack: replay honest worker ``epsilon``'s gradient
+(behavioral parity: ``byzpy/attacks/mimic.py:35-142``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from .base import Attack
+
+
+class MimicAttack(Attack):
+    name = "mimic"
+    uses_honest_grads = True
+
+    def __init__(self, *, epsilon: int = 0) -> None:
+        if epsilon < 0:
+            raise ValueError("epsilon must be >= 0")
+        self.epsilon = int(epsilon)
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
+        if not honest_grads:
+            raise ValueError("MimicAttack requires honest_grads")
+        if self.epsilon >= len(honest_grads):
+            raise ValueError(
+                f"epsilon must index an honest worker in [0, {len(honest_grads)}) "
+                f"(got {self.epsilon})"
+            )
+        # copy so downstream mutation of the attack output can't alias the
+        # honest gradient (reference copies too)
+        return jax.tree_util.tree_map(lambda a: a + 0, honest_grads[self.epsilon])
+
+
+__all__ = ["MimicAttack"]

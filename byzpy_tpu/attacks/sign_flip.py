@@ -1,0 +1,30 @@
+"""Sign-flip attack: ``scale * base_grad``, default scale -1
+(behavioral parity: ``byzpy/attacks/sign_flip.py:22-145``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+
+from ..ops import attack_ops
+from .base import Attack
+
+
+class SignFlipAttack(Attack):
+    name = "sign-flip"
+    uses_base_grad = True
+
+    def __init__(self, *, scale: float = -1.0) -> None:
+        self.scale = float(scale)
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
+        if base_grad is None:
+            raise ValueError("SignFlipAttack requires base_grad")
+        return jax.tree_util.tree_map(
+            lambda leaf: attack_ops.sign_flip(leaf, scale=self.scale), base_grad
+        )
+
+
+__all__ = ["SignFlipAttack"]

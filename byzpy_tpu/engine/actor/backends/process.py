@@ -159,6 +159,8 @@ class ProcessActorBackend:
 
     async def _request(self, op: str, data: Any) -> Any:
         self._ensure_started()
+        if self._reader_task is not None and self._reader_task.done():
+            raise ConnectionError("actor process pipe closed (reader exited)")
         req_id = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut

@@ -35,6 +35,7 @@ class RemoteActorServer:
         self._actors: Dict[str, Any] = {}
         self._mailboxes: Dict[str, Dict[str, asyncio.Queue]] = {}
         self._connections: set[asyncio.StreamWriter] = set()
+        self._handler_tasks: set[asyncio.Task] = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
@@ -49,6 +50,12 @@ class RemoteActorServer:
                 writer.close()
             await self._server.wait_closed()
             self._server = None
+        # cancel handlers still parked on empty mailboxes (abandoned chan_get)
+        for task in list(self._handler_tasks):
+            task.cancel()
+        if self._handler_tasks:
+            await asyncio.gather(*self._handler_tasks, return_exceptions=True)
+        self._handler_tasks.clear()
         self._actors.clear()
         self._mailboxes.clear()
 
@@ -80,7 +87,9 @@ class RemoteActorServer:
         try:
             while True:
                 msg = await wire.recv_obj(reader)
-                asyncio.ensure_future(handle(msg))
+                task = asyncio.ensure_future(handle(msg))
+                self._handler_tasks.add(task)
+                task.add_done_callback(self._handler_tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -174,6 +183,10 @@ class RemoteActorBackend:
 
     async def _request(self, msg: Dict[str, Any]) -> Any:
         self._ensure_started()
+        if self._reader_task is not None and self._reader_task.done():
+            raise ConnectionError(
+                "remote actor connection lost (reader exited); reconnect with start()"
+            )
         req_id = next(self._req_ids)
         msg = {**msg, "req_id": req_id, "actor_id": self.actor_id}
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

@@ -40,9 +40,13 @@ def select_adaptive_chunk_size(
     if pool_size <= 1:
         return configured
 
-    min_per_worker = min_per_worker or _env_int("BYZPY_TPU_CHUNK_MIN_PER_WORKER", 4)
-    max_shrink = max_shrink or _env_int("BYZPY_TPU_CHUNK_MAX_SHRINK", 8)
-    target_factor = target_factor or _env_int("BYZPY_TPU_CHUNK_TARGET_FACTOR", 1)
+    if min_per_worker is None:
+        min_per_worker = _env_int("BYZPY_TPU_CHUNK_MIN_PER_WORKER", 4)
+    if max_shrink is None:
+        max_shrink = _env_int("BYZPY_TPU_CHUNK_MAX_SHRINK", 8)
+    if target_factor is None:
+        target_factor = _env_int("BYZPY_TPU_CHUNK_TARGET_FACTOR", 1)
+    min_per_worker = max(1, min_per_worker)
 
     target_chunks = pool_size * min_per_worker * max(1, target_factor)
     ideal = max(1, math.ceil(total / target_chunks))

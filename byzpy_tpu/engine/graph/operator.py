@@ -147,13 +147,18 @@ async def run_subtasks_windowed(
             done, in_flight = await asyncio.wait(
                 in_flight, return_when=asyncio.FIRST_COMPLETED
             )
-            for t in done:
-                exc = t.exception()
-                if exc is not None:
-                    raise exc
+            # retrieve every exception in the batch, then raise the first, so
+            # siblings don't emit "exception was never retrieved" warnings
+            failures = [t.exception() for t in done if t.exception() is not None]
+            if failures:
+                raise failures[0]
     finally:
-        for t in in_flight:
-            t.cancel()
+        if in_flight:
+            for t in in_flight:
+                t.cancel()
+            # await cancellations so a shared semaphore is fully released
+            # before control returns to concurrently-running operators
+            await asyncio.gather(*in_flight, return_exceptions=True)
     return [results[i] for i in range(idx)]
 
 

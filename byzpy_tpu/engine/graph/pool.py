@@ -222,9 +222,9 @@ class ActorPool:
             self._release(worker)
 
     def _release(self, worker: _PoolWorker) -> None:
+        # drop dead waiters (e.g. cancelled by wait_for) as we scan
+        self._waiters = [(aff, fut) for aff, fut in self._waiters if not fut.done()]
         for i, (aff, fut) in enumerate(self._waiters):
-            if fut.done():
-                continue
             if aff is None or aff in worker.capabilities:
                 self._waiters.pop(i)
                 fut.set_result(worker)

@@ -35,7 +35,12 @@ class ExecutionFuture:
         except asyncio.TimeoutError:
             return False
         except asyncio.CancelledError:
-            pass
+            # only swallow when it's the graph task that was cancelled;
+            # cancellation of the *waiting* coroutine must propagate
+            if not self._task.cancelled():
+                raise
+        except Exception:
+            pass  # task failure is surfaced by result(), not wait()
         return self._task.done()
 
     async def result(self) -> Dict[str, Any]:

@@ -1,0 +1,3 @@
+from .bundle import ModelBundle, softmax_cross_entropy_loss
+
+__all__ = ["ModelBundle", "softmax_cross_entropy_loss"]

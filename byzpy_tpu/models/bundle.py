@@ -1,0 +1,49 @@
+"""ModelBundle: the JAX-native stand-in for a torch ``nn.Module`` handle.
+
+Where the reference passes a mutable torch module into attacks and nodes
+(ref: ``byzpy/attacks/base.py:62``), the JAX equivalent is a pure
+``apply_fn`` plus an explicit parameter pytree and a loss. Everything that
+needs "the model" takes one of these.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def softmax_cross_entropy_loss(apply_fn: Callable) -> Callable:
+    """Default classification loss for integer labels."""
+
+    def loss_fn(params: Any, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        logits = apply_fn(params, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    return loss_fn
+
+
+@dataclass
+class ModelBundle:
+    apply_fn: Callable[[Any, jnp.ndarray], jnp.ndarray]
+    params: Any
+    loss_fn: Optional[Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray]] = None
+
+    def __post_init__(self) -> None:
+        if self.loss_fn is None:
+            self.loss_fn = softmax_cross_entropy_loss(self.apply_fn)
+
+    def grad(self, x: jnp.ndarray, y: jnp.ndarray) -> Any:
+        return jax.grad(self.loss_fn)(self.params, x, y)
+
+    def loss(self, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+        return self.loss_fn(self.params, x, y)
+
+    def with_params(self, params: Any) -> "ModelBundle":
+        return replace(self, params=params)
+
+
+__all__ = ["ModelBundle", "softmax_cross_entropy_loss"]

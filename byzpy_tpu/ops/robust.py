@@ -273,11 +273,11 @@ def caf(x: Array, *, f: int, power_iters: int = 3, seed: int = 0) -> Array:
     big = jnp.asarray(jnp.finfo(jnp.float32).max, x.dtype)
 
     def cond(state):
-        w, _, _, stop = state
-        return (~stop) & (jnp.sum(w) > n - 2 * f)
+        w, _, _, stop, it = state
+        return (~stop) & (jnp.sum(w) > n - 2 * f) & (it < 4 * n)
 
     def body(state):
-        w, best_mu, best_lam, _ = state
+        w, best_mu, best_lam, _, it = state
         total = jnp.sum(w)
         mu = jnp.sum(w[:, None] * x, axis=0) / total
         diffs = x - mu[None, :]
@@ -287,15 +287,21 @@ def caf(x: Array, *, f: int, power_iters: int = 3, seed: int = 0) -> Array:
         best_mu = jnp.where(better, mu, best_mu)
         proj = diffs @ vec
         tau = proj * proj
-        tau_max = jnp.max(tau)
+        # Leverage is compared among surviving points only: a zero-weight
+        # outlier's huge tau would otherwise dominate tau_max and make the
+        # survivors' update factors round to 1.0 (loop never terminates).
+        # Restricting to w > 0 zeroes the max-leverage survivor every pass,
+        # so the loop takes at most n iterations.
+        tau_alive = jnp.where(w > 0.0, tau, -jnp.inf)
+        tau_max = jnp.max(tau_alive)
         degenerate = tau_max <= 1e-12
         w_new = jnp.clip(w * (1.0 - tau / jnp.maximum(tau_max, 1e-30)), 0.0, None)
         w = jnp.where(degenerate, w, w_new)
         stop = degenerate | (jnp.sum(w) <= 0.0)
-        return w, best_mu, best_lam, stop
+        return w, best_mu, best_lam, stop, it + 1
 
-    state0 = (jnp.ones((n,), x.dtype), jnp.mean(x, axis=0), big, jnp.asarray(False))
-    _, best_mu, _, _ = lax.while_loop(cond, body, state0)
+    state0 = (jnp.ones((n,), x.dtype), jnp.mean(x, axis=0), big, jnp.asarray(False), 0)
+    _, best_mu, _, _, _ = lax.while_loop(cond, body, state0)
     return best_mu
 
 

@@ -1,0 +1,7 @@
+from .arc import ARC
+from .base import PreAggregator
+from .bucketing import Bucketing
+from .clipping import Clipping
+from .nnm import NearestNeighborMixing
+
+__all__ = ["PreAggregator", "Clipping", "Bucketing", "NearestNeighborMixing", "ARC"]

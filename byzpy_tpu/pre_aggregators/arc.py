@@ -1,0 +1,28 @@
+"""ARC: Adaptive Robust Clipping
+(behavioral parity: ``byzpy/pre_aggregators/arc.py:36-161``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import preagg
+from .base import PreAggregator
+
+
+class ARC(PreAggregator):
+    name = "pre-agg/arc"
+
+    def __init__(self, f: int = 0) -> None:
+        if f < 0:
+            raise ValueError("f must be >= 0")
+        self.f = int(f)
+
+    def validate_n(self, n: int) -> None:
+        if self.f > n:
+            raise ValueError(f"f must be <= number of vectors (got f={self.f}, n={n})")
+
+    def _transform_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return preagg.arc_clip(x, f=self.f)
+
+
+__all__ = ["ARC"]

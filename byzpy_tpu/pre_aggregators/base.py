@@ -1,0 +1,46 @@
+"""PreAggregator base class (API parity: ``byzpy/pre_aggregators/base.py:9-74``).
+
+Pre-aggregators transform a sequence of vectors before aggregation and
+return a list of vectors (possibly of different length). Subclasses
+implement ``_transform_matrix`` on the stacked ``(n, d)`` matrix; it may
+return fewer rows (bucketing).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, List, Mapping, Sequence
+
+import jax.numpy as jnp
+
+from ..engine.graph.operator import OpContext, Operator
+from ..utils.trees import stack_gradients, unstack_rows
+
+
+class PreAggregator(Operator, ABC):
+    name = "pre_aggregator"
+    input_key = "vectors"
+
+    def compute(self, inputs: Mapping[str, Any], *, context: OpContext) -> List[Any]:
+        if self.input_key not in inputs:
+            raise KeyError(f"{self.name} expects input key {self.input_key!r}")
+        values = inputs[self.input_key]
+        if not isinstance(values, Sequence) and not hasattr(values, "ndim"):
+            raise TypeError(f"{self.name} expects a sequence at {self.input_key!r}")
+        return self.pre_aggregate(values)
+
+    def pre_aggregate(self, xs: Sequence[Any]) -> List[Any]:
+        matrix, unravel = stack_gradients(xs)
+        self.validate_n(matrix.shape[0])
+        out = self._transform_matrix(matrix)
+        return unstack_rows(out, unravel)
+
+    def validate_n(self, n: int) -> None:
+        """Hook for subclasses to validate hyperparameters against n."""
+
+    @abstractmethod
+    def _transform_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Transform the stacked ``(n, d)`` matrix to ``(m, d)``."""
+
+
+__all__ = ["PreAggregator"]

@@ -1,0 +1,54 @@
+"""Bucketing (Karimireddy et al.): random permutation -> buckets -> means
+(behavioral parity: ``byzpy/pre_aggregators/bucketing.py:28-120``).
+
+Randomness is an explicit ``jax.random`` key (or a caller-supplied
+permutation), replacing the reference's numpy ``rng``/``perm`` arguments
+with the jit-reproducible equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops import preagg
+from .base import PreAggregator
+
+
+class Bucketing(PreAggregator):
+    name = "pre-agg/bucketing"
+
+    def __init__(
+        self,
+        bucket_size: int,
+        *,
+        perm: Optional[Sequence[int]] = None,
+        key: Optional[jax.Array] = None,
+        seed: int = 0,
+    ) -> None:
+        if bucket_size <= 0:
+            raise ValueError("bucket_size must be > 0")
+        self.bucket_size = int(bucket_size)
+        self._explicit_perm = None if perm is None else np.asarray(perm, dtype=np.int32)
+        self._key = key if key is not None else jax.random.PRNGKey(seed)
+
+    def _resolve_perm(self, n: int) -> jnp.ndarray:
+        if self._explicit_perm is not None:
+            if self._explicit_perm.shape != (n,):
+                raise ValueError(
+                    f"perm must have shape ({n},); got {self._explicit_perm.shape}"
+                )
+            return jnp.asarray(self._explicit_perm)
+        # split so successive pre_aggregate calls see fresh permutations
+        self._key, sub = jax.random.split(self._key)
+        return jax.random.permutation(sub, n)
+
+    def _transform_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        perm = self._resolve_perm(x.shape[0])
+        return preagg.bucket_means(x, perm, bucket_size=self.bucket_size)
+
+
+__all__ = ["Bucketing"]

@@ -1,0 +1,24 @@
+"""Static L2-norm clipping
+(behavioral parity: ``byzpy/pre_aggregators/clipping.py:35-130``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import preagg
+from .base import PreAggregator
+
+
+class Clipping(PreAggregator):
+    name = "pre-agg/clipping"
+
+    def __init__(self, threshold: float) -> None:
+        if threshold < 0:
+            raise ValueError("threshold must be >= 0")
+        self.threshold = float(threshold)
+
+    def _transform_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
+        return preagg.clip_rows(x, threshold=self.threshold)
+
+
+__all__ = ["Clipping"]

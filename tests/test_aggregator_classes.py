@@ -1,0 +1,208 @@
+"""Class-layer tests: every aggregator/pre-aggregator class, pytree I/O,
+direct-vs-pool-subtask parity (the reference's key invariant), and
+run_operator integration."""
+
+import asyncio
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from byzpy_tpu import run_operator
+from byzpy_tpu.aggregators import (
+    CAF,
+    CenteredClipping,
+    ComparativeGradientElimination,
+    CoordinateWiseMedian,
+    CoordinateWiseTrimmedMean,
+    GeometricMedian,
+    Krum,
+    MeanOfMedians,
+    MinimumDiameterAveraging,
+    MoNNA,
+    MultiKrum,
+    SMEA,
+)
+from byzpy_tpu.engine.graph import ActorPool, ActorPoolConfig
+from byzpy_tpu.pre_aggregators import ARC, Bucketing, Clipping, NearestNeighborMixing
+
+
+def grads(n=10, d=65, seed=0):
+    r = np.random.default_rng(seed)
+    return [jnp.asarray(r.normal(size=d).astype(np.float32)) for _ in range(n)]
+
+
+def tree_grads(n=8, seed=1):
+    r = np.random.default_rng(seed)
+    return [
+        {"w": jnp.asarray(r.normal(size=(5, 4)).astype(np.float32)),
+         "b": jnp.asarray(r.normal(size=4).astype(np.float32))}
+        for _ in range(n)
+    ]
+
+
+ALL_AGGREGATORS = [
+    CoordinateWiseMedian(),
+    CoordinateWiseTrimmedMean(f=2),
+    MeanOfMedians(f=2),
+    MultiKrum(f=2, q=3),
+    Krum(f=2),
+    GeometricMedian(),
+    MinimumDiameterAveraging(f=2),
+    MoNNA(f=2),
+    SMEA(f=2),
+    CenteredClipping(c_tau=1.0, M=5),
+    CAF(f=2),
+    ComparativeGradientElimination(f=2),
+]
+
+
+@pytest.mark.parametrize("agg", ALL_AGGREGATORS, ids=lambda a: a.name)
+def test_aggregate_returns_input_shape(agg):
+    gs = grads()
+    out = agg.aggregate(gs)
+    assert out.shape == gs[0].shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("agg", ALL_AGGREGATORS, ids=lambda a: a.name)
+def test_aggregate_pytree_roundtrip(agg):
+    gs = tree_grads()
+    out = agg.aggregate(gs)
+    assert set(out.keys()) == {"w", "b"}
+    assert out["w"].shape == (5, 4)
+    assert out["b"].shape == (4,)
+
+
+SUBTASK_AGGREGATORS = [
+    CoordinateWiseMedian(chunk_size=16),
+    CoordinateWiseTrimmedMean(f=2, chunk_size=16),
+    MeanOfMedians(f=2, chunk_size=16),
+    MultiKrum(f=2, q=3, chunk_size=3),
+    Krum(f=2, chunk_size=3),
+    MoNNA(f=2, chunk_size=3),
+    ComparativeGradientElimination(f=2, chunk_size=3),
+    MinimumDiameterAveraging(f=2, chunk_size=10),
+    SMEA(f=2, chunk_size=10),
+]
+
+
+@pytest.mark.parametrize("agg", SUBTASK_AGGREGATORS, ids=lambda a: a.name)
+def test_direct_vs_pool_subtask_parity(agg):
+    """The pool-chunked path must produce the same result as aggregate()."""
+    gs = grads(n=9, d=47, seed=3)
+    direct = np.asarray(agg.aggregate(gs))
+
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=3)) as pool:
+            return await run_operator(agg, gs, pool=pool)
+
+    pooled = np.asarray(asyncio.run(main()))
+    np.testing.assert_allclose(pooled, direct, rtol=1e-5, atol=1e-6)
+
+
+def test_robustness_under_outliers():
+    """All f-tolerant aggregators must shrug off f strong outliers."""
+    gs = grads(n=8, d=30, seed=5)
+    honest_mean = np.stack([np.asarray(g) for g in gs]).mean(0)
+    poisoned = gs + [jnp.full((30,), 1e4), jnp.full((30,), -1e4)]
+    for agg in [
+        CoordinateWiseMedian(),
+        CoordinateWiseTrimmedMean(f=2),
+        MeanOfMedians(f=2),
+        MultiKrum(f=2, q=3),
+        MinimumDiameterAveraging(f=2),
+        MoNNA(f=2),
+        SMEA(f=2),
+        ComparativeGradientElimination(f=2),
+        CAF(f=2),
+    ]:
+        out = np.asarray(agg.aggregate(poisoned))
+        assert np.linalg.norm(out - honest_mean) < 10.0, agg.name
+
+
+def test_validation_errors():
+    gs = grads(n=5)
+    with pytest.raises(ValueError):
+        CoordinateWiseTrimmedMean(f=3).aggregate(gs)
+    with pytest.raises(ValueError):
+        MultiKrum(f=4, q=1).aggregate(gs)
+    with pytest.raises(ValueError):
+        MoNNA(f=3).aggregate(gs)
+    with pytest.raises(ValueError):
+        CoordinateWiseTrimmedMean(f=-1)
+    with pytest.raises(ValueError):
+        MultiKrum(f=1, q=0)
+    with pytest.raises(ValueError):
+        GeometricMedian(init="bogus")
+
+
+def test_matrix_input_accepted():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(6, 12)).astype(np.float32))
+    out = CoordinateWiseMedian().aggregate(x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.median(np.asarray(x), axis=0), rtol=1e-5, atol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# pre-aggregators
+# ---------------------------------------------------------------------------
+
+
+def test_clipping_class():
+    vs = grads(n=6, d=20, seed=7)
+    out = Clipping(threshold=0.5).pre_aggregate(vs)
+    assert len(out) == 6
+    for v in out:
+        assert float(jnp.linalg.norm(v)) <= 0.5 + 1e-4
+
+
+def test_bucketing_class_counts_and_mean_preservation():
+    vs = grads(n=10, d=8, seed=8)
+    b = Bucketing(bucket_size=3, seed=42)
+    out = b.pre_aggregate(vs)
+    assert len(out) == 4  # ceil(10/3)
+    # bucketing preserves the weighted overall mean up to ragged-bucket weights
+    out2 = Bucketing(bucket_size=3, seed=42).pre_aggregate(vs)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out2[0]), rtol=1e-6)
+    # explicit identity perm gives deterministic buckets
+    ident = Bucketing(bucket_size=5, perm=list(range(10))).pre_aggregate(vs)
+    np.testing.assert_allclose(
+        np.asarray(ident[0]),
+        np.stack([np.asarray(v) for v in vs[:5]]).mean(0),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_nnm_class():
+    vs = grads(n=8, d=10, seed=9)
+    out = NearestNeighborMixing(f=2).pre_aggregate(vs)
+    assert len(out) == 8
+    with pytest.raises(ValueError):
+        NearestNeighborMixing(f=8).pre_aggregate(vs)
+
+
+def test_combination_unranking():
+    from itertools import combinations, islice
+
+    from byzpy_tpu.utils.combinatorics import iter_combinations, unrank_combination
+
+    for n, m in [(6, 3), (8, 5), (5, 1), (5, 5)]:
+        ref = list(combinations(range(n), m))
+        assert list(iter_combinations(n, m)) == ref
+        for start in sorted({0, len(ref) // 2, len(ref) - 1}):
+            assert unrank_combination(n, m, start) == ref[start]
+            assert list(iter_combinations(n, m, start)) == ref[start:]
+    with pytest.raises(ValueError):
+        unrank_combination(5, 2, 10)
+
+
+def test_arc_class():
+    vs = grads(n=8, d=10, seed=10)
+    vs[3] = vs[3] * 100
+    out = ARC(f=2).pre_aggregate(vs)
+    norms_in = [float(jnp.linalg.norm(v)) for v in vs]
+    norms_out = [float(jnp.linalg.norm(v)) for v in out]
+    assert norms_out[3] < norms_in[3]  # big vector clipped
+    assert len(out) == 8

@@ -1,0 +1,92 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byzpy_tpu.attacks import (
+    EmpireAttack,
+    GaussianAttack,
+    InfAttack,
+    LabelFlipAttack,
+    LittleAttack,
+    MimicAttack,
+    SignFlipAttack,
+)
+from byzpy_tpu.engine.graph.operator import OpContext
+from byzpy_tpu.models import ModelBundle
+
+
+def grads(n=6, d=12, seed=0):
+    r = np.random.default_rng(seed)
+    return [jnp.asarray(r.normal(size=d).astype(np.float32)) for _ in range(n)]
+
+
+def test_sign_flip_tree():
+    base = {"w": jnp.ones((2, 2)), "b": jnp.ones(2)}
+    out = SignFlipAttack().apply(base_grad=base)
+    np.testing.assert_allclose(np.asarray(out["w"]), -np.ones((2, 2)))
+
+
+def test_empire_and_flags():
+    hs = grads()
+    atk = EmpireAttack()
+    assert atk.uses_honest_grads and not atk.uses_base_grad
+    out = atk.apply(honest_grads=hs)
+    want = -np.stack([np.asarray(g) for g in hs]).mean(0)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+
+
+def test_little_shrinks_toward_mean():
+    hs = grads(n=9, seed=2)
+    out = np.asarray(LittleAttack(f=2).apply(honest_grads=hs))
+    mu = np.stack([np.asarray(g) for g in hs]).mean(0)
+    sig = np.stack([np.asarray(g) for g in hs]).std(0)
+    # attack stays within a few sigma of the mean (that's the point)
+    assert np.all(np.abs(out - mu) <= 3 * sig + 1e-6)
+
+
+def test_gaussian_fresh_draws_and_reproducible():
+    hs = grads()
+    a1 = GaussianAttack(seed=7)
+    v1 = np.asarray(a1.apply(honest_grads=hs))
+    v2 = np.asarray(a1.apply(honest_grads=hs))
+    assert not np.allclose(v1, v2)  # fresh draw per apply
+    a2 = GaussianAttack(seed=7)
+    np.testing.assert_array_equal(np.asarray(a2.apply(honest_grads=hs)), v1)
+
+
+def test_inf_and_mimic():
+    hs = grads()
+    assert np.all(np.isposinf(np.asarray(InfAttack().apply(honest_grads=hs))))
+    out = MimicAttack(epsilon=2).apply(honest_grads=hs)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(hs[2]))
+    with pytest.raises(ValueError):
+        MimicAttack(epsilon=99).apply(honest_grads=hs)
+
+
+def test_label_flip_with_model_bundle():
+    r = np.random.default_rng(3)
+    params = {"w": jnp.zeros((4, 3))}
+    bundle = ModelBundle(apply_fn=lambda p, x: x @ p["w"], params=params)
+    x = jnp.asarray(r.normal(size=(6, 4)).astype(np.float32))
+    y = jnp.asarray(np.array([0, 1, 2, 0, 1, 2]))
+    atk = LabelFlipAttack(num_classes=3)
+    g_mal = atk.apply(model=bundle, x=x, y=y)
+    g_honest = bundle.grad(x, y)
+    assert not np.allclose(np.asarray(g_mal["w"]), np.asarray(g_honest["w"]))
+    # identity mapping == honest gradient
+    ident = LabelFlipAttack(mapping=[0, 1, 2]).apply(model=bundle, x=x, y=y)
+    np.testing.assert_allclose(
+        np.asarray(ident["w"]), np.asarray(g_honest["w"]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_compute_collects_flagged_inputs():
+    hs = grads()
+    ctx = OpContext("atk")
+    out = EmpireAttack().compute({"honest_grads": hs}, context=ctx)
+    assert out.shape == hs[0].shape
+    with pytest.raises(KeyError):
+        EmpireAttack().compute({}, context=ctx)
+    with pytest.raises(KeyError):
+        SignFlipAttack().compute({"honest_grads": hs}, context=ctx)
