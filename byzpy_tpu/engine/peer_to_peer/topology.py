@@ -1,0 +1,113 @@
+"""Directed communication topology (API parity:
+``byzpy/engine/peer_to_peer/topology.py:27-38``).
+
+Beyond the reference's adjacency bookkeeping, a topology here can export a
+**static neighbor-index matrix** — the form the SPMD gossip step consumes
+(`byzpy_tpu.parallel.gossip`): under jit, per-node neighbor selection must
+be a gather with static indices, and a ring maps onto ``lax.ppermute``
+shifts over ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+
+@dataclass
+class Topology:
+    """Directed graph over integer node indices ``0..n-1``."""
+
+    n_nodes: int
+    edges: Set[Tuple[int, int]] = field(default_factory=set)
+
+    def add_edge(self, src: int, dst: int) -> None:
+        self._check(src)
+        self._check(dst)
+        if src != dst:
+            self.edges.add((src, dst))
+
+    def _check(self, i: int) -> None:
+        if not 0 <= i < self.n_nodes:
+            raise ValueError(f"node index {i} out of range [0, {self.n_nodes})")
+
+    def out_neighbors(self, i: int) -> List[int]:
+        self._check(i)
+        return sorted(dst for src, dst in self.edges if src == i)
+
+    def in_neighbors(self, i: int) -> List[int]:
+        self._check(i)
+        return sorted(src for src, dst in self.edges if dst == i)
+
+    # -- factories (ref: topology.py:27-38) --------------------------------
+
+    @classmethod
+    def complete(cls, n: int) -> "Topology":
+        t = cls(n)
+        t.edges = {(i, j) for i in range(n) for j in range(n) if i != j}
+        return t
+
+    @classmethod
+    def ring(cls, n: int, k: int = 1) -> "Topology":
+        """Each node sends to its next ``k`` clockwise neighbors."""
+        t = cls(n)
+        for i in range(n):
+            for step in range(1, k + 1):
+                t.add_edge(i, (i + step) % n)
+        return t
+
+    @classmethod
+    def from_edges(cls, n: int, edges: Iterable[Tuple[int, int]]) -> "Topology":
+        t = cls(n)
+        for s, d in edges:
+            t.add_edge(s, d)
+        return t
+
+    # -- SPMD export -------------------------------------------------------
+
+    def is_ring(self) -> Optional[int]:
+        """Return ``k`` if this is exactly ``ring(n, k)``, else ``None``
+        (rings lower to ``ppermute`` shifts instead of a full all_gather)."""
+        for k in range(1, self.n_nodes):
+            if self.edges == Topology.ring(self.n_nodes, k).edges:
+                return k
+        return None
+
+    def in_neighbor_matrix(self, *, include_self: bool = True) -> np.ndarray:
+        """``(n, k_max)`` int32 matrix of in-neighbor indices, short rows
+        padded by repeating the row's first entry (duplicates are harmless
+        for the mean/median-style aggregations applied over the row).
+
+        With ``include_self=False`` every node must have at least one
+        in-neighbor — there is no value that could pad an empty row without
+        silently re-including the excluded self.
+        """
+        rows = []
+        k_max = 0
+        for i in range(self.n_nodes):
+            nb = ([i] if include_self else []) + self.in_neighbors(i)
+            if not nb:
+                raise ValueError(
+                    f"node {i} has no in-neighbors; with include_self=False "
+                    "every node needs at least one"
+                )
+            rows.append(nb)
+            k_max = max(k_max, len(nb))
+        mat = np.zeros((self.n_nodes, k_max), dtype=np.int32)
+        for i, nb in enumerate(rows):
+            mat[i] = nb + [nb[0]] * (k_max - len(nb))
+        return mat
+
+    def in_mask(self, *, include_self: bool = True) -> np.ndarray:
+        """``(n, n)`` float32 mask: ``m[i, j] = 1`` if node i receives from j."""
+        m = np.zeros((self.n_nodes, self.n_nodes), dtype=np.float32)
+        for src, dst in self.edges:
+            m[dst, src] = 1.0
+        if include_self:
+            np.fill_diagonal(m, 1.0)
+        return m
+
+
+__all__ = ["Topology"]

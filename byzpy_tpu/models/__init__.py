@@ -1,3 +1,35 @@
 from .bundle import ModelBundle, softmax_cross_entropy_loss
+from .data import ShardedDataset, host_batches, sample_batch, synthetic_classification
+from .nets import (
+    MLP,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    SmallCNN,
+    cifar_resnet18,
+    imagenet_resnet50,
+    make_bundle,
+    mnist_cnn,
+    mnist_mlp,
+)
 
-__all__ = ["ModelBundle", "softmax_cross_entropy_loss"]
+__all__ = [
+    "ModelBundle",
+    "softmax_cross_entropy_loss",
+    "MLP",
+    "SmallCNN",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "make_bundle",
+    "mnist_mlp",
+    "mnist_cnn",
+    "cifar_resnet18",
+    "imagenet_resnet50",
+    "ShardedDataset",
+    "synthetic_classification",
+    "sample_batch",
+    "host_batches",
+]

@@ -1,0 +1,32 @@
+from .gossip import (
+    GossipStepConfig,
+    build_gossip_train_step,
+    build_ring_gossip_train_step,
+    ring_exchange,
+)
+from .mesh import (
+    feature_mesh,
+    grid_mesh,
+    make_mesh,
+    node_mesh,
+    replicated,
+    sharding,
+)
+from .ps import PSStepConfig, build_ps_train_step, default_optimizer, jit_ps_train_step
+
+__all__ = [
+    "make_mesh",
+    "node_mesh",
+    "feature_mesh",
+    "grid_mesh",
+    "sharding",
+    "replicated",
+    "PSStepConfig",
+    "build_ps_train_step",
+    "jit_ps_train_step",
+    "default_optimizer",
+    "GossipStepConfig",
+    "build_gossip_train_step",
+    "build_ring_gossip_train_step",
+    "ring_exchange",
+]

@@ -1,0 +1,96 @@
+"""Device-mesh construction helpers.
+
+Axis conventions used across byzpy_tpu:
+
+* ``"nodes"`` — the Byzantine-training node axis. One logical training node
+  per chip (or per mesh row); gradients live sharded over it and robust
+  aggregation reduces across it.
+* ``"feat"`` — the flattened model-parameter axis; coordinate-wise
+  aggregators shard it so each chip computes medians over a local slice of
+  coordinates (the TPU equivalent of the reference's shm feature chunks,
+  ref: ``byzpy/aggregators/coordinate_wise/median.py:108-134``).
+* ``"data"`` — intra-node batch parallelism, when a node spans >1 chip.
+
+Multi-host: ``jax.devices()`` already enumerates the full slice, so these
+helpers transparently produce multi-host meshes; collectives ride ICI
+within a slice and DCN across slices (JAX/XLA handles the routing).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    axis_sizes: Sequence[int] | None = None,
+    axis_names: Sequence[str] = ("nodes",),
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all visible devices).
+
+    With ``axis_sizes=None`` all devices go to the first axis. A size of -1
+    means "whatever is left" (at most one -1, numpy-style).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if axis_sizes is None:
+        axis_sizes = [len(devs)] + [1] * (len(axis_names) - 1)
+    sizes = list(axis_sizes)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devs) % known:
+            raise ValueError(
+                f"cannot infer -1 axis: {len(devs)} devices not divisible by {known}"
+            )
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        raise ValueError(f"mesh wants {total} devices but only {len(devs)} visible")
+    arr = np.array(devs[:total]).reshape(sizes)
+    return Mesh(arr, tuple(axis_names))
+
+
+def node_mesh(n_nodes: int | None = None, *, devices=None) -> Mesh:
+    """1-D mesh over the ``nodes`` axis (one chip per training node)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = n_nodes or len(devs)
+    return make_mesh([n], ("nodes",), devices=devs)
+
+
+def feature_mesh(n_shards: int | None = None, *, devices=None) -> Mesh:
+    """1-D mesh over the ``feat`` axis for coordinate-sharded aggregation."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = n_shards or len(devs)
+    return make_mesh([n], ("feat",), devices=devs)
+
+
+def grid_mesh(n_nodes: int, data_per_node: int = 1, *, devices=None) -> Mesh:
+    """2-D ``(nodes, data)`` mesh: nodes axis × intra-node data parallelism."""
+    return make_mesh([n_nodes, data_per_node], ("nodes", "data"), devices=devices)
+
+
+def sharding(mesh: Mesh, *spec: str | None | Tuple[str, ...]) -> NamedSharding:
+    """Shorthand: ``sharding(mesh, "nodes", None)`` ==
+    ``NamedSharding(mesh, PartitionSpec("nodes", None))``."""
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+__all__ = [
+    "make_mesh",
+    "node_mesh",
+    "feature_mesh",
+    "grid_mesh",
+    "sharding",
+    "replicated",
+    "Mesh",
+    "NamedSharding",
+    "PartitionSpec",
+]

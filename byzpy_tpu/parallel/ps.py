@@ -1,0 +1,174 @@
+"""SPMD parameter-server training: the whole PS round as ONE jitted program.
+
+The reference's PS round is host-orchestrated actor traffic — stream honest
+gradients as-completed, feed them to byzantine actors, pickle everything
+through pipes/shm, aggregate, fan the update back out
+(ref: ``byzpy/engine/parameter_server/ps.py:103-144``). On TPU that entire
+round collapses into a single compiled step over a ``Mesh``:
+
+* per-node gradients: data is sharded ``P("nodes", ...)``; a ``vmap`` over
+  the node axis computes every node's gradient in parallel, each on its own
+  chip;
+* byzantine behavior: honest rows are a static slice of the stacked
+  gradient matrix; the attack is a pure function of them writing the
+  byzantine rows (SURVEY §7e — functional masking instead of separate
+  actor code paths);
+* aggregation: the ``(n, d)`` matrix is re-laid-out feature-sharded via a
+  sharding constraint — XLA inserts the ``all_to_all`` "gradient
+  transpose" over ICI — so coordinate-wise aggregators run fully locally
+  per chip and geometric ones psum an ``(n, n)`` Gram block;
+* update: the aggregated vector is unraveled and applied with optax;
+  params/opt-state stay replicated.
+
+No pickling, no shm, no host round-trips — the collectives ARE the
+parameter server.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.bundle import ModelBundle
+from ..utils.trees import ravel_pytree_fn
+
+AggFn = Callable[[jnp.ndarray], jnp.ndarray]          # (n, d) -> (d,)
+PreAggFn = Callable[[jnp.ndarray], jnp.ndarray]       # (n, d) -> (m, d)
+# attack: (honest (h, d), key) -> (n_byz, d)
+AttackFn = Callable[[jnp.ndarray, jax.Array], jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class PSStepConfig:
+    n_nodes: int
+    n_byzantine: int = 0
+    learning_rate: float = 0.05
+    momentum: float = 0.9
+
+    @property
+    def n_honest(self) -> int:
+        return self.n_nodes - self.n_byzantine
+
+
+def default_optimizer(cfg: PSStepConfig) -> optax.GradientTransformation:
+    """SGD+momentum, matching the reference examples' torch SGD
+    (ref: ``examples/ps/nodes.py:70-74``)."""
+    return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
+
+
+def build_ps_train_step(
+    bundle: ModelBundle,
+    aggregate: AggFn,
+    cfg: PSStepConfig,
+    *,
+    attack: Optional[AttackFn] = None,
+    pre_aggregate: Optional[PreAggFn] = None,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    mesh: Optional[Mesh] = None,
+    grad_dtype: Any = None,
+) -> Tuple[Callable, Any]:
+    """Build ``(train_step, opt_state0)``.
+
+    ``train_step(params, opt_state, xs, ys, key)`` expects per-node batches
+    stacked on a leading node axis: ``xs: (n_nodes, B, ...)``,
+    ``ys: (n_nodes, B)``. With ``mesh`` given, batches are constrained to
+    ``P("nodes", ...)`` and the gradient matrix transposes to feature
+    sharding before aggregation; without a mesh it is the same program on
+    one device.
+
+    Returns ``(params, opt_state, metrics)`` where metrics carries the mean
+    honest loss and the aggregated-gradient norm.
+    """
+    opt = optimizer or default_optimizer(cfg)
+    opt_state0 = opt.init(bundle.params)
+    ravel, unravel = ravel_pytree_fn(bundle.params)
+    loss_fn = bundle.loss_fn
+    h, b = cfg.n_honest, cfg.n_byzantine
+    if not 0 <= b < cfg.n_nodes:
+        raise ValueError(f"need 0 <= n_byzantine < n_nodes (got {b}/{cfg.n_nodes})")
+
+    node_spec = None
+    feat_spec = None
+    if mesh is not None:
+        axis = "nodes" if "nodes" in mesh.axis_names else mesh.axis_names[0]
+        node_spec = NamedSharding(mesh, P(axis))
+        feat_spec = NamedSharding(mesh, P(None, axis))
+
+    def per_node_grad(params, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        flat = ravel(g)
+        if grad_dtype is not None:
+            flat = flat.astype(grad_dtype)
+        return loss, flat
+
+    param_dtype = ravel(bundle.params).dtype
+
+    def train_step(params, opt_state, xs, ys, key):
+        if node_spec is not None:
+            xs = jax.lax.with_sharding_constraint(xs, node_spec)
+            ys = jax.lax.with_sharding_constraint(ys, node_spec)
+        # Every node's forward/backward runs in parallel across the mesh:
+        # vmap over the node axis of node-sharded data with replicated params.
+        losses, grads = jax.vmap(per_node_grad, in_axes=(None, 0, 0))(params, xs, ys)
+        honest = grads[:h] if b else grads
+        if b:
+            if attack is not None:
+                byz = attack(honest, key)
+            else:
+                # no attack configured: byzantine nodes echo honest
+                # gradients (cycled, so any b < n works)
+                byz = jnp.tile(honest, ((b + h - 1) // h, 1))[:b]
+            byz = jnp.broadcast_to(byz, (b, honest.shape[1])).astype(honest.dtype)
+            matrix = jnp.concatenate([honest, byz], axis=0)
+        else:
+            matrix = honest
+        if feat_spec is not None:
+            # Gradient transpose: node-sharded rows -> feature-sharded
+            # columns (XLA lowers this constraint to an all_to_all over ICI),
+            # so the robust aggregation below is chip-local per coordinate.
+            matrix = jax.lax.with_sharding_constraint(matrix, feat_spec)
+        if pre_aggregate is not None:
+            matrix = pre_aggregate(matrix)
+        agg_flat = aggregate(matrix).astype(param_dtype)
+        update = unravel(agg_flat)
+        updates, opt_state = opt.update(update, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "honest_loss": jnp.mean(losses[:h]),
+            "agg_grad_norm": jnp.linalg.norm(agg_flat),
+        }
+        return params, opt_state, metrics
+
+    return train_step, opt_state0
+
+
+def jit_ps_train_step(
+    bundle: ModelBundle,
+    aggregate: AggFn,
+    cfg: PSStepConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+    **kwargs: Any,
+) -> Tuple[Callable, Any]:
+    """``build_ps_train_step`` + ``jax.jit`` with params/opt-state donation
+    (in-place HBM update, the TPU idiom for training loops)."""
+    step, opt_state0 = build_ps_train_step(
+        bundle, aggregate, cfg, mesh=mesh, **kwargs
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums), opt_state0
+
+
+__all__ = [
+    "PSStepConfig",
+    "default_optimizer",
+    "build_ps_train_step",
+    "jit_ps_train_step",
+]

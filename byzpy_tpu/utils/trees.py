@@ -66,3 +66,19 @@ def unstack_rows(matrix: jnp.ndarray, unravel: Callable[[jnp.ndarray], Any]) -> 
 def tree_size(tree: Any) -> int:
     """Total number of elements across all leaves of a pytree."""
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+
+
+def ravel_pytree_fn(
+    example: Any,
+) -> Tuple[Callable[[Any], jnp.ndarray], Callable[[jnp.ndarray], Any]]:
+    """``(ravel, unravel)`` closures for pytrees shaped like ``example``.
+
+    Both are trace-safe, so jitted training steps can flatten per-node
+    gradient trees into rows of the aggregation matrix and back.
+    """
+    _, unravel = ravel_pytree(example)
+
+    def ravel(tree: Any) -> jnp.ndarray:
+        return ravel_pytree(tree)[0]
+
+    return ravel, unravel
