@@ -1,0 +1,72 @@
+"""Headline benchmark: robust aggregation throughput at 1M-dim on TPU.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline value: Multi-Krum grads/sec on a 64 x 1,048,576 gradient matrix
+(the BASELINE.json north-star config: "robust-agg grads/sec (Krum,
+CW-Median) at 1M-dim").
+
+``vs_baseline``: geometric-mean speedup over the reference's best published
+ActorPool latencies on the two matched workloads it does publish
+(Multi-Krum 80x65,536 f=20 q=12 -> 26.30 ms; CW-Median 64x65,536 ->
+37 ms; BASELINE.md / reference benchmarks/README.md:16-17).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from byzpy_tpu.ops import robust
+
+
+def timed(fn, *args, warmup: int = 2, repeat: int = 10) -> float:
+    """Median wall seconds per call, post-compilation, device-synchronized."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def grads(key, n, d, dtype=jnp.float32):
+    return jax.random.normal(key, (n, d), dtype)
+
+
+def main() -> None:
+    key = jax.random.PRNGKey(0)
+
+    # Headline: Krum at 1M-dim (north-star config).
+    x_1m = grads(key, 64, 1_048_576)
+    krum_1m = jax.jit(partial(robust.multi_krum, f=8, q=12))
+    t_krum_1m = timed(krum_1m, x_1m)
+    value = 64 / t_krum_1m  # gradients aggregated per second
+
+    # Matched reference workloads for vs_baseline.
+    x_krum = grads(key, 80, 65_536)
+    t_krum = timed(jax.jit(partial(robust.multi_krum, f=20, q=12)), x_krum)
+    x_med = grads(key, 64, 65_536)
+    t_med = timed(jax.jit(robust.coordinate_median), x_med)
+
+    ref_best = {"krum": 26.30e-3, "median": 37e-3}  # BASELINE.md best-pool
+    speedup = ((ref_best["krum"] / t_krum) * (ref_best["median"] / t_med)) ** 0.5
+
+    print(json.dumps({
+        "metric": "multi_krum_64x1M_grads_per_sec",
+        "value": round(value, 2),
+        "unit": "grads/sec",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

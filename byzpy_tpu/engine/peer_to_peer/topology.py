@@ -75,17 +75,14 @@ class Topology:
                 return k
         return None
 
-    def in_neighbor_matrix(self, *, include_self: bool = True) -> np.ndarray:
-        """``(n, k_max)`` int32 matrix of in-neighbor indices, short rows
-        padded by repeating the row's first entry (duplicates are harmless
-        for the mean/median-style aggregations applied over the row).
+    def in_neighbor_lists(self, *, include_self: bool = True) -> List[List[int]]:
+        """Per-node in-neighbor index lists (self prepended by default).
 
         With ``include_self=False`` every node must have at least one
         in-neighbor — there is no value that could pad an empty row without
         silently re-including the excluded self.
         """
         rows = []
-        k_max = 0
         for i in range(self.n_nodes):
             nb = ([i] if include_self else []) + self.in_neighbors(i)
             if not nb:
@@ -94,11 +91,43 @@ class Topology:
                     "every node needs at least one"
                 )
             rows.append(nb)
-            k_max = max(k_max, len(nb))
-        mat = np.zeros((self.n_nodes, k_max), dtype=np.int32)
+        return rows
+
+    def in_neighbor_matrix(self, *, include_self: bool = True) -> np.ndarray:
+        """``(n, k)`` int32 matrix of in-neighbor indices. Only valid for
+        **regular** topologies (every node has the same in-degree) — padding
+        short rows would skew the weights of whatever aggregation is applied
+        over the row. For irregular topologies use
+        :meth:`in_neighbor_groups`, which the SPMD gossip step consumes.
+        """
+        rows = self.in_neighbor_lists(include_self=include_self)
+        degs = {len(nb) for nb in rows}
+        if len(degs) > 1:
+            raise ValueError(
+                f"topology is irregular (in-degrees {sorted(degs)}); use "
+                "in_neighbor_groups() instead of a padded matrix"
+            )
+        return np.asarray(rows, dtype=np.int32)
+
+    def in_neighbor_groups(
+        self, *, include_self: bool = True
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Group nodes by in-degree: list of ``(node_idx (g,), neighbors
+        (g, k))`` int32 pairs, one per distinct in-degree ``k``. Each group
+        has a static neighbor count, so a jitted program can vmap an
+        aggregator over every group without padding (a regular topology
+        yields exactly one group)."""
+        rows = self.in_neighbor_lists(include_self=include_self)
+        by_deg: Dict[int, List[int]] = {}
         for i, nb in enumerate(rows):
-            mat[i] = nb + [nb[0]] * (k_max - len(nb))
-        return mat
+            by_deg.setdefault(len(nb), []).append(i)
+        return [
+            (
+                np.asarray(idxs, dtype=np.int32),
+                np.asarray([rows[i] for i in idxs], dtype=np.int32),
+            )
+            for _, idxs in sorted(by_deg.items())
+        ]
 
     def in_mask(self, *, include_self: bool = True) -> np.ndarray:
         """``(n, n)`` float32 mask: ``m[i, j] = 1`` if node i receives from j."""

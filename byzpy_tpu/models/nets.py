@@ -130,6 +130,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     small_input: bool = True  # CIFAR-style stem
     dtype: Dtype = jnp.float32
+    norm: Callable = nn.GroupNorm
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -141,13 +142,13 @@ class ResNet(nn.Module):
             x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
                         use_bias=False, dtype=self.dtype)(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        x = nn.GroupNorm(dtype=self.dtype)(x)
+        x = self.norm(dtype=self.dtype)(x)
         x = nn.relu(x)
         for i, size in enumerate(self.stage_sizes):
             for j in range(size):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = self.block_cls(self.num_filters * 2 ** i, strides=strides,
-                                   dtype=self.dtype)(x)
+                                   dtype=self.dtype, norm=self.norm)(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)
 

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..engine.peer_to_peer.topology import Topology
 from ..models.bundle import ModelBundle
 from ..utils.trees import ravel_pytree_fn
+from .mesh import node_axis, sharding as mesh_sharding
 
 AggFn = Callable[[jnp.ndarray], jnp.ndarray]
 AttackFn = Callable[[jnp.ndarray, jax.Array], jnp.ndarray]
@@ -83,12 +84,18 @@ def build_gossip_train_step(
     n = cfg.n_nodes
     lr = cfg.learning_rate
 
-    neighbors = jnp.asarray(topology.in_neighbor_matrix(include_self=True))
+    # Nodes grouped by in-degree: each group's neighborhood has a static
+    # width, so every node aggregates over exactly its true neighbors (no
+    # padding that would skew aggregation weights on irregular topologies).
+    # Regular topologies (ring/complete) collapse to a single group.
+    neighbor_groups = [
+        (jnp.asarray(idxs), jnp.asarray(nbrs))
+        for idxs, nbrs in topology.in_neighbor_groups(include_self=True)
+    ]
 
     node_sharding = None
     if mesh is not None:
-        axis = "nodes" if "nodes" in mesh.axis_names else mesh.axis_names[0]
-        node_sharding = NamedSharding(mesh, P(axis))
+        node_sharding = mesh_sharding(mesh, node_axis(mesh))
 
     def init_stacked_params() -> jnp.ndarray:
         flat = ravel(bundle.params)
@@ -120,9 +127,13 @@ def build_gossip_train_step(
         else:
             broadcast = theta_half
         # 3+4. each node robust-aggregates its in-neighborhood (self included
-        #    via the self index in `neighbors`). `broadcast` is logically
-        #    all-gathered; XLA materializes it from the static gather below.
-        theta_new = jax.vmap(lambda nbr_idx: aggregate(broadcast[nbr_idx]))(neighbors)
+        #    via the self index in each group's neighbor rows). `broadcast`
+        #    is logically all-gathered; XLA materializes it from the static
+        #    gathers below, one vmap per in-degree group.
+        theta_new = theta_half
+        for idxs, nbrs in neighbor_groups:
+            rows = jax.vmap(lambda nbr_idx: aggregate(broadcast[nbr_idx]))(nbrs)
+            theta_new = theta_new.at[idxs].set(rows.astype(theta_new.dtype))
         # byzantine nodes keep their own half-step state
         if b:
             keep = jnp.arange(n)[:, None] >= h
@@ -144,7 +155,6 @@ def ring_exchange(x: jnp.ndarray, k: int, *, axis_name: str) -> jnp.ndarray:
     Traffic: O(k·d) per link per round, all rides the ring on ICI; compare
     the reference's per-edge TCP pickles (ref: ``context.py:928-978``).
     """
-    idx = jax.lax.axis_index(axis_name)
     n = jax.lax.psum(1, axis_name)
     received = []
     for step in range(1, k + 1):
@@ -170,7 +180,7 @@ def build_ring_gossip_train_step(
     with a sign-flip of its own half-step when ``attack`` is None, else
     ``attack(own_half[None, :], key)``.
     """
-    axis = "nodes" if "nodes" in mesh.axis_names else mesh.axis_names[0]
+    axis = node_axis(mesh)
     n = cfg.n_nodes
     if mesh.shape[axis] != n:
         raise ValueError(f"mesh axis {axis!r} must have size {n}")

@@ -73,6 +73,12 @@ def grid_mesh(n_nodes: int, data_per_node: int = 1, *, devices=None) -> Mesh:
     return make_mesh([n_nodes, data_per_node], ("nodes", "data"), devices=devices)
 
 
+def node_axis(mesh: Mesh) -> str:
+    """The mesh axis training nodes shard over: ``"nodes"`` when present,
+    else the first axis."""
+    return "nodes" if "nodes" in mesh.axis_names else mesh.axis_names[0]
+
+
 def sharding(mesh: Mesh, *spec: str | None | Tuple[str, ...]) -> NamedSharding:
     """Shorthand: ``sharding(mesh, "nodes", None)`` ==
     ``NamedSharding(mesh, PartitionSpec("nodes", None))``."""
@@ -88,6 +94,7 @@ __all__ = [
     "node_mesh",
     "feature_mesh",
     "grid_mesh",
+    "node_axis",
     "sharding",
     "replicated",
     "Mesh",

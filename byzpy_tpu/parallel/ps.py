@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.bundle import ModelBundle
 from ..utils.trees import ravel_pytree_fn
+from .mesh import node_axis
 
 AggFn = Callable[[jnp.ndarray], jnp.ndarray]          # (n, d) -> (d,)
 PreAggFn = Callable[[jnp.ndarray], jnp.ndarray]       # (n, d) -> (m, d)
@@ -96,7 +97,7 @@ def build_ps_train_step(
     node_spec = None
     feat_spec = None
     if mesh is not None:
-        axis = "nodes" if "nodes" in mesh.axis_names else mesh.axis_names[0]
+        axis = node_axis(mesh)
         node_spec = NamedSharding(mesh, P(axis))
         feat_spec = NamedSharding(mesh, P(None, axis))
 

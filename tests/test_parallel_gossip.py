@@ -27,6 +27,18 @@ def setup():
     return bundle, xs, ys
 
 
+def _half_steps(bundle, theta0, xs, ys, lr):
+    """Recompute every node's local SGD half-step by hand (numpy oracle)."""
+    from byzpy_tpu.utils.trees import ravel_pytree_fn
+
+    ravel, unravel = ravel_pytree_fn(bundle.params)
+    halves = []
+    for i in range(theta0.shape[0]):
+        g = jax.grad(bundle.loss_fn)(unravel(np.asarray(theta0[i])), xs[i], ys[i])
+        halves.append(np.asarray(theta0[i]) - lr * np.asarray(ravel(g)))
+    return np.stack(halves)
+
+
 def test_topology_factories():
     t = Topology.ring(5, 1)
     assert t.out_neighbors(0) == [1]
@@ -38,6 +50,39 @@ def test_topology_factories():
     m = t.in_neighbor_matrix()
     assert m.shape == (5, 2)
     assert m[0].tolist() == [0, 4]
+
+
+def test_irregular_topology_neighbor_groups():
+    # node 2 has in-degree 2, everyone else in-degree 1
+    t = Topology.from_edges(4, [(0, 1), (1, 2), (2, 3), (3, 0), (0, 2)])
+    with pytest.raises(ValueError, match="irregular"):
+        t.in_neighbor_matrix()
+    groups = t.in_neighbor_groups(include_self=True)
+    assert [g[1].shape[1] for g in groups] == [2, 3]
+    flat = sorted(i for idxs, _ in groups for i in idxs.tolist())
+    assert flat == [0, 1, 2, 3]
+    (idx2, nb2) = next(g for g in groups if 2 in g[0].tolist())
+    assert nb2[idx2.tolist().index(2)].tolist() == [2, 0, 1]
+
+
+def test_gossip_irregular_topology_exact_neighbor_mean(setup):
+    # On an irregular topology with aggregate=mean, each node's new state
+    # must be the exact mean of {self} ∪ in-neighbors — no padding skew.
+    bundle, xs, ys = setup
+    topo = Topology.from_edges(
+        N, [(i, (i + 1) % N) for i in range(N)] + [(0, 2)]
+    )
+    cfg = GossipStepConfig(n_nodes=N, n_byzantine=0, learning_rate=0.05)
+    step, init = build_gossip_train_step(
+        bundle, lambda m: jnp.mean(m, axis=0), topo, cfg
+    )
+    theta = init()
+    theta1, _ = jax.jit(step)(theta, xs, ys, jax.random.PRNGKey(0))
+    halves = _half_steps(bundle, theta, xs, ys, cfg.learning_rate)
+    for i in range(N):
+        nbrs = [i] + topo.in_neighbors(i)
+        want = np.mean([halves[j] for j in nbrs], axis=0)
+        np.testing.assert_allclose(np.asarray(theta1[i]), want, rtol=1e-4, atol=1e-5)
 
 
 def test_ring_exchange_collects_neighbors():
@@ -76,14 +121,7 @@ def test_gossip_round_no_byzantine_matches_neighbor_mean(setup):
     assert np.isfinite(float(metrics["honest_loss"]))
     # recompute the half-steps by hand and check each new row equals
     # mean(own half-step, in-neighbor half-step) for ring(N, 1)
-    from byzpy_tpu.utils.trees import ravel_pytree_fn
-
-    ravel, unravel = ravel_pytree_fn(bundle.params)
-    halves = []
-    for i in range(N):
-        g = jax.grad(bundle.loss_fn)(unravel(np.asarray(theta0[i])), xs[i], ys[i])
-        halves.append(np.asarray(theta0[i]) - 0.05 * np.asarray(ravel(g)))
-    halves = np.stack(halves)
+    halves = _half_steps(bundle, theta0, xs, ys, 0.05)
     for i in range(N):
         want = (halves[i] + halves[(i - 1) % N]) / 2.0
         np.testing.assert_allclose(np.asarray(theta1[i]), want, rtol=1e-4, atol=1e-5)
