@@ -1,0 +1,18 @@
+from .base import ByzantineNode, HonestNode, Node
+from .cluster import DecentralizedCluster
+from .context import InProcessContext, NodeContext
+from .decentralized import DecentralizedNode
+from .process_context import ProcessContext
+from .router import MessageRouter
+
+__all__ = [
+    "Node",
+    "HonestNode",
+    "ByzantineNode",
+    "NodeContext",
+    "InProcessContext",
+    "ProcessContext",
+    "DecentralizedNode",
+    "DecentralizedCluster",
+    "MessageRouter",
+]

@@ -1,0 +1,56 @@
+"""Training-node ABCs (API parity: ``byzpy/engine/node/base.py:1-39``).
+
+A node owns its data shard and local state. JAX-native conventions:
+
+* gradients are flat ``jnp.ndarray`` vectors (or pytrees a caller stacks
+  with :func:`byzpy_tpu.utils.trees.stack_gradients`) — the shapes the
+  robust-aggregation data plane consumes directly;
+* a node's compute should be jit-compiled by the implementation; the ABCs
+  are host-side orchestration surface only.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Sequence, Tuple
+
+
+class Node(abc.ABC):
+    """Common surface: batch supply + applying the aggregated update."""
+
+    @abc.abstractmethod
+    def next_batch(self) -> Tuple[Any, Any]:
+        """Return the next ``(x, y)`` local batch."""
+
+    @abc.abstractmethod
+    def apply_server_gradient(self, gradient: Any) -> None:
+        """Apply the aggregated gradient to local model state."""
+
+
+class HonestNode(Node):
+    """A node that computes true gradients on its own shard."""
+
+    @abc.abstractmethod
+    def honest_gradient(self, x: Any, y: Any) -> Any:
+        """Gradient of the local loss at the current parameters."""
+
+    def honest_gradient_for_next_batch(self) -> Any:
+        x, y = self.next_batch()
+        return self.honest_gradient(x, y)
+
+
+class ByzantineNode(Node):
+    """A node that emits adversarial vectors, possibly informed by the
+    honest gradients it can observe (omniscient-adversary model)."""
+
+    @abc.abstractmethod
+    def byzantine_gradient(self, honest_gradients: Sequence[Any]) -> Any:
+        """Malicious vector, shaped like an honest gradient."""
+
+    def byzantine_gradient_for_next_batch(
+        self, honest_gradients: Sequence[Any]
+    ) -> Any:
+        return self.byzantine_gradient(honest_gradients)
+
+
+__all__ = ["Node", "HonestNode", "ByzantineNode"]

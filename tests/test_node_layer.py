@@ -1,0 +1,177 @@
+"""Node layer: DecentralizedNode + contexts + router + cluster.
+
+Mirrors the reference's in-process cluster test strategy
+(ref: ``byzpy/engine/node/tests/test_topology_integration.py``).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.engine.graph.graph import ComputationGraph, GraphInput, GraphNode
+from byzpy_tpu.engine.graph.ops import CallableOp
+from byzpy_tpu.engine.node import (
+    DecentralizedCluster,
+    DecentralizedNode,
+    InProcessContext,
+    ProcessContext,
+)
+from byzpy_tpu.engine.peer_to_peer import Topology
+
+
+@pytest.fixture(autouse=True)
+def _clear_registries():
+    InProcessContext.clear_registry()
+    ProcessContext.clear_registry()
+    yield
+    InProcessContext.clear_registry()
+    ProcessContext.clear_registry()
+
+
+def _make_cluster(n, topology=None):
+    topo = topology or Topology.complete(n)
+    cluster = DecentralizedCluster(topo)
+    for i in range(n):
+        nid = f"node-{i}"
+        cluster.add_node(DecentralizedNode(nid, InProcessContext(nid)))
+    return cluster
+
+
+def test_cluster_broadcast_and_handlers():
+    async def run():
+        cluster = _make_cluster(4)
+        received = {f"node-{i}": [] for i in range(4)}
+
+        async with cluster:
+            for nid, node in cluster.nodes.items():
+                async def handler(msg, nid=nid):
+                    received[nid].append((msg.sender, msg.payload))
+                node.register_handler("gossip", handler)
+
+            await cluster.node("node-0").broadcast_message("gossip", 42)
+            await asyncio.sleep(0.05)
+
+        for i in range(1, 4):
+            assert received[f"node-{i}"] == [("node-0", 42)]
+        assert received["node-0"] == []  # no self-loop in complete topology
+
+    asyncio.run(run())
+
+
+def test_ring_topology_restricts_direct_sends():
+    async def run():
+        topo = Topology.ring(4, 1)
+        cluster = _make_cluster(4, topo)
+        async with cluster:
+            n0 = cluster.node("node-0")
+            await n0.send_message("node-1", "ping", "hi")  # edge exists
+            with pytest.raises(ValueError, match="forbids"):
+                await n0.send_message("node-2", "ping", "hi")  # no edge
+            # replies skip the topology check
+            await cluster.node("node-1").reply_message("node-0", "pong", "yo")
+            msg = await n0.wait_for_message("pong", timeout=2)
+            assert msg.payload == "yo"
+
+    asyncio.run(run())
+
+
+def test_wait_for_message_and_pipeline():
+    async def run():
+        topo = Topology.complete(2)
+        cluster = _make_cluster(2, topo)
+        async with cluster:
+            a, b = cluster.node("node-0"), cluster.node("node-1")
+            graph = ComputationGraph(
+                nodes=[
+                    GraphNode(
+                        name="double",
+                        op=CallableOp(lambda v: v * 2),
+                        inputs={"v": GraphInput("v")},
+                    )
+                ]
+            )
+            a.register_pipeline("double", graph)
+            out = await a.execute_pipeline("double", {"v": 21})
+            assert out["double"] == 42
+
+            # message triggers across nodes
+            waiter = asyncio.ensure_future(a.wait_for_message("grad", timeout=2))
+            await b.send_message("node-0", "grad", np.ones(3))
+            msg = await waiter
+            assert msg.sender == "node-1"
+            np.testing.assert_array_equal(msg.payload, np.ones(3))
+
+    asyncio.run(run())
+
+
+def test_autonomous_task_and_shutdown():
+    async def run():
+        cluster = _make_cluster(2)
+        ticks = []
+
+        async def autonomous(node):
+            while True:
+                ticks.append(node.node_id)
+                await asyncio.sleep(0.01)
+
+        async with cluster:
+            cluster.node("node-0").start_autonomous_task(autonomous)
+            await asyncio.sleep(0.05)
+        assert len(ticks) >= 2  # ran, then got cancelled by shutdown
+
+    asyncio.run(run())
+
+
+def test_unknown_pipeline_raises():
+    async def run():
+        cluster = _make_cluster(2)
+        async with cluster:
+            with pytest.raises(KeyError, match="no pipeline"):
+                await cluster.node("node-0").execute_pipeline("nope")
+
+    asyncio.run(run())
+
+
+def _configure_child(node):
+    """Picklable child-node config: a pipeline + an echo handler."""
+    from byzpy_tpu.engine.graph.graph import ComputationGraph, GraphInput, GraphNode
+    from byzpy_tpu.engine.graph.ops import CallableOp
+
+    graph = ComputationGraph(
+        nodes=[
+            GraphNode(
+                name="square",
+                op=CallableOp(lambda v: v * v),
+                inputs={"v": GraphInput("v")},
+            )
+        ]
+    )
+    node.register_pipeline("square", graph)
+
+    async def echo(msg):
+        await node.reply_message(msg.sender, "echo", msg.payload)
+
+    node.register_handler("ping", echo)
+
+
+@pytest.mark.slow
+def test_process_context_pipeline_and_messaging():
+    async def run():
+        topo = Topology.complete(2)
+        cluster = DecentralizedCluster(topo)
+        parent = DecentralizedNode("parent", InProcessContext("parent"))
+        child = DecentralizedNode(
+            "child", ProcessContext("child", _configure_child)
+        )
+        cluster.add_node(parent)
+        cluster.add_node(child)
+        async with cluster:
+            out = await child.execute_pipeline("square", {"v": 7})
+            assert out["square"] == 49
+            await parent.send_message("child", "ping", 123)
+            msg = await parent.wait_for_message("echo", timeout=10)
+            assert msg.payload == 123
+            assert msg.sender == "child"
+
+    asyncio.run(run())
