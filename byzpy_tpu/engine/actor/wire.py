@@ -31,16 +31,35 @@ def decode(body: bytes) -> Any:
 def host_view(obj: Any) -> Any:
     """Convert any jax.Arrays in a payload pytree to numpy before it crosses
     a process or network boundary (device buffers don't pickle portably and
-    must never transit the control plane anyway)."""
+    must never transit the control plane anyway). Dataclass envelopes
+    (e.g. ``Message``) are rebuilt field-by-field — they are not registered
+    pytrees, so a plain ``tree_map`` would pass their device arrays through
+    untouched."""
+    import dataclasses
+
     import jax
     import numpy as np
 
+    def _is_dc(x: Any) -> bool:
+        return dataclasses.is_dataclass(x) and not isinstance(x, type)
+
+    if _is_dc(obj):
+        return dataclasses.replace(
+            obj,
+            **{
+                f.name: host_view(getattr(obj, f.name))
+                for f in dataclasses.fields(obj)
+            },
+        )
+
     def conv(leaf: Any) -> Any:
+        if _is_dc(leaf):
+            return host_view(leaf)
         if isinstance(leaf, jax.Array):
             return np.asarray(leaf)
         return leaf
 
-    return jax.tree_util.tree_map(conv, obj)
+    return jax.tree_util.tree_map(conv, obj, is_leaf=_is_dc)
 
 
 async def send_obj(writer: asyncio.StreamWriter, obj: Any) -> None:

@@ -11,8 +11,10 @@ decentralized nodes trigger pipelines off gossip traffic.
 from __future__ import annotations
 
 import asyncio
+import logging
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, Dict, List, Mapping, Optional
+from typing import Any, Deque, Dict, List, Mapping, Optional
 
 from .graph import ComputationGraph, GraphInput
 from .operator import OpContext
@@ -90,7 +92,13 @@ class NodeScheduler:
 
 
 class MessageAwareNodeScheduler(NodeScheduler):
-    """NodeScheduler + inbox with waiter futures and a type-keyed cache."""
+    """NodeScheduler + inbox with waiter futures and a type-keyed cache.
+
+    The cache is bounded per message type (``max_cached_per_type``): a node
+    that consumes some traffic only through handlers would otherwise
+    accumulate every delivered message forever. On overflow the oldest
+    message of that type is dropped (and logged at debug level).
+    """
 
     def __init__(
         self,
@@ -98,10 +106,12 @@ class MessageAwareNodeScheduler(NodeScheduler):
         *,
         pool: Optional[ActorPool] = None,
         metadata: Optional[Mapping[str, Any]] = None,
+        max_cached_per_type: int = 1024,
     ) -> None:
         super().__init__(graph, pool=pool, metadata=metadata)
-        self._cached: Dict[str, List[Any]] = {}
+        self._cached: Dict[str, Deque[Any]] = {}
         self._waiters: Dict[str, List[asyncio.Future]] = {}
+        self._max_cached = max(1, int(max_cached_per_type))
 
     def swap_graph(self, graph: ComputationGraph) -> None:
         """Replace the scheduled graph (decentralized nodes swap per-pipeline
@@ -117,14 +127,22 @@ class MessageAwareNodeScheduler(NodeScheduler):
             if not fut.done():
                 fut.set_result(message)
                 return
-        self._cached.setdefault(message_type, []).append(message)
+        cache = self._cached.setdefault(
+            message_type, deque(maxlen=self._max_cached)
+        )
+        if len(cache) == self._max_cached:
+            logging.getLogger(__name__).debug(
+                "message cache for %r full (%d); dropping oldest",
+                message_type, self._max_cached,
+            )
+        cache.append(message)
 
     async def wait_for_message(
         self, message_type: str, *, timeout: Optional[float] = None
     ) -> Any:
         cached = self._cached.get(message_type)
         if cached:
-            return cached.pop(0)
+            return cached.popleft()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._waiters.setdefault(message_type, []).append(fut)
         if timeout is None:

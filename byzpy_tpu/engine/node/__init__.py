@@ -1,3 +1,4 @@
+from .actors import ByzantineNodeActor, HonestNodeActor, NodeActor
 from .base import ByzantineNode, HonestNode, Node
 from .cluster import DecentralizedCluster
 from .context import InProcessContext, NodeContext
@@ -9,6 +10,9 @@ __all__ = [
     "Node",
     "HonestNode",
     "ByzantineNode",
+    "NodeActor",
+    "HonestNodeActor",
+    "ByzantineNodeActor",
     "NodeContext",
     "InProcessContext",
     "ProcessContext",

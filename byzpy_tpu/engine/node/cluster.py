@@ -3,7 +3,7 @@ topology (parity: ``byzpy/engine/node/cluster.py:12-108``)."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..peer_to_peer.topology import Topology
 from .decentralized import DecentralizedNode
@@ -47,8 +47,19 @@ class DecentralizedCluster:
         ids = self.node_ids_map()
         for node in self._nodes.values():
             node.bind_topology(self.topology, ids)
-        for node in self._nodes.values():
-            await node.start()
+        started: List[DecentralizedNode] = []
+        try:
+            for node in self._nodes.values():
+                await node.start()
+                started.append(node)
+        except BaseException:
+            # partial start must not leak registry entries / child processes
+            for node in reversed(started):
+                try:
+                    await node.shutdown()
+                except Exception:  # noqa: BLE001 — best-effort rollback
+                    pass
+            raise
 
     async def shutdown_all(self) -> None:
         for node_id in reversed(self._order):

@@ -30,11 +30,8 @@ logger = logging.getLogger(__name__)
 
 Handler = Callable[[Message], Awaitable[None]]
 
-# placeholder graph so the scheduler exists before any pipeline runs
-_EMPTY_GRAPH = None
-
-
 def _empty_graph() -> ComputationGraph:
+    """Placeholder graph so the scheduler exists before any pipeline runs."""
     from ..graph.ops import CallableOp
     from ..graph.graph import GraphNode
 

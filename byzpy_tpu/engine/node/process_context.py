@@ -67,35 +67,61 @@ async def _child_async(node_id, blob, inbox_q, outbox_q, cmd_q, result_q) -> Non
         configure(node)
     await node.start()
 
+    import logging
+    import queue as _queue
+
+    log = logging.getLogger(__name__)
+
+    async def _run_pipeline(req_id: str, name: str, inputs) -> None:
+        try:
+            result = await node.execute_pipeline(name, inputs)
+            result_q.put((req_id, "ok", host_view(result)))
+        except Exception as exc:  # noqa: BLE001 — report to parent
+            result_q.put((req_id, "error", repr(exc)))
+
+    pipeline_tasks: list[asyncio.Task] = []
     running = True
     while running:
         progressed = False
         try:
             msg = inbox_q.get_nowait()
-        except Exception:
+        except _queue.Empty:
             msg = None
+        except Exception:  # noqa: BLE001 — a frame that fails to unpickle
+            log.exception("node %s: dropping undecodable inbox frame", node_id)
+            msg = None
+            progressed = True
         if msg is not None:
             progressed = True
             await node.handle_incoming_message(msg)
         try:
             cmd = cmd_q.get_nowait()
             progressed = True
-        except Exception:
+        except _queue.Empty:
             cmd = None
         if cmd is not None:
             if cmd[0] == "stop":
                 running = False
             elif cmd[0] == "execute_pipeline":
                 _, req_id, name, inputs = cmd
-                try:
-                    result = await node.execute_pipeline(name, inputs)
-                    result_q.put((req_id, "ok", host_view(result)))
-                except Exception as exc:  # noqa: BLE001 — report to parent
-                    result_q.put((req_id, "error", repr(exc)))
+                # run as a background task so the inbox keeps draining —
+                # pipelines may block on wait_for_message for traffic that
+                # still has to flow through this loop
+                pipeline_tasks.append(
+                    asyncio.ensure_future(_run_pipeline(req_id, name, inputs))
+                )
+        pipeline_tasks = [t for t in pipeline_tasks if not t.done()]
         if not progressed:
             # reference polls its queues at 1ms (ref: context.py:319-490);
             # same cadence, but non-blocking so the loop stays responsive
             await asyncio.sleep(0.001)
+    for task in pipeline_tasks:
+        task.cancel()
+    for task in pipeline_tasks:
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
     await node.shutdown()
     result_q.put((None, "stopped", None))
 
@@ -140,9 +166,9 @@ class ProcessContext(NodeContext):
         if not ProcessContext._route_registered:
             register_delivery_route(_process_route)
             ProcessContext._route_registered = True
-        router = getattr(node, "_router", None)
+        router = node._router  # may be None when no topology is bound
         topology = router.topology if router is not None else None
-        node_ids = router._idx_to_id if router is not None else None
+        node_ids = router.node_ids if router is not None else None
         blob = cloudpickle.dumps((self._configure, topology, node_ids))
         self._proc = self._ctx.Process(
             target=_child_main,
@@ -218,12 +244,21 @@ class ProcessContext(NodeContext):
                 fut.set_result(payload)
             else:
                 fut.set_exception(RuntimeError(f"pipeline failed: {payload}"))
+        # child is gone (or shutdown began): nothing will resolve what's left
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError(f"node {self.node_id!r} is no longer running")
+                )
+        self._pending.clear()
 
     async def remote_execute_pipeline(
         self, name: str, inputs: Mapping[str, Any]
     ) -> Any:
         """Proxy ``execute_pipeline`` into the child (DecentralizedNode
         detects this method and delegates)."""
+        if self._proc is None or not self._proc.is_alive():
+            raise ConnectionError(f"node {self.node_id!r} is not running")
         req_id = uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut

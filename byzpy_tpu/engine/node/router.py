@@ -9,7 +9,7 @@ checks (you may always answer who spoke to you).
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List
 
 from ..peer_to_peer.topology import Topology
 
@@ -42,6 +42,11 @@ class MessageRouter:
     @property
     def index(self) -> int:
         return self._id_to_idx[self.node_id]
+
+    @property
+    def node_ids(self) -> Dict[int, str]:
+        """The shared index→id addressing map (copy)."""
+        return dict(self._idx_to_id)
 
     def out_neighbor_ids(self) -> List[str]:
         return [

@@ -1,0 +1,3 @@
+from .ps import ParameterServer
+
+__all__ = ["ParameterServer"]

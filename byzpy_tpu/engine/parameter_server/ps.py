@@ -1,0 +1,160 @@
+"""Byzantine-robust parameter-server orchestrator.
+
+Behavior parity: ``byzpy/engine/parameter_server/ps.py:103-144`` — one
+round = stream honest gradients as they complete → feed them to byzantine
+nodes → optional pre-aggregation → robust aggregate (direct, or scheduled
+on an :class:`~byzpy_tpu.engine.graph.pool.ActorPool`) → fan the aggregated
+gradient out to every node's ``apply_server_gradient``.
+
+TPU framing: this is the *actor-mode* parameter server for heterogeneous
+deployments (nodes in threads / processes / remote hosts / pinned chips).
+When all nodes fit one slice, the fused SPMD round in
+``byzpy_tpu.parallel.ps`` does the same semantics inside a single jitted
+step — per-device gradient shards, byzantine mask, collective aggregate —
+with no host round-trips; this class is the general fabric around it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence
+
+from ...aggregators.base import Aggregator
+from ...pre_aggregators.base import PreAggregator
+from ..graph.executor import OperatorExecutor
+from ..graph.pool import ActorPool, ActorPoolConfig
+
+
+async def _invoke(obj: Any, method: str, *args: Any) -> Any:
+    """Call ``obj.method(*args)``, awaiting if it returns an awaitable —
+    nodes may be plain local objects (sync) or :class:`NodeActor`s (async)."""
+    fn = getattr(obj, method)
+    out = fn(*args)
+    if inspect.isawaitable(out):
+        out = await out
+    return out
+
+
+class ParameterServer:
+    """Robust-aggregation training coordinator over honest + byzantine nodes.
+
+    Parameters
+    ----------
+    honest_nodes:
+        Objects exposing ``honest_gradient_for_next_batch()`` and
+        ``apply_server_gradient(g)`` (sync or async — plain
+        :class:`~byzpy_tpu.engine.node.base.HonestNode` instances or
+        :class:`~byzpy_tpu.engine.node.actors.NodeActor` handles).
+    byzantine_nodes:
+        Objects exposing ``byzantine_gradient_for_next_batch(honest_grads)``
+        and ``apply_server_gradient(g)``.
+    aggregator:
+        The robust :class:`Aggregator`. With a pool, aggregation is
+        scheduled through the graph engine (subtask fan-out on chunked
+        aggregators); without one it runs inline as a single jitted call.
+    pre_aggregator:
+        Optional :class:`PreAggregator` applied to the gradient list first.
+    """
+
+    def __init__(
+        self,
+        honest_nodes: Sequence[Any],
+        byzantine_nodes: Sequence[Any] = (),
+        *,
+        aggregator: Aggregator,
+        pre_aggregator: Optional[PreAggregator] = None,
+        pool: Optional[ActorPool] = None,
+        pool_config: Optional[ActorPoolConfig | Sequence[ActorPoolConfig]] = None,
+    ) -> None:
+        if not honest_nodes:
+            raise ValueError("ParameterServer needs at least one honest node")
+        self.honest_nodes = list(honest_nodes)
+        self.byzantine_nodes = list(byzantine_nodes)
+        self.aggregator = aggregator
+        self.pre_aggregator = pre_aggregator
+        self._executor = (
+            OperatorExecutor(aggregator, pool=pool, pool_config=pool_config)
+            if (pool is not None or pool_config is not None)
+            else None
+        )
+        self.rounds_completed = 0
+
+    # -- round pieces (ref: ps.py:89-101) ------------------------------------
+
+    async def _stream_honest(self) -> List[Any]:
+        """Gather honest gradients as they complete; order follows
+        ``honest_nodes`` so aggregation is deterministic."""
+        tasks = [
+            asyncio.ensure_future(
+                _invoke(node, "honest_gradient_for_next_batch")
+            )
+            for node in self.honest_nodes
+        ]
+        # as-completed draining keeps slow nodes from serializing the round
+        # (ref: ps.py:89-92); results are then re-ordered by node index.
+        await asyncio.wait(tasks)
+        return [t.result() for t in tasks]
+
+    async def _stream_byzantine(self, honest_grads: List[Any]) -> List[Any]:
+        if not self.byzantine_nodes:
+            return []
+        tasks = [
+            asyncio.ensure_future(
+                _invoke(node, "byzantine_gradient_for_next_batch", honest_grads)
+            )
+            for node in self.byzantine_nodes
+        ]
+        await asyncio.wait(tasks)
+        return [t.result() for t in tasks]
+
+    async def _aggregate(self, gradients: List[Any]) -> Any:
+        if self.pre_aggregator is not None:
+            gradients = self.pre_aggregator.pre_aggregate(gradients)
+        if self._executor is not None:
+            return await self._executor.run(gradients)
+        return self.aggregator.aggregate(gradients)
+
+    # -- public API ----------------------------------------------------------
+
+    async def round(self) -> Any:
+        """One training round; returns the aggregated gradient
+        (ref: ``ps.py:103-144``)."""
+        honest = await self._stream_honest()
+        byz = await self._stream_byzantine(honest)
+        aggregated = await self._aggregate(honest + byz)
+        await asyncio.gather(
+            *(
+                _invoke(node, "apply_server_gradient", aggregated)
+                for node in self.honest_nodes + self.byzantine_nodes
+            )
+        )
+        self.rounds_completed += 1
+        return aggregated
+
+    async def run(
+        self,
+        rounds: int,
+        *,
+        on_round: Optional[Callable[[int, Any], Optional[Awaitable[None]]]] = None,
+    ) -> None:
+        """Run ``rounds`` rounds; ``on_round(i, aggregated)`` fires after each."""
+        for i in range(rounds):
+            aggregated = await self.round()
+            if on_round is not None:
+                out = on_round(i, aggregated)
+                if inspect.isawaitable(out):
+                    await out
+
+    async def close(self) -> None:
+        if self._executor is not None:
+            await self._executor.close()
+
+    async def __aenter__(self) -> "ParameterServer":
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+
+__all__ = ["ParameterServer"]
