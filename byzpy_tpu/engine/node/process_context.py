@@ -160,6 +160,15 @@ class ProcessContext(NodeContext):
     def clear_registry(cls) -> None:
         cls._registry.clear()
 
+    def set_configure(self, configure: Configure) -> None:
+        """Install (or replace) the child-side configure hook. Public
+        contract for orchestrators that must register pipelines where the
+        node state actually lives (the child process) — e.g. the P2P
+        runner. Must be called before :meth:`start`."""
+        if self._proc is not None:
+            raise RuntimeError("cannot set configure hook after start()")
+        self._configure = configure
+
     async def start(self, node) -> None:
         if self.node_id in self._registry:
             raise RuntimeError(f"node id {self.node_id!r} already registered")

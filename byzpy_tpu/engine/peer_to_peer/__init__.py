@@ -1,3 +1,21 @@
+from .nodes import (
+    AttackP2PWorker,
+    ByzantineP2PWorker,
+    FunctionP2PWorker,
+    HonestP2PWorker,
+    SGDModelWorker,
+)
+from .runner import DecentralizedPeerToPeer
 from .topology import Topology
+from .train import PeerToPeer
 
-__all__ = ["Topology"]
+__all__ = [
+    "Topology",
+    "PeerToPeer",
+    "DecentralizedPeerToPeer",
+    "HonestP2PWorker",
+    "ByzantineP2PWorker",
+    "SGDModelWorker",
+    "AttackP2PWorker",
+    "FunctionP2PWorker",
+]

@@ -1,0 +1,135 @@
+"""P2P worker behaviors: the per-node training logic the runner installs
+into :class:`~byzpy_tpu.engine.node.decentralized.DecentralizedNode`
+pipelines.
+
+Behavior parity: the reference's half-step/aggregate mixin + byzantine
+vector crafting (``byzpy/engine/peer_to_peer/runner.py:79-104``,
+``mixin.py:59-69``). A worker here is deliberately picklable (cloudpickle)
+so the same object can be shipped into a subprocess node.
+
+TPU framing: ``SGDModelWorker.half_step`` is one jitted value-and-grad +
+SGD update; parameters travel as a single flat ``(d,)`` vector — the shape
+the robust aggregators and the SPMD gossip step consume.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class HonestP2PWorker(abc.ABC):
+    """Local training logic for one honest peer."""
+
+    @abc.abstractmethod
+    def half_step(self, lr: float) -> jnp.ndarray:
+        """Take a half SGD step on local data; return the flat parameter
+        vector θ½ to gossip."""
+
+    @abc.abstractmethod
+    def parameters(self) -> jnp.ndarray:
+        """Current flat parameter vector."""
+
+    @abc.abstractmethod
+    def apply_aggregate(self, vector: Any) -> None:
+        """Replace local parameters with the robust-aggregated vector."""
+
+
+class ByzantineP2PWorker(abc.ABC):
+    """Malicious-vector crafting for one byzantine peer."""
+
+    @abc.abstractmethod
+    def malicious_vector(self, honest_vectors: List[jnp.ndarray]) -> jnp.ndarray:
+        """Craft the vector to gossip, given the honest θ½ vectors observed
+        from in-neighbors this round (possibly empty)."""
+
+
+class SGDModelWorker(HonestP2PWorker):
+    """Honest worker over a :class:`~byzpy_tpu.models.ModelBundle`.
+
+    ``batch_fn()`` supplies ``(x, y)``; the half step is a jit-compiled
+    loss-grad + SGD update on the flattened parameter vector.
+    """
+
+    def __init__(self, bundle: Any, batch_fn: Callable[[], Tuple[Any, Any]]) -> None:
+        from jax.flatten_util import ravel_pytree
+
+        self.bundle = bundle
+        self.batch_fn = batch_fn
+        flat, unravel = ravel_pytree(bundle.params)
+        self._flat = flat
+        self._unravel = unravel
+
+        def _step(flat_params, x, y, lr):
+            params = unravel(flat_params)
+            loss, grads = jax.value_and_grad(bundle.loss_fn)(params, x, y)
+            gflat, _ = ravel_pytree(grads)
+            return flat_params - lr * gflat, loss
+
+        self._jit_step = jax.jit(_step)
+        self.last_loss: Optional[float] = None
+
+    def half_step(self, lr: float) -> jnp.ndarray:
+        x, y = self.batch_fn()
+        self._flat, loss = self._jit_step(self._flat, x, y, jnp.float32(lr))
+        self.last_loss = float(loss)
+        return self._flat
+
+    def parameters(self) -> jnp.ndarray:
+        return self._flat
+
+    def apply_aggregate(self, vector: Any) -> None:
+        self._flat = jnp.asarray(vector)
+
+    @property
+    def params(self) -> Any:
+        """Parameters as the bundle's pytree structure."""
+        return self._unravel(self._flat)
+
+
+class AttackP2PWorker(ByzantineP2PWorker):
+    """Byzantine worker delegating to an :class:`~byzpy_tpu.attacks.base.
+    Attack` operator (``uses_honest_grads`` attacks consume the observed
+    vectors; others ignore them)."""
+
+    def __init__(self, attack: Any, *, dim: Optional[int] = None) -> None:
+        self.attack = attack
+        self.dim = dim
+
+    def malicious_vector(self, honest_vectors: List[jnp.ndarray]) -> jnp.ndarray:
+        if not honest_vectors:
+            if self.dim is None:
+                raise ValueError(
+                    "byzantine worker observed no honest vectors and has no "
+                    "dim fallback; give AttackP2PWorker(dim=...) or a "
+                    "topology where byzantine nodes have honest in-neighbors"
+                )
+            honest_vectors = [jnp.zeros((self.dim,), jnp.float32)]
+        kwargs: dict = {}
+        if getattr(self.attack, "uses_honest_grads", False):
+            kwargs["honest_grads"] = list(honest_vectors)
+        if getattr(self.attack, "uses_base_grad", False):
+            kwargs["base_grad"] = honest_vectors[0]
+        return self.attack.apply(**kwargs)
+
+
+class FunctionP2PWorker(ByzantineP2PWorker):
+    """Byzantine worker from a bare function ``f(honest_vectors) -> vector``."""
+
+    def __init__(self, fn: Callable[[List[jnp.ndarray]], jnp.ndarray]) -> None:
+        self.fn = fn
+
+    def malicious_vector(self, honest_vectors: List[jnp.ndarray]) -> jnp.ndarray:
+        return self.fn(honest_vectors)
+
+
+__all__ = [
+    "HonestP2PWorker",
+    "ByzantineP2PWorker",
+    "SGDModelWorker",
+    "AttackP2PWorker",
+    "FunctionP2PWorker",
+]

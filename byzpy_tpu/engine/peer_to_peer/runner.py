@@ -1,0 +1,294 @@
+"""DecentralizedPeerToPeer: gossip training over message-driven nodes.
+
+Behavior parity: ``byzpy/engine/peer_to_peer/runner.py:184-392`` — one
+round = every honest node runs its ``half_step`` pipeline → broadcasts θ½
+to out-neighbors ("gradient" messages, ref: runner.py:308-315) → byzantine
+nodes craft malicious vectors from the honest vectors they observed and
+broadcast them (runner.py:316-368) → every honest node runs ``aggregate``
+over its own θ½ + everything received (runner.py:374-388).
+
+The per-node logic is installed as DecentralizedNode pipelines by a
+``configure`` function that works identically in-process and inside a
+subprocess child (the reference ships node objects with module registries,
+runner.py:48-49; here the worker object itself is cloudpickled).
+
+TPU framing: this runtime is the general fabric for heterogeneous /
+multi-host deployments. When every peer lives on one slice, the fused
+SPMD round in ``byzpy_tpu.parallel.gossip`` runs the same semantics as one
+jitted step with ``ppermute``/gather collectives — prefer it for pure-TPU
+topologies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from functools import partial
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ...aggregators.base import Aggregator
+from ..graph.graph import ComputationGraph, GraphInput, GraphNode
+from ..graph.ops import CallableOp
+from ..node.context import InProcessContext, NodeContext
+from ..node.decentralized import DecentralizedNode
+
+if TYPE_CHECKING:  # pragma: no cover — avoids node.cluster -> topology cycle
+    from ..node.cluster import DecentralizedCluster
+from .nodes import ByzantineP2PWorker, HonestP2PWorker
+from .topology import Topology
+
+GOSSIP_TYPE = "gradient"  # message type name matches the reference handler
+
+
+def _configure_honest(
+    node: DecentralizedNode,
+    worker: HonestP2PWorker,
+    aggregator: Aggregator,
+    timeout: Optional[float],
+) -> None:
+    """Install half_step/aggregate pipelines on an honest node."""
+
+    def half_step(lr):
+        return worker.half_step(float(lr))
+
+    async def aggregate(expected):
+        received = []
+        for _ in range(int(expected)):
+            msg = await node.wait_for_message(GOSSIP_TYPE, timeout=timeout)
+            received.append(jnp.asarray(msg.payload))
+        vectors = [worker.parameters()] + received
+        result = aggregator.aggregate(vectors)
+        worker.apply_aggregate(result)
+        return result
+
+    node.register_pipeline(
+        "half_step",
+        ComputationGraph([
+            GraphNode(name="half_step", op=CallableOp(half_step),
+                      inputs={"lr": GraphInput("lr")})
+        ]),
+    )
+    node.register_pipeline(
+        "aggregate",
+        ComputationGraph([
+            GraphNode(name="aggregate", op=CallableOp(aggregate),
+                      inputs={"expected": GraphInput("expected")})
+        ]),
+    )
+
+
+def _configure_byzantine(
+    node: DecentralizedNode,
+    worker: ByzantineP2PWorker,
+    honest_ids: Sequence[str],
+    timeout: Optional[float],
+) -> None:
+    """Install the attack pipeline on a byzantine node. It waits for
+    ``expected`` *honest* vectors; frames from other byzantine peers
+    (including stale ones from earlier rounds) are consumed and discarded."""
+    honest_set = set(honest_ids)
+
+    async def attack(expected):
+        honest: List[jnp.ndarray] = []
+        while len(honest) < int(expected):
+            msg = await node.wait_for_message(GOSSIP_TYPE, timeout=timeout)
+            if msg.sender in honest_set:
+                honest.append(jnp.asarray(msg.payload))
+        return worker.malicious_vector(honest)
+
+    node.register_pipeline(
+        "attack",
+        ComputationGraph([
+            GraphNode(name="attack", op=CallableOp(attack),
+                      inputs={"expected": GraphInput("expected")})
+        ]),
+    )
+
+
+class DecentralizedPeerToPeer:
+    """Byzantine-robust gossip training over a cluster of message-driven
+    nodes (any :class:`NodeContext` mix).
+
+    Node ids are ``node-<topology index>``; by default byzantine workers
+    occupy the last indices.
+    """
+
+    def __init__(
+        self,
+        honest_workers: Sequence[HonestP2PWorker],
+        byzantine_workers: Sequence[ByzantineP2PWorker],
+        *,
+        aggregator: Aggregator,
+        topology: Topology,
+        learning_rate: float = 0.1,
+        context_factory: Optional[Callable[[str], NodeContext]] = None,
+        byzantine_indices: Optional[Sequence[int]] = None,
+        gossip_timeout: Optional[float] = 30.0,
+    ) -> None:
+        n = topology.n_nodes
+        if len(honest_workers) + len(byzantine_workers) != n:
+            raise ValueError(
+                f"{len(honest_workers)}+{len(byzantine_workers)} workers for "
+                f"a {n}-node topology"
+            )
+        self.topology = topology
+        self.learning_rate = learning_rate
+        self._timeout = gossip_timeout
+        if byzantine_indices is None:
+            byzantine_indices = range(n - len(byzantine_workers), n)
+        self.byzantine_indices = sorted(int(i) for i in byzantine_indices)
+        if len(self.byzantine_indices) != len(byzantine_workers):
+            raise ValueError("byzantine_indices must match byzantine_workers")
+        self.honest_indices = [
+            i for i in range(n) if i not in set(self.byzantine_indices)
+        ]
+        if len(self.honest_indices) != len(honest_workers):
+            raise ValueError("honest worker count does not fill the topology")
+
+        self._workers: Dict[int, Any] = {}
+        for i, w in zip(self.honest_indices, honest_workers):
+            self._workers[i] = w
+        for i, w in zip(self.byzantine_indices, byzantine_workers):
+            self._workers[i] = w
+        self.aggregator = aggregator
+        self._ctx_factory = context_factory or (lambda nid: InProcessContext(nid))
+        self.node_ids = {i: f"node-{i}" for i in range(n)}
+        self.nodes: Dict[int, DecentralizedNode] = {}
+        self._cluster: Optional["DecentralizedCluster"] = None
+        self._started = False
+        self.rounds_completed = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _install(self, i: int, node: DecentralizedNode, honest_ids: List[str]) -> None:
+        """Install worker pipelines: directly for local contexts, or as the
+        subprocess ``configure`` hook when the node lives in a child process
+        (the closures must then run child-side, where the worker state is)."""
+        byz = i in set(self.byzantine_indices)
+        if byz:
+            configure = partial(
+                _configure_byzantine,
+                worker=self._workers[i],
+                honest_ids=honest_ids,
+                timeout=self._timeout,
+            )
+        else:
+            configure = partial(
+                _configure_honest,
+                worker=self._workers[i],
+                aggregator=self.aggregator,
+                timeout=self._timeout,
+            )
+        ctx = node.context
+        if hasattr(ctx, "remote_execute_pipeline"):
+            # the node state lives remotely; pipelines must be registered
+            # there via the context's public configure contract
+            if not hasattr(ctx, "set_configure"):
+                raise TypeError(
+                    f"context {type(ctx).__name__} proxies pipelines "
+                    "remotely but has no set_configure(hook) — the P2P "
+                    "runner cannot install worker pipelines on it"
+                )
+            if getattr(ctx, "_configure", None) is not None:
+                raise ValueError(
+                    f"context for node {node.node_id!r} already has a "
+                    "configure hook; P2P needs to install its own"
+                )
+            ctx.set_configure(configure)
+        else:
+            configure(node)
+
+    async def setup(self) -> None:
+        if self._started:
+            return
+        from ..node.cluster import DecentralizedCluster
+
+        honest_ids = [self.node_ids[i] for i in self.honest_indices]
+        self._cluster = DecentralizedCluster(self.topology)
+        for i in range(self.topology.n_nodes):
+            nid = self.node_ids[i]
+            node = DecentralizedNode(nid, self._ctx_factory(nid))
+            self._install(i, node, honest_ids)
+            self.nodes[i] = node
+            self._cluster.add_node(node)
+        # cluster binds the topology with its own shared id map and handles
+        # start rollback on partial failure
+        await self._cluster.start_all()
+        self._started = True
+
+    async def shutdown(self) -> None:
+        if self._cluster is not None:
+            await self._cluster.shutdown_all()
+            self._cluster = None
+        self.nodes.clear()
+        self._started = False
+
+    async def __aenter__(self) -> "DecentralizedPeerToPeer":
+        await self.setup()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.shutdown()
+
+    # -- training ------------------------------------------------------------
+
+    def _honest_expected(self, i: int) -> int:
+        return len(self.topology.in_neighbors(i))
+
+    def _byz_expected(self, i: int) -> int:
+        honest = set(self.honest_indices)
+        return len([j for j in self.topology.in_neighbors(i) if j in honest])
+
+    async def run_round_async(self) -> Dict[int, Any]:
+        """One gossip round; returns each honest node's aggregated vector."""
+        if not self._started:
+            await self.setup()
+        lr = self.learning_rate
+
+        # 1. half steps (concurrently; ref: runner.py:295-298)
+        half = await asyncio.gather(*(
+            self.nodes[i].execute_pipeline("half_step", {"lr": lr})
+            for i in self.honest_indices
+        ))
+        half_vectors = {
+            i: out["half_step"] for i, out in zip(self.honest_indices, half)
+        }
+
+        # 2. honest broadcasts (ref: runner.py:308-315)
+        for i in self.honest_indices:
+            await self.nodes[i].broadcast_message(
+                GOSSIP_TYPE, half_vectors[i]
+            )
+
+        # 3. byzantine: craft from observed honest vectors, then broadcast
+        #    (ref: runner.py:316-368)
+        if self.byzantine_indices:
+            attacks = await asyncio.gather(*(
+                self.nodes[i].execute_pipeline(
+                    "attack", {"expected": self._byz_expected(i)}
+                )
+                for i in self.byzantine_indices
+            ))
+            for i, out in zip(self.byzantine_indices, attacks):
+                await self.nodes[i].broadcast_message(GOSSIP_TYPE, out["attack"])
+
+        # 4. robust aggregation of own θ½ + received (ref: runner.py:374-388)
+        aggregated = await asyncio.gather(*(
+            self.nodes[i].execute_pipeline(
+                "aggregate", {"expected": self._honest_expected(i)}
+            )
+            for i in self.honest_indices
+        ))
+        self.rounds_completed += 1
+        return {
+            i: out["aggregate"]
+            for i, out in zip(self.honest_indices, aggregated)
+        }
+
+    async def run_async(self, rounds: int) -> None:
+        for _ in range(rounds):
+            await self.run_round_async()
+
+
+__all__ = ["DecentralizedPeerToPeer", "GOSSIP_TYPE"]

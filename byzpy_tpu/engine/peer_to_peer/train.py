@@ -1,0 +1,86 @@
+"""PeerToPeer: the user-facing gossip-training facade.
+
+API parity: ``byzpy/engine/peer_to_peer/train.py:17-86`` — construct with
+honest/byzantine workers, a robust aggregator, and a topology; call
+``round()`` / ``run(rounds)`` (sync wrappers) or the async equivalents.
+All orchestration delegates to :class:`DecentralizedPeerToPeer`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from ...aggregators.base import Aggregator
+from ..node.context import NodeContext
+from .nodes import ByzantineP2PWorker, HonestP2PWorker
+from .runner import DecentralizedPeerToPeer
+from .topology import Topology
+
+
+class PeerToPeer:
+    """Synchronous facade over :class:`DecentralizedPeerToPeer`.
+
+    >>> p2p = PeerToPeer(honest, byz, aggregator=Krum(f=1),
+    ...                  topology=Topology.complete(5))
+    >>> p2p.run(rounds=10)        # sync: owns its event loop
+    >>> # or, inside an existing event loop:
+    >>> await p2p.round()         # one round (alias of round_async)
+    """
+
+    def __init__(
+        self,
+        honest_workers: Sequence[HonestP2PWorker],
+        byzantine_workers: Sequence[ByzantineP2PWorker] = (),
+        *,
+        aggregator: Aggregator,
+        topology: Topology,
+        learning_rate: float = 0.1,
+        context_factory: Optional[Callable[[str], NodeContext]] = None,
+        byzantine_indices: Optional[Sequence[int]] = None,
+        gossip_timeout: Optional[float] = 30.0,
+    ) -> None:
+        self.runner = DecentralizedPeerToPeer(
+            honest_workers,
+            byzantine_workers,
+            aggregator=aggregator,
+            topology=topology,
+            learning_rate=learning_rate,
+            context_factory=context_factory,
+            byzantine_indices=byzantine_indices,
+            gossip_timeout=gossip_timeout,
+        )
+
+    @property
+    def rounds_completed(self) -> int:
+        return self.runner.rounds_completed
+
+    # -- async API -----------------------------------------------------------
+
+    async def round_async(self) -> Dict[int, Any]:
+        return await self.runner.run_round_async()
+
+    # reference-parity name (ref: train.py:82-83); async like the original
+    round = round_async
+
+    async def run_async(self, rounds: int) -> None:
+        await self.runner.run_async(rounds)
+
+    async def shutdown_async(self) -> None:
+        await self.runner.shutdown()
+
+    # -- sync wrappers (each owns one event loop for the whole session) ------
+
+    def run(self, rounds: int) -> None:
+        """Set up, run ``rounds`` gossip rounds, and shut down — in one
+        event loop (in-process contexts bind queues to the running loop, so
+        setup/round/shutdown must share it)."""
+
+        async def _go() -> None:
+            async with self.runner:
+                await self.runner.run_async(rounds)
+
+        asyncio.run(_go())
+
+
+__all__ = ["PeerToPeer"]
