@@ -18,6 +18,7 @@ import asyncio
 import inspect
 import itertools
 import multiprocessing as mp
+import os
 import traceback
 import uuid
 from typing import Any, Dict, Optional
@@ -108,8 +109,13 @@ async def _worker_loop(conn) -> None:  # pragma: no cover - runs in child proces
 class ProcessActorBackend:
     scheme = "process"
 
-    def __init__(self, *, actor_id: str | None = None) -> None:
+    def __init__(
+        self, *, actor_id: str | None = None, child_platform: str = "cpu"
+    ) -> None:
         self.actor_id = actor_id or f"proc-{next(_counter)}-{uuid.uuid4().hex[:6]}"
+        self._child_platform = (
+            os.environ.get("BYZPY_TPU_CHILD_PLATFORM") or child_platform
+        )
         self._proc: mp.process.BaseProcess | None = None
         self._conn = None
         self._reader_task: asyncio.Task | None = None
@@ -124,7 +130,20 @@ class ProcessActorBackend:
         ctx = mp.get_context("spawn")
         parent_conn, child_conn = ctx.Pipe(duplex=True)
         self._proc = ctx.Process(target=_worker_main, args=(child_conn,), daemon=True)
-        self._proc.start()
+        # children must not inherit the parent's accelerator bindings: a TPU
+        # chip admits one process, so a child re-registering the plugin
+        # would deadlock against the parent (same guard as ProcessContext)
+        patch = {"JAX_PLATFORMS": self._child_platform, "PALLAS_AXON_POOL_IPS": ""}
+        saved = {k: os.environ.get(k) for k in patch}
+        os.environ.update(patch)
+        try:
+            self._proc.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         child_conn.close()
         self._conn = parent_conn
         self._send_lock = asyncio.Lock()

@@ -39,11 +39,13 @@ class RemoteCallableOp(Operator):
         name: Optional[str] = None,
         affinity: Optional[str] = None,
         max_retries: int = 0,
+        cache_fn: bool = True,
     ) -> None:
         self.fn = fn
         self.name = name or getattr(fn, "__name__", "remote-callable-op")
         self.affinity = affinity
         self.max_retries = max_retries
+        self.cache_fn = cache_fn
 
     def create_subtasks(self, inputs: Mapping[str, Any], *, context: OpContext):
         yield SubTask(
@@ -52,6 +54,7 @@ class RemoteCallableOp(Operator):
             name=self.name,
             affinity=self.affinity,
             max_retries=self.max_retries,
+            cache_fn=self.cache_fn,
         )
 
     def reduce_subtasks(self, partials, inputs, *, context: OpContext) -> Any:

@@ -91,6 +91,14 @@ class _PoolWorker:
     async def run(self, st: SubTask) -> Any:
         if self._in_process:
             return await self.backend.call("execute", st.fn, tuple(st.args), dict(st.kwargs))
+        if not st.cache_fn:
+            # stateful fn: fresh pickle every run so the worker sees current
+            # state (the worker-side cache keys on blob bytes, so changed
+            # state means a changed key — stale entries just age out)
+            blob = cloudpickle.dumps(st.fn)
+            return await self.backend.call(
+                "execute_blob", blob, tuple(st.args), dict(st.kwargs)
+            )
         entry = self._blob_cache.get(id(st.fn))
         if entry is not None and entry[0] is st.fn:
             blob = entry[1]

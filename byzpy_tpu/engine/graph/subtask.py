@@ -19,6 +19,10 @@ class SubTask:
     name: Optional[str] = None
     affinity: Optional[str] = None
     max_retries: int = 0
+    # False for fns closing over mutable state (e.g. bound methods of a
+    # training node): the pool must re-serialize on every run instead of
+    # caching the first pickle, or workers see frozen state forever
+    cache_fn: bool = True
 
 
 __all__ = ["SubTask"]

@@ -1,5 +1,18 @@
 from .actors import ByzantineNodeActor, HonestNodeActor, NodeActor
+from .application import (
+    ByzantineNodeApplication,
+    HonestNodeApplication,
+    NodeApplication,
+)
 from .base import ByzantineNode, HonestNode, Node
+from .distributed import DistributedByzantineNode, DistributedHonestNode
+from .mesh_context import MeshRemoteContext
+from .remote import (
+    RemoteClientContext,
+    RemoteNodeClient,
+    RemoteNodeServer,
+    ServerNodeContext,
+)
 from .cluster import DecentralizedCluster
 from .context import InProcessContext, NodeContext
 from .decentralized import DecentralizedNode
@@ -13,6 +26,16 @@ __all__ = [
     "NodeActor",
     "HonestNodeActor",
     "ByzantineNodeActor",
+    "NodeApplication",
+    "HonestNodeApplication",
+    "ByzantineNodeApplication",
+    "DistributedHonestNode",
+    "DistributedByzantineNode",
+    "RemoteNodeServer",
+    "RemoteNodeClient",
+    "RemoteClientContext",
+    "ServerNodeContext",
+    "MeshRemoteContext",
     "NodeContext",
     "InProcessContext",
     "ProcessContext",

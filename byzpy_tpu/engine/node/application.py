@@ -1,0 +1,193 @@
+"""NodeApplication: a named-pipeline registry over one ActorPool.
+
+Behavior parity: ``byzpy/engine/node/application.py:1-269`` — an
+application owns (or borrows) an :class:`ActorPool`, registers named
+pipelines (``ComputationGraph`` + metadata), and runs them on a
+:class:`NodeScheduler`. ``HonestNodeApplication`` reserves the
+``aggregate`` / ``honest_gradient`` names (application.py:144-216) and
+``ByzantineNodeApplication`` reserves ``attack`` (application.py:219-261);
+those are installed through dedicated helpers so orchestration layers can
+rely on their contracts.
+
+TPU framing: a pipeline's operators are jit-compiled; the pool exists for
+operators that fan out subtasks (chunked aggregators on heterogeneous
+workers) and for host-side work. Single-op aggregation on one chip runs
+inline without any pool at all.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, ClassVar, Dict, FrozenSet, List, Mapping, Optional, Sequence
+
+from ...aggregators.base import Aggregator
+from ...attacks.base import Attack
+from ..graph.graph import ComputationGraph
+from ..graph.ops import make_single_operator_graph
+from ..graph.pool import ActorPool, ActorPoolConfig
+from ..graph.scheduler import NodeScheduler
+
+
+class NodeApplication:
+    """Named pipelines + one pool + per-pipeline metadata."""
+
+    reserved_pipelines: ClassVar[FrozenSet[str]] = frozenset()
+
+    def __init__(
+        self,
+        *,
+        pool: Optional[ActorPool] = None,
+        pool_config: Optional[ActorPoolConfig | Sequence[ActorPoolConfig]] = None,
+        metadata: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        self._external_pool = pool is not None
+        self._pool = pool
+        if self._pool is None and pool_config is not None:
+            self._pool = ActorPool(pool_config)
+        self._metadata = dict(metadata or {})
+        self._pipelines: Dict[str, ComputationGraph] = {}
+        self._pipeline_meta: Dict[str, Dict[str, Any]] = {}
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def pool(self) -> Optional[ActorPool]:
+        return self._pool
+
+    async def start(self) -> None:
+        if self._pool is not None and not self._started:
+            await self._pool.start()
+        self._started = True
+
+    async def close(self) -> None:
+        if self._pool is not None and not self._external_pool:
+            await self._pool.close()
+        self._started = False
+
+    async def __aenter__(self) -> "NodeApplication":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+    # -- registry ------------------------------------------------------------
+
+    def register_pipeline(
+        self,
+        name: str,
+        graph: ComputationGraph,
+        *,
+        metadata: Optional[Mapping[str, Any]] = None,
+        _internal: bool = False,
+    ) -> None:
+        if not _internal and name in self.reserved_pipelines:
+            raise ValueError(
+                f"pipeline name {name!r} is reserved by "
+                f"{type(self).__name__}; use the dedicated register helper"
+            )
+        if name in self._pipelines:
+            raise ValueError(f"pipeline {name!r} already registered")
+        self._pipelines[name] = graph
+        self._pipeline_meta[name] = dict(metadata or {})
+
+    def pipeline_names(self) -> List[str]:
+        return sorted(self._pipelines)
+
+    def pipeline_metadata(self, name: str) -> Dict[str, Any]:
+        return dict(self._pipeline_meta[name])
+
+    # -- execution -----------------------------------------------------------
+
+    async def run_pipeline(
+        self, name: str, inputs: Optional[Mapping[str, Any]] = None
+    ) -> Dict[str, Any]:
+        graph = self._pipelines.get(name)
+        if graph is None:
+            raise KeyError(
+                f"no pipeline {name!r}; registered: {self.pipeline_names()}"
+            )
+        await self.start()
+        metadata = {**self._metadata, **self._pipeline_meta[name]}
+        scheduler = NodeScheduler(graph, pool=self._pool, metadata=metadata)
+        return await scheduler.run(inputs)
+
+    def run_pipeline_sync(
+        self, name: str, inputs: Optional[Mapping[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """Convenience for non-async callers; owns a fresh event loop."""
+        return asyncio.run(self.run_pipeline(name, inputs))
+
+
+class HonestNodeApplication(NodeApplication):
+    """Application with the honest-node pipeline contract
+    (ref: ``application.py:144-216``)."""
+
+    reserved_pipelines = frozenset({"aggregate", "honest_gradient"})
+
+    def register_aggregation(
+        self, aggregator: Aggregator, *, metadata: Optional[Mapping[str, Any]] = None
+    ) -> None:
+        self.register_pipeline(
+            "aggregate",
+            make_single_operator_graph(aggregator, node_name="aggregate"),
+            metadata=metadata,
+            _internal=True,
+        )
+
+    def register_gradient(
+        self, graph: ComputationGraph, *, metadata: Optional[Mapping[str, Any]] = None
+    ) -> None:
+        self.register_pipeline(
+            "honest_gradient", graph, metadata=metadata, _internal=True
+        )
+
+    async def aggregate(self, gradients: Sequence[Any]) -> Any:
+        out = await self.run_pipeline("aggregate", {"gradients": gradients})
+        return out["aggregate"]
+
+
+class ByzantineNodeApplication(NodeApplication):
+    """Application with the byzantine-node pipeline contract
+    (ref: ``application.py:219-261``)."""
+
+    reserved_pipelines = frozenset({"attack"})
+
+    def register_attack(
+        self,
+        attack: Attack,
+        *,
+        input_keys: Optional[Mapping[str, str]] = None,
+        metadata: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        if input_keys is None:
+            # derive from the attack's declared needs (ref: attacks/base.py
+            # flags) — each need becomes an application input of that name
+            keys = []
+            if attack.uses_model_batch:
+                keys += ["model", "x", "y"]
+            if attack.uses_honest_grads:
+                keys.append("honest_grads")
+            if attack.uses_base_grad:
+                keys.append("base_grad")
+            input_keys = {k: k for k in keys}
+        self.register_pipeline(
+            "attack",
+            make_single_operator_graph(
+                attack, input_keys=input_keys, node_name="attack"
+            ),
+            metadata=metadata,
+            _internal=True,
+        )
+
+    async def attack(self, **inputs: Any) -> Any:
+        out = await self.run_pipeline("attack", inputs)
+        return out["attack"]
+
+
+__all__ = [
+    "NodeApplication",
+    "HonestNodeApplication",
+    "ByzantineNodeApplication",
+]

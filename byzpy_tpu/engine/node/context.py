@@ -62,6 +62,16 @@ def register_delivery_route(route: DeliveryRoute) -> None:
         _delivery_routes.append(route)
 
 
+def unregister_delivery_route(route: DeliveryRoute) -> None:
+    """Remove a previously registered hook (no-op if absent). Anything that
+    registers a bound-method route must unregister it on close, or the
+    owning object is kept alive and can shadow newer routes."""
+    try:
+        _delivery_routes.remove(route)
+    except ValueError:
+        pass
+
+
 async def route_message(target_id: str, message: Message) -> bool:
     for route in _delivery_routes:
         if await route(target_id, message):

@@ -1,0 +1,171 @@
+"""Distributed node wrappers: user nodes whose heavy calls run as
+pool-scheduled pipelines automatically.
+
+Behavior parity: ``byzpy/engine/node/distributed.py:52-314`` —
+``DistributedHonestNode`` auto-registers an ``aggregate`` pipeline (robust
+aggregator over its own pool) and an ``honest_gradient`` pipeline wrapping
+the user's gradient method (distributed.py:108-134, minus the shm handle
+dance — arrays are passed directly, device-resident for in-process
+workers). ``DistributedByzantineNode.__init_subclass__`` captures a user's
+``byzantine_gradient`` override and rewires calls through a
+``RemoteCallableOp`` pipeline with signature-derived input keys
+(distributed.py:140-223).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..graph.graph import ComputationGraph, GraphInput, GraphNode
+from ..graph.ops import RemoteCallableOp
+from ..graph.pool import ActorPool, ActorPoolConfig
+from ...aggregators.base import Aggregator
+from .application import ByzantineNodeApplication, HonestNodeApplication
+from .base import ByzantineNode, HonestNode
+
+
+class DistributedHonestNode(HonestNode):
+    """Honest node whose gradient + aggregation calls schedule on a pool.
+
+    Subclasses implement ``next_batch`` and ``honest_gradient`` as usual;
+    ``honest_gradient_for_next_batch`` becomes a pipeline run (one worker
+    hop when a pool is attached, inline otherwise), and ``aggregate`` runs
+    the configured robust aggregator with subtask fan-out.
+    """
+
+    def __init__(
+        self,
+        *,
+        aggregator: Optional[Aggregator] = None,
+        pool: Optional[ActorPool] = None,
+        pool_config: Optional[ActorPoolConfig | Sequence[ActorPoolConfig]] = None,
+    ) -> None:
+        self.app = HonestNodeApplication(pool=pool, pool_config=pool_config)
+        if aggregator is not None:
+            self.app.register_aggregation(aggregator)
+        self.app.register_gradient(
+            ComputationGraph([
+                GraphNode(
+                    name="honest_gradient",
+                    # cache_fn=False: the bound method closes over mutable
+                    # node state (params advance every round), so process/
+                    # remote workers must get a fresh pickle per call
+                    op=RemoteCallableOp(
+                        self._gradient_entry, name="honest_gradient",
+                        cache_fn=False,
+                    ),
+                    inputs={"x": GraphInput("x"), "y": GraphInput("y")},
+                )
+            ])
+        )
+
+    def _gradient_entry(self, x: Any, y: Any) -> Any:
+        return self.honest_gradient(x, y)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # the application (pool, backends, live asyncio state) must not ride
+        # along when a worker pickles this node for a gradient subtask; the
+        # worker-side copy only ever calls honest_gradient
+        state = dict(self.__dict__)
+        state["app"] = None
+        return state
+
+    async def honest_gradient_for_next_batch(self) -> Any:
+        x, y = self.next_batch()
+        out = await self.app.run_pipeline("honest_gradient", {"x": x, "y": y})
+        return out["honest_gradient"]
+
+    async def aggregate(self, gradients: Sequence[Any]) -> Any:
+        """Robust-aggregate on this node's pool (ref: distributed.py:108-134)."""
+        return await self.app.aggregate(gradients)
+
+    async def close(self) -> None:
+        await self.app.close()
+
+
+class DistributedByzantineNode(ByzantineNode):
+    """Byzantine node whose ``byzantine_gradient`` body executes as a
+    pool pipeline.
+
+    Subclass and override ``byzantine_gradient`` normally::
+
+        class MyAttacker(DistributedByzantineNode):
+            def byzantine_gradient(self, honest_gradients):
+                return -2.0 * sum(honest_gradients) / len(honest_gradients)
+
+    ``__init_subclass__`` lifts the override into an ``attack`` pipeline;
+    calls return awaitables resolved by the orchestrators' ``_invoke``.
+    """
+
+    _user_byzantine_gradient = None
+    _byz_input_keys: List[str] = []
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        user_fn = cls.__dict__.get("byzantine_gradient")
+        if user_fn is None:
+            return
+        cls._user_byzantine_gradient = user_fn
+        sig = inspect.signature(user_fn)
+        keys = [p for p in sig.parameters if p != "self"]
+        if not keys:
+            raise TypeError(
+                "byzantine_gradient must take at least one argument "
+                "(the honest gradients)"
+            )
+        cls._byz_input_keys = keys
+
+        def wrapped(self: "DistributedByzantineNode", *args: Any, **kw: Any):
+            inputs: Dict[str, Any] = dict(zip(cls._byz_input_keys, args))
+            inputs.update(kw)
+            return self._run_attack_pipeline(inputs)
+
+        wrapped.__name__ = "byzantine_gradient"
+        wrapped.__doc__ = user_fn.__doc__
+        cls.byzantine_gradient = wrapped  # type: ignore[method-assign]
+
+    def __init__(
+        self,
+        *,
+        pool: Optional[ActorPool] = None,
+        pool_config: Optional[ActorPoolConfig | Sequence[ActorPoolConfig]] = None,
+    ) -> None:
+        if type(self)._user_byzantine_gradient is None:
+            raise TypeError(
+                "DistributedByzantineNode subclasses must override "
+                "byzantine_gradient"
+            )
+        self.app = ByzantineNodeApplication(pool=pool, pool_config=pool_config)
+        keys = type(self)._byz_input_keys
+        self.app.register_pipeline(
+            "attack",
+            ComputationGraph([
+                GraphNode(
+                    name="attack",
+                    op=RemoteCallableOp(
+                        self._attack_entry, name="attack", cache_fn=False
+                    ),
+                    inputs={k: GraphInput(k) for k in keys},
+                )
+            ]),
+            _internal=True,
+        )
+
+    def _attack_entry(self, **inputs: Any) -> Any:
+        return type(self)._user_byzantine_gradient(self, **inputs)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = dict(self.__dict__)
+        state["app"] = None
+        return state
+
+    async def _run_attack_pipeline(self, inputs: Dict[str, Any]) -> Any:
+        out = await self.app.run_pipeline("attack", inputs)
+        return out["attack"]
+
+    async def close(self) -> None:
+        await self.app.close()
+
+
+__all__ = ["DistributedHonestNode", "DistributedByzantineNode"]

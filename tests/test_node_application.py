@@ -1,0 +1,181 @@
+"""NodeApplication registry + Distributed node wrappers.
+
+Parity targets: ``byzpy/engine/node/application.py`` (reserved pipeline
+names, pool lifecycle, metadata) and ``byzpy/engine/node/distributed.py``
+(auto-registered gradient/aggregate pipelines, __init_subclass__ rewiring
+of byzantine_gradient through a pool pipeline).
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import CoordinateWiseMedian, CoordinateWiseTrimmedMean
+from byzpy_tpu.attacks import EmpireAttack
+from byzpy_tpu.engine.graph.graph import ComputationGraph, GraphInput, GraphNode
+from byzpy_tpu.engine.graph.ops import CallableOp
+from byzpy_tpu.engine.graph.pool import ActorPoolConfig
+from byzpy_tpu.engine.node.application import (
+    ByzantineNodeApplication,
+    HonestNodeApplication,
+    NodeApplication,
+)
+from byzpy_tpu.engine.node.distributed import (
+    DistributedByzantineNode,
+    DistributedHonestNode,
+)
+from byzpy_tpu.engine.parameter_server import ParameterServer
+
+
+def _one_node_graph(fn, name="op", **inputs):
+    return ComputationGraph([
+        GraphNode(name=name, op=CallableOp(fn),
+                  inputs={k: GraphInput(v) for k, v in inputs.items()})
+    ])
+
+
+def test_application_pipeline_registry_and_run():
+    app = NodeApplication()
+    app.register_pipeline(
+        "double", _one_node_graph(lambda v: 2 * v, name="double", v="v"),
+        metadata={"kind": "test"},
+    )
+    assert app.pipeline_names() == ["double"]
+    assert app.pipeline_metadata("double") == {"kind": "test"}
+    out = asyncio.run(app.run_pipeline("double", {"v": 21}))
+    assert out["double"] == 42
+    with pytest.raises(ValueError):
+        app.register_pipeline("double", _one_node_graph(lambda v: v, v="v"))
+    with pytest.raises(KeyError):
+        asyncio.run(app.run_pipeline("missing"))
+
+
+def test_reserved_names_guarded():
+    app = HonestNodeApplication()
+    with pytest.raises(ValueError):
+        app.register_pipeline(
+            "aggregate", _one_node_graph(lambda v: v, v="v")
+        )
+    app.register_aggregation(CoordinateWiseMedian())
+    grads = [jnp.full((4,), v) for v in (1.0, 2.0, 9.0)]
+    agg = asyncio.run(app.aggregate(grads))
+    np.testing.assert_allclose(np.asarray(agg), 2.0)
+
+
+def test_byzantine_application_attack_pipeline():
+    app = ByzantineNodeApplication()
+    app.register_attack(EmpireAttack(scale=-1.0))
+
+    async def go():
+        return await app.attack(honest_grads=[jnp.ones((3,)), 3 * jnp.ones((3,))])
+
+    out = asyncio.run(go())
+    np.testing.assert_allclose(np.asarray(out), -2.0)
+
+
+class GradNode(DistributedHonestNode):
+    def __init__(self, target, **kw):
+        super().__init__(**kw)
+        self.target = jnp.full((6,), float(target))
+        self.w = jnp.zeros((6,))
+
+    def next_batch(self):
+        return None, None
+
+    def honest_gradient(self, x, y):
+        return 2.0 * (self.w - self.target)
+
+    def apply_server_gradient(self, g):
+        self.w = self.w - 0.25 * jnp.asarray(g)
+
+
+class ScaledEmpire(DistributedByzantineNode):
+    def next_batch(self):
+        return None, None
+
+    def apply_server_gradient(self, g):
+        pass
+
+    def byzantine_gradient(self, honest_gradients):
+        stacked = jnp.stack([jnp.asarray(g) for g in honest_gradients])
+        return -4.0 * jnp.mean(stacked, axis=0)
+
+
+def test_distributed_honest_node_pipelines():
+    async def go():
+        node = GradNode(
+            3.0,
+            aggregator=CoordinateWiseMedian(),
+            pool_config=ActorPoolConfig(backend="thread", count=2),
+        )
+        g = await node.honest_gradient_for_next_batch()
+        np.testing.assert_allclose(np.asarray(g), -6.0)
+        agg = await node.aggregate([jnp.ones((4,)), 5 * jnp.ones((4,)), jnp.ones((4,))])
+        np.testing.assert_allclose(np.asarray(agg), 1.0)
+        await node.close()
+
+    asyncio.run(go())
+
+
+def test_distributed_byzantine_rewiring():
+    async def go():
+        node = ScaledEmpire()
+        out = await node.byzantine_gradient([jnp.ones((3,)), jnp.ones((3,))])
+        np.testing.assert_allclose(np.asarray(out), -4.0)
+        await node.close()
+
+    asyncio.run(go())
+
+
+def test_distributed_byzantine_requires_override():
+    class NoOverride(DistributedByzantineNode):
+        def next_batch(self):
+            return None, None
+
+        def apply_server_gradient(self, g):
+            pass
+
+    with pytest.raises(TypeError):
+        NoOverride()
+
+
+def test_distributed_honest_node_process_pool_sees_fresh_state():
+    """Gradient subtasks on a process pool must re-pickle the node every
+    round (cache_fn=False) so workers see post-update parameters — the
+    stale-blob failure mode this guards against returned the round-1
+    gradient forever."""
+
+    async def go():
+        node = GradNode(
+            2.0,
+            pool_config=ActorPoolConfig(backend="process", count=1),
+        )
+        try:
+            g1 = await node.honest_gradient_for_next_batch()
+            np.testing.assert_allclose(np.asarray(g1), -4.0)
+            node.apply_server_gradient(g1)  # w: 0 -> 1
+            g2 = await node.honest_gradient_for_next_batch()
+            np.testing.assert_allclose(np.asarray(g2), -2.0)
+        finally:
+            await node.close()
+
+    asyncio.run(go())
+
+
+def test_distributed_nodes_in_parameter_server():
+    async def go():
+        honest = [GradNode(1.0) for _ in range(4)]
+        byz = [ScaledEmpire()]
+        ps = ParameterServer(
+            honest, byz, aggregator=CoordinateWiseTrimmedMean(f=1)
+        )
+        for _ in range(25):
+            await ps.round()
+        for n in honest:
+            np.testing.assert_allclose(np.asarray(n.w), 1.0, atol=5e-2)
+        for n in honest + byz:
+            await n.close()
+
+    asyncio.run(go())
