@@ -15,7 +15,16 @@ Front door (ref: ``byzpy/__init__.py:1-4``)::
     result = asyncio.run(run_operator(CoordinateWiseMedian(), gradients))
 """
 
-from .engine.graph.executor import OperatorExecutor, run_operator
 from .version import __version__
 
 __all__ = ["__version__", "OperatorExecutor", "run_operator"]
+
+
+def __getattr__(name: str):
+    # lazy: keeps `import byzpy_tpu` (and the CLI, whose doctor must be able
+    # to report a broken jax install) from importing jax at package import
+    if name in ("OperatorExecutor", "run_operator"):
+        from .engine.graph import executor
+
+        return getattr(executor, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,140 @@
+"""byzpy-tpu command-line interface.
+
+API parity: ``byzpy/cli.py:122-164`` — subcommands ``version``, ``doctor``
+(environment report; the reference probes torch/CUDA/cupy/UCX at
+cli.py:38-74, here we probe the JAX platform, device inventory, and
+native-extension availability), and ``list aggregators|attacks|
+pre-aggregators`` via subclass discovery (ref: cli.py:14-35).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Type
+
+from .version import __version__
+
+
+def _subclasses_of(base: Type) -> List[Type]:
+    """All concrete registered subclasses, sorted by name (the package
+    __init__ imports every built-in, so walking the subclass tree is the
+    same discovery the reference does by scanning packages)."""
+    seen: Dict[str, Type] = {}
+    stack = list(base.__subclasses__())
+    while stack:
+        cls = stack.pop()
+        stack.extend(cls.__subclasses__())
+        if not getattr(cls, "__abstractmethods__", None):
+            seen[cls.__name__] = cls
+    return [seen[k] for k in sorted(seen)]
+
+
+def _collect(kind: str) -> List[Type]:
+    if kind == "aggregators":
+        import byzpy_tpu.aggregators as pkg
+        from byzpy_tpu.aggregators.base import Aggregator as base
+    elif kind == "attacks":
+        import byzpy_tpu.attacks as pkg  # noqa: F401 — import registers subclasses
+        from byzpy_tpu.attacks.base import Attack as base
+    elif kind == "pre-aggregators":
+        import byzpy_tpu.pre_aggregators as pkg  # noqa: F401
+        from byzpy_tpu.pre_aggregators.base import PreAggregator as base
+    else:  # pragma: no cover - argparse choices guard this
+        raise ValueError(kind)
+    return _subclasses_of(base)
+
+
+def cmd_version(_args: argparse.Namespace) -> int:
+    print(__version__)
+    return 0
+
+
+def doctor_report() -> Dict[str, Any]:
+    """Environment probe (ref: ``byzpy doctor``, cli.py:38-74)."""
+    report: Dict[str, Any] = {"version": __version__, "python": sys.version.split()[0]}
+    try:
+        import jax
+
+        report["jax"] = {"version": jax.__version__, "ok": True}
+        try:
+            devices = jax.devices()
+            report["devices"] = [
+                {
+                    "id": d.id,
+                    "platform": d.platform,
+                    "kind": getattr(d, "device_kind", "?"),
+                    "process": getattr(d, "process_index", 0),
+                }
+                for d in devices
+            ]
+            report["default_backend"] = jax.default_backend()
+            report["device_count"] = len(devices)
+            report["process_count"] = jax.process_count()
+        except Exception as exc:  # noqa: BLE001 — report, don't crash doctor
+            report["devices_error"] = repr(exc)
+    except Exception as exc:  # noqa: BLE001
+        report["jax"] = {"ok": False, "error": repr(exc)}
+    for mod in ("flax", "optax", "cloudpickle"):
+        try:
+            m = __import__(mod)
+            report[mod] = {"ok": True, "version": getattr(m, "__version__", "?")}
+        except Exception as exc:  # noqa: BLE001
+            report[mod] = {"ok": False, "error": repr(exc)}
+    try:
+        from .engine.storage import native_store
+
+        report["native_shm_store"] = {"ok": native_store.available()}
+    except Exception:  # noqa: BLE001 — optional native extension
+        report["native_shm_store"] = {"ok": False}
+    return report
+
+
+def cmd_doctor(args: argparse.Namespace) -> int:
+    report = doctor_report()
+    if args.format == "json":
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for key, value in sorted(report.items()):
+            print(f"{key}: {value}")
+    return 0
+
+
+def cmd_list(args: argparse.Namespace) -> int:
+    for cls in _collect(args.kind):
+        name = getattr(cls, "name", None) or cls.__name__
+        print(f"{cls.__name__}\t({name})")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="byzpy-tpu",
+        description="TPU-native Byzantine-robust distributed learning framework",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_version = sub.add_parser("version", help="print the package version")
+    p_version.set_defaults(fn=cmd_version)
+
+    p_doctor = sub.add_parser("doctor", help="report the JAX/TPU environment")
+    p_doctor.add_argument("--format", choices=("text", "json"), default="text")
+    p_doctor.set_defaults(fn=cmd_doctor)
+
+    p_list = sub.add_parser("list", help="list available operator classes")
+    p_list.add_argument(
+        "kind", choices=("aggregators", "attacks", "pre-aggregators")
+    )
+    p_list.set_defaults(fn=cmd_list)
+
+    return parser
+
+
+def main(argv: List[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,11 @@
+from .actor import get_actor, set_actor, use_actor
+from .mesh import get_default_mesh, set_default_mesh, use_mesh
+
+__all__ = [
+    "set_actor",
+    "get_actor",
+    "use_actor",
+    "set_default_mesh",
+    "get_default_mesh",
+    "use_mesh",
+]

@@ -1,0 +1,63 @@
+"""Global default actor-backend spec (API parity:
+``byzpy/configs/actor.py:1-30`` — ``set_actor``/``get_actor`` plus a
+context-manager override).
+
+Specs are the strings ``resolve_backend`` understands: ``"thread"``,
+``"process"``, ``"tpu"``/``"tpu:N"``, ``"tcp://host:port"``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+_DEFAULT_ACTOR = "thread"
+_actor_spec = _DEFAULT_ACTOR
+
+
+def set_actor(spec: str) -> None:
+    """Set the process-wide default actor backend spec."""
+    if not isinstance(spec, str) or not spec:
+        raise ValueError(f"invalid actor spec {spec!r}")
+    _validate(spec)
+    global _actor_spec
+    _actor_spec = spec
+
+
+def _validate(spec: str) -> None:
+    if spec in ("thread", "process", "tpu"):
+        return
+    if spec.startswith("tpu:"):
+        try:
+            int(spec.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(
+                f"tpu spec must be tpu:<device-index> (got {spec!r})"
+            ) from None
+        return
+    if spec.startswith("tcp://"):
+        host, _, port = spec[len("tcp://"):].rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"tcp spec must be tcp://host:port (got {spec!r})")
+        return
+    raise ValueError(f"unknown actor backend spec {spec!r}")
+
+
+def get_actor() -> str:
+    return _actor_spec
+
+
+@contextlib.contextmanager
+def use_actor(spec: str) -> Iterator[None]:
+    """Temporarily override the default actor spec."""
+    global _actor_spec
+    _validate(spec)
+    previous = _actor_spec
+    _actor_spec = spec
+    try:
+        yield
+    finally:
+        _actor_spec = previous
+
+
+__all__ = ["set_actor", "get_actor", "use_actor"]

@@ -1,0 +1,49 @@
+"""Global default device mesh.
+
+The reference's ``configs/backend.py`` selects the global tensor backend
+(``byzpy/configs/backend.py:12-34``); the TPU-native analogue is selecting
+the global *device mesh* that sharded aggregation and SPMD training steps
+use when none is passed explicitly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from jax.sharding import Mesh
+
+_default_mesh: Optional[Mesh] = None
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    """Set (or clear, with ``None``) the process-wide default mesh."""
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh(*, create: bool = False) -> Optional[Mesh]:
+    """The configured default mesh. With ``create=True`` and nothing
+    configured, builds a 1-D ``nodes`` mesh over all visible devices."""
+    if _default_mesh is not None:
+        return _default_mesh
+    if create:
+        from ..parallel.mesh import node_mesh
+
+        return node_mesh()
+    return None
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh) -> Iterator[Mesh]:
+    """Temporarily set the default mesh."""
+    global _default_mesh
+    previous = _default_mesh
+    _default_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _default_mesh = previous
+
+
+__all__ = ["set_default_mesh", "get_default_mesh", "use_mesh"]

@@ -25,11 +25,14 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
-from .. import wire
+from .. import ipc, wire
 from ..channels import Endpoint
 from ..router import channel_router
 
 _counter = itertools.count()
+
+# BYZPY_TPU_SHM=0 forces all payloads inline through the pipe
+_SHM_ENABLED = os.environ.get("BYZPY_TPU_SHM", "1") != "0"
 
 
 # ---------------------------------------------------------------------------
@@ -57,10 +60,12 @@ async def _worker_loop(conn) -> None:  # pragma: no cover - runs in child proces
         try:
             if op == "construct":
                 target, args, kwargs = data
+                args, kwargs = ipc.unwrap_payload((args, kwargs), copy=True, close=True)
                 obj_holder["obj"] = target(*args, **kwargs)
                 result = None
             elif op == "call":
                 method, args, kwargs = data
+                args, kwargs = ipc.unwrap_payload((args, kwargs), copy=True, close=True)
                 obj = obj_holder.get("obj")
                 if obj is None:
                     raise RuntimeError("actor not constructed")
@@ -74,6 +79,10 @@ async def _worker_loop(conn) -> None:  # pragma: no cover - runs in child proces
                 result = None
             elif op == "chan_put":
                 name, payload = data
+                # copy shm payloads out now: the sender unlinks its segments
+                # as soon as this request is acknowledged, and the mailbox
+                # may be drained much later
+                payload = ipc.unwrap_payload(payload, copy=True, close=True)
                 await mailboxes.setdefault(name, asyncio.Queue()).put(payload)
                 result = None
             elif op == "chan_get":
@@ -190,10 +199,25 @@ class ProcessActorBackend:
         return await fut
 
     async def construct(self, target: Any, /, *args: Any, **kwargs: Any) -> None:
-        await self._request("construct", (target, wire.host_view(args), wire.host_view(kwargs)))
+        await self._shm_request("construct", target, args, kwargs)
 
     async def call(self, method: str, /, *args: Any, **kwargs: Any) -> Any:
-        return await self._request("call", (method, wire.host_view(args), wire.host_view(kwargs)))
+        return await self._shm_request("call", method, args, kwargs)
+
+    async def _shm_request(self, op: str, head: Any, args: Any, kwargs: Any) -> Any:
+        """Ship large host arrays via the native shm store instead of the
+        pipe (ref: the reference's wrap_payload on every process hop,
+        ``byzpy/engine/actor/ipc.py:20-42``); the child copies out and
+        unmaps, the parent unlinks after the reply."""
+        payload = wire.host_view((args, kwargs))
+        if _SHM_ENABLED:
+            payload, handles = ipc.wrap_payload(payload)
+        else:
+            handles = []
+        try:
+            return await self._request(op, (head, payload[0], payload[1]))
+        finally:
+            ipc.cleanup_handles(handles)
 
     async def close(self) -> None:
         if not self._started:
@@ -225,7 +249,15 @@ class ProcessActorBackend:
         await self._request("chan_open", name)
 
     async def deliver_local(self, name: str, payload: Any) -> None:
-        await self._request("chan_put", (name, wire.host_view(payload)))
+        hosted = wire.host_view(payload)
+        if _SHM_ENABLED:
+            wrapped, handles = ipc.wrap_payload(hosted)
+        else:
+            wrapped, handles = hosted, []
+        try:
+            await self._request("chan_put", (name, wrapped))
+        finally:
+            ipc.cleanup_handles(handles)
 
     async def chan_put(
         self, name: str, payload: Any, *, endpoint: Optional[Endpoint] = None

@@ -1,0 +1,116 @@
+"""Cross-process payload wrapping via the native shm store.
+
+Behavior parity: ``byzpy/engine/actor/ipc.py:20-56`` — large host arrays
+in a payload pytree are swapped for shm handles before pickling, and
+swapped back (as zero-copy views) on the receiving side. Device arrays are
+first brought to host (this wire is host-side only; chips exchange tensors
+via collectives).
+
+Arrays smaller than ``min_bytes`` travel inline — the pickle round-trip is
+cheaper than two mmap syscalls for small payloads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from ..storage import native_store
+
+_TAG = "__BYZPY_SHARED_TENSOR__"
+DEFAULT_MIN_BYTES = 64 * 1024
+
+
+def _rebuild_tuple(x: tuple, values: list) -> tuple:
+    # preserve namedtuples (and tuple subclasses with a sequence ctor)
+    if hasattr(x, "_fields"):
+        return type(x)(*values)
+    if type(x) is not tuple:
+        try:
+            return type(x)(values)
+        except TypeError:
+            pass
+    return tuple(values)
+
+
+def wrap_payload(
+    obj: Any, *, min_bytes: int = DEFAULT_MIN_BYTES
+) -> Tuple[Any, List[native_store.SharedTensorHandle]]:
+    """Recursively replace large arrays with shm handles. Returns the
+    wrapped payload and the handles registered (caller owns cleanup; on
+    error, everything registered so far is unlinked before the raise)."""
+    handles: List[native_store.SharedTensorHandle] = []
+
+    def wrap(x: Any) -> Any:
+        if isinstance(x, np.ndarray) and x.nbytes >= min_bytes and not x.dtype.hasobject:
+            handle = native_store.register_tensor(x)
+            handles.append(handle)
+            return (_TAG, handle)
+        if hasattr(x, "__array__") and not isinstance(x, np.ndarray):
+            # jax.Array / torch-style duck arrays: host copy first
+            arr = np.asarray(x)
+            if arr.nbytes >= min_bytes and not arr.dtype.hasobject:
+                handle = native_store.register_tensor(arr)
+                handles.append(handle)
+                return (_TAG, handle)
+            return x
+        if isinstance(x, dict):
+            return {k: wrap(v) for k, v in x.items()}
+        if isinstance(x, tuple):
+            return _rebuild_tuple(x, [wrap(v) for v in x])
+        if isinstance(x, list):
+            return [wrap(v) for v in x]
+        return x
+
+    try:
+        return wrap(obj), handles
+    except BaseException:
+        cleanup_handles(handles)
+        raise
+
+
+def unwrap_payload(obj: Any, *, copy: bool = False, close: bool = False) -> Any:
+    """Swap shm handles back for arrays. With ``copy=False`` the arrays are
+    zero-copy views into the segment — valid only while the segment lives;
+    pass ``copy=True`` when the result must outlive the sender's cleanup.
+    ``close=True`` (requires ``copy``) unmaps each segment right after
+    copying — the receiving-process pattern, so per-call mappings don't
+    accumulate."""
+    if close and not copy:
+        raise ValueError("close=True requires copy=True (views need the mapping)")
+
+    def unwrap(x: Any) -> Any:
+        if (
+            isinstance(x, tuple)
+            and len(x) == 2
+            and x[0] == _TAG
+            and isinstance(x[1], native_store.SharedTensorHandle)
+        ):
+            view = native_store.open_tensor(x[1])
+            if copy:
+                out = view.copy()
+                if close:
+                    native_store.close_tensor(x[1])
+                return out
+            return view
+        if isinstance(x, dict):
+            return {k: unwrap(v) for k, v in x.items()}
+        if isinstance(x, tuple):
+            return _rebuild_tuple(x, [unwrap(v) for v in x])
+        if isinstance(x, list):
+            return [unwrap(v) for v in x]
+        return x
+
+    return unwrap(obj)
+
+
+def cleanup_handles(handles: List[native_store.SharedTensorHandle]) -> None:
+    for handle in handles:
+        try:
+            native_store.cleanup_tensor(handle)
+        except OSError:
+            pass
+
+
+__all__ = ["wrap_payload", "unwrap_payload", "cleanup_handles", "DEFAULT_MIN_BYTES"]

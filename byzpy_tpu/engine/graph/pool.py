@@ -40,15 +40,28 @@ def _infer_capabilities(backend_spec: str) -> frozenset[str]:
 
 @dataclass(frozen=True)
 class ActorPoolConfig:
-    backend: str = "thread"
+    # None means "the configured default" (configs.actor.set_actor/get_actor)
+    backend: Optional[str] = None
     count: int = 1
     capabilities: Optional[Sequence[str]] = None
     name: Optional[str] = None
 
-    def resolved_capabilities(self) -> frozenset[str]:
+    def resolved_backend(self) -> str:
+        if self.backend is not None:
+            return self.backend
+        from ...configs.actor import get_actor
+
+        return get_actor()
+
+    def resolved_capabilities(
+        self, backend: Optional[str] = None
+    ) -> frozenset[str]:
+        """Capabilities for ``backend`` (pass the value from one
+        ``resolved_backend()`` call — resolving twice races the mutable
+        config default)."""
         if self.capabilities is not None:
             return frozenset(self.capabilities)
-        return _infer_capabilities(self.backend)
+        return _infer_capabilities(backend or self.resolved_backend())
 
 
 class _SubTaskWorker:
@@ -129,11 +142,12 @@ class ActorPool:
         pool_id = next(self._pool_ids)
         self._workers: List[_PoolWorker] = []
         for ci, cfg in enumerate(configs):
-            caps = cfg.resolved_capabilities()
+            backend = cfg.resolved_backend()
+            caps = cfg.resolved_capabilities(backend)
             for wi in range(cfg.count):
-                base = cfg.name or f"pool{pool_id}-{cfg.backend.split('://')[0].replace(':', '_')}"
+                base = cfg.name or f"pool{pool_id}-{backend.split('://')[0].replace(':', '_')}"
                 name = f"{base}-{ci}-{wi}"
-                self._workers.append(_PoolWorker(name, cfg.backend, caps))
+                self._workers.append(_PoolWorker(name, backend, caps))
         self._free: List[_PoolWorker] = []
         self._waiters: List[tuple[Optional[str], asyncio.Future]] = []
         self._started = False

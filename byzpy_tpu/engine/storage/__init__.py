@@ -1,0 +1,17 @@
+from .native_store import (
+    SharedTensorHandle,
+    available,
+    cleanup_tensor,
+    close_tensor,
+    open_tensor,
+    register_tensor,
+)
+
+__all__ = [
+    "SharedTensorHandle",
+    "available",
+    "register_tensor",
+    "open_tensor",
+    "close_tensor",
+    "cleanup_tensor",
+]

@@ -1,0 +1,214 @@
+"""Shared-memory tensor store over the native ``bshm`` C library.
+
+Functional parity with the reference's shm store
+(``byzpy/engine/storage/shared_store.py:21-54``): ``register_tensor`` puts
+a numpy array into a named POSIX shm segment and returns a picklable
+:class:`SharedTensorHandle`; ``open_tensor`` maps it (zero-copy) in any
+process; ``cleanup_tensor`` unlinks it. The C library (compiled lazily
+from ``native/bshm.c``; see :func:`available`) avoids
+``multiprocessing.shared_memory``'s resource tracker, whose at-exit
+unlinking misfires across independently spawned actor processes. When no
+C toolchain is present, a pure-Python fallback keeps the same API.
+
+TPU framing: this store is for **host-side** handoff (process actors, data
+loading). Device arrays never live here — they stay resident as
+``jax.Array``s and move via collectives.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+_C_SRC = os.path.join(os.path.dirname(__file__), "native", "bshm.c")
+_CACHE_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+    "byzpy_tpu",
+)
+
+
+def _build_library() -> Optional[str]:
+    """Compile bshm.c to a shared library (cached)."""
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    lib_path = os.path.join(_CACHE_DIR, "libbshm.so")
+    if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(_C_SRC):
+        return lib_path
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            with tempfile.NamedTemporaryFile(
+                suffix=".so", dir=_CACHE_DIR, delete=False
+            ) as tmp:
+                tmp_path = tmp.name
+            proc = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", tmp_path, _C_SRC],
+                capture_output=True,
+                timeout=120,
+            )
+            if proc.returncode == 0:
+                os.replace(tmp_path, lib_path)
+                return lib_path
+            os.unlink(tmp_path)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    with _LIB_LOCK:
+        if _LIB is not None or _LIB_TRIED:
+            return _LIB
+        _LIB_TRIED = True
+        path = _build_library()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.bshm_map.restype = ctypes.c_void_p
+        lib.bshm_map.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.bshm_unmap.restype = ctypes.c_int
+        lib.bshm_unmap.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.bshm_unlink.restype = ctypes.c_int
+        lib.bshm_unlink.argtypes = [ctypes.c_char_p]
+        lib.bshm_size.restype = ctypes.c_uint64
+        lib.bshm_size.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    """True when the native library is (or can be) built and loaded."""
+    return _load() is not None
+
+
+@dataclass(frozen=True)
+class SharedTensorHandle:
+    """Picklable descriptor of a shm-resident tensor
+    (parity: ``shared_store.py`` name+shape+dtype handles).
+
+    ``dtype`` holds a ``np.lib.format`` descr (str for simple dtypes, list
+    for structured ones) so it round-trips through ``np.dtype``."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: object
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(self.dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64) * self.np_dtype.itemsize)
+
+
+# maps kept per-process so views can be unmapped deterministically; a name
+# may be mapped more than once (open_tensor called repeatedly), so each
+# mapping is tracked and all are released on close
+_mappings: Dict[str, List[Tuple[int, int]]] = {}  # name -> [(ptr, nbytes)]
+
+
+def _map(name: str, nbytes: int, create: bool) -> np.ndarray:
+    lib = _load()
+    if lib is not None:
+        err = ctypes.c_int(0)
+        ptr = lib.bshm_map(name.encode(), nbytes, 1 if create else 0,
+                           ctypes.byref(err))
+        if not ptr:
+            raise OSError(err.value, f"bshm_map({name!r}) failed: errno {err.value}")
+        _mappings.setdefault(name, []).append((ptr, nbytes))
+        buf = (ctypes.c_ubyte * nbytes).from_address(ptr)
+        return np.frombuffer(buf, dtype=np.uint8)
+    # fallback: multiprocessing.shared_memory (tracker caveats documented)
+    from multiprocessing import resource_tracker, shared_memory
+
+    shm = shared_memory.SharedMemory(
+        name=name.lstrip("/"), create=create, size=nbytes
+    )
+    # the resource tracker would unlink segments owned by *other* processes
+    # at exit; opening (not creating) must unregister to stay hands-off
+    if not create:
+        try:
+            resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+        except Exception:  # noqa: BLE001 — tracker API is private/fragile
+            pass
+    _fallback_segments.setdefault(name, []).append(shm)
+    return np.frombuffer(shm.buf, dtype=np.uint8)[:nbytes]
+
+
+_fallback_segments: Dict[str, List[object]] = {}
+
+
+def register_tensor(
+    array: np.ndarray, *, name: Optional[str] = None
+) -> SharedTensorHandle:
+    """Copy ``array`` into a fresh shm segment; returns its handle
+    (ref: ``shared_store.py:21-29``)."""
+    array = np.ascontiguousarray(array)
+    if array.dtype.hasobject:
+        raise TypeError("object-dtype arrays cannot live in shared memory")
+    name = name or f"/byzpy-{uuid.uuid4().hex[:16]}"
+    descr = np.lib.format.dtype_to_descr(array.dtype)
+    handle = SharedTensorHandle(name, tuple(array.shape), descr)
+    view = _map(name, max(1, handle.nbytes), create=True)
+    view[: handle.nbytes] = array.view(np.uint8).reshape(-1)
+    return handle
+
+
+def open_tensor(handle: SharedTensorHandle) -> np.ndarray:
+    """Zero-copy view of a registered tensor in this process
+    (ref: ``shared_store.py:32-41``)."""
+    view = _map(handle.name, max(1, handle.nbytes), create=False)
+    return view[: handle.nbytes].view(handle.np_dtype).reshape(handle.shape)
+
+
+def close_tensor(handle: SharedTensorHandle) -> None:
+    """Unmap all of this process's views of the segment (segment persists)."""
+    lib = _load()
+    if lib is not None:
+        for ptr, nbytes in _mappings.pop(handle.name, []):
+            lib.bshm_unmap(ptr, nbytes)
+        return
+    for shm in _fallback_segments.pop(handle.name, []):
+        shm.close()
+
+
+def cleanup_tensor(handle: SharedTensorHandle) -> None:
+    """Unmap and unlink the segment (ref: ``shared_store.py:44-54``)."""
+    close_tensor(handle)
+    lib = _load()
+    if lib is not None:
+        lib.bshm_unlink(handle.name.encode())
+        return
+    from multiprocessing import shared_memory
+
+    try:
+        shm = shared_memory.SharedMemory(name=handle.name.lstrip("/"))
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+
+
+__all__ = [
+    "SharedTensorHandle",
+    "available",
+    "register_tensor",
+    "open_tensor",
+    "close_tensor",
+    "cleanup_tensor",
+]

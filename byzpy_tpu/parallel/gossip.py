@@ -93,6 +93,10 @@ def build_gossip_train_step(
         for idxs, nbrs in topology.in_neighbor_groups(include_self=True)
     ]
 
+    if mesh is None:
+        from ..configs.mesh import get_default_mesh
+
+        mesh = get_default_mesh()
     node_sharding = None
     if mesh is not None:
         node_sharding = mesh_sharding(mesh, node_axis(mesh))

@@ -94,6 +94,10 @@ def build_ps_train_step(
     if not 0 <= b < cfg.n_nodes:
         raise ValueError(f"need 0 <= n_byzantine < n_nodes (got {b}/{cfg.n_nodes})")
 
+    if mesh is None:
+        from ..configs.mesh import get_default_mesh
+
+        mesh = get_default_mesh()
     node_spec = None
     feat_spec = None
     if mesh is not None:

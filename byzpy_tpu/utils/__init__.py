@@ -1,3 +1,17 @@
+from .checkpoint import CheckpointManager, restore_checkpoint, save_checkpoint
+from .metrics import MetricsLogger, StepTimer, trace
 from .trees import stack_gradients, unstack_rows
+from .training import train_with_progress, train_with_progress_async
 
-__all__ = ["stack_gradients", "unstack_rows"]
+__all__ = [
+    "stack_gradients",
+    "unstack_rows",
+    "train_with_progress",
+    "train_with_progress_async",
+    "CheckpointManager",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "MetricsLogger",
+    "StepTimer",
+    "trace",
+]

@@ -1,0 +1,80 @@
+"""Training loop helper (API parity: ``byzpy/utils/training.py:7-34``).
+
+``train_with_progress`` drives a ParameterServer (or anything with an async
+``round()``) for N rounds with optional periodic evaluation, returning the
+evaluation history. Progress rendering uses tqdm when available and
+degrades to silence otherwise (tqdm is not a hard dependency).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any, Awaitable, Callable, List, Optional, Tuple
+
+EvalCallback = Callable[[int], Any]
+
+
+async def train_with_progress_async(
+    ps: Any,
+    rounds: int,
+    *,
+    eval_callback: Optional[EvalCallback] = None,
+    eval_interval: int = 10,
+    progress: bool = True,
+) -> List[Tuple[int, Any]]:
+    """Run ``rounds`` rounds of ``ps.round()``; call ``eval_callback(i)``
+    every ``eval_interval`` rounds (and after the last). Returns
+    ``[(round_index, eval_result), ...]``."""
+    bar = None
+    if progress:
+        try:
+            from tqdm import tqdm
+
+            bar = tqdm(total=rounds, desc="training", leave=False)
+        except ImportError:
+            bar = None
+    history: List[Tuple[int, Any]] = []
+    try:
+        for i in range(rounds):
+            out = ps.round()
+            if inspect.isawaitable(out):
+                await out
+            if eval_callback is not None and (
+                (i + 1) % eval_interval == 0 or i == rounds - 1
+            ):
+                result = eval_callback(i)
+                if inspect.isawaitable(result):
+                    result = await result
+                history.append((i, result))
+                if bar is not None and result is not None:
+                    bar.set_postfix_str(str(result))
+            if bar is not None:
+                bar.update(1)
+    finally:
+        if bar is not None:
+            bar.close()
+    return history
+
+
+def train_with_progress(
+    ps: Any,
+    rounds: int,
+    *,
+    eval_callback: Optional[EvalCallback] = None,
+    eval_interval: int = 10,
+    progress: bool = True,
+) -> List[Tuple[int, Any]]:
+    """Sync wrapper (owns an event loop), matching the reference signature."""
+    return asyncio.run(
+        train_with_progress_async(
+            ps,
+            rounds,
+            eval_callback=eval_callback,
+            eval_interval=eval_interval,
+            progress=progress,
+        )
+    )
+
+
+__all__ = ["train_with_progress", "train_with_progress_async"]

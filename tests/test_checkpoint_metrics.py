@@ -1,0 +1,98 @@
+"""Checkpoint/resume (sharded) + metrics logging.
+
+These are survey-mandated additions (SURVEY §5) with no reference
+equivalent: checkpoints must round-trip sharded pytrees (restore onto a
+mesh re-shards), metrics must capture structured step series.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzpy_tpu.parallel.mesh import node_mesh, replicated, sharding
+from byzpy_tpu.utils.checkpoint import (
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from byzpy_tpu.utils.metrics import MetricsLogger, StepTimer
+
+
+def test_checkpoint_roundtrip_plain(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(8.0), "b": jnp.zeros((3,))},
+        "round": jnp.asarray(7),
+    }
+    d = str(tmp_path / "ck")
+    with CheckpointManager(d) as mgr:
+        assert mgr.latest_step() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore()
+        mgr.save(3, state)
+        mgr.save(5, state)
+        assert mgr.latest_step() == 5
+        assert mgr.all_steps() == [3, 5]
+        out = mgr.restore()
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]), np.arange(8.0))
+    assert int(out["round"]) == 7
+
+
+def test_checkpoint_restores_sharded(tmp_path, devices):
+    mesh = node_mesh(8)
+    x = jax.device_put(jnp.arange(64.0).reshape(8, 8), sharding(mesh, "nodes"))
+    d = str(tmp_path / "ck")
+    save_checkpoint(d, 1, {"x": x})
+
+    # restore with a sharded target layout
+    like = {"x": jax.ShapeDtypeStruct((8, 8), jnp.float32,
+                                      sharding=sharding(mesh, "nodes"))}
+    out = restore_checkpoint(d, like=like)
+    assert out["x"].sharding.spec == sharding(mesh, "nodes").spec
+    np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(x))
+
+    # restore replicated instead — resharding on load
+    like_rep = {"x": jax.ShapeDtypeStruct((8, 8), jnp.float32,
+                                          sharding=replicated(mesh))}
+    out2 = restore_checkpoint(d, like=like_rep)
+    assert out2["x"].sharding.is_fully_replicated
+    np.testing.assert_allclose(np.asarray(out2["x"]), np.asarray(x))
+
+
+def test_checkpoint_max_to_keep(tmp_path):
+    d = str(tmp_path / "ck")
+    with CheckpointManager(d, max_to_keep=2) as mgr:
+        for s in (1, 2, 3, 4):
+            mgr.save(s, {"v": jnp.asarray(float(s))})
+        assert mgr.all_steps() == [3, 4]
+
+
+def test_metrics_logger_history_and_sink(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricsLogger(path) as log:
+        log.log(0, loss=jnp.asarray(2.5), acc=0.1)
+        log.log(1, loss=1.5)
+        log.log(2, loss=jnp.asarray(0.5), acc=0.9)
+        assert log.series("loss") == [2.5, 1.5, 0.5]
+        assert log.latest("acc") == 0.9
+        s = log.summary()
+        assert s["loss"]["min"] == 0.5 and s["loss"]["count"] == 3
+        assert s["acc"]["last"] == 0.9
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 3 and lines[0]["loss"] == 2.5
+    assert all("time" in l and "step" in l for l in lines)
+
+
+def test_step_timer_blocks_on_device_work():
+    t = StepTimer()
+    x = jnp.ones((256, 256))
+    t.start()
+    y = x @ x
+    dt = t.stop(y)
+    assert dt > 0
+    assert t.mean_s > 0 and t.median_s > 0
+    with pytest.raises(RuntimeError):
+        t.stop()

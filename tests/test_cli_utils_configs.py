@@ -1,0 +1,105 @@
+"""CLI, training utils, and config setters.
+
+Parity targets: ``byzpy/cli.py`` (version/doctor/list), ``byzpy/utils/
+training.py`` (train_with_progress), ``byzpy/configs/actor.py`` (+ the
+mesh analogue of configs/backend.py).
+"""
+
+import json
+
+import pytest
+
+from byzpy_tpu.cli import doctor_report, main
+from byzpy_tpu.configs import (
+    get_actor,
+    get_default_mesh,
+    set_actor,
+    set_default_mesh,
+    use_actor,
+    use_mesh,
+)
+from byzpy_tpu.utils.training import train_with_progress
+from byzpy_tpu.version import __version__
+
+
+def test_cli_version(capsys):
+    assert main(["version"]) == 0
+    assert capsys.readouterr().out.strip() == __version__
+
+
+def test_cli_doctor_json(capsys):
+    assert main(["doctor", "--format", "json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["jax"]["ok"]
+    assert report["device_count"] >= 8  # virtual CPU mesh from conftest
+    assert all(d["platform"] == "cpu" for d in report["devices"])
+    assert report["version"] == __version__
+
+
+def test_cli_list_kinds(capsys):
+    assert main(["list", "aggregators"]) == 0
+    out = capsys.readouterr().out
+    for expected in ("CoordinateWiseMedian", "MultiKrum", "GeometricMedian",
+                     "CenteredClipping", "SMEA"):
+        assert expected in out
+    assert main(["list", "attacks"]) == 0
+    out = capsys.readouterr().out
+    assert "SignFlipAttack" in out and "LittleAttack" in out
+    assert main(["list", "pre-aggregators"]) == 0
+    out = capsys.readouterr().out
+    assert "Bucketing" in out and "NearestNeighborMixing" in out
+
+
+def test_doctor_report_probes_deps():
+    report = doctor_report()
+    assert report["flax"]["ok"] and report["optax"]["ok"]
+    assert "native_shm_store" in report
+
+
+def test_train_with_progress_runs_rounds_and_evals():
+    class FakePS:
+        def __init__(self):
+            self.rounds = 0
+
+        async def round(self):
+            self.rounds += 1
+
+    ps = FakePS()
+    evals = []
+    history = train_with_progress(
+        ps, 25,
+        eval_callback=lambda i: evals.append(i) or ps.rounds,
+        eval_interval=10,
+        progress=False,
+    )
+    assert ps.rounds == 25
+    assert [i for i, _ in history] == [9, 19, 24]
+    assert [r for _, r in history] == [10, 20, 25]
+
+
+def test_actor_config_roundtrip():
+    assert get_actor() == "thread"
+    set_actor("process")
+    try:
+        assert get_actor() == "process"
+        with use_actor("tpu"):
+            assert get_actor() == "tpu"
+        assert get_actor() == "process"
+    finally:
+        set_actor("thread")
+    with pytest.raises(ValueError):
+        set_actor("warp-drive")
+
+
+def test_mesh_config_roundtrip(devices):
+    assert get_default_mesh() is None
+    mesh = get_default_mesh(create=True)
+    assert mesh is not None and mesh.devices.size >= 8
+    set_default_mesh(mesh)
+    try:
+        assert get_default_mesh() is mesh
+    finally:
+        set_default_mesh(None)
+    with use_mesh(mesh):
+        assert get_default_mesh() is mesh
+    assert get_default_mesh() is None

@@ -1,0 +1,121 @@
+"""Native shm tensor store + cross-process payload wrapping.
+
+Parity targets: ``byzpy/engine/storage/shared_store.py`` (register/open/
+cleanup of named tensors) and ``byzpy/engine/actor/ipc.py`` (payload
+wrap/unwrap around process hops). The store here is a C library (POSIX
+shm via ctypes) with a pure-Python fallback.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.engine.actor.ipc import (
+    cleanup_handles,
+    unwrap_payload,
+    wrap_payload,
+)
+from byzpy_tpu.engine.storage import native_store
+
+
+def test_native_library_builds():
+    """The image has a C toolchain, so the native path must be live (the
+    fallback exists for toolchain-less installs)."""
+    assert native_store.available()
+
+
+def test_register_open_cleanup_roundtrip():
+    arr = np.arange(1024, dtype=np.float32).reshape(32, 32)
+    handle = native_store.register_tensor(arr)
+    assert handle.shape == (32, 32) and handle.np_dtype == np.float32
+    assert handle.nbytes == arr.nbytes
+    view = native_store.open_tensor(handle)
+    np.testing.assert_array_equal(view, arr)
+    # shm is shared: writes through one mapping are visible via another
+    view[0, 0] = 123.0
+    view2 = native_store.open_tensor(handle)
+    assert view2[0, 0] == 123.0
+    native_store.cleanup_tensor(handle)
+    with pytest.raises(OSError):
+        native_store.open_tensor(handle)
+
+
+def test_wrap_payload_thresholds_and_structure():
+    big = np.ones((64 * 1024,), dtype=np.float32)  # 256 KiB
+    small = np.ones((4,), dtype=np.float32)
+    payload = {"g": [big, small], "meta": ("x", 1)}
+    wrapped, handles = wrap_payload(payload)
+    try:
+        assert len(handles) == 1  # only the big array moved to shm
+        assert isinstance(wrapped["g"][0], tuple)
+        assert isinstance(wrapped["g"][1], np.ndarray)
+        out = unwrap_payload(wrapped, copy=True, close=True)
+        np.testing.assert_array_equal(out["g"][0], big)
+        np.testing.assert_array_equal(out["g"][1], small)
+        assert out["meta"] == ("x", 1)
+    finally:
+        cleanup_handles(handles)
+
+
+def test_unwrap_close_requires_copy():
+    with pytest.raises(ValueError):
+        unwrap_payload({}, copy=False, close=True)
+
+
+def test_wrap_preserves_namedtuples():
+    from collections import namedtuple
+
+    Point = namedtuple("Point", "x y")
+    big = np.ones((64 * 1024,), dtype=np.float32)
+    wrapped, handles = wrap_payload(Point(x=big, y=1))
+    try:
+        assert isinstance(wrapped, Point) and wrapped.y == 1
+        out = unwrap_payload(wrapped, copy=True, close=True)
+        assert isinstance(out, Point)
+        np.testing.assert_array_equal(out.x, big)
+    finally:
+        cleanup_handles(handles)
+
+
+def test_structured_dtype_roundtrip():
+    dt = np.dtype([("a", "<f4"), ("b", "<i4")])
+    arr = np.zeros(32 * 1024, dtype=dt)
+    arr["a"] = 1.5
+    arr["b"] = 7
+    handle = native_store.register_tensor(arr)
+    try:
+        view = native_store.open_tensor(handle)
+        assert view.dtype == dt
+        assert view["b"][0] == 7 and view["a"][-1] == 1.5
+    finally:
+        native_store.cleanup_tensor(handle)
+
+
+def test_object_dtype_rejected():
+    with pytest.raises(TypeError):
+        native_store.register_tensor(np.array([object()], dtype=object))
+
+
+def test_process_actor_large_payload_via_shm():
+    """A process actor call with a multi-MB array arrives intact (riding
+    the shm path, not the pipe)."""
+    from byzpy_tpu.engine.actor.backends.process import ProcessActorBackend
+    from byzpy_tpu.engine.actor.base import spawn_actor
+
+    class Echo:
+        def stats(self, arr):
+            return float(arr.sum()), arr.shape, float(arr[-1, -1])
+
+    async def go():
+        backend = ProcessActorBackend()
+        ref = await spawn_actor(backend, Echo)
+        big = np.full((1024, 1024), 2.0, dtype=np.float32)  # 4 MiB
+        big[-1, -1] = 7.0
+        total, shape, corner = await ref.stats(big)
+        assert shape == (1024, 1024)
+        assert corner == 7.0
+        assert total == pytest.approx(2.0 * (1024 * 1024 - 1) + 7.0)
+        await backend.close()
+
+    asyncio.run(go())
