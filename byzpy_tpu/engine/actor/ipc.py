@@ -22,6 +22,12 @@ _TAG = "__BYZPY_SHARED_TENSOR__"
 DEFAULT_MIN_BYTES = 64 * 1024
 
 
+def _is_dataclass_instance(x: Any) -> bool:
+    import dataclasses
+
+    return dataclasses.is_dataclass(x) and not isinstance(x, type)
+
+
 def _rebuild_tuple(x: tuple, values: list) -> tuple:
     # preserve namedtuples (and tuple subclasses with a sequence ctor)
     if hasattr(x, "_fields"):
@@ -55,6 +61,16 @@ def wrap_payload(
                 handles.append(handle)
                 return (_TAG, handle)
             return x
+        if _is_dataclass_instance(x):
+            import dataclasses
+
+            return dataclasses.replace(
+                x,
+                **{
+                    f.name: wrap(getattr(x, f.name))
+                    for f in dataclasses.fields(x)
+                },
+            )
         if isinstance(x, dict):
             return {k: wrap(v) for k, v in x.items()}
         if isinstance(x, tuple):
@@ -84,6 +100,9 @@ def unwrap_payload(obj: Any, *, copy: bool = False, close: bool = False) -> Any:
         if (
             isinstance(x, tuple)
             and len(x) == 2
+            # isinstance check first: comparing an ndarray to _TAG would
+            # produce an ambiguous-truth-value array
+            and isinstance(x[0], str)
             and x[0] == _TAG
             and isinstance(x[1], native_store.SharedTensorHandle)
         ):
@@ -91,9 +110,20 @@ def unwrap_payload(obj: Any, *, copy: bool = False, close: bool = False) -> Any:
             if copy:
                 out = view.copy()
                 if close:
+                    del view  # the mapping can't close under a live view
                     native_store.close_tensor(x[1])
                 return out
             return view
+        if _is_dataclass_instance(x):
+            import dataclasses
+
+            return dataclasses.replace(
+                x,
+                **{
+                    f.name: unwrap(getattr(x, f.name))
+                    for f in dataclasses.fields(x)
+                },
+            )
         if isinstance(x, dict):
             return {k: unwrap(v) for k, v in x.items()}
         if isinstance(x, tuple):

@@ -24,7 +24,7 @@ import tempfile
 import threading
 import uuid
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -177,14 +177,25 @@ def open_tensor(handle: SharedTensorHandle) -> np.ndarray:
 
 
 def close_tensor(handle: SharedTensorHandle) -> None:
-    """Unmap all of this process's views of the segment (segment persists)."""
+    """Unmap all of this process's views of the segment (segment persists).
+
+    Callers must drop their numpy views first; in the fallback,
+    ``SharedMemory.close`` refuses while exported buffers exist, and such
+    segments are kept open (re-closed on a later call) rather than erroring.
+    """
     lib = _load()
     if lib is not None:
         for ptr, nbytes in _mappings.pop(handle.name, []):
             lib.bshm_unmap(ptr, nbytes)
         return
+    survivors = []
     for shm in _fallback_segments.pop(handle.name, []):
-        shm.close()
+        try:
+            shm.close()
+        except BufferError:
+            survivors.append(shm)  # a live view still pins the mapping
+    if survivors:
+        _fallback_segments[handle.name] = survivors
 
 
 def cleanup_tensor(handle: SharedTensorHandle) -> None:

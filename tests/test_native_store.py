@@ -8,6 +8,7 @@ shm via ctypes) with a pure-Python fallback.
 
 import asyncio
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -61,6 +62,50 @@ def test_wrap_payload_thresholds_and_structure():
 def test_unwrap_close_requires_copy():
     with pytest.raises(ValueError):
         unwrap_payload({}, copy=False, close=True)
+
+
+def test_unwrap_tolerates_array_first_tuples():
+    """A 2-tuple whose first element is an ndarray must not trip the shm-tag
+    check (ambiguous array truth value)."""
+    payload = (np.ones(4, np.float32), "x")
+    out = unwrap_payload(payload)
+    np.testing.assert_array_equal(out[0], payload[0])
+    assert out[1] == "x"
+
+
+def test_wrap_descends_into_dataclass_envelopes():
+    """Message-style dataclass payloads go through the shm path like dicts."""
+    from byzpy_tpu.engine.node.context import Message
+
+    big = np.full((64 * 1024,), 3.0, dtype=np.float32)
+    msg = Message("grad", "n0", big, {"round": 1})
+    wrapped, handles = wrap_payload(msg)
+    try:
+        assert len(handles) == 1
+        assert isinstance(wrapped.payload, tuple)  # shm marker
+        out = unwrap_payload(wrapped, copy=True, close=True)
+        np.testing.assert_array_equal(out.payload, big)
+        assert out.metadata == {"round": 1}
+    finally:
+        cleanup_handles(handles)
+
+
+def test_coordinate_ops_int_and_1d_inputs(monkeypatch):
+    """Dispatch must not break non-2D or integer inputs (the jnp paths
+    handled both before the Pallas fork existed)."""
+    from byzpy_tpu.ops import robust
+
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    v = jnp.arange(9.0)
+    np.testing.assert_allclose(float(robust.trimmed_mean(v[:, None] * jnp.ones((1, 4)), f=2)[0]), 4.0)
+    ints = jnp.arange(12, dtype=jnp.int32).reshape(3, 4)
+    np.testing.assert_allclose(np.asarray(robust.coordinate_median(ints)),
+                               np.median(np.asarray(ints), axis=0))
+    # int sort through the network directly (iinfo padding)
+    from byzpy_tpu.ops.pallas_kernels import sort_columns
+
+    out = sort_columns(jnp.asarray([[3, 1], [2, 5], [9, 0]], jnp.int32), interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), [[2, 0], [3, 1], [9, 5]])
 
 
 def test_wrap_preserves_namedtuples():
