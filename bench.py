@@ -16,7 +16,6 @@ ActorPool latencies on the two matched workloads it does publish
 from __future__ import annotations
 
 import json
-import time
 from functools import partial
 
 import jax
@@ -25,17 +24,12 @@ import jax.numpy as jnp
 from byzpy_tpu.ops import robust
 
 
-def timed(fn, *args, warmup: int = 2, repeat: int = 10) -> float:
-    """Median wall seconds per call, post-compilation, device-synchronized."""
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    times = []
-    for _ in range(repeat):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2]
+def timed(fn, *args, warmup: int = 2, repeat: int = 20) -> float:
+    """Mean wall seconds per call; tunnel-hardened (see
+    ``byzpy_tpu.utils.metrics.timed_call_s``)."""
+    from byzpy_tpu.utils.metrics import timed_call_s
+
+    return timed_call_s(fn, *args, warmup=warmup, repeat=repeat)
 
 
 def grads(key, n, d, dtype=jnp.float32):

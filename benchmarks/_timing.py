@@ -1,0 +1,29 @@
+"""Shared timing harness for the benchmark scripts."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Callable, Dict
+
+import jax
+
+
+def timed_ms(fn: Callable, *args: Any, warmup: int = 2, repeat: int = 20) -> float:
+    """Mean wall milliseconds per call (see
+    :func:`byzpy_tpu.utils.metrics.timed_call_s` for the tunnel-measurement
+    hazards this defends against)."""
+    from byzpy_tpu.utils.metrics import timed_call_s
+
+    return timed_call_s(fn, *args, warmup=warmup, repeat=repeat) * 1e3
+
+
+def report(name: str, ms: float, **extra: Any) -> Dict[str, Any]:
+    row = {"workload": name, "ms": round(ms, 3), **extra}
+    print(json.dumps(row))
+    print(f"{name:48s} {ms:10.3f} ms  {extra or ''}", file=sys.stderr)
+    return row
+
+
+__all__ = ["timed_ms", "report"]

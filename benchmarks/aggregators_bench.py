@@ -1,0 +1,60 @@
+"""Aggregator latency grid (ref: ``byzpy/benchmarks/README.md:10-30``).
+
+Reference workloads (their best pooled CPU latencies in BASELINE.md) plus
+the 1M-dim north-star shapes. One JSON line per row.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)                      # for _timing
+sys.path.insert(0, os.path.dirname(_here))     # repo root
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from _timing import report, timed_ms
+from byzpy_tpu.aggregators import MinimumDiameterAveraging
+from byzpy_tpu.ops import robust
+
+
+def grads(n, d, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (n, d), jnp.float32)
+
+
+_mda_op = MinimumDiameterAveraging(f=5)
+
+
+def mda(x):
+    return _mda_op.aggregate(x)
+
+
+def main():
+    # the reference's published grid
+    report("cw_median_64x65536", timed_ms(jax.jit(robust.coordinate_median), grads(64, 65536)),
+           ref_best_ms=37.0)
+    report("cw_trimmed_mean_64x65536",
+           timed_ms(jax.jit(partial(robust.trimmed_mean, f=15)), grads(64, 65536)),
+           ref_best_ms=43.0)
+    report("multi_krum_80x65536_f20_q12",
+           timed_ms(jax.jit(partial(robust.multi_krum, f=20, q=12)), grads(80, 65536)),
+           ref_best_ms=26.30)
+    report("geometric_median_64x65536",
+           timed_ms(jax.jit(partial(robust.geometric_median, max_iter=64)), grads(64, 65536)))
+    report("centered_clipping_64x65536",
+           timed_ms(jax.jit(partial(robust.centered_clipping, c_tau=10.0, M=10)), grads(64, 65536)))
+    report("cge_64x65536", timed_ms(jax.jit(partial(robust.cge, f=15)), grads(64, 65536)))
+    report("monna_64x65536", timed_ms(jax.jit(partial(robust.monna, f=15)), grads(64, 65536)))
+    report("mda_30x2048_f5", timed_ms(mda, grads(30, 2048)))
+
+    # north-star 1M-dim shapes
+    report("cw_median_64x1M", timed_ms(jax.jit(robust.coordinate_median), grads(64, 1 << 20)))
+    report("multi_krum_64x1M_f8_q12",
+           timed_ms(jax.jit(partial(robust.multi_krum, f=8, q=12)), grads(64, 1 << 20)))
+
+
+if __name__ == "__main__":
+    main()

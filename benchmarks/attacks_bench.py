@@ -1,0 +1,39 @@
+"""Attack crafting latency (ref: ``byzpy/benchmarks/pytorch/*_actor_pool.py``
+attack sweeps): time to produce one malicious vector from 64×65,536 honest
+gradients."""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)                      # for _timing
+sys.path.insert(0, os.path.dirname(_here))     # repo root
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from _timing import report, timed_ms
+from byzpy_tpu.ops import attack_ops
+
+
+def main():
+    honest = jax.random.normal(jax.random.PRNGKey(0), (64, 65536), jnp.float32)
+    base = honest[0]
+    key = jax.random.PRNGKey(1)
+
+    report("sign_flip_64x65536",
+           timed_ms(jax.jit(partial(attack_ops.sign_flip, scale=-1.0)), base))
+    report("empire_64x65536",
+           timed_ms(jax.jit(partial(attack_ops.empire, scale=-1.0)), honest))
+    report("little_64x65536",
+           timed_ms(jax.jit(partial(attack_ops.little, f=15, n_total=64)), honest))
+    report("gaussian_64x65536",
+           timed_ms(jax.jit(lambda k: attack_ops.gaussian(k, (65536,))), key))
+    report("mimic_64x65536",
+           timed_ms(jax.jit(partial(attack_ops.mimic, epsilon=0)), honest))
+
+
+if __name__ == "__main__":
+    main()

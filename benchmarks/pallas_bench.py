@@ -1,0 +1,37 @@
+"""Pallas sorting-network vs XLA sort across node counts at 1M-dim
+(the measurement behind ``pallas_kernels.MAX_NETWORK_ROWS``)."""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)                      # for _timing
+sys.path.insert(0, os.path.dirname(_here))     # repo root
+
+import jax
+import jax.numpy as jnp
+
+from _timing import report, timed_ms
+from byzpy_tpu.ops.pallas_kernels import median_pallas
+
+D = 1 << 20
+
+
+def main():
+    interpret = jax.default_backend() != "tpu"
+    for n in (8, 16, 32, 64, 128):
+        x = jax.random.normal(jax.random.PRNGKey(n), (n, D), jnp.float32)
+        t_pallas = timed_ms(
+            jax.jit(lambda v: median_pallas(v, interpret=interpret)), x, repeat=30
+        )
+        t_xla = timed_ms(jax.jit(lambda v: jnp.median(v, axis=0)), x, repeat=30)
+        report(
+            f"median_{n}x1M",
+            t_pallas,
+            xla_ms=round(t_xla, 3),
+            speedup=round(t_xla / t_pallas, 2),
+        )
+
+
+if __name__ == "__main__":
+    main()

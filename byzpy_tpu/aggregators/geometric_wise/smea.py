@@ -10,7 +10,6 @@ averaged.
 from __future__ import annotations
 
 import math
-from itertools import islice
 
 import numpy as np
 import jax.numpy as jnp
@@ -19,7 +18,6 @@ from ...engine.graph.chunking import select_adaptive_chunk_size
 from ...engine.graph.operator import OpContext
 from ...engine.graph.subtask import SubTask
 from ...ops import robust
-from ...utils.combinatorics import iter_combinations
 from ...utils.trees import stack_gradients
 from ..base import Aggregator
 
@@ -29,23 +27,15 @@ _DEVICE_BATCH = 2048
 def _score_combo_range_smea(
     host_gram: np.ndarray, n: int, m: int, start: int, count: int
 ) -> tuple[float, np.ndarray]:
+    from .minimum_diameter_average import _combo_batches, _device_best
+
     gram = jnp.asarray(host_gram)
-    it = islice(iter_combinations(n, m, start), count)
-    best_score = math.inf
-    best_combo: np.ndarray | None = None
-    while True:
-        block = list(islice(it, _DEVICE_BATCH))
-        if not block:
-            break
-        combos = jnp.asarray(np.asarray(block, dtype=np.int32))
-        scores = robust.subset_max_eigvals(gram, combos)
-        i = int(jnp.argmin(scores))
-        score = float(scores[i])
-        if score < best_score:
-            best_score = score
-            best_combo = np.asarray(combos[i])
-    assert best_combo is not None
-    return best_score, best_combo
+    batch = min(_DEVICE_BATCH, count)
+    return _device_best(
+        gram,
+        _combo_batches(n, m, batch, start=start, count=count),
+        score_fn=robust.subset_max_eigvals,
+    )
 
 
 class SMEA(Aggregator):
