@@ -51,12 +51,14 @@ def steps_per_sec(n_devices, repeat=20):
     key = jax.random.PRNGKey(0)
     params = bundle.params
 
+    from byzpy_tpu.utils.metrics import force_result
+
     params, opt_state, _ = jit_step(params, opt_state, xs, ys, key)  # compile
-    jax.block_until_ready(params)
+    force_result(params)  # tunnel block_until_ready returns early; host copy can't
     t0 = time.perf_counter()
     for _ in range(repeat):
         params, opt_state, _ = jit_step(params, opt_state, xs, ys, key)
-    jax.block_until_ready(params)
+    force_result(params)
     return repeat / (time.perf_counter() - t0)
 
 

@@ -1,0 +1,21 @@
+"""Prototype-lineage runtime (parity with the reference's earlier stack:
+``byzpy/engine/node_runner.py``, ``node_cluster.py``, ``engine/transport/``,
+``engine/parameter_server/runner.py`` — SURVEY §2 "Prototype runners").
+
+The modern runtime is ``byzpy_tpu.engine.node`` (DecentralizedNode +
+contexts); these simpler pieces are kept, as the reference keeps its own,
+for minimal step-loop demos: polled mailbox transports and a
+process-per-node runner with cmd/result queues.
+"""
+
+from .runner import NodeCluster, NodeRunner, StepParameterServer
+from .transport import LocalMailbox, TcpMailbox, Transport
+
+__all__ = [
+    "Transport",
+    "LocalMailbox",
+    "TcpMailbox",
+    "NodeRunner",
+    "NodeCluster",
+    "StepParameterServer",
+]

@@ -1,3 +1,4 @@
+from . import collectives
 from .gossip import (
     GossipStepConfig,
     build_gossip_train_step,
@@ -15,6 +16,7 @@ from .mesh import (
 from .ps import PSStepConfig, build_ps_train_step, default_optimizer, jit_ps_train_step
 
 __all__ = [
+    "collectives",
     "make_mesh",
     "node_mesh",
     "feature_mesh",

@@ -78,7 +78,11 @@ def main():
                 f"round {r + 1}: honest_loss {float(metrics['honest_loss']):.3f} "
                 f"accuracy {acc:.3f}"
             )
-    assert acc > 0.5, "did not learn"
+    final_acc = float(
+        jnp.mean(jnp.argmax(bundle.apply_fn(params, x), -1) == y)
+    )
+    print(f"final accuracy after {ROUNDS} rounds: {final_acc:.3f}")
+    assert final_acc > 0.5, "did not learn"
 
 
 if __name__ == "__main__":
