@@ -1,0 +1,232 @@
+"""Transformer model family (decoder-only LM + sequence classifier).
+
+The reference has no transformer code at all (SURVEY §5: "no transformer/
+attention code"); this family exists because long-context training is
+first-class in the TPU build. Attention routes through one of two paths:
+
+* ``attention="full"`` — standard softmax attention (single chip);
+* ``attention="ring"`` — exact ring attention over a sequence-parallel
+  mesh axis (`byzpy_tpu.parallel.ring_attention`): activations stay
+  sequence-sharded through the whole block stack, K/V rotate over ICI.
+
+Design notes: pre-LN blocks, NHWC-free (pure (B, L, D) matmuls on the
+MXU), bf16-friendly via ``dtype``, static shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .bundle import ModelBundle
+
+Dtype = Any
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA whose score/value contraction is pluggable (full vs ring)."""
+
+    num_heads: int
+    causal: bool = False
+    attention: str = "full"  # "full" | "ring"
+    ring_axis: str = "sp"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, l, d = x.shape
+        h = self.num_heads
+        if d % h:
+            raise ValueError(f"model dim {d} not divisible by {h} heads")
+        dh = d // h
+        qkv = nn.DenseGeneral((3, h, dh), axis=-1, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)  # each (b, l, h, dh)
+        q = jnp.transpose(q, (0, 2, 1, 3))  # (b, h, l, dh)
+        k = jnp.transpose(k, (0, 2, 1, 3))
+        v = jnp.transpose(v, (0, 2, 1, 3))
+
+        if self.attention == "ring":
+            from ..parallel.ring_attention import ring_attention
+
+            attn = jax.vmap(jax.vmap(
+                partial(ring_attention, axis_name=self.ring_axis,
+                        causal=self.causal)
+            ))(q, k, v)
+        else:
+            from ..parallel.ring_attention import full_attention
+
+            attn = full_attention(q, k, v, causal=self.causal)
+        attn = jnp.transpose(attn, (0, 2, 1, 3)).reshape(b, l, d)
+        return nn.DenseGeneral(d, axis=-1, dtype=self.dtype, name="out")(attn)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    causal: bool = False
+    attention: str = "full"
+    ring_axis: str = "sp"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MultiHeadAttention(
+            self.num_heads, causal=self.causal, attention=self.attention,
+            ring_axis=self.ring_axis, dtype=self.dtype,
+        )(y)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(d * self.mlp_ratio, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, dtype=self.dtype)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM over integer tokens: ``(B, L) -> (B, L, vocab)``."""
+
+    vocab_size: int = 256
+    dim: int = 128
+    depth: int = 2
+    num_heads: int = 4
+    max_len: int = 1024
+    attention: str = "full"
+    ring_axis: str = "sp"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        b, l = tokens.shape
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype)(tokens)
+        positions = jnp.arange(l)
+        if self.attention == "ring":
+            # under sequence sharding `l` is the LOCAL block length; global
+            # positions are offset by this device's ring index
+            positions = positions + jax.lax.axis_index(self.ring_axis) * l
+        pos = nn.Embed(self.max_len, self.dim, dtype=self.dtype)(positions[None, :])
+        x = x + pos
+        for _ in range(self.depth):
+            x = TransformerBlock(
+                self.num_heads, causal=True, attention=self.attention,
+                ring_axis=self.ring_axis, dtype=self.dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+class TransformerClassifier(nn.Module):
+    """Mean-pooled encoder classifier: ``(B, L) -> (B, classes)``."""
+
+    vocab_size: int = 256
+    num_classes: int = 10
+    dim: int = 128
+    depth: int = 2
+    num_heads: int = 4
+    max_len: int = 1024
+    attention: str = "full"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        b, l = tokens.shape
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype)(tokens)
+        x = x + nn.Embed(self.max_len, self.dim, dtype=self.dtype)(
+            jnp.arange(l)[None, :]
+        )
+        for _ in range(self.depth):
+            x = TransformerBlock(
+                self.num_heads, causal=False, attention=self.attention,
+                dtype=self.dtype,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(x.mean(axis=1))
+        return logits.astype(jnp.float32)
+
+
+def tiny_lm(
+    seed: int = 0,
+    *,
+    vocab_size: int = 256,
+    dim: int = 128,
+    depth: int = 2,
+    num_heads: int = 4,
+    max_len: int = 1024,
+    attention: str = "full",
+    dtype: Dtype = jnp.float32,
+) -> ModelBundle:
+    """LM bundle with next-token cross-entropy loss."""
+    model = TransformerLM(
+        vocab_size=vocab_size, dim=dim, depth=depth, num_heads=num_heads,
+        max_len=max_len, attention=attention, dtype=dtype,
+    )
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )
+
+    def loss_fn(p, tokens, _unused_y=None):
+        logits = model.apply(p, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        import optax
+
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+
+    return ModelBundle(apply_fn=model.apply, params=params, loss_fn=loss_fn)
+
+
+def tiny_classifier(
+    seed: int = 0, *, num_classes: int = 10, dim: int = 64, depth: int = 2,
+    num_heads: int = 4, dtype: Dtype = jnp.float32,
+) -> ModelBundle:
+    model = TransformerClassifier(
+        num_classes=num_classes, dim=dim, depth=depth, num_heads=num_heads,
+        dtype=dtype,
+    )
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))
+    return ModelBundle(apply_fn=model.apply, params=params)
+
+
+def sequence_parallel_forward(
+    mesh,
+    apply_fn,
+    params,
+    tokens: jnp.ndarray,
+    *,
+    axis_name: str = "sp",
+):
+    """Run a ring-attention model over sequence-sharded tokens.
+
+    ``tokens``: ``(B, L)`` with the length axis sharded over ``axis_name``;
+    params are replicated (closed over). Returns ``(B, L, vocab)`` logits
+    with the same sequence sharding. The model must have been built with
+    ``attention="ring"`` and the same ``ring_axis``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.collectives import sharded_fn
+
+    fn = sharded_fn(
+        mesh, axis_name,
+        lambda toks: apply_fn(params, toks),
+        in_spec=P(None, axis_name),
+        out_spec=P(None, axis_name, None),
+    )
+    return fn(tokens)
+
+
+__all__ = [
+    "MultiHeadAttention",
+    "TransformerBlock",
+    "TransformerLM",
+    "TransformerClassifier",
+    "tiny_lm",
+    "tiny_classifier",
+    "sequence_parallel_forward",
+]

@@ -133,11 +133,21 @@ def sharded_fn(
     out_spec: Optional[P] = None,
 ) -> Callable[[Array], Array]:
     """Wrap a per-shard function (which may call the primitives above with
-    ``axis_name``) into a jitted host-level callable on sharded arrays."""
+    ``axis_name``) into a jitted host-level callable on sharded arrays.
+
+    ``in_spec`` may be one ``PartitionSpec`` (single-argument fn) or a
+    plain tuple of specs for multi-argument fns (note ``PartitionSpec`` is
+    itself a tuple subclass, hence the explicit type check)."""
     in_spec = in_spec if in_spec is not None else P(axis_name)
-    out_spec = out_spec if out_spec is not None else in_spec
+    if isinstance(in_spec, P) or not isinstance(in_spec, tuple):
+        in_specs = (in_spec,)
+        default_out = in_spec
+    else:
+        in_specs = in_spec
+        default_out = in_spec[0]
+    out_spec = out_spec if out_spec is not None else default_out
     mapped = shard_map(
-        fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_spec,
         **{_SHARD_MAP_CHECK_KW: False},
     )
     return jax.jit(mapped)

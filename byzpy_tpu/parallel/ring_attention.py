@@ -1,0 +1,143 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+Long-context support the reference has no analogue for (SURVEY §5 records
+the absence; the task's TPU framing makes it first-class): the sequence
+axis is sharded over a mesh axis, each device holds local Q/K/V blocks,
+and K/V blocks rotate around the ICI ring (``lax.ppermute``) while a
+flash-style online softmax accumulates exact attention — peak memory is
+O(L·d / n_devices) per chip and the K/V transfer overlaps the block
+matmuls (Liu et al. 2023, "Ring Attention with Blockwise Transformers").
+
+``ring_attention`` is the in-SPMD primitive (call inside ``shard_map``
+with a named axis); ``ring_attention_sharded`` wraps mesh plumbing for
+host-level sharded arrays. Causal masking uses global block offsets, so
+rotated blocks mask correctly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import sharded_fn
+
+Array = jnp.ndarray
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, *, scale, causal, q_offset, k_offset):
+    """Scores + masked logits for one (Q-block, K-block) pair in f32."""
+    s = jnp.einsum("qd,kd->qk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        lq, lk = q.shape[0], k.shape[0]
+        qi = q_offset + lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        ki = k_offset + lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    return s
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Array:
+    """Exact attention where each device holds the local sequence block.
+
+    ``q, k, v``: ``(L_local, d)`` (vmap over batch/heads outside). The
+    device's global block index is its position on ``axis_name``; K/V
+    rotate ``n`` steps so every Q block sees every K/V block.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    lq, d = q.shape
+    lk = k.shape[0]
+    scale = scale if scale is not None else (1.0 / (d ** 0.5))
+    q32 = q.astype(jnp.float32)
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        # k_blk started as block `me` and has been rotated i times: the
+        # ring shift x -> x+1 means after i steps we hold block (me - i)
+        src = (me - i) % n
+        s = _block_attn(
+            q32, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+            scale=scale, causal=causal,
+            q_offset=me * lq, k_offset=src * lk,
+        )
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        # guard fully-masked rows: exp(-inf - -inf) -> exp(0); the l term
+        # stays 0 because every score is -inf
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        o_new = o * alpha[:, None] + p @ v_blk.astype(jnp.float32)
+        # rotate K/V to the next device (overlaps with the next block's
+        # compute under XLA latency hiding)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return o_new, m_new, l_new, k_next, v_next
+
+    o0 = jnp.zeros((lq, d), jnp.float32)
+    m0 = jnp.full((lq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((lq,), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    out = o / jnp.maximum(l, 1e-30)[:, None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q: Array, k: Array, v: Array, *, causal: bool = False,
+                   scale: Optional[float] = None) -> Array:
+    """Single-device oracle with the same semantics."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else (1.0 / (d ** 0.5))
+    s = jnp.einsum("...qd,...kd->...qk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        qi = lax.broadcasted_iota(jnp.int32, (lq, lk), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (lq, lk), 1)
+        s = jnp.where(qi >= ki, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    mesh: Mesh,
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    axis_name: Optional[str] = None,
+    causal: bool = False,
+) -> Array:
+    """Host-level entry: ``(L, d)`` arrays sharded ``P(axis)`` on the
+    sequence axis (re-sharded if not). Returns the attention output with
+    the same sequence sharding."""
+    axis = axis_name or mesh.axis_names[0]
+
+    fn = sharded_fn(
+        mesh, axis,
+        partial(_ring3, axis, causal),
+        in_spec=(P(axis), P(axis), P(axis)),  # type: ignore[arg-type]
+        out_spec=P(axis),
+    )
+    return fn(q, k, v)
+
+
+def _ring3(axis, causal, q, k, v):
+    return ring_attention(q, k, v, axis, causal=causal)
+
+
+__all__ = ["ring_attention", "ring_attention_sharded", "full_attention"]
