@@ -67,8 +67,7 @@ class MultiKrum(RowScoredAggregator, Aggregator):
         return {"f": self.f}
 
     def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
-        sel = jnp.argsort(scores)[: self.q]
-        return jnp.mean(matrix[sel], axis=0)
+        return robust.ranked_mean(matrix, scores, self.q)
 
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum(x, f=self.f, q=self.q)

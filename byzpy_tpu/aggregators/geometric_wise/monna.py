@@ -44,8 +44,7 @@ class MoNNA(RowScoredAggregator, Aggregator):
         return {"reference_index": self.reference_index}
 
     def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
-        sel = jnp.argsort(scores)[: matrix.shape[0] - self.f]
-        return jnp.mean(matrix[sel], axis=0)
+        return robust.ranked_mean(matrix, scores, matrix.shape[0] - self.f)
 
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.monna(x, f=self.f, reference_index=self.reference_index)

@@ -35,8 +35,7 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
             raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={self.f})")
 
     def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
-        keep = jnp.argsort(scores)[: matrix.shape[0] - self.f]
-        return jnp.mean(matrix[keep], axis=0)
+        return robust.ranked_mean(matrix, scores, matrix.shape[0] - self.f)
 
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.cge(x, f=self.f)

@@ -140,6 +140,41 @@ def krum_scores(x: Array, *, f: int) -> Array:
     return jnp.sum(row_sorted[:, 1 : n - f], axis=1)
 
 
+def ranked_mean(x: Array, scores: Array, q: int) -> Array:
+    """Mean of the ``q`` lowest-score rows of ``x`` without a row gather.
+
+    Equivalent to ``jnp.mean(x[jnp.argsort(scores)[:q]], axis=0)`` (stable
+    ties broken by row index), but selection happens through a masked
+    matvec: XLA's dynamic row gather on TPU measured ~7x slower than its
+    HBM cost (1.45 ms vs ~0.2 ms for 12 rows of a 64x1M f32 matrix on
+    v5e), while the rank-mask contraction streams ``x`` once at full
+    bandwidth on the MXU.
+    """
+    n = x.shape[0]
+    idx = jnp.arange(n)
+    # Two-level key (isnan, score) reproduces argsort's NaN-last ordering:
+    # plain comparisons would rank a NaN-score row first (all comparisons
+    # against NaN are False), letting an adversarial NaN gradient into the
+    # selection.
+    isnan = jnp.isnan(scores)
+    s = jnp.where(isnan, jnp.zeros_like(scores), scores)
+    nan_lt = (~isnan[None, :]) & isnan[:, None]
+    nan_eq = isnan[None, :] == isnan[:, None]
+    lt = nan_lt | (nan_eq & (s[None, :] < s[:, None]))
+    eq = nan_eq & (s[None, :] == s[:, None])
+    rank = jnp.sum(lt | (eq & (idx[None, :] < idx[:, None])), axis=1)
+    acc = _feature_matmul_dtype(x)
+    selected = rank < q
+    w = jnp.where(selected, 1.0 / q, 0.0).astype(acc)
+    # Zero non-selected rows before the contraction: 0-weight times a NaN/inf
+    # gradient is NaN in the matvec, whereas a gather physically excludes the
+    # row. Selected rows keep their values, so non-finite data that IS chosen
+    # still propagates faithfully. The select fuses into the einsum's read.
+    xm = jnp.where(selected[:, None], x, jnp.zeros((), x.dtype))
+    out = jnp.einsum("n,nd->d", w, xm, preferred_element_type=acc)
+    return out.astype(x.dtype)
+
+
 @partial(jax.jit, static_argnames=("f", "q"))
 def multi_krum(x: Array, *, f: int, q: int) -> Array:
     """Multi-Krum: mean of the ``q`` lowest-score nodes
@@ -149,8 +184,7 @@ def multi_krum(x: Array, *, f: int, q: int) -> Array:
     if not 1 <= q <= n - f:
         raise ValueError(f"q must satisfy 1 <= q <= n - f (got n={n}, f={f}, q={q})")
     scores = krum_scores(x, f=f)
-    sel = jnp.argsort(scores)[:q]  # stable sort: ties broken by node index
-    return jnp.mean(x[sel], axis=0)
+    return ranked_mean(x, scores, q)
 
 
 def krum(x: Array, *, f: int) -> Array:
@@ -234,8 +268,7 @@ def cge(x: Array, *, f: int) -> Array:
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
     norms = jnp.sum(x * x, axis=1)
-    keep = jnp.argsort(norms)[: n - f]
-    return jnp.mean(x[keep], axis=0)
+    return ranked_mean(x, norms, n - f)
 
 
 @partial(jax.jit, static_argnames=("f", "reference_index"))
@@ -251,8 +284,7 @@ def monna(x: Array, *, f: int, reference_index: int = 0) -> Array:
         raise ValueError(f"reference_index must be in [0, {n}) (got {reference_index})")
     diff = x - x[reference_index][None, :]
     dists = jnp.sum(diff * diff, axis=1)
-    sel = jnp.argsort(dists)[: n - f]
-    return jnp.mean(x[sel], axis=0)
+    return ranked_mean(x, dists, n - f)
 
 
 @partial(jax.jit, static_argnames=("f", "power_iters"))
@@ -365,6 +397,26 @@ def best_subset_by_score(scores: Array) -> Array:
     return jnp.argmin(scores)
 
 
+def aggregate_stream(agg_fn, xs: Array) -> Array:
+    """Apply ``agg_fn`` to a stream of ``K`` stacked gradient matrices
+    ``xs: (K, n, d)`` inside ONE compiled program (``lax.scan``), returning
+    ``(K, d)`` aggregates.
+
+    In a real training loop the aggregator runs once per round inside a
+    compiled step; calling it as a standalone dispatch instead pays the
+    host->device launch latency every round (measured ~1.4 ms per call
+    through a tunneled v5e — comparable to the entire 64x1M Multi-Krum
+    compute). Streaming K rounds per dispatch amortizes that, which is the
+    honest shape for throughput measurement and for replaying buffered
+    rounds.
+    """
+    def body(carry, xi):
+        return carry, agg_fn(xi)
+
+    _, ys = lax.scan(body, None, xs)
+    return ys
+
+
 __all__ = [
     "gram_matrix",
     "pairwise_sq_dists",
@@ -372,6 +424,7 @@ __all__ = [
     "trimmed_mean",
     "mean_of_medians",
     "krum_scores",
+    "ranked_mean",
     "multi_krum",
     "krum",
     "geometric_median",
@@ -383,4 +436,5 @@ __all__ = [
     "subset_max_eigvals",
     "subset_mean",
     "best_subset_by_score",
+    "aggregate_stream",
 ]

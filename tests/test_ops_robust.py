@@ -193,3 +193,30 @@ def test_bf16_inputs_accumulate_in_f32():
         robust.pairwise_sq_dists(jnp.asarray(x, dtype=jnp.bfloat16)).astype(jnp.float32)
     )
     np.testing.assert_allclose(d2_bf16, d2_f32, rtol=0.05, atol=0.1)
+
+
+def test_ranked_mean_matches_stable_argsort():
+    x = randx(12, 9, seed=11)
+    scores = np.asarray(x[:, 0]).copy()
+    scores[3] = scores[7]  # tie broken by index, as stable argsort
+    got = np.asarray(robust.ranked_mean(jnp.asarray(x), jnp.asarray(scores), 5))
+    sel = np.argsort(scores, kind="stable")[:5]
+    np.testing.assert_allclose(got, x[sel].mean(0), rtol=1e-5, atol=1e-6)
+
+
+def test_ranked_mean_excludes_nan_scores():
+    # A byzantine node emitting NaN gradients yields a NaN Krum score; the
+    # selection must rank it last (argsort's NaN-last order), not first.
+    x = randx(6, 8, seed=12)
+    scores = np.array([0.3, np.nan, 0.1, 0.2, np.nan, 0.4], dtype=np.float32)
+    got = np.asarray(robust.ranked_mean(jnp.asarray(x), jnp.asarray(scores), 3))
+    sel = np.argsort(scores, kind="stable")[:3]  # NaN sorts last in numpy too
+    assert not np.isnan(got).any()
+    np.testing.assert_allclose(got, x[sel].mean(0), rtol=1e-5, atol=1e-6)
+
+
+def test_multi_krum_with_nan_byzantine_row():
+    x = randx(8, 16, seed=13)
+    x[5] = np.nan
+    got = np.asarray(robust.multi_krum(jnp.asarray(x), f=1, q=3))
+    assert not np.isnan(got).any()
