@@ -72,18 +72,39 @@ def batcher_pairs(n: int):
     return pairs
 
 
-def _sort_columns_kernel(x_ref, out_ref, *, n_rows: int):
+def _sort_columns_kernel(x_ref, out_ref, *, n_rows: int, is_float: bool):
     """Sort each column of the (n_rows, TILE) block ascending via Batcher's
     sorting network. The network is branch-free, unrolled at trace time
     (n_rows is static), and every compare–exchange is a VPU min/max on a
-    (TILE,) lane vector."""
+    (TILE,) lane vector.
+
+    Float blocks sort on a monotone int32 key instead of raw float min/max:
+    IEEE min/max have no total order over non-finite values (a single NaN
+    poisons every exchange it touches, and ``finfo.max`` padding used to
+    displace ``+inf``). The key map — canonicalize NaN, bitcast, flip the
+    magnitude bits of negatives — is its own inverse and reproduces
+    ``jnp.sort``'s total order (-inf < finite < +inf < NaN) with the O(n)
+    transform paid once per element, keeping the O(n log^2 n) exchanges on
+    cheap integer min/max.
+    """
     block = x_ref[:]
-    rows = [block[i] for i in range(n_rows)]
+    if is_float:
+        blk = jnp.where(jnp.isnan(block), jnp.full_like(block, jnp.nan), block)
+        keys = jax.lax.bitcast_convert_type(blk, jnp.int32)
+        keys = jnp.where(keys < 0, keys ^ jnp.int32(0x7FFFFFFF), keys)
+    else:
+        keys = block
+    rows = [keys[i] for i in range(n_rows)]
     for i, j in batcher_pairs(n_rows):
         lo = jnp.minimum(rows[i], rows[j])
         hi = jnp.maximum(rows[i], rows[j])
         rows[i], rows[j] = lo, hi
-    out_ref[:] = jnp.stack(rows)
+    keys = jnp.stack(rows)
+    if is_float:
+        keys = jnp.where(keys < 0, keys ^ jnp.int32(0x7FFFFFFF), keys)
+        out_ref[:] = jax.lax.bitcast_convert_type(keys, block.dtype)
+    else:
+        out_ref[:] = keys
 
 
 def _auto_tile(n_pad: int) -> int:
@@ -99,26 +120,38 @@ def sort_columns(
 ) -> Array:
     """Columns of ``x`` (shape ``(n, d)``) sorted ascending along axis 0.
 
-    Pads ``n`` up to a sublane multiple with ``+inf`` rows (they sink to the
-    bottom and are sliced off) and ``d`` up to a lane-aligned tile.
+    Matches ``jnp.sort``'s value ordering including non-finite values
+    (-inf < finite < +inf < NaN; divergences are bit-level only: -0.0 keys
+    strictly before +0.0 where the stable ``jnp.sort`` preserves input
+    order, and NaN payload/sign bits are canonicalized to the quiet +NaN).
+    Pads ``n`` up to a sublane multiple with
+    NaN rows for floats (the largest sort key — they sink to the bottom and
+    are sliced off; ``iinfo.max`` for ints) and ``d`` up to a lane-aligned
+    tile. 16-bit floats sort through an exact f32 round-trip: the kernel's
+    int32 key path needs 32-bit rows, and every bf16/f16 value is exactly
+    representable in f32.
     """
     if interpret is None:
         interpret = not _on_tpu()
-    n, d = x.shape
     dtype = x.dtype
+    is_float = bool(jnp.issubdtype(dtype, jnp.floating))
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return sort_columns(
+            x.astype(jnp.float32), tile=tile, interpret=interpret
+        ).astype(dtype)
+    if is_float and dtype != jnp.float32:
+        return jnp.sort(x, axis=0)  # f64 etc.: no 64-bit key path on TPU
+    n, d = x.shape
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
         tile = _auto_tile(n_pad)
     d_pad = _round_up(max(d, 1), tile)
-    info = (
-        jnp.finfo(dtype) if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype)
-    )
-    big = jnp.asarray(info.max, dtype)
+    big = jnp.asarray(jnp.nan if is_float else jnp.iinfo(dtype).max, dtype)
     xp = jnp.full((n_pad, d_pad), big, dtype)
     xp = xp.at[:n, :d].set(x)
 
     out = pl.pallas_call(
-        functools.partial(_sort_columns_kernel, n_rows=n_pad),
+        functools.partial(_sort_columns_kernel, n_rows=n_pad, is_float=is_float),
         out_shape=jax.ShapeDtypeStruct((n_pad, d_pad), dtype),
         grid=(d_pad // tile,),
         in_specs=[
@@ -136,11 +169,22 @@ def median_pallas(
     x: Array, *, tile: Optional[int] = None, interpret: Optional[bool] = None
 ) -> Array:
     """Coordinate-wise median via the sorting network (matches
-    ``jnp.median(x, axis=0)``)."""
+    ``jnp.median(x, axis=0)``, including NaN propagation: NaNs sort last, so
+    a column contains one iff its bottom sorted row is NaN)."""
     n = x.shape[0]
     s = sort_columns(x, tile=tile, interpret=interpret)
     lo, hi = (n - 1) // 2, n // 2
-    return (s[lo] + s[hi]) * jnp.asarray(0.5, x.dtype)
+    # Output dtype matched to jnp.median by construction (original dtype for
+    # floats, a float dtype for ints — float64 for int64 under x64).
+    out_dtype = jax.eval_shape(
+        lambda a: jnp.median(a, axis=0), jax.ShapeDtypeStruct(x.shape, x.dtype)
+    ).dtype
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        # midpoint in the input dtype, exactly as jnp.median: for f16 this
+        # overflows to inf for half-max magnitudes — so does the oracle.
+        med = (s[lo] + s[hi]) * jnp.asarray(0.5, x.dtype)
+        return jnp.where(jnp.isnan(s[n - 1]), jnp.asarray(jnp.nan, out_dtype), med)
+    return (s[lo].astype(out_dtype) + s[hi].astype(out_dtype)) * 0.5
 
 
 def trimmed_mean_pallas(

@@ -91,6 +91,133 @@ def test_dispatch_policy_env_override(monkeypatch):
     assert not use_pallas_for(8, 1 << 20)
 
 
+def _inject_nonfinite(x, seed):
+    """Sprinkle +inf / -inf / NaN over ~10% of entries each."""
+    rng = np.random.default_rng(seed)
+    a = np.asarray(x).copy()
+    for val in (np.inf, -np.inf, np.nan):
+        mask = rng.random(a.shape) < 0.1
+        a[mask] = val
+    return jnp.asarray(a)
+
+
+@pytest.mark.parametrize("special", ["inf", "-inf", "nan", "mixed", "all-nan-col"])
+def test_sort_columns_nonfinite_matches_jnp(special):
+    """jnp.sort total order (-inf < finite < +inf < NaN) survives the network;
+    regression for the finfo.max padding bug that ranked +inf after padding
+    and let NaN poison the compare-exchanges."""
+    x = jax.random.normal(jax.random.PRNGKey(7), (9, 700), jnp.float32) * 5.0
+    a = np.asarray(x).copy()
+    if special == "inf":
+        a[2, ::3] = np.inf
+    elif special == "-inf":
+        a[4, ::5] = -np.inf
+    elif special == "nan":
+        a[1, ::4] = np.nan
+    elif special == "mixed":
+        a = np.asarray(_inject_nonfinite(x, seed=11))
+    else:  # a full column of NaN
+        a[:, 42] = np.nan
+    out = sort_columns(jnp.asarray(a), interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(jnp.sort(jnp.asarray(a), axis=0))
+    )
+
+
+def test_sort_columns_negative_zero_and_extremes():
+    """-0.0/+0.0 compare equal; finfo.max/min sort strictly inside inf."""
+    fmax = np.float32(np.finfo(np.float32).max)
+    col = np.array(
+        [[np.inf], [-np.inf], [fmax], [-fmax], [0.0], [-0.0], [1.0]], np.float32
+    )
+    a = np.tile(col, (1, 300))
+    out = np.asarray(sort_columns(jnp.asarray(a), interpret=True))
+    np.testing.assert_array_equal(out, np.sort(a, axis=0))
+    assert out[-1, 0] == np.inf and out[-2, 0] == fmax
+
+
+def test_median_trimmed_mean_with_inf_match_xla():
+    """The repo's own InfAttack shape: one +inf row among honest rows must
+    leave the median/trimmed-mean finite and equal to the XLA path."""
+    x = jax.random.normal(jax.random.PRNGKey(9), (8, 1024), jnp.float32)
+    a = np.asarray(x).copy()
+    a[3, :] = np.inf
+    xa = jnp.asarray(a)
+    med = np.asarray(median_pallas(xa, interpret=True))
+    np.testing.assert_array_equal(med, np.asarray(jnp.median(xa, axis=0)))
+    assert np.isfinite(med).all()
+    s = jnp.sort(xa, axis=0)
+    np.testing.assert_array_equal(
+        np.asarray(trimmed_mean_pallas(xa, f=1, interpret=True)),
+        np.asarray(jnp.mean(s[1:-1], axis=0)),
+    )
+
+
+def test_median_int_input_promotes_like_jnp():
+    x = jnp.asarray(np.array([[1, 4], [2, 3], [3, 2], [4, 1]], np.int32))
+    out = median_pallas(x, interpret=True)
+    ref = jnp.median(x, axis=0)
+    assert out.dtype == ref.dtype
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_median_f16_parity_including_overflow():
+    """jnp.median midpoints in the input dtype — for f16 at half-max
+    magnitude that overflows to inf, and parity means we overflow the same
+    way (verified against the oracle, not an idealized contract)."""
+    x = jnp.full((4, 300), 40000.0, jnp.float16)
+    out = median_pallas(x, interpret=True)
+    ref = jnp.median(x, axis=0)
+    assert out.dtype == ref.dtype
+    np.testing.assert_array_equal(np.asarray(out, np.float32), np.asarray(ref, np.float32))
+
+
+def test_median_nan_propagates_like_jnp():
+    """jnp.median returns NaN for any column containing NaN; the Pallas
+    median must agree (caught on-chip: sort-based middle pick is finite)."""
+    x = jax.random.normal(jax.random.PRNGKey(21), (8, 512), jnp.float32)
+    a = np.asarray(x).copy()
+    a[5, ::7] = np.nan
+    xa = jnp.asarray(a)
+    np.testing.assert_array_equal(
+        np.asarray(median_pallas(xa, interpret=True)),
+        np.asarray(jnp.median(xa, axis=0)),
+    )
+
+
+def test_sort_columns_bf16_roundtrip():
+    x = (jax.random.normal(jax.random.PRNGKey(5), (6, 500)) * 3).astype(jnp.bfloat16)
+    a = np.asarray(x, np.float32).copy()
+    a[0, ::7] = np.inf
+    xa = jnp.asarray(a).astype(jnp.bfloat16)
+    out = sort_columns(xa, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32), np.asarray(jnp.sort(xa, axis=0), np.float32)
+    )
+
+
+def test_inf_attack_into_median_large_dim(monkeypatch):
+    """Integration: InfAttack output flowing into CoordinateWiseMedian at
+    d >= 256k routed through the Pallas path (VERDICT r2 item 2) — the
+    framework's own attack must not break its own median."""
+    from byzpy_tpu.aggregators.coordinate_wise.median import CoordinateWiseMedian
+    from byzpy_tpu.attacks.inf import InfAttack
+
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")  # force Pallas (interpret on CPU)
+    d = 262_144
+    honest = [
+        jax.random.normal(jax.random.PRNGKey(i), (d,), jnp.float32) for i in range(5)
+    ]
+    byz = InfAttack().apply(honest_grads=honest)
+    assert not np.isfinite(np.asarray(byz)).any()
+    stacked = jnp.stack(honest + [byz])
+    got = np.asarray(CoordinateWiseMedian().aggregate(list(honest) + [byz]))
+    want = np.asarray(jnp.median(stacked, axis=0))
+    np.testing.assert_array_equal(got, want)
+    assert np.isfinite(got).all()
+
+
 def test_robust_ops_use_pallas_when_forced(monkeypatch):
     """Forcing the flag routes the public ops through the kernels (still in
     interpret mode on CPU) and results stay correct."""
