@@ -9,6 +9,10 @@ carry overlapping requests (a blocking ``chan_get`` never stalls calls).
 On TPU pods this wire is for orchestration only — gradient tensors move
 between chips via XLA collectives over ICI/DCN (``byzpy_tpu.parallel``),
 not through this socket.
+
+Security: frames are cloudpickle — remote code execution for anyone
+who can reach the socket. Trusted/firewalled networks or loopback
+only; see ``byzpy_tpu.engine.actor.wire.warn_untrusted_bind``.
 """
 
 from __future__ import annotations
@@ -38,6 +42,7 @@ class RemoteActorServer:
         self._handler_tasks: set[asyncio.Task] = set()
 
     async def start(self) -> None:
+        wire.warn_untrusted_bind(self.host, "RemoteActorServer")
         self._server = await asyncio.start_server(self._on_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
 

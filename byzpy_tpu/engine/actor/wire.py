@@ -5,18 +5,43 @@ Control-plane wire format (ref: ``byzpy/engine/actor/_wire.py:8-18``): a
 converted to numpy on serialization — bulk tensor movement between chips
 never goes through this wire; it rides XLA collectives (see
 ``byzpy_tpu.parallel``).
+
+.. warning:: **Trusted networks only.** Frames are cloudpickle: anyone who
+   can reach the socket can execute arbitrary code in the receiving
+   process (same property as the reference's pickle wire). Bind servers to
+   loopback or a private, firewalled fabric; for anything beyond that, add
+   application-layer authentication such as the HMAC frame signing used in
+   ``examples`` (ref: ``examples/ps/remote_tcp/ps_node.py``).
 """
 
 from __future__ import annotations
 
 import asyncio
 import struct
+import warnings
 from typing import Any
 
 import cloudpickle
 
 _HEADER = struct.Struct(">I")
 MAX_FRAME = 1 << 31
+
+_LOOPBACK = {"127.0.0.1", "::1", "localhost"}  # "" binds ALL interfaces — warn
+
+
+def warn_untrusted_bind(host: str, component: str) -> None:
+    """One-line safety rail: surface a RuntimeWarning when a cloudpickle
+    control-plane server binds beyond loopback, where deserializing frames
+    means remote code execution for anyone who can reach the port."""
+    if host not in _LOOPBACK:
+        warnings.warn(
+            f"{component} binding to {host!r}: the control-plane wire "
+            "deserializes cloudpickle frames, which allows arbitrary code "
+            "execution by anyone able to reach this socket. Use only on "
+            "trusted/firewalled networks (or keep to loopback).",
+            RuntimeWarning,
+            stacklevel=3,
+        )
 
 
 def encode(obj: Any) -> bytes:
@@ -76,4 +101,4 @@ async def recv_obj(reader: asyncio.StreamReader) -> Any:
     return decode(body)
 
 
-__all__ = ["send_obj", "recv_obj", "encode", "decode", "host_view"]
+__all__ = ["send_obj", "recv_obj", "encode", "decode", "host_view", "warn_untrusted_bind"]

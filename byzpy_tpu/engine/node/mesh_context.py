@@ -10,6 +10,10 @@ TPU framing: each mesh node is typically one host (with its own chips);
 this wire is the host-level control/gossip plane for deployments without a
 shared JAX distributed runtime. Payloads are converted to host arrays at
 the boundary (``host_view``).
+
+Security: frames are cloudpickle — remote code execution for anyone
+who can reach the socket. Trusted/firewalled networks or loopback
+only; see ``byzpy_tpu.engine.actor.wire.warn_untrusted_bind``.
 """
 
 from __future__ import annotations
@@ -18,7 +22,7 @@ import asyncio
 import logging
 from typing import Any, Dict, Mapping, Optional, Tuple
 
-from ..actor.wire import host_view, recv_obj, send_obj
+from ..actor.wire import host_view, recv_obj, send_obj, warn_untrusted_bind
 from .context import Message, NodeContext
 
 logger = logging.getLogger(__name__)
@@ -67,6 +71,7 @@ class MeshRemoteContext(NodeContext):
     async def start(self, node) -> None:
         self._node = node
         self._closing = False
+        warn_untrusted_bind(self.host, "MeshRemoteContext")
         self._server = await asyncio.start_server(
             self._handle_inbound, self.host, self.port
         )

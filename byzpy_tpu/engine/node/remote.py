@@ -10,6 +10,10 @@ connection-state checks.
 TPU framing: this is the **control plane** for multi-host deployments —
 frames carry pipeline triggers and small host tensors. Bulk tensors across
 hosts belong to jax multi-host collectives (DCN), not this wire.
+
+Security: frames are cloudpickle — remote code execution for anyone
+who can reach the socket. Trusted/firewalled networks or loopback
+only; see ``byzpy_tpu.engine.actor.wire.warn_untrusted_bind``.
 """
 
 from __future__ import annotations
@@ -18,7 +22,7 @@ import asyncio
 import logging
 from typing import Any, Dict, Optional, Tuple
 
-from ..actor.wire import host_view, recv_obj, send_obj
+from ..actor.wire import host_view, recv_obj, send_obj, warn_untrusted_bind
 from .context import (
     Message,
     NodeContext,
@@ -80,6 +84,7 @@ class RemoteNodeServer:
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:
+        warn_untrusted_bind(self.host, "RemoteNodeServer")
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port
         )

@@ -36,6 +36,20 @@ async def _invoke(obj: Any, method: str, *args: Any) -> Any:
     return out
 
 
+async def _gather_all(coros) -> List[Any]:
+    """Run coroutines concurrently; wait for ALL to settle, then raise the
+    first failure (if any) with every sibling exception already retrieved.
+    Plain ``asyncio.wait`` + ``t.result()`` would surface one error and
+    leave the siblings' exceptions unretrieved (logged as warnings at GC,
+    lost for debugging); bare ``gather`` would abandon still-running
+    siblings mid-round."""
+    results = await asyncio.gather(*coros, return_exceptions=True)
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
+    return results
+
+
 class ParameterServer:
     """Robust-aggregation training coordinator over honest + byzantine nodes.
 
@@ -85,28 +99,20 @@ class ParameterServer:
     async def _stream_honest(self) -> List[Any]:
         """Gather honest gradients as they complete; order follows
         ``honest_nodes`` so aggregation is deterministic."""
-        tasks = [
-            asyncio.ensure_future(
-                _invoke(node, "honest_gradient_for_next_batch")
-            )
+        # concurrent fan-out keeps slow nodes from serializing the round
+        # (ref: ps.py:89-92); gather preserves node order.
+        return await _gather_all(
+            _invoke(node, "honest_gradient_for_next_batch")
             for node in self.honest_nodes
-        ]
-        # as-completed draining keeps slow nodes from serializing the round
-        # (ref: ps.py:89-92); results are then re-ordered by node index.
-        await asyncio.wait(tasks)
-        return [t.result() for t in tasks]
+        )
 
     async def _stream_byzantine(self, honest_grads: List[Any]) -> List[Any]:
         if not self.byzantine_nodes:
             return []
-        tasks = [
-            asyncio.ensure_future(
-                _invoke(node, "byzantine_gradient_for_next_batch", honest_grads)
-            )
+        return await _gather_all(
+            _invoke(node, "byzantine_gradient_for_next_batch", honest_grads)
             for node in self.byzantine_nodes
-        ]
-        await asyncio.wait(tasks)
-        return [t.result() for t in tasks]
+        )
 
     async def _aggregate(self, gradients: List[Any]) -> Any:
         if self.pre_aggregator is not None:
@@ -123,11 +129,9 @@ class ParameterServer:
         honest = await self._stream_honest()
         byz = await self._stream_byzantine(honest)
         aggregated = await self._aggregate(honest + byz)
-        await asyncio.gather(
-            *(
-                _invoke(node, "apply_server_gradient", aggregated)
-                for node in self.honest_nodes + self.byzantine_nodes
-            )
+        await _gather_all(
+            _invoke(node, "apply_server_gradient", aggregated)
+            for node in self.honest_nodes + self.byzantine_nodes
         )
         self.rounds_completed += 1
         return aggregated

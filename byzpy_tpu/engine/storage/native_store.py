@@ -126,6 +126,20 @@ def _map(name: str, nbytes: int, create: bool) -> np.ndarray:
     lib = _load()
     if lib is not None:
         err = ctypes.c_int(0)
+        if not create:
+            # Touching mapped pages past the segment's real size delivers
+            # SIGBUS (not a Python exception), so a stale/mismatched handle
+            # must be rejected before the view is handed out.
+            actual = int(lib.bshm_size(name.encode(), ctypes.byref(err)))
+            if actual == 0 and err.value != 0:
+                raise OSError(
+                    err.value, f"bshm_size({name!r}) failed: errno {err.value}"
+                )
+            if actual < nbytes:
+                raise ValueError(
+                    f"shared segment {name!r} holds {actual} bytes but the "
+                    f"handle expects {nbytes}: stale or mismatched handle"
+                )
         ptr = lib.bshm_map(name.encode(), nbytes, 1 if create else 0,
                            ctypes.byref(err))
         if not ptr:
@@ -140,12 +154,19 @@ def _map(name: str, nbytes: int, create: bool) -> np.ndarray:
         name=name.lstrip("/"), create=create, size=nbytes
     )
     # the resource tracker would unlink segments owned by *other* processes
-    # at exit; opening (not creating) must unregister to stay hands-off
+    # at exit; opening (not creating) must unregister to stay hands-off —
+    # including on the stale-handle error path below
     if not create:
         try:
             resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
         except Exception:  # noqa: BLE001 — tracker API is private/fragile
             pass
+    if not create and shm.size < nbytes:  # size is page-rounded, so >= holds
+        shm.close()
+        raise ValueError(
+            f"shared segment {name!r} holds {shm.size} bytes but the "
+            f"handle expects {nbytes}: stale or mismatched handle"
+        )
     _fallback_segments.setdefault(name, []).append(shm)
     return np.frombuffer(shm.buf, dtype=np.uint8)[:nbytes]
 

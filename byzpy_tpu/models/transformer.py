@@ -27,6 +27,26 @@ from .bundle import ModelBundle
 Dtype = Any
 
 
+def _ring_axis_bound(axis: str) -> bool:
+    """Whether ``axis`` is bound by an enclosing ``shard_map``/``pmap``.
+    ``model.init`` (and single-device inference) runs outside any binding;
+    ring models must then degrade to the exact single-block semantics
+    instead of raising an unbound-axis NameError."""
+    try:
+        jax.lax.axis_size(axis)
+        return True
+    except NameError:
+        return False
+
+
+def _ring_position_offset(axis: str, block_len: int) -> jnp.ndarray:
+    """Global position offset of this device's sequence block: ring index
+    times local block length; 0 when ``axis`` is unbound (single block)."""
+    if not _ring_axis_bound(axis):
+        return jnp.asarray(0, jnp.int32)
+    return jax.lax.axis_index(axis) * block_len
+
+
 class MultiHeadAttention(nn.Module):
     """MHA whose score/value contraction is pluggable (full vs ring)."""
 
@@ -49,7 +69,7 @@ class MultiHeadAttention(nn.Module):
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
 
-        if self.attention == "ring":
+        if self.attention == "ring" and _ring_axis_bound(self.ring_axis):
             from ..parallel.ring_attention import ring_attention
 
             attn = jax.vmap(jax.vmap(
@@ -57,6 +77,9 @@ class MultiHeadAttention(nn.Module):
                         causal=self.causal)
             ))(q, k, v)
         else:
+            # "full", or "ring" outside a mesh binding (init / single
+            # device), where one local block == the whole sequence and full
+            # attention is the exact same computation
             from ..parallel.ring_attention import full_attention
 
             attn = full_attention(q, k, v, causal=self.causal)
@@ -107,7 +130,7 @@ class TransformerLM(nn.Module):
         if self.attention == "ring":
             # under sequence sharding `l` is the LOCAL block length; global
             # positions are offset by this device's ring index
-            positions = positions + jax.lax.axis_index(self.ring_axis) * l
+            positions = positions + _ring_position_offset(self.ring_axis, l)
         pos = nn.Embed(self.max_len, self.dim, dtype=self.dtype)(positions[None, :])
         x = x + pos
         for _ in range(self.depth):

@@ -164,3 +164,23 @@ def test_process_actor_large_payload_via_shm():
         await backend.close()
 
     asyncio.run(go())
+
+
+def test_open_tensor_rejects_stale_oversized_handle():
+    """A handle claiming more bytes than the segment holds must raise
+    instead of handing out a view whose tail pages SIGBUS on first touch."""
+    arr = np.arange(16, dtype=np.float32)
+    handle = native_store.register_tensor(arr)
+    try:
+        stale = native_store.SharedTensorHandle(
+            handle.name, (1024, 1024), handle.dtype
+        )
+        with pytest.raises(ValueError, match="stale or mismatched"):
+            native_store.open_tensor(stale)
+        # the honest handle still opens fine afterwards
+        view = native_store.open_tensor(handle)
+        np.testing.assert_array_equal(np.asarray(view), arr)
+        del view
+        native_store.close_tensor(handle)
+    finally:
+        native_store.cleanup_tensor(handle)

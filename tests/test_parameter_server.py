@@ -145,3 +145,43 @@ def test_spawn_type_validation():
             await ByzantineNodeActor.spawn(QuadNode, 1.0, backend="thread")
 
     asyncio.run(go())
+
+
+def test_ps_round_failure_retrieves_all_sibling_exceptions(caplog):
+    """When several nodes fail in one round, the round raises the first
+    failure only after every task settles, and every sibling exception is
+    retrieved — asyncio reports dropped ones through the 'asyncio' logger
+    as 'Task exception was never retrieved' when the task is GC'd."""
+    import gc
+    import logging
+
+    completed = []
+
+    class GoodNode:
+        def honest_gradient_for_next_batch(self):
+            return [jnp.ones((4,))]
+
+        def apply_server_gradient(self, g):
+            pass
+
+    class BadNode(GoodNode):
+        def __init__(self, msg):
+            self.msg = msg
+
+        async def honest_gradient_for_next_batch(self):
+            await asyncio.sleep(0.01)
+            completed.append(self.msg)
+            raise RuntimeError(self.msg)
+
+    ps = ParameterServer(
+        honest_nodes=[BadNode("boom-a"), GoodNode(), BadNode("boom-b")],
+        byzantine_nodes=[],
+        aggregator=CoordinateWiseMedian(),
+    )
+    with caplog.at_level(logging.ERROR, logger="asyncio"):
+        with pytest.raises(RuntimeError, match="boom-a"):
+            asyncio.run(ps.round())
+        gc.collect()  # triggers Task.__del__ reporting for dropped exceptions
+    assert set(completed) == {"boom-a", "boom-b"}  # raise waited for ALL
+    dropped = [r for r in caplog.records if "never retrieved" in r.getMessage()]
+    assert not dropped, dropped

@@ -216,3 +216,23 @@ def test_mesh_send_falls_back_to_inbound_connection():
         await nb.shutdown()
 
     asyncio.run(go())
+
+
+def test_untrusted_bind_warns_beyond_loopback():
+    """Binding a cloudpickle control-plane server beyond loopback warns
+    (the wire is remote-code-execution for anyone reaching the socket);
+    loopback binds stay silent."""
+    import warnings
+
+    from byzpy_tpu.engine.actor.backends.remote import RemoteActorServer
+
+    async def bind(host):
+        server = RemoteActorServer(host=host, port=0)
+        await server.start()
+        await server.close()
+
+    with pytest.warns(RuntimeWarning, match="trusted"):
+        asyncio.run(bind("0.0.0.0"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        asyncio.run(bind("127.0.0.1"))

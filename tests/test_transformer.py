@@ -66,3 +66,21 @@ def test_ring_lm_matches_full_lm(devices):
                                rtol=2e-4, atol=2e-4)
     # logits stay sequence-sharded
     assert out.sharding.spec[1] == "sp"
+
+
+def test_ring_lm_init_and_apply_outside_shard_map():
+    """Ring models must initialize (and run) on a single device with no
+    mesh bound: the ring axis degrades to position 0 / full attention,
+    which is exactly one-block ring semantics (ADVICE r2)."""
+    vocab, dim, depth, heads = 32, 32, 1, 4
+    ring = TransformerLM(vocab_size=vocab, dim=dim, depth=depth,
+                         num_heads=heads, attention="ring", ring_axis="sp")
+    full = TransformerLM(vocab_size=vocab, dim=dim, depth=depth,
+                         num_heads=heads, attention="full")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, vocab)
+    params = ring.init(jax.random.PRNGKey(0), tokens)  # used to NameError
+    out_ring = ring.apply(params, tokens)
+    out_full = full.apply(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_full), rtol=1e-5, atol=1e-5
+    )
