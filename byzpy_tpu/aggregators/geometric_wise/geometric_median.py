@@ -1,24 +1,30 @@
 """Geometric median via Weiszfeld iterations
 (behavioral parity: ``byzpy/aggregators/geometric_wise/geometric_median.py:33-158``).
 
-The reference implements the iteration as *barriered subtasks*: every
-Weiszfeld step fans partial weighted sums over shm chunks and reduces on the
-coordinator. On TPU the whole iteration is a single ``lax.while_loop`` —
-with a feature-sharded matrix the per-step distance reduction becomes a
-psum and there are zero host round-trips, so no barriered machinery exists
-here by design.
+Two execution paths:
+
+* single device (no pool / one worker): the whole iteration is one
+  ``lax.while_loop`` — with a feature-sharded matrix the per-step distance
+  reduction becomes a psum and there are zero host round-trips;
+* actor pool: the reference's *barriered* mode — every Weiszfeld step fans
+  per-row-chunk weighted partial sums over the pool (chunks live in the
+  shared store, only the center travels per iteration) and reduces on the
+  coordinator (ref: ``geometric_median.py:106-158``).
 """
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from ...ops import robust
 from ..base import Aggregator
+from ..chunked import BarrieredIterativeAggregator, _weiszfeld_chunk
 
 
-class GeometricMedian(Aggregator):
+class GeometricMedian(BarrieredIterativeAggregator, Aggregator):
     name = "geometric-median"
+    _barrier_chunk_fn = staticmethod(_weiszfeld_chunk)
 
     def __init__(
         self,
@@ -45,6 +51,27 @@ class GeometricMedian(Aggregator):
         return robust.geometric_median(
             x, tol=self.tol, max_iter=self.max_iter, eps=self.eps, init=self.init
         )
+
+    # -- barriered hooks (pool mode) -----------------------------------------
+
+    def _barrier_params(self):
+        return {"eps": self.eps}
+
+    def _barrier_init(self, host: np.ndarray) -> np.ndarray:
+        if self.init == "median":
+            return np.median(host, axis=0)
+        return host.mean(axis=0)
+
+    def _barrier_update(self, partials, center, n_total):
+        num = np.sum([p[0] for p in partials], axis=0)
+        den = sum(p[1] for p in partials)
+        return num / max(den, 1e-30)
+
+    def _barrier_max_iters(self) -> int:
+        return self.max_iter
+
+    def _barrier_converged(self, old, new) -> bool:
+        return float(np.linalg.norm(new - old)) <= self.tol
 
 
 __all__ = ["GeometricMedian"]

@@ -21,7 +21,7 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 import jax.numpy as jnp
 
-from ...engine.graph.chunking import select_adaptive_chunk_size
+from ...engine.graph.chunking import pool_size_from_context, select_adaptive_chunk_size
 from ...engine.graph.operator import OpContext
 from ...engine.graph.subtask import SubTask
 from ...ops import robust
@@ -270,9 +270,8 @@ class MinimumDiameterAveraging(Aggregator):
             return gen_seeded()
 
         total = math.comb(n, m)
-        metadata = getattr(context, "metadata", None) or {}
         chunk = select_adaptive_chunk_size(
-            total, self.chunk_size, pool_size=int(metadata.get("pool_size") or 0)
+            total, self.chunk_size, pool_size=pool_size_from_context(context)
         )
 
         def gen():

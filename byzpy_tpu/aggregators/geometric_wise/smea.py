@@ -14,7 +14,7 @@ import math
 import numpy as np
 import jax.numpy as jnp
 
-from ...engine.graph.chunking import select_adaptive_chunk_size
+from ...engine.graph.chunking import pool_size_from_context, select_adaptive_chunk_size
 from ...engine.graph.operator import OpContext
 from ...engine.graph.subtask import SubTask
 from ...ops import robust
@@ -71,9 +71,8 @@ class SMEA(Aggregator):
         m = n - self.f
         total = math.comb(n, m)
         host_gram = np.asarray(robust.gram_matrix(matrix))
-        metadata = getattr(context, "metadata", None) or {}
         chunk = select_adaptive_chunk_size(
-            total, self.chunk_size, pool_size=int(metadata.get("pool_size") or 0)
+            total, self.chunk_size, pool_size=pool_size_from_context(context)
         )
 
         def gen():

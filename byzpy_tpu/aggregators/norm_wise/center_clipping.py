@@ -1,22 +1,26 @@
 """Centered Clipping (Karimireddy et al. 2021, ICML)
 (behavioral parity: ``byzpy/aggregators/norm_wise/center_clipping.py:29-269``).
 
-The reference iterates with barriered subtasks writing per-chunk
-contribution slots into shm; here the M clipping iterations are a
-``lax.fori_loop`` inside one compiled program (per-iteration distance
-reductions shard over the mesh as psums).
+Single-device path: the M clipping iterations are a ``lax.fori_loop``
+inside one compiled program (per-iteration distance reductions shard over
+the mesh as psums). Pool path: the reference's *barriered* mode — each of
+the M iterations fans per-row-chunk clip sums over the pool and the
+coordinator applies ``v += mean`` (ref: ``center_clipping.py:158-257``).
 """
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from ...ops import robust
 from ..base import Aggregator
+from ..chunked import BarrieredIterativeAggregator, _centered_clip_chunk
 
 
-class CenteredClipping(Aggregator):
+class CenteredClipping(BarrieredIterativeAggregator, Aggregator):
     name = "centered-clipping"
+    _barrier_chunk_fn = staticmethod(_centered_clip_chunk)
 
     def __init__(
         self,
@@ -43,6 +47,25 @@ class CenteredClipping(Aggregator):
         return robust.centered_clipping(
             x, c_tau=self.c_tau, M=self.M, eps=self.eps, init=self.init
         )
+
+    # -- barriered hooks (pool mode) -----------------------------------------
+
+    def _barrier_params(self):
+        return {"c_tau": self.c_tau, "eps": self.eps}
+
+    def _barrier_init(self, host: np.ndarray) -> np.ndarray:
+        if self.init == "mean":
+            return host.mean(axis=0)
+        if self.init == "median":
+            return np.median(host, axis=0)
+        return np.zeros(host.shape[1], host.dtype)
+
+    def _barrier_update(self, partials, center, n_total):
+        total = np.sum([p[0] for p in partials], axis=0)
+        return center + total / n_total
+
+    def _barrier_max_iters(self) -> int:
+        return self.M
 
 
 __all__ = ["CenteredClipping"]

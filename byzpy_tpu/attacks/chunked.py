@@ -1,0 +1,158 @@
+"""Subtask fan-out for attacks on actor pools.
+
+The reference parallelizes every attack except LabelFlip by slicing the
+work across pool workers (``byzpy/attacks/base.py:47-119`` + per-attack
+``create_subtasks``). Here the analogous split is over the feature
+dimension of the stacked honest matrix (or the raveled base gradient):
+each subtask emits the malicious coordinates for one column span and the
+reduce concatenates them back into the gradient pytree.
+
+On a single device the plain ``apply`` path (one jitted call) is faster;
+this mode exists for heterogeneous pools and scheduler-integration parity.
+Chunk functions are module-level so process/remote workers can unpickle
+them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..engine.graph.chunking import pool_size_from_context, select_adaptive_chunk_size
+from ..engine.graph.operator import OpContext
+from ..engine.graph.subtask import SubTask
+from ..ops import attack_ops
+from ..utils.trees import stack_gradients
+
+
+
+# -- module-level chunk kernels (picklable by name) --------------------------
+
+
+def _empire_chunk(cols: np.ndarray, *, scale: float) -> np.ndarray:
+    return np.asarray(attack_ops.empire(jnp.asarray(cols), scale=scale))
+
+
+def _little_chunk(cols: np.ndarray, *, f: int, n_total: int) -> np.ndarray:
+    return np.asarray(attack_ops.little(jnp.asarray(cols), f=f, n_total=n_total))
+
+
+def _mimic_chunk(cols: np.ndarray, *, epsilon: int) -> np.ndarray:
+    return np.asarray(cols[epsilon])
+
+
+def _inf_chunk(width: int, *, dtype_descr: str) -> np.ndarray:
+    return np.full((width,), np.inf, dtype=np.dtype(dtype_descr))
+
+
+def _sign_flip_chunk(cols: np.ndarray, *, scale: float) -> np.ndarray:
+    # base_grad stacks to a (1, w) block
+    return np.asarray(attack_ops.sign_flip(jnp.asarray(cols[0]), scale=scale))
+
+
+def _gaussian_chunk(
+    width: int, key_data: np.ndarray, idx: int, *, mu: float, sigma: float,
+    dtype_descr: str,
+) -> np.ndarray:
+    key = jax.random.fold_in(jnp.asarray(key_data, jnp.uint32), idx)
+    out = attack_ops.gaussian(
+        key, (width,), dtype=np.dtype(dtype_descr), mu=mu, sigma=sigma
+    )
+    return np.asarray(out)
+
+
+# -- mixin -------------------------------------------------------------------
+
+
+class FeatureChunkedAttack:
+    """Mixin: fan malicious-coordinate spans across the pool and
+    concatenate (the reference's attack subtask mode, feature-sharded the
+    way the TPU data plane shards coordinates)."""
+
+    supports_subtasks = True
+    chunk_size = 65536
+    _chunk_fn: Any = None
+
+    def _chunk_params(self, host: np.ndarray) -> Mapping[str, Any]:
+        return {}
+
+    def _chunk_host(self, inputs: Mapping[str, Any]) -> np.ndarray:
+        """The (n, d) stacked honest matrix (or (1, d) base-grad block)."""
+        grads = inputs.get("honest_grads")
+        if not grads:
+            raise ValueError(f"{self.name} attack requires honest_grads")
+        matrix, _ = stack_gradients(grads)
+        return np.asarray(matrix)
+
+    def _unravel_like(self, inputs: Mapping[str, Any]):
+        grads = inputs.get("honest_grads")
+        _, unravel = stack_gradients(grads)
+        return unravel
+
+    def _chunk_args(
+        self, host: np.ndarray, start: int, end: int, idx: int
+    ) -> tuple:
+        return (host[:, start:end],)
+
+    def create_subtasks(
+        self, inputs: Mapping[str, Any], *, context: OpContext
+    ) -> Iterable[SubTask]:
+        host = self._chunk_host(inputs)
+        d = host.shape[-1]
+        chunk = select_adaptive_chunk_size(
+            d, self.chunk_size, pool_size=pool_size_from_context(context)
+        )
+        params = dict(self._chunk_params(host))
+        fn = type(self)._chunk_fn
+        # eager list (spans are few and args are views of `host`): instance
+        # state read by _chunk_args (e.g. a split PRNG key) must be captured
+        # before a concurrent create_subtasks call advances it
+        tasks = []
+        for idx, start in enumerate(range(0, d, chunk)):
+            end = min(d, start + chunk)
+            tasks.append(
+                SubTask(
+                    fn=fn,
+                    args=self._chunk_args(host, start, end, idx),
+                    kwargs=params,
+                    name=f"{self.name}-feat[{start}:{end}]",
+                )
+            )
+        return tasks
+
+    def reduce_subtasks(
+        self, partials, inputs: Mapping[str, Any], *, context: OpContext
+    ) -> Any:
+        vec = jnp.concatenate([jnp.asarray(p) for p in partials])
+        return self._unravel_like(inputs)(vec)
+
+
+class BaseGradChunkedAttack(FeatureChunkedAttack):
+    """Variant for ``uses_base_grad`` attacks: spans come from the node's
+    own gradient instead of the honest matrix."""
+
+    def _chunk_host(self, inputs: Mapping[str, Any]) -> np.ndarray:
+        base = inputs.get("base_grad")
+        if base is None:
+            raise ValueError(f"{self.name} attack requires base_grad")
+        matrix, _ = stack_gradients([base])
+        return np.asarray(matrix)
+
+    def _unravel_like(self, inputs: Mapping[str, Any]):
+        _, unravel = stack_gradients([inputs.get("base_grad")])
+        return unravel
+
+
+__all__ = [
+    "FeatureChunkedAttack",
+    "BaseGradChunkedAttack",
+    "_empire_chunk",
+    "_little_chunk",
+    "_mimic_chunk",
+    "_inf_chunk",
+    "_sign_flip_chunk",
+    "_gaussian_chunk",
+]

@@ -8,14 +8,19 @@ from typing import Any, List, Optional
 from ..ops import attack_ops
 from ..utils.trees import stack_gradients
 from .base import Attack
+from .chunked import FeatureChunkedAttack, _empire_chunk
 
 
-class EmpireAttack(Attack):
+class EmpireAttack(FeatureChunkedAttack, Attack):
     name = "empire"
     uses_honest_grads = True
+    _chunk_fn = staticmethod(_empire_chunk)
 
     def __init__(self, *, scale: float = -1.0) -> None:
         self.scale = float(scale)
+
+    def _chunk_params(self, host):
+        return {"scale": self.scale}
 
     def apply(self, *, model=None, x=None, y=None,
               honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:

@@ -8,15 +8,18 @@ from __future__ import annotations
 from typing import Any, List, Optional
 
 import jax
+import numpy as np
 
 from ..ops import attack_ops
 from ..utils.trees import stack_gradients
 from .base import Attack
+from .chunked import FeatureChunkedAttack, _gaussian_chunk
 
 
-class GaussianAttack(Attack):
+class GaussianAttack(FeatureChunkedAttack, Attack):
     name = "gaussian"
     uses_honest_grads = True
+    _chunk_fn = staticmethod(_gaussian_chunk)
 
     def __init__(self, *, mu: float = 0.0, sigma: float = 1.0, seed: int = 0,
                  key: Optional[jax.Array] = None) -> None:
@@ -36,6 +39,24 @@ class GaussianAttack(Attack):
             sub, (matrix.shape[1],), dtype=matrix.dtype, mu=self.mu, sigma=self.sigma
         )
         return unravel(noise)
+
+    # -- fan-out: per-chunk noise from a fold_in'd subkey (the draw differs
+    # from the single-dispatch path but is the same distribution; the
+    # reference's chunked RNG likewise draws per chunk) -----------------------
+
+    def create_subtasks(self, inputs, *, context):
+        self._key, self._fanout_key = jax.random.split(self._key)
+        return super().create_subtasks(inputs, context=context)
+
+    def _chunk_params(self, host):
+        return {
+            "mu": self.mu,
+            "sigma": self.sigma,
+            "dtype_descr": host.dtype.str,
+        }
+
+    def _chunk_args(self, host, start, end, idx):
+        return (end - start, np.asarray(self._fanout_key), idx)
 
 
 __all__ = ["GaussianAttack"]

@@ -8,11 +8,19 @@ from typing import Any, List, Optional
 from ..ops import attack_ops
 from ..utils.trees import stack_gradients
 from .base import Attack
+from .chunked import FeatureChunkedAttack, _inf_chunk
 
 
-class InfAttack(Attack):
+class InfAttack(FeatureChunkedAttack, Attack):
     name = "inf"
     uses_honest_grads = True
+    _chunk_fn = staticmethod(_inf_chunk)
+
+    def _chunk_params(self, host):
+        return {"dtype_descr": host.dtype.str}
+
+    def _chunk_args(self, host, start, end, idx):
+        return (end - start,)
 
     def apply(self, *, model=None, x=None, y=None,
               honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:

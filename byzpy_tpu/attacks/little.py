@@ -11,11 +11,13 @@ from typing import Any, List, Optional
 from ..ops import attack_ops
 from ..utils.trees import stack_gradients
 from .base import Attack
+from .chunked import FeatureChunkedAttack, _little_chunk
 
 
-class LittleAttack(Attack):
+class LittleAttack(FeatureChunkedAttack, Attack):
     name = "little"
     uses_honest_grads = True
+    _chunk_fn = staticmethod(_little_chunk)
 
     def __init__(self, f: int, N: Optional[int] = None) -> None:
         if f < 0:
@@ -23,14 +25,23 @@ class LittleAttack(Attack):
         self.f = int(f)
         self.N = None if N is None else int(N)
 
+    def _chunk_params(self, host):
+        return {"f": self.f, "n_total": self._resolve_total(host.shape[0])}
+
+    def _resolve_total(self, n_honest: int) -> int:
+        """``N`` defaults to honest count + f (ref little.py:81-139); one
+        resolver serves both the direct and the pooled path."""
+        total = self.N if self.N is not None else n_honest + self.f
+        if total < self.f:
+            raise ValueError(f"N must be >= f (got N={total}, f={self.f})")
+        return total
+
     def apply(self, *, model=None, x=None, y=None,
               honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:
         if not honest_grads:
             raise ValueError("LittleAttack requires honest_grads")
         matrix, unravel = stack_gradients(honest_grads)
-        total = self.N if self.N is not None else matrix.shape[0] + self.f
-        if total < self.f:
-            raise ValueError(f"N must be >= f (got N={total}, f={self.f})")
+        total = self._resolve_total(matrix.shape[0])
         return unravel(attack_ops.little(matrix, f=self.f, n_total=total))
 
 

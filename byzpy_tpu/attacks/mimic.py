@@ -8,16 +8,26 @@ from typing import Any, List, Optional
 import jax
 
 from .base import Attack
+from .chunked import FeatureChunkedAttack, _mimic_chunk
 
 
-class MimicAttack(Attack):
+class MimicAttack(FeatureChunkedAttack, Attack):
     name = "mimic"
     uses_honest_grads = True
+    _chunk_fn = staticmethod(_mimic_chunk)
 
     def __init__(self, *, epsilon: int = 0) -> None:
         if epsilon < 0:
             raise ValueError("epsilon must be >= 0")
         self.epsilon = int(epsilon)
+
+    def _chunk_params(self, host):
+        if self.epsilon >= host.shape[0]:
+            raise ValueError(
+                f"epsilon must index an honest worker in [0, {host.shape[0]}) "
+                f"(got {self.epsilon})"
+            )
+        return {"epsilon": self.epsilon}
 
     def apply(self, *, model=None, x=None, y=None,
               honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:

@@ -9,14 +9,19 @@ import jax
 
 from ..ops import attack_ops
 from .base import Attack
+from .chunked import BaseGradChunkedAttack, _sign_flip_chunk
 
 
-class SignFlipAttack(Attack):
+class SignFlipAttack(BaseGradChunkedAttack, Attack):
     name = "sign-flip"
     uses_base_grad = True
+    _chunk_fn = staticmethod(_sign_flip_chunk)
 
     def __init__(self, *, scale: float = -1.0) -> None:
         self.scale = float(scale)
+
+    def _chunk_params(self, host):
+        return {"scale": self.scale}
 
     def apply(self, *, model=None, x=None, y=None,
               honest_grads: Optional[List[Any]] = None, base_grad: Any = None) -> Any:

@@ -54,4 +54,12 @@ def select_adaptive_chunk_size(
     return max(floor, min(configured, ideal))
 
 
-__all__ = ["select_adaptive_chunk_size"]
+__all__ = ["select_adaptive_chunk_size", "pool_size_from_context"]
+
+
+def pool_size_from_context(context) -> int:
+    """Worker count the scheduler injected into operator metadata (0 when
+    running without a pool); single source of truth for every chunked
+    operator's adaptive sizing."""
+    metadata = getattr(context, "metadata", None) or {}
+    return int(metadata.get("pool_size") or 0)
