@@ -189,9 +189,7 @@ class BarrieredIterativeAggregator:
     def _barrier_init(self, host: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
-    def _barrier_update(
-        self, partials: Any, center: np.ndarray, n_total: int
-    ) -> np.ndarray:
+    def _barrier_update(self, partials: Any, center: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
     def _barrier_max_iters(self) -> int:
@@ -218,11 +216,13 @@ class BarrieredIterativeAggregator:
         fn = type(self)._barrier_chunk_fn
         handles = []
         spans = []
-        for start in range(0, n, chunk):
-            end = min(n, start + chunk)
-            handles.append(register_tensor(np.ascontiguousarray(host[start:end])))
-            spans.append((start, end))
         try:
+            # registration inside the try: a partial failure (e.g. ENOSPC on
+            # /dev/shm) must still unlink the segments already registered
+            for start in range(0, n, chunk):
+                end = min(n, start + chunk)
+                handles.append(register_tensor(np.ascontiguousarray(host[start:end])))
+                spans.append((start, end))
             center = self._barrier_init(host)
             for _ in range(self._barrier_max_iters()):
                 tasks = [
@@ -235,7 +235,7 @@ class BarrieredIterativeAggregator:
                     for h, (s, e) in zip(handles, spans)
                 ]
                 partials = await self._run_subtasks(pool, tasks, context)
-                new_center = self._barrier_update(partials, center, n)
+                new_center = self._barrier_update(partials, center)
                 done = self._barrier_converged(center, new_center)
                 center = new_center
                 if done:

@@ -62,7 +62,7 @@ class GeometricMedian(BarrieredIterativeAggregator, Aggregator):
             return np.median(host, axis=0)
         return host.mean(axis=0)
 
-    def _barrier_update(self, partials, center, n_total):
+    def _barrier_update(self, partials, center):
         num = np.sum([p[0] for p in partials], axis=0)
         den = sum(p[1] for p in partials)
         return num / max(den, 1e-30)

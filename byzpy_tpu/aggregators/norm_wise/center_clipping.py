@@ -60,9 +60,12 @@ class CenteredClipping(BarrieredIterativeAggregator, Aggregator):
             return np.median(host, axis=0)
         return np.zeros(host.shape[1], host.dtype)
 
-    def _barrier_update(self, partials, center, n_total):
+    def _barrier_update(self, partials, center):
+        # denominator from the partials themselves: one source of truth for
+        # the row count, matching the 1/n mean in the fused path
         total = np.sum([p[0] for p in partials], axis=0)
-        return center + total / n_total
+        rows = sum(p[1] for p in partials)
+        return center + total / rows
 
     def _barrier_max_iters(self) -> int:
         return self.M

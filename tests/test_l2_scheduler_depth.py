@@ -1,0 +1,617 @@
+"""Deep L2 coverage: ParallelScheduler, Operator dispatch modes, windowed
+subtask execution, and the message machinery.
+
+Mirrors the intent of the reference suites
+``engine/graph/tests/test_parallel_scheduler.py`` (concurrency caps,
+failure propagation, shared subtask budget), ``test_operator.py``
+(dispatch-mode selection, windowed refill ordering, semaphore
+release-on-failure, affinity), ``test_message_trigger_op.py`` and
+``test_scheduler_message.py`` (trigger ops, waiter/cache discipline).
+"""
+
+import asyncio
+
+import pytest
+
+from byzpy_tpu.engine.graph import (
+    ActorPool,
+    ActorPoolConfig,
+    ComputationGraph,
+    GraphInput,
+    GraphNode,
+)
+from byzpy_tpu.engine.graph.operator import (
+    MessageTriggerOp,
+    OpContext,
+    Operator,
+    run_subtasks_windowed,
+)
+from byzpy_tpu.engine.graph.parallel_scheduler import ParallelScheduler
+from byzpy_tpu.engine.graph.scheduler import (
+    MessageAwareNodeScheduler,
+    MessageSource,
+    NodeScheduler,
+)
+from byzpy_tpu.engine.graph.subtask import SubTask
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+class SleepOp(Operator):
+    """Records entry/exit so tests can assert overlap and ordering."""
+
+    def __init__(self, name, delay=0.05, log=None, result=None):
+        self.name = name
+        self.delay = delay
+        self.log = log if log is not None else []
+        self.result = result if result is not None else name
+
+    async def compute(self, inputs, *, context):
+        self.log.append(("start", self.name))
+        await asyncio.sleep(self.delay)
+        self.log.append(("end", self.name))
+        return self.result
+
+
+class GaugeOp(Operator):
+    """Tracks the peak number of concurrently-running instances."""
+
+    running = 0
+    peak = 0
+
+    def __init__(self, name, delay=0.05):
+        self.name = name
+        self.delay = delay
+
+    async def compute(self, inputs, *, context):
+        cls = GaugeOp
+        cls.running += 1
+        cls.peak = max(cls.peak, cls.running)
+        try:
+            await asyncio.sleep(self.delay)
+        finally:
+            cls.running -= 1
+        return self.name
+
+
+class FailOp(Operator):
+    name = "fail"
+
+    async def compute(self, inputs, *, context):
+        raise RuntimeError("node exploded")
+
+
+def graph_of(*nodes, outputs=None):
+    return ComputationGraph(list(nodes), outputs=outputs)
+
+
+# ---------------------------------------------------------------------------
+# ParallelScheduler
+# ---------------------------------------------------------------------------
+
+
+def test_parallel_independent_branches_overlap():
+    """Two independent branches must interleave (start/start before any
+    end), unlike the sequential NodeScheduler."""
+    log = []
+    g = graph_of(
+        GraphNode("a", SleepOp("a", 0.05, log), {}),
+        GraphNode("b", SleepOp("b", 0.05, log), {}),
+        outputs=["a", "b"],
+    )
+    asyncio.run(ParallelScheduler(g).run({}))
+    starts = [i for i, (kind, _) in enumerate(log) if kind == "start"]
+    first_end = min(i for i, (kind, _) in enumerate(log) if kind == "end")
+    assert max(starts) < first_end, log  # both started before either ended
+
+
+def test_sequential_scheduler_does_not_overlap():
+    log = []
+    g = graph_of(
+        GraphNode("a", SleepOp("a", 0.02, log), {}),
+        GraphNode("b", SleepOp("b", 0.02, log), {}),
+        outputs=["a", "b"],
+    )
+    asyncio.run(NodeScheduler(g).run({}))
+    assert log == [("start", "a"), ("end", "a"), ("start", "b"), ("end", "b")]
+
+
+def test_parallel_max_concurrent_nodes_cap():
+    GaugeOp.running = GaugeOp.peak = 0
+    g = graph_of(
+        *(GraphNode(f"n{i}", GaugeOp(f"n{i}", 0.02), {}) for i in range(6)),
+        outputs=[f"n{i}" for i in range(6)],
+    )
+    asyncio.run(ParallelScheduler(g, max_concurrent_nodes=2).run({}))
+    assert GaugeOp.peak <= 2, GaugeOp.peak
+
+
+def test_parallel_unbounded_runs_all_at_once():
+    GaugeOp.running = GaugeOp.peak = 0
+    g = graph_of(
+        *(GraphNode(f"n{i}", GaugeOp(f"n{i}", 0.03), {}) for i in range(5)),
+        outputs=[f"n{i}" for i in range(5)],
+    )
+    asyncio.run(ParallelScheduler(g).run({}))
+    assert GaugeOp.peak == 5
+
+
+def test_parallel_dependency_ordering():
+    """A strict chain on the parallel scheduler still executes in order."""
+    log = []
+
+    class PassThrough(SleepOp):
+        async def compute(self, inputs, *, context):
+            await super().compute(inputs, context=context)
+            return inputs.get("x", 0) + 1
+
+    g = graph_of(
+        GraphNode("a", PassThrough("a", 0.01, log), {"x": GraphInput("seed")}),
+        GraphNode("b", PassThrough("b", 0.01, log), {"x": "a"}),
+        GraphNode("c", PassThrough("c", 0.01, log), {"x": "b"}),
+        outputs=["c"],
+    )
+    out = asyncio.run(ParallelScheduler(g).run({"seed": 10}))
+    assert out == {"c": 13}
+    assert log == [
+        ("start", "a"), ("end", "a"),
+        ("start", "b"), ("end", "b"),
+        ("start", "c"), ("end", "c"),
+    ]
+
+
+def test_parallel_wide_diamond_values():
+    def make(fn_name, f):
+        class Op(Operator):
+            name = fn_name
+
+            async def compute(self, inputs, *, context):
+                return f(**inputs)
+
+        return Op()
+
+    g = graph_of(
+        GraphNode("src", make("src", lambda x: x * 2), {"x": GraphInput("x")}),
+        GraphNode("l", make("l", lambda v: v + 1), {"v": "src"}),
+        GraphNode("r", make("r", lambda v: v + 2), {"v": "src"}),
+        GraphNode("join", make("join", lambda a, b: (a, b)), {"a": "l", "b": "r"}),
+        outputs=["join"],
+    )
+    assert asyncio.run(ParallelScheduler(g).run({"x": 5})) == {"join": (11, 12)}
+
+
+def test_parallel_node_failure_propagates():
+    g = graph_of(
+        GraphNode("ok", SleepOp("ok", 0.01), {}),
+        GraphNode("bad", FailOp(), {}),
+        outputs=["ok", "bad"],
+    )
+    with pytest.raises(RuntimeError, match="node exploded"):
+        asyncio.run(ParallelScheduler(g).run({}))
+
+
+def test_parallel_failure_does_not_hang_downstream():
+    """A consumer of a failed node must not deadlock the run."""
+    g = graph_of(
+        GraphNode("bad", FailOp(), {}),
+        GraphNode("after", SleepOp("after", 0.01), {"x": "bad"}),
+        outputs=["after"],
+    )
+    with pytest.raises(RuntimeError, match="node exploded"):
+        asyncio.run(asyncio.wait_for(ParallelScheduler(g).run({}), timeout=5))
+
+
+def test_parallel_missing_app_input_raises_keyerror():
+    g = graph_of(
+        GraphNode("a", SleepOp("a", 0.0), {"x": GraphInput("missing")}),
+        outputs=["a"],
+    )
+    with pytest.raises(KeyError, match="missing"):
+        asyncio.run(ParallelScheduler(g).run({}))
+
+
+def test_parallel_unknown_string_source_raises():
+    g = graph_of(
+        GraphNode("a", SleepOp("a", 0.0), {"x": "nonexistent"}),
+        outputs=["a"],
+    )
+    with pytest.raises(KeyError, match="nonexistent"):
+        asyncio.run(ParallelScheduler(g).run({}))
+
+
+def test_parallel_string_source_falls_back_to_inputs():
+    """A string source that is not a node name resolves from the input
+    mapping (how sessions feed cached upstream values)."""
+
+    class Echo(Operator):
+        name = "echo"
+
+        async def compute(self, inputs, *, context):
+            return inputs["x"]
+
+    g = graph_of(GraphNode("a", Echo(), {"x": "warm"}), outputs=["a"])
+    out = asyncio.run(ParallelScheduler(g).run({"warm": 42}))
+    assert out == {"a": 42}
+
+
+def test_parallel_message_source_rejected():
+    g = graph_of(
+        GraphNode("a", SleepOp("a", 0.0), {"x": MessageSource("gradient")}),
+        outputs=["a"],
+    )
+    with pytest.raises(RuntimeError, match="MessageAware"):
+        asyncio.run(ParallelScheduler(g).run({}))
+
+
+def test_parallel_only_outputs_returned():
+    g = graph_of(
+        GraphNode("a", SleepOp("a", 0.0, result=1), {}),
+        GraphNode("b", SleepOp("b", 0.0, result=2), {"x": "a"}),
+        outputs=["b"],
+    )
+    assert asyncio.run(ParallelScheduler(g).run({})) == {"b": 2}
+
+
+def test_parallel_shared_subtask_budget_across_operators():
+    """max_pending_subtasks bounds in-flight subtasks ACROSS concurrently
+    running operators via the shared semaphore."""
+    state = {"running": 0, "peak": 0}
+
+    class Fanner(Operator):
+        supports_subtasks = True
+        max_subtasks_inflight = 0  # per-op unbounded; shared budget only
+
+        def __init__(self, name):
+            self.name = name
+
+        def create_subtasks(self, inputs, *, context):
+            async def unit():
+                state["running"] += 1
+                state["peak"] = max(state["peak"], state["running"])
+                await asyncio.sleep(0.01)
+                state["running"] -= 1
+                return 1
+
+            for i in range(6):
+                yield SubTask(fn=unit, name=f"{self.name}-{i}")
+
+        def reduce_subtasks(self, partials, inputs, *, context):
+            return sum(partials)
+
+        async def compute(self, inputs, *, context):
+            return 0
+
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            g = graph_of(
+                GraphNode("f1", Fanner("f1"), {}),
+                GraphNode("f2", Fanner("f2"), {}),
+                outputs=["f1", "f2"],
+            )
+            return await ParallelScheduler(
+                g, pool=pool, max_pending_subtasks=3
+            ).run({})
+
+    out = asyncio.run(main())
+    assert out == {"f1": 6, "f2": 6}
+    assert state["peak"] <= 3, state["peak"]
+
+
+# ---------------------------------------------------------------------------
+# Operator dispatch modes + windowed runner
+# ---------------------------------------------------------------------------
+
+
+class RecordingOp(Operator):
+    """Operator that records which execution path ran."""
+
+    supports_subtasks = True
+    name = "recording"
+
+    def __init__(self):
+        self.paths = []
+
+    async def compute(self, inputs, *, context):
+        self.paths.append("compute")
+        return "compute"
+
+    def create_subtasks(self, inputs, *, context):
+        self.paths.append("create")
+        for i in range(3):
+            yield SubTask(fn=lambda i=i: i, name=f"st{i}")
+
+    def reduce_subtasks(self, partials, inputs, *, context):
+        self.paths.append("reduce")
+        return partials
+
+
+def _run_op(op, pool=None):
+    async def main():
+        return await op.run({}, context=OpContext("n"), pool=pool)
+
+    return asyncio.run(main())
+
+
+def test_operator_plain_compute_without_pool():
+    op = RecordingOp()
+    assert _run_op(op) == "compute"
+    assert op.paths == ["compute"]
+
+
+def test_operator_subtasks_need_multiworker_pool():
+    async def main():
+        op = RecordingOp()
+        async with ActorPool(ActorPoolConfig(backend="thread", count=1)) as pool:
+            out = await op.run({}, context=OpContext("n"), pool=pool)
+        return op.paths, out
+
+    paths, out = asyncio.run(main())
+    assert paths == ["compute"] and out == "compute"  # 1 worker -> no fan-out
+
+    async def main2():
+        op = RecordingOp()
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            out = await op.run({}, context=OpContext("n"), pool=pool)
+        return op.paths, out
+
+    paths, out = asyncio.run(main2())
+    assert paths == ["create", "reduce"] and out == [0, 1, 2]
+
+
+def test_operator_empty_subtasks_falls_back_to_compute():
+    class EmptyFan(RecordingOp):
+        def create_subtasks(self, inputs, *, context):
+            self.paths.append("create")
+            return []
+
+    async def main():
+        op = EmptyFan()
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            out = await op.run({}, context=OpContext("n"), pool=pool)
+        return op.paths, out
+
+    paths, out = asyncio.run(main())
+    assert paths == ["create", "compute"] and out == "compute"
+
+
+def test_windowed_results_in_submission_order():
+    """Later-submitted subtasks may finish first; results must still come
+    back in submission order."""
+
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=4)) as pool:
+            async def unit(i):
+                await asyncio.sleep(0.03 if i % 2 == 0 else 0.0)
+                return i
+
+            sts = [SubTask(fn=unit, args=(i,), name=f"s{i}") for i in range(8)]
+            return await run_subtasks_windowed(pool, sts, limit=4)
+
+    assert asyncio.run(main()) == list(range(8))
+
+
+def test_windowed_limit_bounds_inflight():
+    state = {"running": 0, "peak": 0}
+
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=8)) as pool:
+            async def unit():
+                state["running"] += 1
+                state["peak"] = max(state["peak"], state["running"])
+                await asyncio.sleep(0.01)
+                state["running"] -= 1
+                return 1
+
+            sts = [SubTask(fn=unit, name=f"s{i}") for i in range(12)]
+            return await run_subtasks_windowed(pool, sts, limit=3)
+
+    assert sum(asyncio.run(main())) == 12
+    assert state["peak"] <= 3, state["peak"]
+
+
+def test_windowed_failure_cancels_and_releases_semaphore():
+    """A failing subtask raises, and the shared semaphore is fully
+    released so a following operator can still use its budget."""
+
+    async def main():
+        sem = asyncio.Semaphore(2)
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            async def boom():
+                raise ValueError("subtask failed")
+
+            sts = [SubTask(fn=boom, name=f"s{i}") for i in range(4)]
+            with pytest.raises(ValueError, match="subtask failed"):
+                await run_subtasks_windowed(pool, sts, limit=2, semaphore=sem)
+
+            # budget fully restored: both permits immediately acquirable
+            await asyncio.wait_for(sem.acquire(), 1)
+            await asyncio.wait_for(sem.acquire(), 1)
+            sem.release()
+            sem.release()
+
+            async def ok():
+                return "fine"
+
+            out = await run_subtasks_windowed(
+                pool, [SubTask(fn=ok, name="ok")], limit=2, semaphore=sem
+            )
+            return out
+
+    assert asyncio.run(main()) == ["fine"]
+
+
+def test_windowed_subtask_retry_budget():
+    attempts = {"n": 0}
+
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            def flaky():
+                attempts["n"] += 1
+                if attempts["n"] < 3:
+                    raise OSError("transient")
+                return "recovered"
+
+            st = SubTask(fn=flaky, name="flaky", max_retries=2)
+            return await run_subtasks_windowed(pool, [st], limit=1)
+
+    assert asyncio.run(main()) == ["recovered"]
+    assert attempts["n"] == 3
+
+
+def test_operator_affinity_metadata_round_robin():
+    """worker_affinities metadata assigns affinities round-robin to
+    subtasks that lack one."""
+    seen = []
+
+    class AffOp(Operator):
+        supports_subtasks = True
+        name = "aff"
+
+        def create_subtasks(self, inputs, *, context):
+            for i in range(4):
+                yield SubTask(fn=lambda i=i: i, name=f"s{i}")
+
+        def reduce_subtasks(self, partials, inputs, *, context):
+            return partials
+
+        async def compute(self, inputs, *, context):
+            return None
+
+    class SpyPool:
+        size = 2
+
+        async def run_subtask(self, st):
+            seen.append(st.affinity)
+            return 0
+
+    async def main():
+        op = AffOp()
+        ctx = OpContext("n", metadata={"worker_affinities": ["w0", "w1"]})
+        return await op.run({}, context=ctx, pool=SpyPool())
+
+    asyncio.run(main())
+    assert seen == ["w0", "w1", "w0", "w1"]
+
+
+# ---------------------------------------------------------------------------
+# Message machinery
+# ---------------------------------------------------------------------------
+
+
+def _msg_graph(op):
+    return graph_of(GraphNode("trigger", op, {}), outputs=["trigger"])
+
+
+def test_message_trigger_returns_full_message():
+    sched = MessageAwareNodeScheduler(_msg_graph(MessageTriggerOp("gradient")))
+
+    async def main():
+        await sched.deliver_message("gradient", {"vector": [1, 2], "round": 7})
+        return await sched.run({})
+
+    out = asyncio.run(main())
+    assert out["trigger"] == {"vector": [1, 2], "round": 7}
+
+
+def test_message_trigger_field_extraction():
+    sched = MessageAwareNodeScheduler(
+        _msg_graph(MessageTriggerOp("gradient", field="vector"))
+    )
+
+    async def main():
+        await sched.deliver_message("gradient", {"vector": [3, 4]})
+        return await sched.run({})
+
+    assert asyncio.run(main())["trigger"] == [3, 4]
+
+
+def test_message_trigger_timeout():
+    sched = MessageAwareNodeScheduler(
+        _msg_graph(MessageTriggerOp("never", timeout=0.05))
+    )
+    with pytest.raises(TimeoutError, match="never"):
+        asyncio.run(sched.run({}))
+
+
+def test_message_trigger_requires_message_aware_scheduler():
+    sched = NodeScheduler(_msg_graph(MessageTriggerOp("gradient")))
+    with pytest.raises(RuntimeError, match="wait_for_message"):
+        asyncio.run(sched.run({}))
+
+
+def test_wait_before_deliver_wakes_waiter():
+    g = _msg_graph(MessageTriggerOp("late"))
+    sched = MessageAwareNodeScheduler(g)
+
+    async def main():
+        run = asyncio.ensure_future(sched.run({}))
+        await asyncio.sleep(0.02)  # run() is now parked on the waiter
+        await sched.deliver_message("late", "payload")
+        return await run
+
+    assert asyncio.run(main())["trigger"] == "payload"
+
+
+def test_multiple_waiters_fifo():
+    sched = MessageAwareNodeScheduler(_msg_graph(MessageTriggerOp("t")))
+
+    async def main():
+        w1 = asyncio.ensure_future(sched.wait_for_message("t"))
+        await asyncio.sleep(0)
+        w2 = asyncio.ensure_future(sched.wait_for_message("t"))
+        await asyncio.sleep(0)
+        await sched.deliver_message("t", "first")
+        await sched.deliver_message("t", "second")
+        return await w1, await w2
+
+    assert asyncio.run(main()) == ("first", "second")
+
+
+def test_message_cache_bounded_drops_oldest():
+    sched = MessageAwareNodeScheduler(
+        _msg_graph(MessageTriggerOp("t")), max_cached_per_type=3
+    )
+
+    async def main():
+        for i in range(5):
+            await sched.deliver_message("t", i)
+        assert sched.pending_message_count("t") == 3
+        return [await sched.wait_for_message("t") for _ in range(3)]
+
+    assert asyncio.run(main()) == [2, 3, 4]  # 0 and 1 dropped
+
+
+def test_message_source_graph_input():
+    class Echo(Operator):
+        name = "echo"
+
+        async def compute(self, inputs, *, context):
+            return inputs["v"]
+
+    g = graph_of(
+        GraphNode("n", Echo(), {"v": MessageSource("grad", field="x")}),
+        outputs=["n"],
+    )
+    sched = MessageAwareNodeScheduler(g)
+
+    async def main():
+        await sched.deliver_message("grad", {"x": 99})
+        return await sched.run({})
+
+    assert asyncio.run(main())["n"] == 99
+
+
+def test_swap_graph_reuses_inbox():
+    """Swapping graphs preserves cached messages (decentralized nodes swap
+    per-pipeline graphs into one scheduler)."""
+    sched = MessageAwareNodeScheduler(_msg_graph(MessageTriggerOp("a")))
+
+    async def main():
+        await sched.deliver_message("b", "kept")
+        sched.swap_graph(_msg_graph(MessageTriggerOp("b")))
+        return await sched.run({})
+
+    assert asyncio.run(main())["trigger"] == "kept"

@@ -1,0 +1,489 @@
+"""Deep L2 coverage: ExecutionSession/ExecutionFuture, ActorPool
+acquisition/affinity/channels, OperatorExecutor, and the lazy builder.
+
+Mirrors the intent of the reference suites
+``engine/graph/tests/test_session.py`` (cache pruning, futures,
+cancellation), ``test_pool.py`` (affinity under contention, rotation,
+waiters), ``test_executor.py`` / ``test_run_operator.py`` and
+``test_lazy.py``.
+"""
+
+import asyncio
+
+import pytest
+
+from byzpy_tpu.engine.graph import (
+    ActorPool,
+    ActorPoolConfig,
+    ComputationGraph,
+    GraphBuilder,
+    GraphInput,
+    GraphNode,
+)
+from byzpy_tpu.engine.graph.executor import OperatorExecutor, run_operator
+from byzpy_tpu.engine.graph.operator import OpContext, Operator
+from byzpy_tpu.engine.graph.ops import CallableOp, RemoteCallableOp
+from byzpy_tpu.engine.graph.session import ExecutionSession
+from byzpy_tpu.engine.graph.subtask import SubTask
+
+
+class CountingOp(Operator):
+    """Counts compute() invocations — the probe for cache behavior."""
+
+    def __init__(self, name, fn=None):
+        self.name = name
+        self.calls = 0
+        self.fn = fn or (lambda **kw: name)
+
+    async def compute(self, inputs, *, context):
+        self.calls += 1
+        return self.fn(**inputs)
+
+
+def chain_graph(a, b):
+    return ComputationGraph(
+        [
+            GraphNode("a", a, {"x": GraphInput("x")}),
+            GraphNode("b", b, {"x": "a"}),
+        ],
+        outputs=["b"],
+    )
+
+
+# ---------------------------------------------------------------------------
+# ExecutionSession
+# ---------------------------------------------------------------------------
+
+
+def test_session_skips_cached_nodes_on_rerun():
+    a = CountingOp("a", lambda x: x + 1)
+    b = CountingOp("b", lambda x: x * 10)
+    g = chain_graph(a, b)
+    s = ExecutionSession()
+
+    async def main():
+        r1 = await s.execute(g, {"x": 1})
+        r2 = await s.execute(g, {"x": 999})  # fully cached: input ignored
+        return r1, r2
+
+    r1, r2 = asyncio.run(main())
+    assert r1 == {"b": 20} and r2 == {"b": 20}
+    assert a.calls == 1 and b.calls == 1
+    assert set(s.cached_nodes) == {"a", "b"}
+
+
+def test_session_partial_invalidate_reruns_only_downstream_consumer():
+    a = CountingOp("a", lambda x: x + 1)
+    b = CountingOp("b", lambda x: x * 10)
+    g = chain_graph(a, b)
+    s = ExecutionSession()
+
+    async def main():
+        await s.execute(g, {"x": 1})
+        s.invalidate(["b"])
+        return await s.execute(g, {"x": 1})
+
+    out = asyncio.run(main())
+    assert out == {"b": 20}
+    assert a.calls == 1  # cached upstream fed the re-run
+    assert b.calls == 2
+
+
+def test_session_full_invalidate_and_use_cache_false():
+    a = CountingOp("a", lambda x: x + 1)
+    b = CountingOp("b", lambda x: x * 10)
+    g = chain_graph(a, b)
+    s = ExecutionSession()
+
+    async def main():
+        await s.execute(g, {"x": 1})
+        s.invalidate()
+        await s.execute(g, {"x": 2})
+        await s.execute(g, {"x": 3}, use_cache=False)
+
+    asyncio.run(main())
+    assert a.calls == 3 and b.calls == 3
+
+
+def test_session_seed_feeds_downstream():
+    a = CountingOp("a", lambda x: x + 1)
+    b = CountingOp("b", lambda x: x * 10)
+    g = chain_graph(a, b)
+    s = ExecutionSession()
+    s.seed("a", 7)
+
+    async def main():
+        return await s.execute(g, {"x": 1})
+
+    assert asyncio.run(main()) == {"b": 70}
+    assert a.calls == 0 and b.calls == 1
+
+
+def test_session_cache_shared_across_graphs():
+    """A node cached from one graph serves a different graph that contains
+    a node of the same name."""
+    a = CountingOp("a", lambda x: x + 1)
+    s = ExecutionSession()
+    g1 = ComputationGraph([GraphNode("a", a, {"x": GraphInput("x")})])
+
+    b = CountingOp("b", lambda x: -x)
+    g2 = chain_graph(CountingOp("unused"), b)  # has its own "a" node
+
+    async def main():
+        await s.execute(g1, {"x": 4})
+        return await s.execute(g2, {"x": 0})
+
+    assert asyncio.run(main()) == {"b": -5}
+    assert a.calls == 1
+
+
+def test_future_done_wait_result():
+    a = CountingOp("a", lambda x: x + 1)
+    g = ComputationGraph([GraphNode("a", a, {"x": GraphInput("x")})])
+    s = ExecutionSession()
+
+    async def main():
+        fut = s.execute_async(g, {"x": 1})
+        assert not fut.done()
+        assert await fut.wait(timeout=5)
+        assert fut.done()
+        return await fut.result()
+
+    assert asyncio.run(main()) == {"a": 2}
+
+
+def test_future_wait_timeout_returns_false():
+    async def slow_fn(**kw):
+        await asyncio.sleep(0.2)
+        return 1
+
+    g = ComputationGraph([GraphNode("slow", CallableOp(slow_fn, name="slow"), {})])
+    s = ExecutionSession()
+
+    async def main():
+        fut = s.execute_async(g)
+        early = await fut.wait(timeout=0.01)
+        late = await fut.wait(timeout=5)
+        return early, late
+
+    assert asyncio.run(main()) == (False, True)
+
+
+def test_future_cancel():
+    async def never(**kw):
+        await asyncio.sleep(30)
+
+    g = ComputationGraph([GraphNode("n", CallableOp(never, name="never"), {})])
+    s = ExecutionSession()
+
+    async def main():
+        fut = s.execute_async(g)
+        await asyncio.sleep(0.01)
+        assert fut.cancel()
+        assert await fut.wait(timeout=5)
+        with pytest.raises(asyncio.CancelledError):
+            await fut.result()
+
+    asyncio.run(main())
+
+
+def test_future_failure_surfaced_by_result_not_wait():
+    def boom(**kw):
+        raise ValueError("graph failed")
+
+    g = ComputationGraph([GraphNode("n", CallableOp(boom, name="boom"), {})])
+    s = ExecutionSession()
+
+    async def main():
+        fut = s.execute_async(g)
+        assert await fut.wait(timeout=5)  # wait() swallows the failure
+        with pytest.raises(ValueError, match="graph failed"):
+            await fut.result()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# ActorPool
+# ---------------------------------------------------------------------------
+
+
+def test_pool_affinity_under_contention_routes_to_capable_worker():
+    """With one 'fast' worker and several plain ones, fast-affinity
+    subtasks must only ever run on the fast worker even under load."""
+    ran_on = []
+
+    async def main():
+        cfgs = [
+            ActorPoolConfig(backend="thread", count=1, capabilities=["cpu", "fast"], name="fastw"),
+            ActorPoolConfig(backend="thread", count=3, name="slow"),
+        ]
+        async with ActorPool(cfgs) as pool:
+            # discover which worker runs each subtask via a name probe the
+            # subtask fn receives through kwargs
+            async def unit(tag):
+                await asyncio.sleep(0.005)
+                return tag
+
+            sts = [
+                SubTask(fn=unit, args=(i,), name=f"s{i}", affinity="fast")
+                for i in range(6)
+            ]
+            # run alongside background load with no affinity
+            bg = [SubTask(fn=unit, args=(100 + i,), name=f"bg{i}") for i in range(6)]
+            results = await asyncio.gather(
+                *(pool.run_subtask(st) for st in sts + bg)
+            )
+            caps = pool.worker_capabilities
+            fast_workers = [n for n, c in caps.items() if "fast" in c]
+            return results, fast_workers
+
+    results, fast_workers = asyncio.run(main())
+    assert sorted(results) == [0, 1, 2, 3, 4, 5, 100, 101, 102, 103, 104, 105]
+    assert len(fast_workers) == 1
+
+
+def test_pool_unsatisfiable_affinity_falls_back_to_any_worker():
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            st = SubTask(fn=lambda: "done", name="s", affinity="gpu")
+            return await pool.run_subtask(st)
+
+    assert asyncio.run(main()) == "done"
+
+
+def test_pool_acquire_blocks_until_release():
+    """With one worker, a second subtask must wait for the first."""
+    order = []
+
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=1)) as pool:
+            async def unit(tag, delay):
+                order.append(("start", tag))
+                await asyncio.sleep(delay)
+                order.append(("end", tag))
+                return tag
+
+            t1 = asyncio.ensure_future(
+                pool.run_subtask(SubTask(fn=unit, args=("a", 0.05), name="a"))
+            )
+            await asyncio.sleep(0.01)
+            t2 = asyncio.ensure_future(
+                pool.run_subtask(SubTask(fn=unit, args=("b", 0.0), name="b"))
+            )
+            await asyncio.gather(t1, t2)
+
+    asyncio.run(main())
+    assert order == [("start", "a"), ("end", "a"), ("start", "b"), ("end", "b")]
+
+
+def test_pool_not_started_raises():
+    pool = ActorPool(ActorPoolConfig(backend="thread", count=1))
+
+    async def main():
+        await pool.run_subtask(SubTask(fn=lambda: 1, name="s"))
+
+    with pytest.raises(RuntimeError, match="not started"):
+        asyncio.run(main())
+
+
+def test_pool_close_cancels_pending_waiters():
+    """A subtask parked on the waiter queue is cancelled (not left hanging)
+    when the pool closes while every worker is held."""
+
+    async def main():
+        pool = ActorPool(ActorPoolConfig(backend="thread", count=1))
+        await pool.start()
+        held = await pool._acquire(None)  # pin the only worker
+        assert held is not None
+        waiter = asyncio.ensure_future(
+            pool.run_subtask(SubTask(fn=lambda: 2, name="waiting"))
+        )
+        await asyncio.sleep(0.01)  # waiter is now queued
+        await pool.close()
+        with pytest.raises(asyncio.CancelledError):
+            await waiter
+
+    asyncio.run(main())
+
+
+def test_pool_run_many_and_channel_broadcast():
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=3)) as pool:
+            outs = await pool.run_many(
+                [SubTask(fn=lambda i=i: i * i, name=f"s{i}") for i in range(5)]
+            )
+            chan = await pool.open_channel("gossip")
+            await chan.broadcast(None, {"round": 1})
+            received = [await chan.recv(n) for n in pool.worker_names]
+            return outs, received
+
+    outs, received = asyncio.run(main())
+    assert outs == [0, 1, 4, 9, 16]
+    assert all(m["payload"] == {"round": 1} for m in received)
+
+
+def test_pool_worker_lookup_and_capabilities():
+    async def main():
+        cfgs = [
+            ActorPoolConfig(backend="thread", count=1, name="named"),
+        ]
+        async with ActorPool(cfgs) as pool:
+            name = pool.worker_names[0]
+            assert pool.worker(name) is not None
+            assert pool.has_capability("cpu")
+            assert not pool.has_capability("tpu")
+            with pytest.raises(KeyError):
+                pool.worker("nope")
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# OperatorExecutor / run_operator
+# ---------------------------------------------------------------------------
+
+
+class KeyedOp(Operator):
+    input_key = "things"
+    name = "keyed"
+
+    async def compute(self, inputs, *, context):
+        return sum(inputs["things"])
+
+
+def test_executor_bare_value_uses_input_key():
+    assert asyncio.run(run_operator(KeyedOp(), [1, 2, 3])) == 6
+
+
+def test_executor_mapping_passthrough():
+    assert asyncio.run(run_operator(KeyedOp(), {"things": [4, 5]})) == 9
+
+
+def test_executor_bare_value_without_key_raises():
+    class NoKey(Operator):
+        name = "nokey"
+
+        async def compute(self, inputs, *, context):
+            return 0
+
+    with pytest.raises(ValueError, match="no input_key"):
+        asyncio.run(run_operator(NoKey(), [1]))
+
+
+def test_executor_explicit_input_key_override():
+    class Wants(Operator):
+        name = "wants"
+
+        async def compute(self, inputs, *, context):
+            return inputs["custom"]
+
+    assert asyncio.run(run_operator(Wants(), "v", input_key="custom")) == "v"
+
+
+def test_executor_owns_pool_lifecycle():
+    async def main():
+        ex = OperatorExecutor(
+            KeyedOp(), pool_config=ActorPoolConfig(backend="thread", count=2)
+        )
+        out = await ex.run([1, 2])
+        pool = ex._pool
+        assert pool is not None and pool._started
+        await ex.close()
+        return out, pool._started
+
+    out, started_after = asyncio.run(main())
+    assert out == 3 and started_after is False
+
+
+def test_executor_borrowed_pool_not_closed():
+    async def main():
+        async with ActorPool(ActorPoolConfig(backend="thread", count=2)) as pool:
+            ex = OperatorExecutor(KeyedOp(), pool=pool)
+            await ex.run([1, 2])
+            await ex.close()
+            return pool._started
+
+    assert asyncio.run(main()) is True
+
+
+def test_remote_callable_op_inline_without_pool():
+    op = RemoteCallableOp(lambda x: x * 2, name="dbl")
+    assert asyncio.run(run_operator(op, {"x": 21})) == 42
+
+
+# ---------------------------------------------------------------------------
+# Lazy builder
+# ---------------------------------------------------------------------------
+
+
+def test_lazy_builder_unique_names_and_explicit_name():
+    b = GraphBuilder()
+    src = b.input("xs")
+    n1 = src.apply(CallableOp(lambda xs: sum(xs), name="agg"), input_key="xs")
+    n2 = n1.apply(CallableOp(lambda v: v + 1, name="agg"), input_key="v")
+    n3 = n2.apply(CallableOp(lambda v: v * 2, name="final"), input_key="v", name="out")
+    g = b.build(n3)
+    assert n3.source == "out"
+    assert len(set(g.nodes)) == 3
+
+    async def main():
+        from byzpy_tpu.engine.graph.scheduler import NodeScheduler
+
+        return await NodeScheduler(g).run({"xs": [1, 2, 3]})
+
+    assert asyncio.run(main()) == {"out": 14}
+
+
+def test_lazy_builder_multi_output():
+    b = GraphBuilder()
+    src = b.input("x")
+    left = src.apply(CallableOp(lambda x: x + 1, name="l"), input_key="x")
+    right = src.apply(CallableOp(lambda x: x - 1, name="r"), input_key="x")
+    g = b.build([left, right])
+    assert set(g.outputs) == {"l", "r"}
+
+
+def test_lazy_builder_extra_inputs_lazynode_and_graphinput():
+    b = GraphBuilder()
+    x = b.input("x")
+    base = x.apply(CallableOp(lambda x: x * 2, name="base"), input_key="x")
+    join = base.apply(
+        CallableOp(lambda v, other, k: (v, other, k), name="join"),
+        input_key="v",
+        extra_inputs={"other": x.apply(CallableOp(lambda x: -x, name="neg"), input_key="x"),
+                      "k": b.input("x").source},
+    )
+    g = b.build(join)
+
+    async def main():
+        from byzpy_tpu.engine.graph.scheduler import NodeScheduler
+
+        return await NodeScheduler(g).run({"x": 3})
+
+    assert asyncio.run(main())["join"] == (6, -3, 3)
+
+
+def test_lazy_builder_raw_input_output_rejected():
+    b = GraphBuilder()
+    x = b.input("x")
+    x.apply(CallableOp(lambda x: x, name="id"), input_key="x")
+    with pytest.raises(ValueError, match="raw inputs"):
+        b.build(x)
+
+
+def test_lazy_builder_empty_rejected():
+    with pytest.raises(ValueError, match="nothing to build"):
+        GraphBuilder().build()
+
+
+def test_lazy_builder_missing_input_key_rejected():
+    class NoKey(Operator):
+        name = "nokey"
+
+        async def compute(self, inputs, *, context):
+            return 0
+
+    b = GraphBuilder()
+    with pytest.raises(ValueError, match="input_key"):
+        b.input("x").apply(NoKey())
