@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import itertools
+import logging
 import traceback
 import uuid
 from typing import Any, Dict, Optional
@@ -27,6 +28,8 @@ from typing import Any, Dict, Optional
 from .. import wire
 from ..channels import Endpoint
 from ..router import channel_router
+
+logger = logging.getLogger(__name__)
 
 
 class RemoteActorServer:
@@ -97,6 +100,9 @@ class RemoteActorServer:
                 task.add_done_callback(self._handler_tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
+        except ValueError as exc:
+            # unauthenticated/tampered frame (wire HMAC) — drop the peer
+            logger.warning("dropping connection: %s", exc)
         finally:
             self._connections.discard(writer)
             writer.close()

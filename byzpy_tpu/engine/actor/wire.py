@@ -9,22 +9,39 @@ never goes through this wire; it rides XLA collectives (see
 .. warning:: **Trusted networks only.** Frames are cloudpickle: anyone who
    can reach the socket can execute arbitrary code in the receiving
    process (same property as the reference's pickle wire). Bind servers to
-   loopback or a private, firewalled fabric; for anything beyond that, add
-   application-layer authentication such as the HMAC frame signing used in
-   ``examples`` (ref: ``examples/ps/remote_tcp/ps_node.py``).
+   loopback or a private, firewalled fabric. Setting ``BYZPY_TPU_WIRE_KEY``
+   (a shared secret, same value on every host) prepends an HMAC-SHA256 tag
+   to every frame and rejects unsigned/forged ones — the analogue of the
+   reference's signed pickle frames (ref:
+   ``examples/ps/remote_tcp/ps_node.py:1-56``). Signing authenticates the
+   sender; it does not encrypt.
 """
 
 from __future__ import annotations
 
-import asyncio
+import hashlib
+import hmac
+import os
 import struct
 import warnings
 from typing import Any
+
+import asyncio
 
 import cloudpickle
 
 _HEADER = struct.Struct(">I")
 MAX_FRAME = 1 << 31
+_SIG_LEN = hashlib.sha256().digest_size
+
+
+def _wire_key() -> bytes | None:
+    key = os.environ.get("BYZPY_TPU_WIRE_KEY")
+    return key.encode() if key else None
+
+
+def _sign(body: bytes, key: bytes) -> bytes:
+    return hmac.new(key, body, hashlib.sha256).digest()
 
 _LOOPBACK = {"127.0.0.1", "::1", "localhost"}  # "" binds ALL interfaces — warn
 
@@ -46,10 +63,23 @@ def warn_untrusted_bind(host: str, component: str) -> None:
 
 def encode(obj: Any) -> bytes:
     body = cloudpickle.dumps(obj)
+    key = _wire_key()
+    if key is not None:
+        body = _sign(body, key) + body
     return _HEADER.pack(len(body)) + body
 
 
 def decode(body: bytes) -> Any:
+    key = _wire_key()
+    if key is not None:
+        if len(body) < _SIG_LEN:
+            raise ValueError("frame too short to carry an HMAC signature")
+        sig, body = body[:_SIG_LEN], body[_SIG_LEN:]
+        if not hmac.compare_digest(sig, _sign(body, key)):
+            raise ValueError(
+                "frame HMAC verification failed: wrong BYZPY_TPU_WIRE_KEY "
+                "or tampered/unsigned frame"
+            )
     return cloudpickle.loads(body)
 
 

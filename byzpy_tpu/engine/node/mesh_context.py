@@ -163,8 +163,8 @@ class MeshRemoteContext(NodeContext):
                 frame = await recv_obj(reader)
                 await self._handle_frame(frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
-                asyncio.CancelledError):
-            pass
+                asyncio.CancelledError, ValueError):
+            pass  # ValueError: unauthenticated frame (wire HMAC)
         finally:
             if self._out.get(peer_id, (None, None, None))[1] is writer:
                 self._out.pop(peer_id, None)
@@ -199,6 +199,9 @@ class MeshRemoteContext(NodeContext):
                     await self._handle_frame(frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
+        except ValueError as exc:
+            # unauthenticated/tampered frame (wire HMAC) — drop the peer
+            logger.warning("mesh %s: dropping inbound: %s", self.node_id, exc)
         finally:
             self._inbound_writers.discard(writer)
             if peer_id is not None and self._in.get(peer_id, (None,))[0] is writer:

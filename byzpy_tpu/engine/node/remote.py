@@ -160,6 +160,10 @@ class RemoteNodeServer:
                     frame = await recv_obj(reader)
                 except (asyncio.IncompleteReadError, ConnectionError):
                     break
+                except ValueError as exc:
+                    # unauthenticated/tampered frame (wire HMAC) — drop peer
+                    logger.warning("dropping connection: %s", exc)
+                    break
                 op = frame.get("op")
                 rid = frame.get("rid")
                 if op == "register":
@@ -248,8 +252,9 @@ class RemoteNodeClient:
                     if fut is not None and not fut.done():
                         fut.set_result(frame)
                     # no future: the request already timed out — drop it
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError, ValueError):
+            pass  # ValueError: unauthenticated frame (wire HMAC)
         finally:
             for fut in self._pending.values():
                 if not fut.done():

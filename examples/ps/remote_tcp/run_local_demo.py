@@ -1,0 +1,58 @@
+"""Single-host demo of the multi-machine PS: starts the three loopback
+actor servers from ``nodes.yaml`` as subprocesses, then runs the
+coordinator against the manifest — the same commands you would run by
+hand across real machines.
+
+    python examples/ps/remote_tcp/run_local_demo.py
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_root = os.path.abspath(os.path.join(_here, *[".."] * 3))
+
+
+def main() -> None:
+    env = dict(os.environ)
+    env.setdefault("BYZPY_TPU_WIRE_KEY", "local-demo-secret")
+    env.setdefault("PS_ROUNDS", "5")
+    # single-host demo: all processes on CPU (see BYZPY_TPU_PLATFORM note
+    # in node_server.py/coordinator.py)
+    env.setdefault("BYZPY_TPU_PLATFORM", "cpu")
+    env["PYTHONPATH"] = _root + os.pathsep + env.get("PYTHONPATH", "")
+
+    # On one host every worker process would contend for the same device;
+    # pin workers to CPU (a real deployment gives each machine its own
+    # chips and drops this).
+    server_env = dict(env)
+
+    servers = []
+    try:
+        for port in (7781, 7782, 7783):
+            servers.append(
+                subprocess.Popen(
+                    [sys.executable, os.path.join(_here, "node_server.py"),
+                     "--host", "127.0.0.1", "--port", str(port)],
+                    env=server_env,
+                )
+            )
+        time.sleep(2.0)  # let servers bind
+        rc = subprocess.call(
+            [sys.executable, os.path.join(_here, "coordinator.py"),
+             "--manifest", os.path.join(_here, "nodes.yaml")],
+            env=env,
+        )
+        sys.exit(rc)
+    finally:
+        for proc in servers:
+            proc.send_signal(signal.SIGTERM)
+        for proc in servers:
+            proc.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    main()

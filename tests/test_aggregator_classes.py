@@ -260,3 +260,45 @@ def test_barriered_pytree_roundtrip():
     np.testing.assert_allclose(
         np.asarray(pooled["b"]), np.asarray(direct["b"]), rtol=1e-4, atol=1e-5
     )
+
+
+def test_smea_host_scorer_matches_device_op():
+    """The host LAPACK scorer (production path) and the jitted device op
+    robust.subset_max_eigvals are two implementations of one formula;
+    divergence is a bug (ops/robust.py vs smea.py)."""
+    import math
+
+    from byzpy_tpu.aggregators.geometric_wise.minimum_diameter_average import (
+        _combo_batches,
+    )
+    from byzpy_tpu.aggregators.geometric_wise.smea import _score_combo_range_smea
+    from byzpy_tpu.ops import robust
+
+    n, f = 9, 3
+    m = n - f
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(n, 64)).astype(np.float32))
+    gram = robust.gram_matrix(x)
+    total = math.comb(n, m)
+    combos = np.concatenate(list(_combo_batches(n, m, total)))[:total]
+    device_scores = np.asarray(robust.subset_max_eigvals(gram, jnp.asarray(combos)))
+    host_best_score, host_best = _score_combo_range_smea(
+        np.asarray(gram), n, m, 0, total
+    )
+    np.testing.assert_allclose(
+        host_best_score, float(device_scores.min()), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(host_best, combos[int(device_scores.argmin())])
+
+
+def test_smea_tolerates_nonfinite_byzantine_rows():
+    """An adversary submitting NaN/inf gradients must neither crash the
+    LAPACK eigensolver nor be selected into the winning subset."""
+    r = np.random.default_rng(1)
+    honest = [jnp.asarray(r.normal(size=128).astype(np.float32)) for _ in range(7)]
+    nan_row = jnp.full((128,), jnp.nan)
+    inf_row = jnp.full((128,), jnp.inf)
+    agg = SMEA(f=2)
+    out = np.asarray(agg.aggregate(honest + [nan_row, inf_row]))
+    assert np.isfinite(out).all()
+    oracle = np.asarray(SMEA(f=2).aggregate(honest + [honest[0], honest[1]]))
+    assert out.shape == oracle.shape

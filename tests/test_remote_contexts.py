@@ -236,3 +236,70 @@ def test_untrusted_bind_warns_beyond_loopback():
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         asyncio.run(bind("127.0.0.1"))
+
+
+def test_wire_hmac_signing_roundtrip_and_rejection(monkeypatch):
+    """BYZPY_TPU_WIRE_KEY signs every frame (HMAC-SHA256) and rejects
+    forged/unsigned/mis-keyed frames — the reference's signed-pickle-frame
+    behavior (ref examples/ps/remote_tcp/ps_node.py)."""
+    from byzpy_tpu.engine.actor import wire
+
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "sekrit")
+    frame = wire.encode({"op": "call", "x": 1})
+    body = frame[4:]
+    assert wire.decode(body) == {"op": "call", "x": 1}
+
+    # tampered payload
+    bad = bytearray(body)
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="HMAC"):
+        wire.decode(bytes(bad))
+
+    # wrong key
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "other")
+    with pytest.raises(ValueError, match="HMAC"):
+        wire.decode(body)
+
+    # unsigned frame rejected while key set
+    monkeypatch.delenv("BYZPY_TPU_WIRE_KEY")
+    unsigned = wire.encode("hello")[4:]
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "sekrit")
+    with pytest.raises(ValueError):
+        wire.decode(unsigned)
+
+    # no key: plain round-trip
+    monkeypatch.delenv("BYZPY_TPU_WIRE_KEY")
+    assert wire.decode(wire.encode("hello")[4:]) == "hello"
+
+
+def test_remote_actor_server_with_signed_wire(monkeypatch):
+    """End-to-end construct/call over loopback with signing enabled on
+    both ends."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "cluster-secret")
+    from byzpy_tpu.engine.actor.backends.remote import (
+        RemoteActorBackend,
+        RemoteActorServer,
+    )
+
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, k):
+            self.v += k
+            return self.v
+
+    async def main():
+        server = RemoteActorServer(host="127.0.0.1", port=0)
+        await server.start()
+        try:
+            be = RemoteActorBackend("127.0.0.1", server.port)
+            await be.start()
+            await be.construct(Counter, 10)
+            out = await be.call("add", 5)
+            await be.close()
+            return out
+        finally:
+            await server.close()
+
+    assert asyncio.run(main()) == 15
