@@ -160,11 +160,20 @@ class MeshRemoteContext(NodeContext):
         """Peers may send frames back down our outbound connection."""
         try:
             while True:
-                frame = await recv_obj(reader)
+                try:
+                    frame = await recv_obj(reader)
+                except ValueError as exc:
+                    # unauthenticated/tampered frame (wire HMAC); handler
+                    # errors are NOT caught here — only the decode
+                    logger.warning(
+                        "mesh %s: dropping outbound-recv from %s: %s",
+                        self.node_id, peer_id, exc,
+                    )
+                    break
                 await self._handle_frame(frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
-                asyncio.CancelledError, ValueError):
-            pass  # ValueError: unauthenticated frame (wire HMAC)
+                asyncio.CancelledError):
+            pass
         finally:
             if self._out.get(peer_id, (None, None, None))[1] is writer:
                 self._out.pop(peer_id, None)
@@ -191,7 +200,14 @@ class MeshRemoteContext(NodeContext):
         self._inbound_writers.add(writer)
         try:
             while True:
-                frame = await recv_obj(reader)
+                try:
+                    frame = await recv_obj(reader)
+                except ValueError as exc:
+                    # unauthenticated/tampered frame (wire HMAC) only
+                    logger.warning(
+                        "mesh %s: dropping inbound: %s", self.node_id, exc
+                    )
+                    break
                 if frame.get("op") == "hello":
                     peer_id = frame["node_id"]
                     self._in[peer_id] = (writer, asyncio.Lock())
@@ -199,9 +215,6 @@ class MeshRemoteContext(NodeContext):
                     await self._handle_frame(frame)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
-        except ValueError as exc:
-            # unauthenticated/tampered frame (wire HMAC) — drop the peer
-            logger.warning("mesh %s: dropping inbound: %s", self.node_id, exc)
         finally:
             self._inbound_writers.discard(writer)
             if peer_id is not None and self._in.get(peer_id, (None,))[0] is writer:

@@ -240,7 +240,15 @@ class RemoteNodeClient:
     async def _receive_loop(self) -> None:
         try:
             while True:
-                frame = await recv_obj(self._reader)
+                try:
+                    frame = await recv_obj(self._reader)
+                except ValueError as exc:
+                    # unauthenticated/tampered frame (wire HMAC) only;
+                    # handler errors are logged by _dispatch, not caught here
+                    logger.warning(
+                        "client %s: dropping connection: %s", self.node_id, exc
+                    )
+                    break
                 if frame.get("op") == "deliver":
                     if self._handler is not None:
                         # background task: a handler that itself sends (and
@@ -252,9 +260,8 @@ class RemoteNodeClient:
                     if fut is not None and not fut.done():
                         fut.set_result(frame)
                     # no future: the request already timed out — drop it
-        except (asyncio.IncompleteReadError, ConnectionError,
-                asyncio.CancelledError, ValueError):
-            pass  # ValueError: unauthenticated frame (wire HMAC)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
         finally:
             for fut in self._pending.values():
                 if not fut.done():

@@ -89,10 +89,15 @@ def load_manifest(path: str) -> dict:
         text = fh.read()
     try:
         import yaml
-
-        return yaml.safe_load(text)
     except ImportError:
-        return json.loads(text)
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError as exc:
+            raise RuntimeError(
+                f"{path} is not JSON and PyYAML is not installed; "
+                "pip install pyyaml or supply a JSON manifest"
+            ) from exc
+    return yaml.safe_load(text)
 
 
 async def main() -> None:

@@ -25,25 +25,29 @@ def main() -> None:
     env.setdefault("BYZPY_TPU_PLATFORM", "cpu")
     env["PYTHONPATH"] = _root + os.pathsep + env.get("PYTHONPATH", "")
 
-    # On one host every worker process would contend for the same device;
-    # pin workers to CPU (a real deployment gives each machine its own
-    # chips and drops this).
-    server_env = dict(env)
+    manifest_path = os.path.join(_here, "nodes.yaml")
+    import yaml
+
+    with open(manifest_path) as fh:
+        manifest = yaml.safe_load(fh)
+    ports = sorted({
+        int(e["address"].rsplit(":", 1)[1]) for e in manifest["nodes"]
+    })
 
     servers = []
     try:
-        for port in (7781, 7782, 7783):
+        for port in ports:
             servers.append(
                 subprocess.Popen(
                     [sys.executable, os.path.join(_here, "node_server.py"),
                      "--host", "127.0.0.1", "--port", str(port)],
-                    env=server_env,
+                    env=env,
                 )
             )
         time.sleep(2.0)  # let servers bind
         rc = subprocess.call(
             [sys.executable, os.path.join(_here, "coordinator.py"),
-             "--manifest", os.path.join(_here, "nodes.yaml")],
+             "--manifest", manifest_path],
             env=env,
         )
         sys.exit(rc)
