@@ -68,8 +68,12 @@ def _ensure_virtual_devices(want: int = 8) -> None:
     initializes the tunnel platform before this script runs — so the
     platform is rebuilt via jax.config + clear_backends (the same dance as
     ``__graft_entry__._ensure_devices``)."""
-    if len(jax.devices()) >= want:
-        return
+    if os.environ.get("SCALING_FORCE_CPU") != "1":
+        try:
+            if len(jax.devices()) >= want:
+                return
+        except Exception:
+            pass  # platform init failed (e.g. tunnel down) -> CPU fallback
     from jax.extend import backend as jeb
 
     jax.config.update("jax_platforms", "cpu")

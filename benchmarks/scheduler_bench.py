@@ -12,8 +12,6 @@ Pinned to the CPU platform like the reference's CPU-pool benchmark.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 import asyncio
 import sys
 import time
@@ -23,6 +21,15 @@ sys.path.insert(0, _here)                      # for _timing
 sys.path.insert(0, os.path.dirname(_here))     # repo root
 
 import jax
+
+# CPU-pinned like the reference's CPU-pool benchmark; env vars are
+# inoperative under the session's pre-registered platform, so switch
+# in-process and drop any already-initialized backend
+jax.config.update("jax_platforms", "cpu")
+from jax.extend import backend as _jeb
+
+_jeb.clear_backends()
+
 import jax.numpy as jnp
 import numpy as np
 

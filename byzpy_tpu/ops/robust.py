@@ -209,9 +209,17 @@ def geometric_median(
     if init not in {"median", "mean"}:
         raise ValueError("init must be 'median' or 'mean'")
     z0 = jnp.median(x, axis=0) if init == "median" else jnp.mean(x, axis=0)
+    # The loop carry tracks the previous center instead of a scalar delta:
+    # every carry component is then derived from ``x``, which keeps the
+    # varying-manual-axes types consistent when this runs inside a
+    # ``shard_map`` region (a constant-initialized carry would be
+    # unvarying on input but varying on output and fail to trace).
+    # zprev0 differs from z0 by > tol per coordinate, forcing iteration 1.
+    zprev0 = z0 + jnp.asarray(1.0 + 2.0 * tol, x.dtype)
 
     def cond(state):
-        _, delta, it = state
+        z, zprev, it = state
+        delta = jnp.sqrt(jnp.sum((z - zprev) ** 2))
         return (delta > tol) & (it < max_iter)
 
     def body(state):
@@ -220,10 +228,9 @@ def geometric_median(
         dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
         w = 1.0 / jnp.maximum(dist, eps)
         z_new = jnp.sum(w[:, None] * x, axis=0) / jnp.sum(w)
-        delta = jnp.sqrt(jnp.sum((z_new - z) ** 2))
-        return z_new, delta, it + 1
+        return z_new, z, it + 1
 
-    z, _, _ = lax.while_loop(cond, body, (z0, jnp.asarray(jnp.inf, x.dtype), 0))
+    z, _, _ = lax.while_loop(cond, body, (z0, zprev0, 0))
     return z
 
 
