@@ -26,4 +26,20 @@ def report(name: str, ms: float, **extra: Any) -> Dict[str, Any]:
     return row
 
 
-__all__ = ["timed_ms", "report"]
+def force_cpu_platform(n_devices: int = 1) -> None:
+    """Rebuild jax on the CPU platform in-process (optionally with virtual
+    devices). Env vars are inoperative once a platform is pre-registered
+    (e.g. by a sitecustomize), so the switch goes through jax.config +
+    clear_backends. One copy for every benchmark script;
+    ``__graft_entry__._ensure_devices`` stays self-contained by design
+    (the driver runs it without this package on the path)."""
+    from jax.extend import backend as jeb
+
+    jax.config.update("jax_platforms", "cpu")
+    jeb.clear_backends()
+    if n_devices > 1:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        jeb.clear_backends()
+
+
+__all__ = ["timed_ms", "report", "force_cpu_platform"]

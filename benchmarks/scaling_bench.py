@@ -74,12 +74,9 @@ def _ensure_virtual_devices(want: int = 8) -> None:
                 return
         except Exception:
             pass  # platform init failed (e.g. tunnel down) -> CPU fallback
-    from jax.extend import backend as jeb
+    from _timing import force_cpu_platform
 
-    jax.config.update("jax_platforms", "cpu")
-    jeb.clear_backends()
-    jax.config.update("jax_num_cpu_devices", want)
-    jeb.clear_backends()
+    force_cpu_platform(want)
     print(f"# fell back to {len(jax.devices())} virtual CPU devices", file=sys.stderr)
 
 

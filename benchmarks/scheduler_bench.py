@@ -22,13 +22,10 @@ sys.path.insert(0, os.path.dirname(_here))     # repo root
 
 import jax
 
-# CPU-pinned like the reference's CPU-pool benchmark; env vars are
-# inoperative under the session's pre-registered platform, so switch
-# in-process and drop any already-initialized backend
-jax.config.update("jax_platforms", "cpu")
-from jax.extend import backend as _jeb
+from _timing import force_cpu_platform
 
-_jeb.clear_backends()
+# CPU-pinned like the reference's CPU-pool benchmark
+force_cpu_platform()
 
 import jax.numpy as jnp
 import numpy as np

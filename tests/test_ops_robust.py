@@ -220,3 +220,43 @@ def test_multi_krum_with_nan_byzantine_row():
     x[5] = np.nan
     got = np.asarray(robust.multi_krum(jnp.asarray(x), f=1, q=3))
     assert not np.isnan(got).any()
+
+
+def test_mean_of_medians_stable_tie_parity():
+    """The threshold+cumsum selection must reproduce stable argsort's
+    node-order tie rule exactly (quantized values force many exact ties
+    in |x - med|)."""
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        n, d, f = 9, 6, int(rng.integers(0, 9))
+        x = (np.round(rng.normal(size=(n, d)) * 2) / 2).astype(np.float32)
+        med = np.median(x, axis=0)
+        order = np.argsort(np.abs(x - med[None]), axis=0, kind="stable")
+        oracle = np.take_along_axis(x, order[: n - f], axis=0).mean(0)
+        got = np.asarray(robust.mean_of_medians(jnp.asarray(x), f=f))
+        np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
+
+
+def test_mean_of_medians_nan_columns_propagate():
+    """A column without n-f finite deviations yields NaN, like the
+    gather-based selection it replaced."""
+    x = np.asarray(
+        np.random.default_rng(1).normal(size=(5, 4)), np.float32
+    )
+    x[:, 2] = np.nan  # whole column NaN -> median NaN -> all devs NaN
+    out = np.asarray(robust.mean_of_medians(jnp.asarray(x), f=1))
+    assert np.isnan(out[2])
+    assert np.isfinite(np.delete(out, 2)).all()
+
+
+def test_geometric_median_iterates_at_large_magnitude():
+    """|z0| >= 2^24 in f32: an additive epsilon on the previous-center
+    carry would round away and skip every Weiszfeld step; the it==0
+    disjunct must force iteration regardless of magnitude."""
+    base = np.full((6, 16), 2.0e7, np.float32)
+    base += np.random.default_rng(0).normal(size=base.shape).astype(np.float32)
+    x = np.concatenate([base, np.full((1, 16), 1.0e12, np.float32)])
+    out = np.asarray(robust.geometric_median(jnp.asarray(x), init="mean"))
+    # init='mean' is attacker-corrupted (~1.4e11); the geometric median
+    # must walk back to the honest cluster
+    assert np.abs(out - base.mean(0)).max() < 1e5, out[:3]
