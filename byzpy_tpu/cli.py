@@ -51,6 +51,40 @@ def cmd_version(_args: argparse.Namespace) -> int:
     return 0
 
 
+def _devices_with_timeout(jax_mod, timeout_s: float = 20.0):
+    """``jax.devices()`` bounded by a timeout: platform plugins that dial a
+    remote accelerator (e.g. a tunneled TPU) can block indefinitely when
+    the link is down, and a diagnostics command must degrade, not hang.
+    The probe thread is daemonic — if it never returns it dies with the
+    process. Override via BYZPY_TPU_DOCTOR_TIMEOUT (seconds)."""
+    import os
+    import threading
+
+    timeout_s = float(os.environ.get("BYZPY_TPU_DOCTOR_TIMEOUT", timeout_s))
+    result: list = []
+
+    def probe() -> None:
+        try:
+            result.append(("ok", jax_mod.devices()))
+        except Exception as exc:  # noqa: BLE001 — forwarded to caller
+            result.append(("err", exc))
+
+    # plain daemon thread: a ThreadPoolExecutor worker is non-daemonic and
+    # its atexit join would hang interpreter shutdown on a stuck probe
+    t = threading.Thread(target=probe, name="doctor-device-probe", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        raise TimeoutError(
+            f"device platform did not initialize within {timeout_s:.0f}s "
+            "(accelerator link down?)"
+        )
+    kind, value = result[0]
+    if kind == "err":
+        raise value
+    return value
+
+
 def doctor_report() -> Dict[str, Any]:
     """Environment probe (ref: ``byzpy doctor``, cli.py:38-74)."""
     report: Dict[str, Any] = {"version": __version__, "python": sys.version.split()[0]}
@@ -59,7 +93,7 @@ def doctor_report() -> Dict[str, Any]:
 
         report["jax"] = {"version": jax.__version__, "ok": True}
         try:
-            devices = jax.devices()
+            devices = _devices_with_timeout(jax)
             report["devices"] = [
                 {
                     "id": d.id,

@@ -103,3 +103,30 @@ def test_mesh_config_roundtrip(devices):
     with use_mesh(mesh):
         assert get_default_mesh() is mesh
     assert get_default_mesh() is None
+
+
+def test_doctor_device_probe_times_out_instead_of_hanging(monkeypatch):
+    """Platform plugins dialing a dead remote accelerator can block
+    forever; doctor must degrade with a devices_error, not hang."""
+    import time
+
+    from byzpy_tpu import cli
+
+    class StuckJax:
+        __version__ = "test"
+
+        @staticmethod
+        def devices():
+            time.sleep(60)
+
+    monkeypatch.setenv("BYZPY_TPU_DOCTOR_TIMEOUT", "0.2")
+    with pytest.raises(TimeoutError, match="did not initialize"):
+        cli._devices_with_timeout(StuckJax)
+
+    class ErrJax:
+        @staticmethod
+        def devices():
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        cli._devices_with_timeout(ErrJax)
