@@ -60,7 +60,10 @@ def _devices_with_timeout(jax_mod, timeout_s: float = 20.0):
     import os
     import threading
 
-    timeout_s = float(os.environ.get("BYZPY_TPU_DOCTOR_TIMEOUT", timeout_s))
+    try:
+        timeout_s = float(os.environ.get("BYZPY_TPU_DOCTOR_TIMEOUT", timeout_s))
+    except ValueError:
+        pass  # malformed override (e.g. "20s"): keep the default
     result: list = []
 
     def probe() -> None:
@@ -76,7 +79,7 @@ def _devices_with_timeout(jax_mod, timeout_s: float = 20.0):
     t.join(timeout_s)
     if not result:
         raise TimeoutError(
-            f"device platform did not initialize within {timeout_s:.0f}s "
+            f"device platform did not initialize within {timeout_s:g}s "
             "(accelerator link down?)"
         )
     kind, value = result[0]
