@@ -31,3 +31,35 @@ def devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs
+
+
+# -- shared decentralized-cluster helpers (used by the node-layer suites) ----
+
+@pytest.fixture(autouse=True)
+def _clear_node_registries():
+    """Every test starts with clean in-process/process node registries."""
+    from byzpy_tpu.engine.node import InProcessContext, ProcessContext
+
+    InProcessContext.clear_registry()
+    ProcessContext.clear_registry()
+    yield
+    InProcessContext.clear_registry()
+    ProcessContext.clear_registry()
+
+
+@pytest.fixture
+def make_cluster():
+    from byzpy_tpu.engine.node import (
+        DecentralizedCluster, DecentralizedNode, InProcessContext,
+    )
+    from byzpy_tpu.engine.peer_to_peer import Topology
+
+    def factory(n, topology=None):
+        topo = topology or Topology.complete(n)
+        cluster = DecentralizedCluster(topo)
+        for i in range(n):
+            nid = f"node-{i}"
+            cluster.add_node(DecentralizedNode(nid, InProcessContext(nid)))
+        return cluster
+
+    return factory

@@ -1,0 +1,289 @@
+"""Deep L4 coverage: topology-constrained messaging, replies, multicast,
+failure tolerance, message-driven training rounds, autonomous nodes.
+
+Mirrors the intent of the reference's
+``engine/node/tests/test_topology_integration.py`` (949 LoC): whole
+decentralized clusters inside one event loop via InProcessContext.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.engine.graph.graph import ComputationGraph, GraphInput, GraphNode
+from byzpy_tpu.engine.graph.ops import CallableOp
+from byzpy_tpu.engine.graph.scheduler import MessageSource
+from byzpy_tpu.engine.peer_to_peer import Topology
+
+# cluster construction + registry cleanup come from conftest fixtures
+# (make_cluster / _clear_node_registries), shared with test_node_layer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_message_travels_the_cycle(make_cluster):
+    """A token forwarded by each node's handler must traverse the full
+    ring back to the origin in neighbor order."""
+
+    async def main():
+        n = 5
+        cluster = make_cluster(n, Topology.ring(n, 1))
+        path = []
+        done = asyncio.Event()
+
+        async with cluster:
+            for nid, node in cluster.nodes.items():
+                async def handler(msg, node=node, nid=nid):
+                    path.append(nid)
+                    if msg.payload["origin"] == nid:
+                        done.set()
+                        return
+                    await node.broadcast_message("token", msg.payload)
+
+                node.register_handler("token", handler)
+
+            await cluster.node("node-0").broadcast_message(
+                "token", {"origin": "node-0"}
+            )
+            await asyncio.wait_for(done.wait(), 5)
+        return path
+
+    path = run(main())
+    assert path == ["node-1", "node-2", "node-3", "node-4", "node-0"]
+
+
+def test_ring_k2_reaches_two_neighbors(make_cluster):
+    async def main():
+        n = 5
+        cluster = make_cluster(n, Topology.ring(n, 2))
+        got = []
+        async with cluster:
+            for nid, node in cluster.nodes.items():
+                async def handler(msg, nid=nid):
+                    got.append(nid)
+
+                node.register_handler("ping", handler)
+            await cluster.node("node-0").broadcast_message("ping", None)
+            await asyncio.sleep(0.05)
+        return sorted(got)
+
+    assert run(main()) == ["node-1", "node-2"]
+
+
+# ---------------------------------------------------------------------------
+# direct / reply / multicast routing
+# ---------------------------------------------------------------------------
+
+
+def test_reply_ignores_topology_direction(make_cluster):
+    """Replies route back along the reverse edge even when the forward
+    topology forbids it (ref router reply semantics)."""
+
+    async def main():
+        # edges only 0 -> 1: node-1 cannot SEND to node-0, but may REPLY
+        topo = Topology.from_edges(2, [(0, 1)])
+        cluster = make_cluster(2, topo)
+        answered = asyncio.Event()
+        answer = {}
+
+        illegal_send_error = {}
+
+        async with cluster:
+            n0, n1 = cluster.node("node-0"), cluster.node("node-1")
+
+            async def on_ask(msg, node=n1):
+                # record instead of pytest.raises: handler exceptions are
+                # swallowed by handle_incoming_message, which would turn a
+                # failed assertion into an opaque 5s timeout
+                try:
+                    await node.send_message("node-0", "ask", "illegal")
+                    illegal_send_error["exc"] = None
+                except ValueError as exc:
+                    illegal_send_error["exc"] = exc
+                await node.reply_message(msg.sender, "ans", msg.payload * 2)
+
+            async def on_ans(msg):
+                answer["v"] = msg.payload
+                answered.set()
+
+            n1.register_handler("ask", on_ask)
+            n0.register_handler("ans", on_ans)
+            await n0.send_message("node-1", "ask", 21)
+            await asyncio.wait_for(answered.wait(), 5)
+        return answer["v"], illegal_send_error["exc"]
+
+    value, illegal_exc = run(main())
+    assert value == 42
+    assert isinstance(illegal_exc, ValueError)  # forward edge 1->0 forbidden
+
+
+def test_multicast_subset_only(make_cluster):
+    async def main():
+        cluster = make_cluster(5)
+        got = []
+        async with cluster:
+            for nid, node in cluster.nodes.items():
+                async def handler(msg, nid=nid):
+                    got.append(nid)
+
+                node.register_handler("m", handler)
+            await cluster.node("node-0").multicast_message(
+                ["node-2", "node-4"], "m", None
+            )
+            await asyncio.sleep(0.05)
+        return sorted(got)
+
+    assert run(main()) == ["node-2", "node-4"]
+
+
+def test_broadcast_tolerates_dead_neighbor(make_cluster):
+    """A shut-down neighbor must not break delivery to the rest
+    (ref router.py:155-186 failure tolerance)."""
+
+    async def main():
+        cluster = make_cluster(4)
+        got = []
+        async with cluster:
+            for nid, node in cluster.nodes.items():
+                async def handler(msg, nid=nid):
+                    got.append(nid)
+
+                node.register_handler("g", handler)
+            await cluster.node("node-2").shutdown()
+            delivered = await cluster.node("node-0").broadcast_message("g", 1)
+            await asyncio.sleep(0.05)
+            return sorted(got), delivered
+
+    got, delivered = run(main())
+    assert got == ["node-1", "node-3"]
+    assert sorted(delivered) == ["node-1", "node-3"]  # reached-ids contract
+
+
+# ---------------------------------------------------------------------------
+# message-driven pipelines (mini decentralized training round)
+# ---------------------------------------------------------------------------
+
+
+def _avg_pipeline():
+    """own vector + one received gradient message -> average. The message
+    input resolves to the full Message envelope; the op unwraps payload."""
+
+    def combine(own, received):
+        return (np.asarray(own) + np.asarray(received.payload["vector"])) / 2
+
+    return ComputationGraph([
+        GraphNode(
+            "combine",
+            CallableOp(combine, name="combine"),
+            {"own": GraphInput("own"),
+             "received": MessageSource("gradient")},
+        )
+    ])
+
+
+def test_pipeline_blocks_on_message_then_combines(make_cluster):
+    async def main():
+        cluster = make_cluster(2)
+        async with cluster:
+            a, b = cluster.node("node-0"), cluster.node("node-1")
+            a.register_pipeline("avg", _avg_pipeline())
+
+            run_task = asyncio.ensure_future(
+                a.execute_pipeline("avg", {"own": [2.0, 4.0]})
+            )
+            await asyncio.sleep(0.05)
+            assert not run_task.done()  # parked on the gradient message
+            await b.send_message("node-0", "gradient", {"vector": [4.0, 8.0]})
+            out = await asyncio.wait_for(run_task, 5)
+            return out["combine"]
+
+    np.testing.assert_allclose(run(main()), [3.0, 6.0])
+
+
+def test_decentralized_average_round_converges(make_cluster):
+    """One gossip round of pairwise averaging on a complete graph moves
+    every node's value toward the global mean."""
+
+    async def main():
+        n = 4
+        values = {f"node-{i}": float(i) for i in range(n)}
+        cluster = make_cluster(n)
+        async with cluster:
+            # every node caches received values via a handler
+            received = {nid: [] for nid in values}
+            for nid, node in cluster.nodes.items():
+                async def handler(msg, nid=nid):
+                    received[nid].append(msg.payload)
+
+                node.register_handler("value", handler)
+            # broadcast, then each node averages what it saw
+            for nid, node in cluster.nodes.items():
+                await node.broadcast_message("value", values[nid])
+            await asyncio.sleep(0.1)
+            new = {
+                nid: (values[nid] + sum(received[nid])) / (1 + len(received[nid]))
+                for nid in values
+            }
+            return new
+
+    new = run(main())
+    for v in new.values():
+        assert v == pytest.approx(1.5)  # global mean of 0..3
+
+
+def test_autonomous_rounds_counter(make_cluster):
+    """start_autonomous_task drives rounds without external ticks and
+    stops cleanly at shutdown."""
+
+    async def main():
+        cluster = make_cluster(2)
+        counts = {"node-0": 0, "node-1": 0}
+        async with cluster:
+            for nid, node in cluster.nodes.items():
+                async def round_loop(node, nid=nid):
+                    while True:
+                        counts[nid] += 1
+                        await asyncio.sleep(0.01)
+
+                node.start_autonomous_task(round_loop)
+            await asyncio.sleep(0.2)
+        return dict(counts)
+
+    counts = run(main())
+    assert all(c >= 3 for c in counts.values()), counts
+
+
+def test_concurrent_pipelines_share_one_scheduler(make_cluster):
+    """Two in-flight executions of different pipelines on one node must
+    not corrupt each other (the node swaps graphs per execution)."""
+
+    async def main():
+        cluster = make_cluster(1, Topology.complete(1))
+        node = cluster.node("node-0")
+
+        async def slow(x):
+            await asyncio.sleep(0.05)
+            return x * 10
+
+        node.register_pipeline("slow", ComputationGraph([
+            GraphNode("out", CallableOp(slow, name="slow"), {"x": GraphInput("x")})
+        ]))
+        node.register_pipeline("fast", ComputationGraph([
+            GraphNode("out", CallableOp(lambda x: x + 1, name="fast"),
+                      {"x": GraphInput("x")})
+        ]))
+        async with cluster:
+            t1 = asyncio.ensure_future(node.execute_pipeline("slow", {"x": 3}))
+            t2 = asyncio.ensure_future(node.execute_pipeline("fast", {"x": 3}))
+            r1, r2 = await asyncio.gather(t1, t2)
+            return r1["out"], r2["out"]
+
+    assert run(main()) == (30, 4)

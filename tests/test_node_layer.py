@@ -20,13 +20,7 @@ from byzpy_tpu.engine.node import (
 from byzpy_tpu.engine.peer_to_peer import Topology
 
 
-@pytest.fixture(autouse=True)
-def _clear_registries():
-    InProcessContext.clear_registry()
-    ProcessContext.clear_registry()
-    yield
-    InProcessContext.clear_registry()
-    ProcessContext.clear_registry()
+# registry cleanup: conftest's autouse _clear_node_registries fixture
 
 
 def _make_cluster(n, topology=None):
