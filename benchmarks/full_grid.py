@@ -35,8 +35,13 @@ def grads(n, d, seed=0):
 
 
 def row(name, ms, byzfl, direct, best_pool, **extra):
-    """Emit one grid row with the reference floor and computed speedups."""
-    speedup = round(best_pool / ms, 2) if best_pool else None
+    """Emit one grid row with the reference floor and computed speedups.
+    "Best" = the reference's best published number: its best pool, or its
+    direct time where its own pooling made it slower (same rule as
+    generate_plots.py / RESULTS.md)."""
+    candidates = [v for v in (best_pool, direct) if v is not None]
+    best = min(candidates) if candidates else None
+    speedup = round(best / ms, 2) if best else None
     report(
         name, ms,
         ref_byzfl_ms=byzfl, ref_direct_ms=direct, ref_best_pool_ms=best_pool,
