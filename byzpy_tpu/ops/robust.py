@@ -199,6 +199,50 @@ def ranked_mean(x: Array, scores: Array, q: int) -> Array:
     return out.astype(x.dtype)
 
 
+def _sharding_allows_kernel(x: Array) -> bool:
+    """A ``pallas_call`` is an opaque custom call to GSPMD: feeding it a
+    device-sharded operand forces XLA to all-gather the full matrix onto
+    every chip, defeating the feature-axis sharding design this module
+    documents (local matmul + psum of the (n, n) block). Dispatch is
+    therefore allowed only when the trace-time mesh is single-device,
+    fully manual (inside ``shard_map`` shapes are already per-shard and
+    the kernel runs on local data), or the spec is provably replicated
+    under explicit-sharding axes. Auto-mode multi-device meshes hide the
+    real spec at trace time, so they conservatively stay on XLA."""
+    try:
+        sharding = jax.typeof(x).sharding
+        mesh = sharding.mesh
+        if getattr(mesh, "size", 1) <= 1:
+            return True
+        from jax.sharding import AxisType
+
+        axis_types = set(getattr(mesh, "axis_types", ()))
+        if axis_types == {AxisType.Manual}:
+            return True
+        if AxisType.Auto in axis_types:
+            return False
+        return all(p is None for p in sharding.spec)
+    except Exception:
+        return True  # no sharding info (eager CPU arrays, older tracers)
+
+
+def _use_selection_kernel(x: Array) -> bool:
+    """True when the fused two-sweep Pallas selection kernel should serve
+    this input (see ``pallas_kernels.selection_mean_pallas``): float data,
+    network-sized ``n``, ``d`` large enough that the kernel's single-read
+    Gram beats XLA's two-read einsum (XLA streams ``x`` as both lhs and
+    rhs: 0.91 ms vs the 0.31 ms one-read floor at 64x1M f32 on v5e), and
+    an unsharded (or per-shard) operand."""
+    from .pallas_kernels import use_pallas_for
+
+    return (
+        x.ndim in (2, 3)  # (n, d) single round or (K, n, d) stream
+        and x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+        and use_pallas_for(x.shape[-2], x.shape[-1])
+        and _sharding_allows_kernel(x)
+    )
+
+
 @partial(jax.jit, static_argnames=("f", "q"))
 def multi_krum(x: Array, *, f: int, q: int) -> Array:
     """Multi-Krum: mean of the ``q`` lowest-score nodes
@@ -207,8 +251,28 @@ def multi_krum(x: Array, *, f: int, q: int) -> Array:
     n = x.shape[0]
     if not 1 <= q <= n - f:
         raise ValueError(f"q must satisfy 1 <= q <= n - f (got n={n}, f={f}, q={q})")
+    if _use_selection_kernel(x):
+        from .pallas_kernels import selection_mean_pallas
+
+        return selection_mean_pallas(x, f=f, q=q, mode="krum")
     scores = krum_scores(x, f=f)
     return ranked_mean(x, scores, q)
+
+
+@partial(jax.jit, static_argnames=("f", "q"))
+def multi_krum_stream(xs: Array, *, f: int, q: int) -> Array:
+    """Multi-Krum over a stream of ``K`` stacked rounds ``xs: (K, n, d)``
+    in one dispatch (the training-loop / replay shape — see
+    ``aggregate_stream``). On TPU at large ``d`` this is ONE fused kernel
+    launch with ``2 K`` HBM sweeps and zero per-round slice copies
+    (``pallas_kernels.selection_mean_stream_pallas``; an XLA-level scan
+    materializes each round's 256 MB slice before the Gram can read it —
+    measured 1.23 ms vs 0.85 ms per 64x1M f32 round on v5e)."""
+    if xs.ndim == 3 and _use_selection_kernel(xs):
+        from .pallas_kernels import selection_mean_stream_pallas
+
+        return selection_mean_stream_pallas(xs, f=f, q=q, mode="krum")
+    return aggregate_stream(partial(multi_krum, f=f, q=q), xs)
 
 
 def krum(x: Array, *, f: int) -> Array:
@@ -299,6 +363,10 @@ def cge(x: Array, *, f: int) -> Array:
     n = x.shape[0]
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
+    if _use_selection_kernel(x):
+        from .pallas_kernels import selection_mean_pallas
+
+        return selection_mean_pallas(x, f=0, q=n - f, mode="cge")
     norms = jnp.sum(x * x, axis=1)
     return ranked_mean(x, norms, n - f)
 
@@ -314,6 +382,12 @@ def monna(x: Array, *, f: int, reference_index: int = 0) -> Array:
         raise ValueError(f"Cannot tolerate 2f >= n (got n={n}, f={f})")
     if not 0 <= reference_index < n:
         raise ValueError(f"reference_index must be in [0, {n}) (got {reference_index})")
+    if _use_selection_kernel(x):
+        from .pallas_kernels import selection_mean_pallas
+
+        return selection_mean_pallas(
+            x, f=0, q=n - f, mode="monna", reference_index=reference_index
+        )
     diff = x - x[reference_index][None, :]
     dists = jnp.sum(diff * diff, axis=1)
     return ranked_mean(x, dists, n - f)
@@ -458,6 +532,7 @@ __all__ = [
     "krum_scores",
     "ranked_mean",
     "multi_krum",
+    "multi_krum_stream",
     "krum",
     "geometric_median",
     "centered_clipping",

@@ -17,6 +17,8 @@ from byzpy_tpu.ops.pallas_kernels import (
     gram_pallas,
     median_pallas,
     pairwise_sq_dists_pallas,
+    selection_mean_pallas,
+    selection_mean_stream_pallas,
     sort_columns,
     trimmed_mean_pallas,
     use_pallas_for,
@@ -235,3 +237,142 @@ def test_robust_ops_use_pallas_when_forced(monkeypatch):
     d2 = np.asarray(robust.pairwise_sq_dists(x))
     diff = np.asarray(x)[:, None, :] - np.asarray(x)[None, :, :]
     np.testing.assert_allclose(d2, (diff ** 2).sum(-1), rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Fused selection-mean kernel (Multi-Krum / CGE / MoNNA in one launch)
+# ---------------------------------------------------------------------------
+
+
+def _xla_multi_krum(x, f, q):
+    scores = robust.krum_scores(x, f=f)
+    return robust.ranked_mean(x, scores, q)
+
+
+@pytest.mark.parametrize(
+    "n,d,f,q", [(64, 512, 8, 12), (17, 300, 3, 5), (16, 257, 2, 1), (8, 128, 1, 6)]
+)
+def test_selection_mean_krum_parity(n, d, f, q):
+    x = jax.random.normal(jax.random.PRNGKey(n + d), (n, d), jnp.float32)
+    got = selection_mean_pallas(x, f=f, q=q, mode="krum", tile=128, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_xla_multi_krum(x, f, q)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_selection_mean_cge_monna_parity():
+    x = jax.random.normal(jax.random.PRNGKey(7), (21, 400), jnp.float32)
+    got = selection_mean_pallas(x, f=0, q=16, mode="cge", tile=128, interpret=True)
+    want = robust.ranked_mean(x, jnp.sum(x * x, axis=1), 16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+    got = selection_mean_pallas(
+        x, f=0, q=16, mode="monna", reference_index=3, tile=128, interpret=True
+    )
+    diff = x - x[3][None, :]
+    want = robust.ranked_mean(x, jnp.sum(diff * diff, axis=1), 16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_selection_mean_nonfinite_rows_excluded():
+    """A NaN row ranks last (never selected at sane q); an inf row gets an
+    inf/NaN score and is likewise excluded — matching ranked_mean's
+    two-level (isnan, score) key exactly."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (12, 200), jnp.float32)
+    x = x.at[3].set(jnp.inf).at[7].set(jnp.nan)
+    got = selection_mean_pallas(x, f=2, q=4, mode="krum", tile=128, interpret=True)
+    want = _xla_multi_krum(x, f=2, q=4)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6, equal_nan=True
+    )
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_selection_mean_all_nan_scores_propagate():
+    """If every row is NaN the selection must return NaN, not zeros from
+    the masked contraction."""
+    x = jnp.full((8, 128), jnp.nan, jnp.float32)
+    got = selection_mean_pallas(x, f=1, q=2, mode="krum", tile=128, interpret=True)
+    want = _xla_multi_krum(x, f=1, q=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_selection_mean_bf16_accumulates_f32():
+    x = (jax.random.normal(jax.random.PRNGKey(5), (32, 384)) * 3).astype(jnp.bfloat16)
+    got = selection_mean_pallas(x, f=4, q=6, tile=128, interpret=True)
+    want = _xla_multi_krum(x, f=4, q=6)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=2e-2, atol=1e-2
+    )
+
+
+def test_selection_mean_vmap_batches():
+    xs = jax.random.normal(jax.random.PRNGKey(9), (3, 16, 256), jnp.float32)
+    got = jax.vmap(
+        lambda a: selection_mean_pallas(a, f=2, q=5, tile=128, interpret=True)
+    )(xs)
+    want = jax.vmap(lambda a: _xla_multi_krum(a, 2, 5))(xs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+
+def test_selection_mean_stream_matches_per_round():
+    xs = jax.random.normal(jax.random.PRNGKey(11), (4, 17, 300), jnp.float32)
+    xs = xs.at[0, 3].set(jnp.nan).at[1, 5].set(jnp.inf)
+    got = selection_mean_stream_pallas(xs, f=3, q=5, tile=128, interpret=True)
+    want = jnp.stack([_xla_multi_krum(xs[k], 3, 5) for k in range(4)])
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6, equal_nan=True
+    )
+    got = selection_mean_stream_pallas(
+        xs, f=0, q=14, mode="monna", reference_index=1, tile=128, interpret=True
+    )
+    want = jnp.stack(
+        [robust.monna(xs[k], f=3, reference_index=1) for k in range(4)]
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5, equal_nan=True
+    )
+
+
+def test_selection_mean_validates_args():
+    x = jnp.zeros((8, 128), jnp.float32)
+    with pytest.raises(ValueError):
+        selection_mean_pallas(x, f=7, q=1, mode="krum", interpret=True)
+    with pytest.raises(ValueError):
+        selection_mean_pallas(x, f=1, q=0, mode="cge", interpret=True)
+    with pytest.raises(ValueError):
+        selection_mean_pallas(x, f=1, q=2, mode="nope", interpret=True)
+    with pytest.raises(ValueError):
+        selection_mean_pallas(x, f=1, q=2, reference_index=9, interpret=True)
+
+
+def test_robust_selection_ops_dispatch_when_forced(monkeypatch):
+    """BYZPY_TPU_PALLAS=1 routes multi_krum/cge/monna and the stream
+    variant through the fused kernel (interpret mode on CPU) with
+    unchanged results. Oracles are computed from the un-jitted internals
+    and the shape is unique to this test: the public ops are ``jax.jit``
+    functions whose trace cache does not key on the env flag, so a same
+    -shape call traced earlier in the process would bypass the dispatch."""
+    x = jax.random.normal(jax.random.PRNGKey(13), (19, 1792), jnp.float32)
+    xs = jnp.stack([x, x * 0.5 + 1.0])
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    np.testing.assert_allclose(
+        np.asarray(robust.multi_krum(x, f=2, q=4)),
+        np.asarray(_xla_multi_krum(x, 2, 4)), rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(robust.cge(x, f=3)),
+        np.asarray(robust.ranked_mean(x, jnp.sum(x * x, axis=1), 16)),
+        rtol=1e-5, atol=1e-6,
+    )
+    diff = x - x[2][None, :]
+    np.testing.assert_allclose(
+        np.asarray(robust.monna(x, f=3, reference_index=2)),
+        np.asarray(robust.ranked_mean(x, jnp.sum(diff * diff, axis=1), 16)),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(robust.multi_krum_stream(xs, f=2, q=4)),
+        np.asarray(jnp.stack([_xla_multi_krum(xs[k], 2, 4) for k in range(2)])),
+        rtol=1e-5, atol=1e-6,
+    )

@@ -57,3 +57,30 @@ def test_median_output_stays_sharded(mesh):
     np.testing.assert_allclose(
         np.asarray(out), np.median(np.asarray(x), axis=0), rtol=1e-5, atol=1e-6
     )
+
+
+def test_selection_kernel_skipped_for_sharded_inputs(mesh, monkeypatch):
+    """The fused Pallas selection kernel must NOT capture device-sharded
+    operands: a pallas_call is opaque to GSPMD, so XLA would all-gather
+    the full matrix onto every chip, defeating the feature-axis sharding
+    design (O(n*d) ICI traffic instead of the einsum path's O(n^2) psum).
+    The dispatch gate checks the trace-time mesh and stays on XLA."""
+    import byzpy_tpu.ops.pallas_kernels as pk
+
+    def boom(*a, **k):
+        raise AssertionError("selection kernel dispatched for sharded input")
+
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    monkeypatch.setattr(pk, "selection_mean_pallas", boom)
+    monkeypatch.setattr(pk, "selection_mean_stream_pallas", boom)
+    # unique shape: the jit cache does not key on the monkeypatch/env
+    x = jax.random.normal(jax.random.PRNGKey(0), (23, 1024), jnp.float32)
+    want = np.asarray(robust.ranked_mean(x, robust.krum_scores(x, f=3), 5))
+    got = np.asarray(jax.jit(
+        lambda a: robust.multi_krum(a, f=3, q=5)
+    )(_sharded(mesh, x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # unsharded input with the same flag DOES dispatch (guard is the only
+    # thing standing between the two paths)
+    with pytest.raises(Exception):
+        robust.multi_krum(jax.random.normal(jax.random.PRNGKey(1), (23, 1152)), f=3, q=5)
