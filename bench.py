@@ -41,21 +41,18 @@ def main() -> None:
 
     # Headline: Krum at 1M-dim (north-star config), measured as a stream of
     # K rounds per dispatch — the shape a real training loop has; a
-    # standalone dispatch pays ~1.4 ms launch latency through the tunnel,
-    # comparable to the whole aggregate. Two batching strategies are
-    # measured and the better one reported: lax.scan (sequential rounds)
-    # and vmap (batched matmuls across rounds — no per-step 256 MB slice).
-    K = 8
-    agg = partial(robust.multi_krum, f=8, q=12)
+    # standalone dispatch pays the full host->device launch round-trip,
+    # comparable to (or larger than) the whole aggregate. The stream runs
+    # as ONE fused Pallas launch (selection_mean_stream_pallas via
+    # multi_krum_stream): 2K HBM sweeps, no per-round slice copies.
+    K = 32
     xs_1m = jax.random.normal(key, (K, 64, 1_048_576), jnp.float32)
-    t_scan = timed(jax.jit(partial(robust.aggregate_stream, agg)), xs_1m) / K
-    t_vmap = timed(jax.jit(jax.vmap(agg)), xs_1m) / K
-    stream_how = "scan" if t_scan <= t_vmap else "vmap"
-    t_krum_1m = min(t_scan, t_vmap)
+    stream = jax.jit(partial(robust.multi_krum_stream, f=8, q=12))
+    t_krum_1m = timed(stream, xs_1m, repeat=40) / K
     value = 64 / t_krum_1m  # gradients aggregated per second
 
     # bf16 variant (halves the two-pass HBM traffic; f32 accumulation)
-    t_bf16 = timed(jax.jit(jax.vmap(agg)), xs_1m.astype(jnp.bfloat16)) / K
+    t_bf16 = timed(stream, xs_1m.astype(jnp.bfloat16), repeat=40) / K
 
     # Matched reference workloads for vs_baseline.
     x_krum = grads(key, 80, 65_536)
@@ -71,14 +68,12 @@ def main() -> None:
     t_single = timed(jax.jit(partial(robust.multi_krum, f=8, q=12)), xs_1m[0])
 
     print(json.dumps({
-        "metric": "multi_krum_64x1M_stream8_grads_per_sec",
+        "metric": "multi_krum_64x1M_stream_grads_per_sec",
         "value": round(value, 2),
         "unit": "grads/sec",
         "vs_baseline": round(speedup, 2),
         "stream_K": K,
-        "stream_batching": stream_how,
-        "stream_scan_grads_per_sec": round(64 / t_scan, 2),
-        "stream_vmap_grads_per_sec": round(64 / t_vmap, 2),
+        "stream_kernel": "selection_mean_stream_pallas",
         "bf16_stream_grads_per_sec": round(64 / t_bf16, 2),
         "single_dispatch_grads_per_sec": round(64 / t_single, 2),
     }))

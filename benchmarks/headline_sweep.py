@@ -64,9 +64,15 @@ def main() -> None:
     vmap_fn = jax.jit(jax.vmap(agg))
     rec("stream_vmap_f32", t(vmap_fn, xs))
 
+    # K rounds as ONE fused Pallas launch (round-3 headline shape):
+    # 2 HBM sweeps per round, no per-round slice copies
+    fused_fn = jax.jit(partial(robust.multi_krum_stream, f=8, q=12))
+    rec("stream_fused_f32", t(fused_fn, xs))
+
     # bf16 variants
     rec("stream_scan_bf16", t(scan_fn, xb))
     rec("stream_vmap_bf16", t(vmap_fn, xb))
+    rec("stream_fused_bf16", t(fused_fn, xb))
 
     # stage floors
     rec("krum_scores_only_f32",

@@ -492,6 +492,75 @@ def subset_max_eigvals(gram: Array, combos: Array) -> Array:
     return jax.vmap(one)(combos)
 
 
+@partial(jax.jit, static_argnames=("sweeps",))
+def subset_max_eigvals_jacobi(gram: Array, combos: Array, *, sweeps: int = 8) -> Array:
+    """SMEA score per subset — identical quantity to
+    ``subset_max_eigvals`` — computed with batched cyclic Jacobi instead
+    of ``eigvalsh``.
+
+    XLA lowers ``eigvalsh`` on TPU to a serialized QR iteration: 380 ms
+    for the C(16,11)=4368 batch of 11x11 problems in the reference's SMEA
+    workload, where this unrolled Jacobi needs ~1 ms of batched VPU work.
+    ``sweeps`` cyclic sweeps of all m(m-1)/2 rotations give quadratic
+    convergence — 8 sweeps reach f32 machine precision at m <= 32, pinned
+    against the LAPACK oracle in tests. Subsets touching a non-finite
+    Gram row score ``+inf`` (an adversary must not crash — or win — the
+    selection; same rule as the host path in
+    ``aggregators/geometric_wise/smea.py``).
+    """
+    m = combos.shape[1]
+    acc = jnp.float32 if gram.dtype in (jnp.bfloat16, jnp.float16) else gram.dtype
+    sub = gram[combos[:, :, None], combos[:, None, :]].astype(acc)  # (c, m, m)
+    h = jnp.eye(m, dtype=acc) - jnp.full((m, m), 1.0 / m, dtype=acc)
+    a = h @ sub @ h
+    bad = ~jnp.all(jnp.isfinite(a), axis=(1, 2))
+    a = jnp.where(bad[:, None, None], jnp.eye(m, dtype=acc), a)
+
+    # Static cyclic rotation schedule, walked by a fori_loop with dynamic
+    # row/column slices: unrolling all sweeps * m(m-1)/2 rotations inline
+    # (~1.8k update ops at m=11, sweeps=8) explodes TPU compile time; the
+    # loop body compiles once and runs the schedule at runtime.
+    pairs = jnp.asarray(
+        [(p, q) for p in range(m - 1) for q in range(p + 1, m)], dtype=jnp.int32
+    )
+    n_pairs = pairs.shape[0]
+
+    def rotate(i, a):
+        # One batched Jacobi rotation zeroing a[:, p, q] (Golub & Van Loan
+        # 8.4): stable c/s from the quadratic in t, then row and column
+        # updates as (c,)-batched vector ops.
+        p = pairs[i % n_pairs, 0]
+        q = pairs[i % n_pairs, 1]
+        rp = lax.dynamic_slice_in_dim(a, p, 1, axis=1)  # (c, 1, m)
+        rq = lax.dynamic_slice_in_dim(a, q, 1, axis=1)
+        app = lax.dynamic_slice_in_dim(rp, p, 1, axis=2)[:, 0, 0]
+        aqq = lax.dynamic_slice_in_dim(rq, q, 1, axis=2)[:, 0, 0]
+        apq = lax.dynamic_slice_in_dim(rp, q, 1, axis=2)[:, 0, 0]
+        safe = jnp.abs(apq) > 1e-30
+        tau = (aqq - app) / jnp.where(safe, 2.0 * apq, 1.0)
+        # sign(0) must be +1 here: tau == 0 (app == aqq) wants a 45-degree
+        # rotation, not the identity jnp.sign's zero would produce.
+        sgn = jnp.where(tau >= 0.0, 1.0, -1.0)
+        t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+        t = jnp.where(safe, t, 0.0)
+        c = 1.0 / jnp.sqrt(1.0 + t * t)
+        s = t * c
+        c_ = c[:, None, None]
+        s_ = s[:, None, None]
+        a = lax.dynamic_update_slice_in_dim(a, c_ * rp - s_ * rq, p, axis=1)
+        a = lax.dynamic_update_slice_in_dim(a, s_ * rp + c_ * rq, q, axis=1)
+        cp = lax.dynamic_slice_in_dim(a, p, 1, axis=2)  # (c, m, 1)
+        cq = lax.dynamic_slice_in_dim(a, q, 1, axis=2)
+        a = lax.dynamic_update_slice_in_dim(a, c_ * cp - s_ * cq, p, axis=2)
+        a = lax.dynamic_update_slice_in_dim(a, s_ * cp + c_ * cq, q, axis=2)
+        return a
+
+    a = lax.fori_loop(0, sweeps * n_pairs, rotate, a)
+    top = jnp.max(jnp.diagonal(a, axis1=1, axis2=2), axis=1)
+    scores = jnp.maximum(top, 0.0) / m
+    return jnp.where(bad, jnp.inf, scores).astype(gram.dtype)
+
+
 @jax.jit
 def subset_mean(x: Array, combo: Array) -> Array:
     """Mean of the rows selected by ``combo``."""
@@ -541,6 +610,7 @@ __all__ = [
     "caf",
     "subset_diameters",
     "subset_max_eigvals",
+    "subset_max_eigvals_jacobi",
     "subset_mean",
     "best_subset_by_score",
     "aggregate_stream",

@@ -304,3 +304,22 @@ def test_smea_tolerates_nonfinite_byzantine_rows():
     # rows, so the result must be their mean — the bad rows were excluded
     honest_mean = np.stack([np.asarray(h) for h in honest]).mean(0)
     np.testing.assert_allclose(out, honest_mean, rtol=1e-5, atol=1e-6)
+
+
+def test_smea_device_path_matches_host_path():
+    """The device-pure Jacobi path (combo spaces <= _DEVICE_COMBO_CAP) and
+    the host LAPACK path must pick the same subset."""
+    import math
+
+    from byzpy_tpu.aggregators.geometric_wise import smea as smea_mod
+
+    rng = np.random.default_rng(5)
+    grads = [jnp.asarray(rng.normal(size=(96,)).astype(np.float32)) for _ in range(12)]
+    agg = SMEA(f=3)
+    got = np.asarray(agg.aggregate(grads))
+    x = np.stack([np.asarray(g) for g in grads])
+    n, m = 12, 9
+    gram = x @ x.T
+    _, best = smea_mod._score_combo_range_smea(gram, n, m, 0, math.comb(n, m))
+    want = x[best].mean(axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

@@ -260,3 +260,53 @@ def test_geometric_median_iterates_at_large_magnitude():
     # init='mean' is attacker-corrupted (~1.4e11); the geometric median
     # must walk back to the honest cluster
     assert np.abs(out - base.mean(0)).max() < 1e5, out[:3]
+
+
+def test_subset_max_eigvals_jacobi_matches_lapack():
+    """The batched-Jacobi device scorer must reproduce LAPACK eigvalsh to
+    float precision (it serves the SMEA device-pure path; the host path
+    and ops.robust.subset_max_eigvals are the comparison points)."""
+    x = randx(16, 256, seed=21)
+    gram = x @ x.T
+    m = 11
+    combos = np.array(list(itertools.combinations(range(16), m)), dtype=np.int32)
+    got = np.asarray(
+        robust.subset_max_eigvals_jacobi(jnp.asarray(gram), jnp.asarray(combos))
+    )
+    h = np.eye(m) - np.full((m, m), 1.0 / m)
+    sub = gram[combos[:, :, None], combos[:, None, :]]
+    want = np.maximum(np.linalg.eigvalsh(h @ sub @ h)[:, -1], 0.0) / m
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert int(np.argmin(got)) == int(np.argmin(want))
+
+
+def test_subset_max_eigvals_jacobi_nonfinite_scores_inf():
+    x = randx(8, 64, seed=22)
+    x[2] = np.inf
+    gram = x @ x.T
+    combos = np.array(list(itertools.combinations(range(8), 5)), dtype=np.int32)
+    got = np.asarray(
+        robust.subset_max_eigvals_jacobi(jnp.asarray(gram), jnp.asarray(combos))
+    )
+    touch = (combos == 2).any(axis=1)
+    assert np.isinf(got[touch]).all()
+    assert np.isfinite(got[~touch]).all()
+
+
+def test_subset_max_eigvals_jacobi_equal_diagonal_rotation():
+    """app == aqq (tau = 0) needs a 45-degree rotation, not the identity:
+    a 2x2 constant-diagonal matrix only diagonalizes through that path."""
+    a = np.array([[2.0, 1.5], [1.5, 2.0]], np.float32)
+    gram = np.zeros((4, 4), np.float32)
+    gram[:2, :2] = a
+    gram[2:, 2:] = np.eye(2, dtype=np.float32) * 5
+    combos = np.array([[0, 1], [2, 3]], np.int32)
+    got = np.asarray(
+        robust.subset_max_eigvals_jacobi(jnp.asarray(gram), jnp.asarray(combos))
+    )
+    h = np.eye(2) - np.full((2, 2), 0.5)
+    want = [
+        max(np.linalg.eigvalsh(h @ gram[np.ix_(c, c)] @ h)[-1], 0.0) / 2
+        for c in combos
+    ]
+    np.testing.assert_allclose(got, np.array(want), rtol=1e-5, atol=1e-6)
