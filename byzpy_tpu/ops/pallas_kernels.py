@@ -278,28 +278,59 @@ def pairwise_sq_dists_pallas(
 # ---------------------------------------------------------------------------
 
 
+def _gram_norms_d2(g, *, n_pad: int):
+    """(norms, d2) from the f32 Gram block, entirely in VMEM."""
+    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
+    col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
+    norms = jnp.sum(jnp.where(row_i == col_i, g, 0.0), axis=0)  # (n_pad,)
+    d2 = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * g, 0.0)
+    return norms, d2
+
+
+def _padded_sort_keys(d2, *, n_pad: int, n_real: int):
+    """int32 sort keys for ``d2`` with padded rows/columns forced to the
+    absolute max key: pads must sink below every real entry, NaN included
+    (canonical-NaN keys are strictly below int32 max), so they can never
+    be selected while any real row remains."""
+    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
+    col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
+    pad = (row_i >= n_real) | (col_i >= n_real)
+    keys = _float_sort_keys(d2)
+    return jnp.where(pad, jnp.iinfo(jnp.int32).max, keys)
+
+
+def _accumulate_gram(x_block, gram_ref, c):
+    """Phase-0 body shared by the fused kernels: zero the scratch on the
+    round's first chunk, then accumulate this feature tile's Gram
+    contribution on the MXU (f32 accumulation; each tile of ``x`` is read
+    from HBM exactly once — XLA's einsum streams ``x`` twice, as lhs and
+    rhs: 0.91 ms vs the 0.31 ms one-read floor at 64x1M f32 on v5e)."""
+    @pl.when(c == 0)
+    def _():
+        gram_ref[:] = jnp.zeros_like(gram_ref)
+
+    gram_ref[:] += jax.lax.dot_general(
+        x_block, x_block,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _selection_scores(g, *, mode: str, n_pad: int, n_real: int, f: int,
                       reference_index: int):
     """Per-node scores from the f32 Gram block ``g`` (``(n_pad, n_pad)``),
     entirely in VMEM. Padded rows are neutralized by the caller's ranking
     (they rank strictly last); here they only need to not pollute real
     nodes' scores."""
-    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
-    col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
-    norms = jnp.sum(jnp.where(row_i == col_i, g, 0.0), axis=0)  # (n_pad,)
+    norms, d2 = _gram_norms_d2(g, n_pad=n_pad)
     if mode == "cge":
         return norms
-    d2 = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * g, 0.0)
     if mode == "monna":
         return d2[reference_index]
     # krum: sum of the n_real - f - 1 smallest off-diagonal distances per
     # column (d2 is symmetric, so column sums == the reference's row sums;
-    # ref: byzpy/aggregators/geometric_wise/krum.py:183-190). Padded rows
-    # must sink below every real entry, NaN included, so they are masked
-    # in key space (int32 max) rather than with +inf.
-    pad = (row_i >= n_real) | (col_i >= n_real)
-    keys = _float_sort_keys(d2)
-    keys = jnp.where(pad, jnp.iinfo(jnp.int32).max, keys)
+    # ref: byzpy/aggregators/geometric_wise/krum.py:183-190).
+    keys = _padded_sort_keys(d2, n_pad=n_pad, n_real=n_real)
     srt = _keys_to_float(_batcher_sort_rows(keys, n_pad), jnp.float32)
     return jnp.sum(srt[1:n_real - f], axis=0)
 
@@ -372,16 +403,7 @@ def _selection_mean_stream_kernel(
 
     @pl.when(p == 0)
     def _():
-        @pl.when(c == 0)
-        def _():
-            gram_ref[:] = jnp.zeros_like(gram_ref)
-
-        xt = x_ref[0]
-        gram_ref[:] += jax.lax.dot_general(
-            xt, xt,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        _accumulate_gram(x_ref[0], gram_ref, c)
         o_ref[0] = jnp.zeros_like(o_ref[0])
 
     @pl.when((p == 1) & (c == 0))
@@ -499,6 +521,163 @@ def selection_mean_pallas(
 
 
 # ---------------------------------------------------------------------------
+# Fused Nearest-Neighbor Mixing (pre-aggregator) kernel
+# ---------------------------------------------------------------------------
+
+
+def _nnm_weights(g, *, n_pad: int, n_real: int, k: int):
+    """Selection state from the Gram block, all ``(n_pad, ...)`` f32:
+
+    * ``mask_clean[j, i]`` — 1 iff row ``j`` is among the ``k`` nearest of
+      mixing-row ``i`` (self included, stable ties by row index) AND row
+      ``j`` is finite. Selection ranks in int32 key space, so NaN/inf
+      distances order exactly like a stable argsort (NaN last, ties by
+      index; the one divergence is -0.0 keying strictly before +0.0, as
+      documented on ``sort_columns``). Padded rows carry the absolute max
+      key — strictly after canonical-NaN keys — so they can never be
+      selected while any real row remains.
+    * ``taint[j]`` — 1 iff row ``j``'s squared norm is non-finite (its
+      data must be zeroed before the mixing dot: 0-weight times NaN
+      poisons a contraction).
+    * ``sel_taint[i]`` — 1 iff mixing-row ``i`` selected a tainted row
+      (its output becomes NaN; see ``ops.preagg.nnm`` for the semantics).
+    """
+    norms, d2 = _gram_norms_d2(g, n_pad=n_pad)
+    keys = _padded_sort_keys(d2, n_pad=n_pad, n_real=n_real)
+    srt = _batcher_sort_rows(keys, n_pad)
+    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
+    col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
+    cut = srt[k - 1]  # (n_pad,): k-th smallest key per column
+    below = keys < cut[None, :]
+    at_f = jnp.where(keys == cut[None, :], 1.0, 0.0)
+    # stable tie fill in row order: cumulative count via a lower-
+    # triangular ones matmul (exact for 0/1 counts in f32 at n <= 128)
+    tri = jnp.where(row_i >= col_i, 1.0, 0.0)
+    csum_at = jax.lax.dot_general(
+        tri, at_f, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    quota = jnp.asarray(float(k), jnp.float32) - jnp.sum(
+        jnp.where(below, 1.0, 0.0), axis=0
+    )
+    take_at = (at_f > 0.5) & (csum_at <= quota[None, :])
+    mask = jnp.where(below | take_at, 1.0, 0.0)
+    taint = jnp.where(jnp.isfinite(norms), 0.0, 1.0)
+    sel_taint = jnp.where(
+        jnp.sum(mask * taint[:, None], axis=0) > 0.5, 1.0, 0.0
+    )
+    mask_clean = mask * (1.0 - taint)[:, None]
+    return mask_clean, taint, sel_taint
+
+
+def _nnm_stream_kernel(
+    x_ref, o_ref, gram_ref, w_ref, t_ref, *, n_pad: int, n_real: int, k: int
+):
+    """NNM with the same two-sweep structure as
+    ``_selection_mean_stream_kernel``, but an ``(n, n)`` selection MASK
+    instead of a weight vector: phase 1 computes ``mask.T @ x / k`` per
+    feature tile on the MXU. HBM traffic per round = 2 reads of ``x`` + 1
+    write of the mixed (n, d) output; the XLA path pays 4 passes (einsum
+    Gram reads ``x`` twice, the mixing matmul once, plus the output) and
+    a scatter-built mask (ref: ``byzpy/pre_aggregators/nnm.py:50-95``).
+    ``t_ref`` holds [taint, sel_taint] columns for the non-finite rule."""
+    p = pl.program_id(1)
+    c = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        _accumulate_gram(x_ref[0], gram_ref, c)
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+    @pl.when((p == 1) & (c == 0))
+    def _():
+        mask_clean, taint, sel_taint = _nnm_weights(
+            gram_ref[:], n_pad=n_pad, n_real=n_real, k=k
+        )
+        w_ref[:] = mask_clean
+        t_ref[0, :] = taint
+        t_ref[1, :] = sel_taint
+
+    @pl.when(p == 1)
+    def _():
+        taint_col = t_ref[0, :][:, None]  # f32 minor-dim insert
+        xt = jnp.where(taint_col > 0.5, 0.0, x_ref[0].astype(jnp.float32))
+        mixed = jax.lax.dot_general(
+            w_ref[:], xt,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        sel_taint_col = t_ref[1, :][:, None]
+        out = jnp.where(sel_taint_col > 0.5, jnp.nan, mixed / k)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("f", "tile", "interpret"))
+def nnm_stream_pallas(
+    xs: Array,
+    *,
+    f: int,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Nearest-Neighbor Mixing over ``K`` stacked rounds ``xs: (K, n, d)``
+    in one fused kernel launch; equals ``jax.vmap(lambda x:
+    ops.preagg.nnm(x, f=f))(xs)``. See ``nnm_pallas`` for the K=1 form."""
+    K, n, d = xs.shape
+    if not 0 <= f < n:
+        raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
+    if interpret is None:
+        interpret = not _on_tpu()
+    if xs.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        raise ValueError(f"unsupported dtype {xs.dtype}")
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    if tile is None:
+        # doubled itemsize: unlike the selection kernels, the (n, tile)
+        # OUTPUT block is as large as the input block, so both count
+        # against the scoped-VMEM budget
+        tile = _auto_selection_tile(d, n_pad, 2 * jnp.dtype(xs.dtype).itemsize)
+    d_pad = _round_up(max(d, 1), tile)
+    if (n_pad, d_pad) == (n, d):
+        xp = xs
+    else:
+        xp = jnp.zeros((K, n_pad, d_pad), xs.dtype).at[:, :n, :d].set(xs)
+
+    out = pl.pallas_call(
+        functools.partial(_nnm_stream_kernel, n_pad=n_pad, n_real=n, k=n - f),
+        out_shape=jax.ShapeDtypeStruct((K, n_pad, d_pad), xs.dtype),
+        grid=(K, 2, d_pad // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, n_pad, tile), lambda kk, p, c: (kk, 0, c),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_pad, tile), lambda kk, p, c: (kk, 0, c),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_pad, n_pad), jnp.float32),
+            pltpu.VMEM((n_pad, n_pad), jnp.float32),
+            pltpu.VMEM((2, n_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp)
+    return out[:, :n, :d]
+
+
+def nnm_pallas(
+    x: Array, *, f: int, tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Fused NNM over one ``(n, d)`` round (K=1 stream; the expand is
+    metadata-only)."""
+    n, d = x.shape
+    del n, d
+    return nnm_stream_pallas(x[None], f=f, tile=tile, interpret=interpret)[0]
+
+
+# ---------------------------------------------------------------------------
 # Dispatch policy
 # ---------------------------------------------------------------------------
 
@@ -508,6 +687,34 @@ def selection_mean_pallas(
 # the padding copy + grid overhead eat the win, so dispatch needs d large.
 MAX_NETWORK_ROWS = 128
 MIN_PALLAS_DIM = 256 * 1024
+
+
+def sharding_allows_pallas(x: Array) -> bool:
+    """A ``pallas_call`` is an opaque custom call to GSPMD: feeding it a
+    device-sharded operand forces XLA to all-gather the full matrix onto
+    every chip, defeating the feature-axis sharding design (local matmul
+    + psum of the (n, n) block — see ``ops.robust``'s module docstring).
+    Dispatch is therefore allowed only when the trace-time mesh is
+    single-device, fully manual (inside ``shard_map`` shapes are already
+    per-shard and the kernel runs on local data), or the spec is provably
+    replicated under explicit-sharding axes. Auto-mode multi-device
+    meshes hide the real spec at trace time, so they conservatively stay
+    on XLA."""
+    try:
+        sharding = jax.typeof(x).sharding
+        mesh = sharding.mesh
+        if getattr(mesh, "size", 1) <= 1:
+            return True
+        from jax.sharding import AxisType
+
+        axis_types = set(getattr(mesh, "axis_types", ()))
+        if axis_types == {AxisType.Manual}:
+            return True
+        if AxisType.Auto in axis_types:
+            return False
+        return all(p is None for p in sharding.spec)
+    except Exception:
+        return True  # no sharding info (eager CPU arrays, older tracers)
 
 
 def use_pallas_for(n: int, d: int) -> bool:
@@ -529,7 +736,10 @@ __all__ = [
     "trimmed_mean_pallas",
     "gram_pallas",
     "pairwise_sq_dists_pallas",
+    "nnm_pallas",
+    "nnm_stream_pallas",
     "selection_mean_pallas",
     "selection_mean_stream_pallas",
+    "sharding_allows_pallas",
     "use_pallas_for",
 ]

@@ -16,7 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .robust import gram_matrix, pairwise_sq_dists
+from .robust import gram_matrix
 
 Array = jnp.ndarray
 
@@ -63,16 +63,44 @@ def nnm(x: Array, *, f: int) -> Array:
     """Nearest-Neighbor Mixing: replace each row by the mean of its
     ``k = n - f`` nearest neighbors (self included)
     (ref: ``byzpy/pre_aggregators/nnm.py:50-95``).
-    """
+
+    Non-finite handling: the mixing matmul runs over taint-zeroed data,
+    and any mixed row whose selection includes a tainted neighbor (one
+    with a non-finite squared norm) is set to NaN afterwards. A plain
+    ``mask @ x`` would poison EVERY row (0-weight times NaN is NaN in a
+    contraction), which no gather-based implementation does; the one
+    divergence from gather semantics is that a row selecting an all-inf
+    neighbor yields NaN here instead of ±inf — both non-finite, both
+    ranked last by every downstream NaN-aware aggregator in this package.
+    On TPU at large ``d`` this dispatches to the fused two-sweep kernel
+    (``pallas_kernels.nnm_pallas``)."""
     n = x.shape[0]
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
     k = n - f
-    d2 = pairwise_sq_dists(x)
-    # k-nearest mask per row, then one (n,n)@(n,d) matmul does the mixing.
+    if (
+        x.ndim == 2
+        and x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+    ):
+        from .pallas_kernels import nnm_pallas, sharding_allows_pallas, use_pallas_for
+
+        if use_pallas_for(*x.shape) and sharding_allows_pallas(x):
+            return nnm_pallas(x, f=f)
+    gram = gram_matrix(x)  # f32 accumulation for 16-bit floats, f64 for f64
+    norms = jnp.diagonal(gram)
+    d2 = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
+    # k-nearest mask per row in the accumulation dtype (matching the fused
+    # kernel's f32 Gram selection), then one (n,n)@(n,d) matmul mixes.
     idx = jnp.argsort(d2, axis=1)[:, :k]
     mask = jnp.zeros_like(d2).at[jnp.arange(n)[:, None], idx].set(1.0)
-    return (mask @ x) / k
+    taint = ~jnp.isfinite(norms)
+    x_clean = jnp.where(taint[:, None], jnp.zeros((), x.dtype), x)
+    acc = gram.dtype
+    mixed = jnp.einsum("ij,jd->id", mask, x_clean, preferred_element_type=acc) / k
+    sel_taint = mask @ jnp.where(taint, 1.0, 0.0).astype(acc) > 0.5
+    return jnp.where(
+        sel_taint[:, None], jnp.asarray(jnp.nan, acc), mixed
+    ).astype(x.dtype)
 
 
 @partial(jax.jit, static_argnames=("f",))

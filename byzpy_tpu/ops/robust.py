@@ -199,33 +199,6 @@ def ranked_mean(x: Array, scores: Array, q: int) -> Array:
     return out.astype(x.dtype)
 
 
-def _sharding_allows_kernel(x: Array) -> bool:
-    """A ``pallas_call`` is an opaque custom call to GSPMD: feeding it a
-    device-sharded operand forces XLA to all-gather the full matrix onto
-    every chip, defeating the feature-axis sharding design this module
-    documents (local matmul + psum of the (n, n) block). Dispatch is
-    therefore allowed only when the trace-time mesh is single-device,
-    fully manual (inside ``shard_map`` shapes are already per-shard and
-    the kernel runs on local data), or the spec is provably replicated
-    under explicit-sharding axes. Auto-mode multi-device meshes hide the
-    real spec at trace time, so they conservatively stay on XLA."""
-    try:
-        sharding = jax.typeof(x).sharding
-        mesh = sharding.mesh
-        if getattr(mesh, "size", 1) <= 1:
-            return True
-        from jax.sharding import AxisType
-
-        axis_types = set(getattr(mesh, "axis_types", ()))
-        if axis_types == {AxisType.Manual}:
-            return True
-        if AxisType.Auto in axis_types:
-            return False
-        return all(p is None for p in sharding.spec)
-    except Exception:
-        return True  # no sharding info (eager CPU arrays, older tracers)
-
-
 def _use_selection_kernel(x: Array) -> bool:
     """True when the fused two-sweep Pallas selection kernel should serve
     this input (see ``pallas_kernels.selection_mean_pallas``): float data,
@@ -233,13 +206,13 @@ def _use_selection_kernel(x: Array) -> bool:
     Gram beats XLA's two-read einsum (XLA streams ``x`` as both lhs and
     rhs: 0.91 ms vs the 0.31 ms one-read floor at 64x1M f32 on v5e), and
     an unsharded (or per-shard) operand."""
-    from .pallas_kernels import use_pallas_for
+    from .pallas_kernels import sharding_allows_pallas, use_pallas_for
 
     return (
         x.ndim in (2, 3)  # (n, d) single round or (K, n, d) stream
         and x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
         and use_pallas_for(x.shape[-2], x.shape[-1])
-        and _sharding_allows_kernel(x)
+        and sharding_allows_pallas(x)
     )
 
 

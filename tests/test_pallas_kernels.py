@@ -376,3 +376,114 @@ def test_robust_selection_ops_dispatch_when_forced(monkeypatch):
         np.asarray(jnp.stack([_xla_multi_krum(xs[k], 2, 4) for k in range(2)])),
         rtol=1e-5, atol=1e-6,
     )
+
+
+# ---------------------------------------------------------------------------
+# Fused NNM kernel
+# ---------------------------------------------------------------------------
+
+
+def _nnm_oracle(x, f):
+    """Reference gather semantics (byzpy/pre_aggregators/nnm.py:50-95):
+    stable argsort of Gram-trick distances, mean of the k selected rows."""
+    x = np.asarray(x, np.float64)
+    n = x.shape[0]
+    k = n - f
+    gram = x @ x.T
+    nrm = np.diagonal(gram)
+    d2 = np.maximum(nrm[:, None] + nrm[None, :] - 2 * gram, 0.0)
+    idx = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    return np.stack([x[idx[i]].mean(0) for i in range(n)])
+
+
+@pytest.mark.parametrize("n,d,f", [(16, 256, 4), (13, 300, 3), (8, 128, 0)])
+def test_nnm_pallas_matches_oracle(n, d, f):
+    from byzpy_tpu.ops.pallas_kernels import nnm_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(n + d + f), (n, d), jnp.float32)
+    got = np.asarray(nnm_pallas(x, f=f, tile=128, interpret=True))
+    np.testing.assert_allclose(got, _nnm_oracle(x, f), rtol=1e-4, atol=1e-5)
+
+
+def test_nnm_pallas_matches_xla_path():
+    from byzpy_tpu.ops import preagg
+    from byzpy_tpu.ops.pallas_kernels import nnm_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (21, 384), jnp.float32)
+    got = np.asarray(nnm_pallas(x, f=5, tile=128, interpret=True))
+    want = np.asarray(preagg.nnm(x, f=5))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_nnm_nonfinite_row_taints_only_selectors():
+    """A NaN gradient must NOT poison every mixed row (the old mask @ x
+    path did): rows that never select it stay exactly at the gather
+    oracle; the NaN row's own mix (which always self-selects) is NaN.
+    Pinned for BOTH the XLA path and the kernel."""
+    from byzpy_tpu.ops import preagg
+    from byzpy_tpu.ops.pallas_kernels import nnm_pallas
+
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(3), (10, 64), jnp.float32)
+    ).copy()
+    x[4] = np.nan
+    # gather-oracle with the NaN row ranked last (its distances are NaN):
+    # each other row's k=7 nearest come from the 9 finite rows
+    keep = [i for i in range(10) if i != 4]
+    xs_f = x[keep].astype(np.float64)
+    d2 = ((xs_f[:, None, :] - xs_f[None, :, :]) ** 2).sum(-1)
+    order = np.argsort(d2, axis=1, kind="stable")[:, :7]
+    want = {keep[i]: xs_f[order[i]].mean(0) for i in range(9)}
+    for impl in (
+        lambda a: preagg.nnm(jnp.asarray(a), f=3),
+        lambda a: nnm_pallas(jnp.asarray(a), f=3, tile=64, interpret=True),
+    ):
+        got = np.asarray(impl(x))
+        assert np.isnan(got[4]).all()  # self-selection taints row 4
+        for i in keep:  # NaN row ranks last: nobody else selects it
+            assert not np.isnan(got[i]).any()
+            np.testing.assert_allclose(got[i], want[i], rtol=1e-3, atol=1e-4)
+
+
+def test_nnm_inf_row_becomes_nan_for_selectors():
+    """Documented divergence from gather semantics: selecting an inf
+    neighbor yields NaN (not +-inf). Force selection with f=0."""
+    from byzpy_tpu.ops import preagg
+    from byzpy_tpu.ops.pallas_kernels import nnm_pallas
+
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(4), (6, 32), jnp.float32)
+    ).copy()
+    x[1] = np.inf
+    for impl in (
+        lambda a: preagg.nnm(jnp.asarray(a), f=0),
+        lambda a: nnm_pallas(jnp.asarray(a), f=0, tile=32, interpret=True),
+    ):
+        got = np.asarray(impl(x))
+        assert np.isnan(got).all()  # every row selects all rows at f=0
+
+
+def test_nnm_stream_and_bf16():
+    from byzpy_tpu.ops import preagg
+    from byzpy_tpu.ops.pallas_kernels import nnm_stream_pallas
+
+    xs = jax.random.normal(jax.random.PRNGKey(5), (3, 12, 256), jnp.float32)
+    got = np.asarray(nnm_stream_pallas(xs, f=3, tile=128, interpret=True))
+    want = np.stack([_nnm_oracle(xs[i], 3) for i in range(3)])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    xb = (xs[0] * 2).astype(jnp.bfloat16)
+    got = nnm_stream_pallas(xb[None], f=3, tile=128, interpret=True)[0]
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), _nnm_oracle(np.asarray(xb, np.float32), 3),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_nnm_dispatch_when_forced(monkeypatch):
+    from byzpy_tpu.ops import preagg
+
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    x = jax.random.normal(jax.random.PRNGKey(6), (11, 1664), jnp.float32)
+    got = np.asarray(preagg.nnm(x, f=2))
+    np.testing.assert_allclose(got, _nnm_oracle(x, 2), rtol=1e-4, atol=1e-5)
