@@ -89,9 +89,15 @@ def ring_attention(
         v_next = lax.ppermute(v_blk, axis_name, perm)
         return o_new, m_new, l_new, k_next, v_next
 
-    o0 = jnp.zeros((lq, d), jnp.float32)
-    m0 = jnp.full((lq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((lq,), jnp.float32)
+    # Carry components derive from q so their varying-manual-axes type
+    # matches the loop outputs under a strict shard_map (a constant-
+    # initialized carry is unvarying on input but varying on output and
+    # fails to trace — same hazard as geometric_median's carry,
+    # ops/robust.py).
+    zero_rows = jnp.sum(q32, axis=1) * 0.0  # (lq,), varying over the axis
+    o0 = q32 * 0.0
+    m0 = zero_rows + _NEG_INF
+    l0 = zero_rows
     o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
     out = o / jnp.maximum(l, 1e-30)[:, None]
     return out.astype(q.dtype)

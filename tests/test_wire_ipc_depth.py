@@ -1,0 +1,188 @@
+"""Wire-framing + shm payload depth tests (control-plane edges the
+loopback integration tests don't isolate): HMAC signing/tamper rejection,
+frame limits, dataclass host views, shm wrap/unwrap lifecycle.
+
+Reference intent: byzpy/engine/actor tests of _wire framing and shm
+payload wrapping.
+"""
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzpy_tpu.engine.actor import ipc, wire
+
+
+# ---------------------------------------------------------------------------
+# encode/decode + HMAC
+# ---------------------------------------------------------------------------
+
+
+def test_encode_decode_roundtrip_plain(monkeypatch):
+    monkeypatch.delenv("BYZPY_TPU_WIRE_KEY", raising=False)
+    payload = {"a": [1, 2, 3], "b": "x" * 1000, "c": (None, 4.5)}
+    frame = wire.encode(payload)
+    (length,) = wire._HEADER.unpack(frame[: wire._HEADER.size])
+    assert length == len(frame) - wire._HEADER.size
+    assert wire.decode(frame[wire._HEADER.size :]) == payload
+
+
+def test_signed_frame_roundtrip_and_tamper_rejection(monkeypatch):
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "sekrit")
+    frame = wire.encode({"v": 7})
+    body = frame[wire._HEADER.size :]
+    assert wire.decode(body) == {"v": 7}
+    # flip one bit in the pickled payload -> signature mismatch
+    tampered = bytearray(body)
+    tampered[-1] ^= 0x01
+    with pytest.raises(ValueError, match="HMAC"):
+        wire.decode(bytes(tampered))
+    # truncated below signature length
+    with pytest.raises(ValueError, match="too short"):
+        wire.decode(body[: wire._SIG_LEN - 1])
+
+
+def test_unsigned_frame_rejected_when_key_set(monkeypatch):
+    monkeypatch.delenv("BYZPY_TPU_WIRE_KEY", raising=False)
+    unsigned = wire.encode({"v": 1})[wire._HEADER.size :]
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "sekrit")
+    with pytest.raises(ValueError):
+        wire.decode(unsigned)
+
+
+def test_wrong_key_rejected(monkeypatch):
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "alpha")
+    body = wire.encode({"v": 2})[wire._HEADER.size :]
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "beta")
+    with pytest.raises(ValueError, match="HMAC"):
+        wire.decode(body)
+
+
+def test_send_recv_over_stream_pair(monkeypatch):
+    """Framing survives an actual asyncio stream, including a frame large
+    enough to span multiple transport reads."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "stream-key")
+    big = {"blob": np.random.default_rng(0).random(200_000)}
+
+    async def main():
+        server_got = asyncio.get_running_loop().create_future()
+
+        async def handler(reader, writer):
+            server_got.set_result(await wire.recv_obj(reader))
+            await wire.send_obj(writer, {"ack": True})
+            writer.close()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await wire.send_obj(writer, big)
+        ack = await wire.recv_obj(reader)
+        got = await server_got
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        return got, ack
+
+    got, ack = asyncio.run(main())
+    np.testing.assert_array_equal(got["blob"], big["blob"])
+    assert ack == {"ack": True}
+
+
+def test_recv_rejects_oversized_header():
+    async def main():
+        reader = asyncio.StreamReader()
+        reader.feed_data(wire._HEADER.pack(wire.MAX_FRAME + 1))
+        with pytest.raises(ValueError, match="too large"):
+            await wire.recv_obj(reader)
+
+    asyncio.run(main())
+
+
+def test_warn_untrusted_bind_only_beyond_loopback(recwarn):
+    wire.warn_untrusted_bind("127.0.0.1", "test")
+    wire.warn_untrusted_bind("localhost", "test")
+    assert not [w for w in recwarn.list if issubclass(w.category, RuntimeWarning)]
+    with pytest.warns(RuntimeWarning, match="trusted"):
+        wire.warn_untrusted_bind("0.0.0.0", "test")
+
+
+# ---------------------------------------------------------------------------
+# host_view
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Envelope:
+    tag: str
+    payload: object
+
+
+def test_host_view_converts_device_arrays_in_dataclasses():
+    msg = _Envelope(tag="grads", payload={"w": jnp.arange(6.0), "n": 3})
+    out = wire.host_view(msg)
+    assert isinstance(out, _Envelope) and out.tag == "grads"
+    assert isinstance(out.payload["w"], np.ndarray)
+    assert out.payload["n"] == 3
+    # nested dataclass inside a list inside a dataclass
+    nested = _Envelope(tag="outer", payload=[_Envelope("inner", jnp.ones((2,)))])
+    out = wire.host_view(nested)
+    assert isinstance(out.payload[0].payload, np.ndarray)
+
+
+def test_host_view_passthrough_plain_values():
+    obj = {"s": "x", "t": (1, 2.0), "arr": np.zeros(3)}
+    out = wire.host_view(obj)
+    assert out["s"] == "x" and out["t"] == (1, 2.0)
+    assert out["arr"] is obj["arr"]  # numpy leaves pass through untouched
+
+
+# ---------------------------------------------------------------------------
+# shm payload wrap/unwrap
+# ---------------------------------------------------------------------------
+
+
+def test_wrap_unwrap_roundtrip_and_threshold():
+    rng = np.random.default_rng(1)
+    small = rng.random(4).astype(np.float32)
+    big = rng.random(100_000).astype(np.float32)
+    payload = {"small": small, "big": big, "scalar": 2.5}
+    wrapped, handles = ipc.wrap_payload(payload, min_bytes=1024)
+    try:
+        # the big array moved to shm, the small one stayed inline
+        assert any(
+            isinstance(leaf, ipc.native_store.SharedTensorHandle)
+            for leaf in jax.tree_util.tree_leaves(
+                wrapped, is_leaf=lambda x: isinstance(
+                    x, ipc.native_store.SharedTensorHandle
+                )
+            )
+        )
+        out = ipc.unwrap_payload(wrapped, copy=True)
+        np.testing.assert_array_equal(out["small"], small)
+        np.testing.assert_array_equal(out["big"], big)
+        assert out["scalar"] == 2.5
+    finally:
+        ipc.cleanup_handles(handles)
+
+
+def test_wrap_payload_dataclass_envelope():
+    msg = _Envelope(tag="m", payload=np.arange(50_000, dtype=np.float32))
+    wrapped, handles = ipc.wrap_payload(msg, min_bytes=1024)
+    try:
+        assert isinstance(wrapped, _Envelope)
+        out = ipc.unwrap_payload(wrapped, copy=True)
+        np.testing.assert_array_equal(out.payload, msg.payload)
+    finally:
+        ipc.cleanup_handles(handles)
+
+
+def test_unwrap_close_releases_shm():
+    arr = np.arange(30_000, dtype=np.float32)
+    wrapped, handles = ipc.wrap_payload({"a": arr}, min_bytes=1024)
+    out = ipc.unwrap_payload(wrapped, copy=True, close=True)
+    np.testing.assert_array_equal(out["a"], arr)
+    ipc.cleanup_handles(handles)  # idempotent after close
