@@ -1,0 +1,111 @@
+"""Round-3 on-chip re-measurement bundle.
+
+Runs every measurement that changed this round and prints one JSON line
+per row (append the relevant ones to ``results/overrides.jsonl`` with a
+provenance note):
+
+* SMEA 16x4096 f=5 grid row (device-pure Jacobi path)
+* PS + Multi-Krum actor round (host-side node model)
+* NNM 196x4096 grid row (fused kernel dispatches only at d >= 256k, so
+  this row is unchanged; measured for confirmation) and a 64x1M NNM
+  stream comparison (fused vs XLA)
+* fused-kernel TPU parity spot-check (selection + NNM, vs the XLA paths)
+
+Usage: python benchmarks/rerun_round3.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from _timing import timed_ms  # noqa: E402
+from byzpy_tpu.ops import preagg, robust  # noqa: E402
+
+
+def emit(workload: str, ms: float, **extra) -> None:
+    print(json.dumps({"workload": workload, "ms": round(ms, 2), **extra}), flush=True)
+
+
+def grads(n, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), n)
+    return [jax.random.normal(k, (d,), jnp.float32) for k in ks]
+
+
+def parity_checks() -> None:
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 524_288), jnp.float32)
+    want = robust.ranked_mean(x, robust.krum_scores(x, f=8), 12)
+    got = robust.multi_krum(x, f=8, q=12)  # dispatches to the fused kernel
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-5, f"selection kernel parity: {err}"
+    xs = x[: 16].reshape(1, 16, 524_288)
+    from byzpy_tpu.ops.pallas_kernels import nnm_stream_pallas
+
+    got = nnm_stream_pallas(xs, f=4)[0]
+    gram = jnp.einsum("id,jd->ij", xs[0], xs[0], preferred_element_type=jnp.float32)
+    nrm = jnp.diagonal(gram)
+    d2 = jnp.maximum(nrm[:, None] + nrm[None, :] - 2 * gram, 0.0)
+    idx = jnp.argsort(d2, axis=1)[:, :12]
+    want = jnp.stack([jnp.mean(xs[0][idx[i]], axis=0) for i in range(16)])
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-4, f"nnm kernel parity: {err}"
+    print("# on-chip kernel parity OK", flush=True)
+
+
+def main() -> None:
+    print(f"# device={jax.devices()[0]}", file=sys.stderr)
+    parity_checks()
+
+    # SMEA grid row (ref best 48.0 ms)
+    from byzpy_tpu.aggregators import SMEA
+
+    smea = SMEA(f=5)
+    g = grads(16, 4096)
+    emit("smea_16x4096_f5", timed_ms(lambda: smea.aggregate(g), repeat=20),
+         ref_best_pool_ms=48.0, ref_direct_ms=82)
+
+    # PS actor round (ref best 42 ms) — host-side node model
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import full_grid
+
+    emit("ps_multikrum_round", full_grid.ps_multi_krum_round_ms(rounds=50),
+         ref_best_pool_ms=42, ref_byzfl_ms=57, ref_direct_ms=71)
+
+    # NNM grid row confirmation (65k-dim: XLA path, unchanged)
+    x196 = jnp.stack(grads(196, 4096, seed=3))
+    emit("nnm_196x4096_f32", timed_ms(jax.jit(partial(preagg.nnm, f=32)), x196),
+         ref_direct_ms=12)
+
+    # NNM 64x1M: fused kernel vs XLA einsum path
+    key = jax.random.PRNGKey(5)
+    xs = jax.random.normal(key, (8, 64, 1_048_576), jnp.float32)
+    from byzpy_tpu.ops.pallas_kernels import nnm_stream_pallas
+
+    t_fused = timed_ms(jax.jit(partial(nnm_stream_pallas, f=8)), xs, repeat=10) / 8
+    os.environ["BYZPY_TPU_PALLAS"] = "0"
+    t_xla = timed_ms(
+        jax.jit(jax.vmap(partial(preagg.nnm, f=8))), xs, repeat=10
+    ) / 8
+    os.environ["BYZPY_TPU_PALLAS"] = "auto"
+    emit("nnm_64x1M_stream8_fused", t_fused, xla_ms=round(t_xla, 2),
+         speedup=round(t_xla / t_fused, 2))
+
+    # headline (same as bench.py, for the overrides record)
+    stream = jax.jit(partial(robust.multi_krum_stream, f=8, q=12))
+    xs32 = jax.random.normal(key, (32, 64, 1_048_576), jnp.float32)
+    t = timed_ms(stream, xs32, repeat=40) / 32
+    emit("multi_krum_64x1M_stream32_f32", t, grads_per_sec=round(64 / (t / 1e3), 1))
+    t = timed_ms(stream, xs32.astype(jnp.bfloat16), repeat=40) / 32
+    emit("multi_krum_64x1M_stream32_bf16", t, grads_per_sec=round(64 / (t / 1e3), 1))
+
+
+if __name__ == "__main__":
+    main()
