@@ -1,0 +1,68 @@
+"""Examples tree smoke tests.
+
+Full example runs take minutes on this 1-core host, so the default suite
+only (a) compiles every example for syntax/import-level rot and (b)
+executes the one sub-second demo end-to-end. Set
+``BYZPY_TPU_RUN_EXAMPLE_TESTS=1`` to also execute the heavier training
+examples with tiny round counts (what CI's nightly lane would do).
+"""
+
+import os
+import pathlib
+import py_compile
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+ENV = {
+    **os.environ,
+    "BYZPY_TPU_PLATFORM": "cpu",
+    "JAX_PLATFORMS": "cpu",
+    "PS_ROUNDS": "2",
+    "P2P_ROUNDS": "2",
+    "ROUNDS": "2",
+    "SEQ_LEN": "64",
+}
+
+
+def _all_example_files():
+    return sorted(EXAMPLES.rglob("*.py"))
+
+
+def test_every_example_compiles():
+    files = _all_example_files()
+    assert len(files) >= 10  # the tree documented in examples/README.md
+    for f in files:
+        py_compile.compile(str(f), doraise=True)
+
+
+def test_actor_demo_runs():
+    out = subprocess.run(
+        [sys.executable, str(EXAMPLES / "actor_demo.py")],
+        env=ENV, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "add(2)" in out.stdout
+
+
+@pytest.mark.skipif(
+    not os.environ.get("BYZPY_TPU_RUN_EXAMPLE_TESTS"),
+    reason="heavy example runs are opt-in (BYZPY_TPU_RUN_EXAMPLE_TESTS=1)",
+)
+@pytest.mark.parametrize(
+    "rel",
+    [
+        "long_context_lm.py",
+        "ps/thread_mnist.py",
+        "ps/spmd_mnist.py",
+        "p2p/gossip_mnist.py",
+    ],
+)
+def test_training_example_runs(rel):
+    out = subprocess.run(
+        [sys.executable, str(EXAMPLES / rel)],
+        env=ENV, capture_output=True, text=True, timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
