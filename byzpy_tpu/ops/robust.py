@@ -76,13 +76,43 @@ def pairwise_sq_dists(x: Array) -> Array:
 
 def coordinate_median(x: Array) -> Array:
     """Coordinate-wise median (ref: ``aggregators/coordinate_wise/median.py``).
-    On TPU with small ``n`` and large ``d`` this runs the Pallas
-    sorting-network kernel (``pallas_kernels.median_pallas``)."""
-    from .pallas_kernels import median_pallas, use_pallas_for
+    On TPU with small ``n`` and large ``d`` this runs the fused
+    sorted-reduce kernel (one HBM read + a (1, d) write; the sorted
+    matrix never returns to HBM — ``pallas_kernels
+    .sorted_reduce_stream_pallas``), falling back to the sort-and-slice
+    network for other float widths."""
+    from .pallas_kernels import (
+        median_pallas,
+        sharding_allows_pallas,
+        sorted_reduce_stream_pallas,
+        use_pallas_for,
+    )
 
     if x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.floating) and use_pallas_for(*x.shape):
+        if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16) and sharding_allows_pallas(x):
+            return sorted_reduce_stream_pallas(x[None], mode="median")[0]
         return median_pallas(x)
     return jnp.median(x, axis=0)
+
+
+def coordinate_median_stream(xs: Array) -> Array:
+    """Coordinate-wise median over ``K`` stacked rounds ``(K, n, d)`` in
+    one fused launch (see ``aggregate_stream`` for why streaming is the
+    training-loop shape); XLA scan fallback elsewhere."""
+    from .pallas_kernels import (
+        sharding_allows_pallas,
+        sorted_reduce_stream_pallas,
+        use_pallas_for,
+    )
+
+    if (
+        xs.ndim == 3
+        and xs.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+        and use_pallas_for(xs.shape[-2], xs.shape[-1])
+        and sharding_allows_pallas(xs)
+    ):
+        return sorted_reduce_stream_pallas(xs, mode="median")
+    return aggregate_stream(coordinate_median, xs)
 
 
 @partial(jax.jit, static_argnames=("f",))
@@ -94,9 +124,16 @@ def trimmed_mean(x: Array, *, f: int) -> Array:
     n = x.shape[0]
     if not 0 <= 2 * f < n:
         raise ValueError(f"trim parameter f must satisfy 0 <= 2f < n (got n={n}, f={f})")
-    from .pallas_kernels import trimmed_mean_pallas, use_pallas_for
+    from .pallas_kernels import (
+        sharding_allows_pallas,
+        sorted_reduce_stream_pallas,
+        trimmed_mean_pallas,
+        use_pallas_for,
+    )
 
     if x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.floating) and use_pallas_for(*x.shape):
+        if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16) and sharding_allows_pallas(x):
+            return sorted_reduce_stream_pallas(x[None], mode="trimmed", f=f)[0]
         return trimmed_mean_pallas(x, f=f)
     s = jnp.sort(x, axis=0)
     return jnp.mean(s[f : n - f], axis=0)
@@ -569,6 +606,7 @@ __all__ = [
     "gram_matrix",
     "pairwise_sq_dists",
     "coordinate_median",
+    "coordinate_median_stream",
     "trimmed_mean",
     "mean_of_medians",
     "krum_scores",

@@ -487,3 +487,97 @@ def test_nnm_dispatch_when_forced(monkeypatch):
     x = jax.random.normal(jax.random.PRNGKey(6), (11, 1664), jnp.float32)
     got = np.asarray(preagg.nnm(x, f=2))
     np.testing.assert_allclose(got, _nnm_oracle(x, 2), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Fused sorted-reduce kernel (median / trimmed mean, no sort write-back)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,d", [(8, 256), (13, 300), (9, 700)])
+def test_sorted_reduce_median_matches_jnp(n, d):
+    from byzpy_tpu.ops.pallas_kernels import sorted_reduce_stream_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(n * d), (n, d), jnp.float32) * 5
+    got = sorted_reduce_stream_pallas(x[None], mode="median", tile=128,
+                                      interpret=True)[0]
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jnp.median(x, axis=0))
+    )
+
+
+def test_sorted_reduce_median_nan_and_inf_parity():
+    from byzpy_tpu.ops.pallas_kernels import sorted_reduce_stream_pallas
+
+    a = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(1), (10, 384), jnp.float32)
+    ).copy()
+    a[3, ::5] = np.inf
+    a[7, ::9] = np.nan
+    a[:, 42] = np.nan
+    x = jnp.asarray(a)
+    got = sorted_reduce_stream_pallas(x[None], mode="median", tile=128,
+                                      interpret=True)[0]
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jnp.median(x, axis=0))
+    )
+
+
+def test_sorted_reduce_trimmed_matches_oracle():
+    from byzpy_tpu.ops.pallas_kernels import sorted_reduce_stream_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (12, 500), jnp.float32)
+    got = sorted_reduce_stream_pallas(x[None], mode="trimmed", f=3, tile=128,
+                                      interpret=True)[0]
+    s = np.sort(np.asarray(x), axis=0)
+    np.testing.assert_allclose(
+        np.asarray(got), s[3:-3].mean(axis=0), rtol=1e-5, atol=1e-6
+    )
+    with pytest.raises(ValueError):
+        sorted_reduce_stream_pallas(x[None], mode="trimmed", f=6, interpret=True)
+    with pytest.raises(ValueError):
+        sorted_reduce_stream_pallas(x[None], mode="nope", interpret=True)
+
+
+def test_sorted_reduce_bf16_median_bit_parity():
+    from byzpy_tpu.ops.pallas_kernels import sorted_reduce_stream_pallas
+
+    x = (jax.random.normal(jax.random.PRNGKey(3), (8, 256)) * 3).astype(
+        jnp.bfloat16
+    )
+    got = sorted_reduce_stream_pallas(x[None], mode="median", tile=128,
+                                      interpret=True)[0]
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got, np.float32),
+        np.asarray(jnp.median(x, axis=0), np.float32),
+    )
+
+
+def test_sorted_reduce_stream_per_round_parity():
+    from byzpy_tpu.ops.pallas_kernels import sorted_reduce_stream_pallas
+
+    xs = jax.random.normal(jax.random.PRNGKey(4), (3, 9, 260), jnp.float32)
+    got = sorted_reduce_stream_pallas(xs, mode="median", tile=128,
+                                      interpret=True)
+    want = jnp.stack([jnp.median(xs[i], axis=0) for i in range(3)])
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_coordinate_median_dispatches_to_fused_reduce(monkeypatch):
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    x = jax.random.normal(jax.random.PRNGKey(5), (10, 1920), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(robust.coordinate_median(x)),
+        np.asarray(jnp.median(x, axis=0)),
+    )
+    s = np.sort(np.asarray(x), axis=0)
+    np.testing.assert_allclose(
+        np.asarray(robust.trimmed_mean(x, f=2)), s[2:-2].mean(axis=0),
+        rtol=1e-5, atol=1e-6,
+    )
+    xs = jnp.stack([x, x * 0.5])
+    np.testing.assert_array_equal(
+        np.asarray(robust.coordinate_median_stream(xs)),
+        np.asarray(jnp.median(xs, axis=1)),
+    )
