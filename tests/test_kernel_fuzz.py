@@ -1,0 +1,120 @@
+"""Seeded fuzz sweep: every fused Pallas kernel vs its XLA oracle.
+
+Randomized (but deterministic) shapes, hyper-parameters, dtypes, and
+non-finite injection patterns — the structured unit tests pin known edge
+cases; this sweep hunts the unknown ones. Interpret mode on CPU, same
+code paths as the chip (tests/conftest.py pins the platform).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from byzpy_tpu.ops import robust
+from byzpy_tpu.ops.pallas_kernels import (
+    nnm_stream_pallas,
+    selection_mean_stream_pallas,
+    sorted_reduce_stream_pallas,
+)
+
+N_CASES = 12
+
+
+def _random_case(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(5, 33))
+    d = int(rng.integers(130, 900))
+    x = rng.normal(size=(n, d)).astype(np.float32) * 10.0 ** float(rng.integers(-2, 3))
+    # sprinkle non-finite rows/entries in ~half the cases
+    if rng.random() < 0.5:
+        for _ in range(int(rng.integers(1, 3))):
+            r = int(rng.integers(0, n))
+            val = rng.choice([np.inf, -np.inf, np.nan])
+            if rng.random() < 0.5:
+                x[r] = val  # whole row
+            else:
+                x[r, :: int(rng.integers(2, 7))] = val
+    return n, d, x
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_selection_mean_krum(seed):
+    n, d, x = _random_case(1000 + seed)
+    rng = np.random.default_rng(seed)
+    f = int(rng.integers(0, max(1, (n - 1) // 2)))
+    q = int(rng.integers(1, n - f + 1))
+    xa = jnp.asarray(x)
+    got = selection_mean_stream_pallas(
+        xa[None], f=f, q=q, mode="krum", tile=128, interpret=True
+    )[0]
+    want = robust.ranked_mean(xa, robust.krum_scores(xa, f=f), q)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5, equal_nan=True
+    )
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_sorted_reduce(seed):
+    n, d, x = _random_case(2000 + seed)
+    xa = jnp.asarray(x)
+    got = sorted_reduce_stream_pallas(
+        xa[None], mode="median", tile=128, interpret=True
+    )[0]
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jnp.median(xa, axis=0))
+    )
+    f = int(np.random.default_rng(seed).integers(0, (n - 1) // 2 + 1))
+    if 2 * f < n:
+        got = sorted_reduce_stream_pallas(
+            xa[None], mode="trimmed", f=f, tile=128, interpret=True
+        )[0]
+        s = jnp.sort(xa, axis=0)
+        want = jnp.mean(s[f : n - f], axis=0)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6,
+            equal_nan=True,
+        )
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_nnm(seed):
+    n, d, x = _random_case(3000 + seed)
+    rng = np.random.default_rng(seed)
+    f = int(rng.integers(0, n))
+    xa = jnp.asarray(x)
+    got = np.asarray(nnm_stream_pallas(xa[None], f=f, tile=128, interpret=True)[0])
+    # oracle: the (fixed) XLA path — identical non-finite semantics
+    import os
+
+    os.environ["BYZPY_TPU_PALLAS"] = "0"
+    try:
+        from byzpy_tpu.ops import preagg
+
+        want = np.asarray(preagg.nnm(xa, f=f))
+    finally:
+        os.environ["BYZPY_TPU_PALLAS"] = "auto"
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5, equal_nan=True)
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_bf16_selection(seed):
+    n, d, x = _random_case(4000 + seed)
+    rng = np.random.default_rng(seed)
+    f = int(rng.integers(0, max(1, (n - 1) // 2)))
+    q = int(rng.integers(1, n - f + 1))
+    xb = jnp.asarray(x).astype(jnp.bfloat16)
+    got = selection_mean_stream_pallas(
+        xb[None], f=f, q=q, mode="krum", tile=128, interpret=True
+    )[0]
+    want = robust.ranked_mean(xb, robust.krum_scores(xb, f=f), q)
+    assert got.dtype == jnp.bfloat16
+    g32 = np.asarray(got, np.float32)
+    w32 = np.asarray(want, np.float32)
+    both_nan = np.isnan(g32) & np.isnan(w32)
+    scale = float(np.nanmax(np.abs(w32[~both_nan]))) if (~both_nan).any() else 1.0
+    # bf16 scores can flip near-tie selections between the two paths;
+    # any legitimate q-subset mean stays within the honest spread
+    assert np.allclose(
+        g32[~both_nan], w32[~both_nan], rtol=0.15, atol=0.15 * max(scale, 1e-6)
+    ) or not np.isfinite(scale)
