@@ -365,6 +365,128 @@ def sorted_reduce_stream_pallas(
 
 
 # ---------------------------------------------------------------------------
+# Fused MeaMed (mean-around-median) kernel
+# ---------------------------------------------------------------------------
+
+
+def _meamed_stream_kernel(
+    x_ref, o_ref, med_ref, *, n_pad: int, n_real: int, f: int,
+):
+    """Two sweeps per round, everything between them in VMEM.
+
+    Phase 0 per tile: key-sort the column block, write the coordinate
+    median into the ``(1, d)`` VMEM scratch (``med_ref``). Phase 1 per
+    tile: re-read the block, deviations ``|x - med|``, key-sort them,
+    threshold-select the ``k = n - f`` closest values per coordinate
+    (stable ties in node order via a triangular-matmul cumulative count —
+    exactly ``ops.robust.mean_of_medians``'s rule), and write the
+    selected mean. Total traffic: 2 reads of ``x`` + a (1, d) write; the
+    XLA path pays ~7 passes (median sort write+read, a materialized
+    deviation matrix, its sort write+read, then the masked sums).
+    A column with fewer than ``k`` finite deviations emits NaN (the cut
+    is NaN), matching the gather-based tie rule."""
+    p = pl.program_id(1)
+    c = pl.program_id(2)
+    k = n_real - f
+    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, x_ref.shape[-1]), 0)
+    maxkey = jnp.iinfo(jnp.int32).max
+
+    @pl.when(p == 0)
+    def _():
+        blk = x_ref[0].astype(jnp.float32)
+        keys = jnp.where(row_i >= n_real, maxkey, _float_sort_keys(blk))
+        srt = _batcher_sort_rows(keys, n_pad)
+        lo, hi = (n_real - 1) // 2, n_real // 2
+        med = (
+            _keys_to_float(srt[lo], jnp.float32)
+            + _keys_to_float(srt[hi], jnp.float32)
+        ) * 0.5
+        has_nan = srt[n_real - 1] > _INF_KEY
+        med = jnp.where(has_nan, jnp.nan, med)
+        med_ref[0, pl.dslice(c * x_ref.shape[-1], x_ref.shape[-1])] = med
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+    @pl.when(p == 1)
+    def _():
+        tile = x_ref.shape[-1]
+        blk = x_ref[0].astype(jnp.float32)
+        med = med_ref[0, pl.dslice(c * tile, tile)]
+        dev = jnp.abs(blk - med[None, :])
+        keys = jnp.where(row_i >= n_real, maxkey, _float_sort_keys(dev))
+        srt = _batcher_sort_rows(keys, n_pad)
+        cut = srt[k - 1]  # (tile,) int32 key of the k-th smallest deviation
+        below = keys < cut[None, :]
+        at_f = jnp.where(keys == cut[None, :], 1.0, 0.0)
+        tri = jnp.where(
+            lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
+            >= lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1),
+            1.0, 0.0,
+        )
+        csum_at = jax.lax.dot_general(
+            tri, at_f, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        quota = jnp.asarray(float(k), jnp.float32) - jnp.sum(
+            jnp.where(below, 1.0, 0.0), axis=0
+        )
+        sel = below | ((at_f > 0.5) & (csum_at <= quota[None, :]))
+        total = jnp.sum(jnp.where(sel, blk, 0.0), axis=0) / k
+        # cut is a NaN key iff fewer than k finite deviations exist
+        out = jnp.where(cut > _INF_KEY, jnp.nan, total)
+        o_ref[0] = out[None, :].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("f", "tile", "interpret"))
+def meamed_stream_pallas(
+    xs: Array,
+    *,
+    f: int,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """MeaMed over ``K`` stacked rounds ``xs: (K, n, d)`` in one fused
+    launch, returning ``(K, d)`` — equals ``ops.robust.mean_of_medians``
+    per round. Float dtypes; ``d`` capped by the VMEM median scratch
+    (``(1, d)`` f32), so the dispatch gate requires ``d <= 2**21``."""
+    K, n, d = xs.shape
+    if not 0 <= f < n:
+        raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
+    if xs.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        raise ValueError(f"unsupported dtype {xs.dtype}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    if tile is None:
+        tile = _auto_selection_tile(d, n_pad, 4)
+    d_pad = _round_up(max(d, 1), tile)
+    if (n_pad, d_pad) == (n, d):
+        xp = xs
+    else:
+        xp = jnp.zeros((K, n_pad, d_pad), xs.dtype).at[:, :n, :d].set(xs)
+
+    out = pl.pallas_call(
+        functools.partial(_meamed_stream_kernel, n_pad=n_pad, n_real=n, f=f),
+        out_shape=jax.ShapeDtypeStruct((K, 1, d_pad), xs.dtype),
+        grid=(K, 2, d_pad // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, n_pad, tile), lambda k, p, c: (k, 0, c),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, tile), lambda k, p, c: (k, 0, c), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((1, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(xp)
+    return out[:, 0, :d]
+
+
+MEAMED_MAX_DIM = 1 << 21  # (1, d) f32 median scratch must fit VMEM
+
+
+# ---------------------------------------------------------------------------
 # Fused selection-mean (Multi-Krum / CGE / MoNNA in one kernel launch)
 # ---------------------------------------------------------------------------
 
@@ -827,6 +949,7 @@ __all__ = [
     "trimmed_mean_pallas",
     "gram_pallas",
     "pairwise_sq_dists_pallas",
+    "meamed_stream_pallas",
     "nnm_pallas",
     "nnm_stream_pallas",
     "selection_mean_pallas",

@@ -155,6 +155,23 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
     k = n - f
+    from .pallas_kernels import (
+        MEAMED_MAX_DIM,
+        meamed_stream_pallas,
+        sharding_allows_pallas,
+        use_pallas_for,
+    )
+
+    if (
+        x.ndim == 2
+        and x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+        and use_pallas_for(*x.shape)
+        and x.shape[1] <= MEAMED_MAX_DIM
+        and sharding_allows_pallas(x)
+    ):
+        # one fused launch: 2 HBM reads + a (1, d) write, vs ~7 passes for
+        # the sort/deviation/sort/mask pipeline below
+        return meamed_stream_pallas(x[None], f=f)[0]
     med = jnp.median(x, axis=0)
     dev = jnp.abs(x - med[None, :])
     from .pallas_kernels import sort_columns, use_pallas_for

@@ -581,3 +581,73 @@ def test_coordinate_median_dispatches_to_fused_reduce(monkeypatch):
         np.asarray(robust.coordinate_median_stream(xs)),
         np.asarray(jnp.median(xs, axis=1)),
     )
+
+
+# ---------------------------------------------------------------------------
+# Fused MeaMed kernel
+# ---------------------------------------------------------------------------
+
+
+def _meamed_oracle(x, f):
+    """Gather-semantics oracle (ref mean_of_medians: keep the n-f values
+    closest to the median per coordinate, stable ties by node order)."""
+    x = np.asarray(x, np.float64)
+    n, d = x.shape
+    k = n - f
+    med = np.median(x, axis=0)
+    dev = np.abs(x - med[None, :])
+    out = np.empty(d)
+    for j in range(d):
+        order = np.argsort(dev[:, j], kind="stable")[:k]
+        out[j] = x[order, j].mean()
+    return out
+
+
+@pytest.mark.parametrize("n,d", [(8, 256), (13, 300), (10, 700)])
+def test_meamed_pallas_matches_oracle(n, d):
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+
+    f = (n - 1) // 3
+    x = jax.random.normal(jax.random.PRNGKey(n * d), (n, d), jnp.float32) * 4
+    got = meamed_stream_pallas(x[None], f=f, tile=128, interpret=True)[0]
+    np.testing.assert_allclose(
+        np.asarray(got), _meamed_oracle(x, f), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_meamed_pallas_matches_xla_path_with_nonfinite():
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+
+    a = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(3), (12, 384), jnp.float32)
+    ).copy()
+    a[2] = np.inf
+    a[5, ::7] = np.nan
+    x = jnp.asarray(a)
+    got = meamed_stream_pallas(x[None], f=3, tile=128, interpret=True)[0]
+    import os
+
+    os.environ["BYZPY_TPU_PALLAS"] = "0"
+    try:
+        want = robust.mean_of_medians(x, f=3)
+    finally:
+        os.environ["BYZPY_TPU_PALLAS"] = "auto"
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6, equal_nan=True
+    )
+
+
+def test_meamed_stream_and_dispatch(monkeypatch):
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+
+    xs = jax.random.normal(jax.random.PRNGKey(4), (3, 9, 260), jnp.float32)
+    got = meamed_stream_pallas(xs, f=2, tile=128, interpret=True)
+    want = np.stack([_meamed_oracle(xs[i], 2) for i in range(3)])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6)
+
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    x = jax.random.normal(jax.random.PRNGKey(5), (11, 2176), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(robust.mean_of_medians(x, f=3)),
+        _meamed_oracle(x, 3), rtol=1e-5, atol=1e-6,
+    )
