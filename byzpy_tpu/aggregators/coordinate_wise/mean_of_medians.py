@@ -38,5 +38,8 @@ class MeanOfMedians(FeatureChunkedAggregator, Aggregator):
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.mean_of_medians(x, f=self.f)
 
+    def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        return robust.mean_of_medians_stream(xs, f=self.f)
+
 
 __all__ = ["MeanOfMedians"]

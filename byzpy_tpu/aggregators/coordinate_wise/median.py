@@ -32,5 +32,8 @@ class CoordinateWiseMedian(FeatureChunkedAggregator, Aggregator):
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.coordinate_median(x)
 
+    def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        return robust.coordinate_median_stream(xs)
+
 
 __all__ = ["CoordinateWiseMedian"]

@@ -40,5 +40,8 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.trimmed_mean(x, f=self.f)
 
+    def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        return robust.trimmed_mean_stream(xs, f=self.f)
+
 
 __all__ = ["CoordinateWiseTrimmedMean"]

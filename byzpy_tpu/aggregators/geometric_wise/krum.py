@@ -72,6 +72,9 @@ class MultiKrum(RowScoredAggregator, Aggregator):
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum(x, f=self.f, q=self.q)
 
+    def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        return robust.multi_krum_stream(xs, f=self.f, q=self.q)
+
 
 class Krum(MultiKrum):
     """Classic Krum: the single lowest-score gradient (Multi-Krum q=1;

@@ -95,24 +95,48 @@ def coordinate_median(x: Array) -> Array:
     return jnp.median(x, axis=0)
 
 
-def coordinate_median_stream(xs: Array) -> Array:
-    """Coordinate-wise median over ``K`` stacked rounds ``(K, n, d)`` in
-    one fused launch (see ``aggregate_stream`` for why streaming is the
-    training-loop shape); XLA scan fallback elsewhere."""
-    from .pallas_kernels import (
-        sharding_allows_pallas,
-        sorted_reduce_stream_pallas,
-        use_pallas_for,
-    )
+def _use_stream_kernel(xs: Array) -> bool:
+    from .pallas_kernels import sharding_allows_pallas, use_pallas_for
 
-    if (
+    return (
         xs.ndim == 3
         and xs.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
         and use_pallas_for(xs.shape[-2], xs.shape[-1])
         and sharding_allows_pallas(xs)
-    ):
+    )
+
+
+def coordinate_median_stream(xs: Array) -> Array:
+    """Coordinate-wise median over ``K`` stacked rounds ``(K, n, d)`` in
+    one fused launch (see ``aggregate_stream`` for why streaming is the
+    training-loop shape); XLA scan fallback elsewhere."""
+    if _use_stream_kernel(xs):
+        from .pallas_kernels import sorted_reduce_stream_pallas
+
         return sorted_reduce_stream_pallas(xs, mode="median")
     return aggregate_stream(coordinate_median, xs)
+
+
+@partial(jax.jit, static_argnames=("f",))
+def trimmed_mean_stream(xs: Array, *, f: int) -> Array:
+    """f-trimmed coordinate mean over stacked rounds in one fused launch."""
+    if _use_stream_kernel(xs):
+        from .pallas_kernels import sorted_reduce_stream_pallas
+
+        return sorted_reduce_stream_pallas(xs, mode="trimmed", f=f)
+    return aggregate_stream(partial(trimmed_mean, f=f), xs)
+
+
+@partial(jax.jit, static_argnames=("f",))
+def mean_of_medians_stream(xs: Array, *, f: int) -> Array:
+    """MeaMed over stacked rounds in one fused launch."""
+    from .pallas_kernels import MEAMED_MAX_DIM
+
+    if _use_stream_kernel(xs) and xs.shape[-1] <= MEAMED_MAX_DIM:
+        from .pallas_kernels import meamed_stream_pallas
+
+        return meamed_stream_pallas(xs, f=f)
+    return aggregate_stream(partial(mean_of_medians, f=f), xs)
 
 
 @partial(jax.jit, static_argnames=("f",))
@@ -624,6 +648,8 @@ __all__ = [
     "pairwise_sq_dists",
     "coordinate_median",
     "coordinate_median_stream",
+    "trimmed_mean_stream",
+    "mean_of_medians_stream",
     "trimmed_mean",
     "mean_of_medians",
     "krum_scores",

@@ -323,3 +323,39 @@ def test_smea_device_path_matches_host_path():
     _, best = smea_mod._score_combo_range_smea(gram, n, m, 0, math.comb(n, m))
     want = x[best].mean(axis=0)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_aggregate_stream_class_api_matches_per_round():
+    """K buffered rounds through Aggregator.aggregate_stream must equal K
+    separate aggregate() calls — for a class with a fused stream override
+    (MultiKrum), a coordinate-wise one (median), and the default scan
+    path (CenteredClipping)."""
+    rng = np.random.default_rng(9)
+    rounds = [
+        [jnp.asarray(rng.normal(size=(40,)).astype(np.float32)) for _ in range(9)]
+        for _ in range(3)
+    ]
+    for agg in (MultiKrum(f=2, q=4), CoordinateWiseMedian(), CenteredClipping(c_tau=1.0)):
+        got = agg.aggregate_stream(rounds)
+        assert len(got) == 3
+        for k in range(3):
+            want = agg.aggregate(rounds[k])
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want), rtol=1e-5, atol=1e-6
+            )
+    assert MultiKrum(f=2, q=4).aggregate_stream([]) == []
+
+
+def test_aggregate_stream_preserves_pytree_structure():
+    rng = np.random.default_rng(10)
+    def tree():
+        return {"w": jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32)),
+                "b": jnp.asarray(rng.normal(size=(5,)).astype(np.float32))}
+    rounds = [[tree() for _ in range(6)] for _ in range(2)]
+    out = CoordinateWiseMedian().aggregate_stream(rounds)
+    assert set(out[0].keys()) == {"w", "b"}
+    assert out[0]["w"].shape == (4, 3)
+    want = CoordinateWiseMedian().aggregate(rounds[1])
+    np.testing.assert_allclose(
+        np.asarray(out[1]["b"]), np.asarray(want["b"]), rtol=1e-6
+    )
