@@ -458,6 +458,13 @@ def meamed_stream_pallas(
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
         tile = _auto_selection_tile(d, n_pad, 4)
+        # unlike the other kernels, the (1, d) f32 median scratch also
+        # lives in scoped VMEM — shrink the input tile until the double-
+        # buffered block plus the scratch fit the ~16 MiB budget
+        while tile > _LANES and (
+            2 * n_pad * tile * 4 + 4 * _round_up(d, tile) > 13 * 1024 * 1024
+        ):
+            tile //= 2
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
