@@ -49,5 +49,8 @@ class MoNNA(RowScoredAggregator, Aggregator):
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.monna(x, f=self.f, reference_index=self.reference_index)
 
+    def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        return robust.monna_stream(xs, f=self.f, reference_index=self.reference_index)
+
 
 __all__ = ["MoNNA"]

@@ -40,5 +40,8 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.cge(x, f=self.f)
 
+    def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        return robust.cge_stream(xs, f=self.f)
+
 
 __all__ = ["ComparativeGradientElimination"]

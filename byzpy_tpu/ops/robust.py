@@ -406,6 +406,35 @@ def centered_clipping(
 
 
 @partial(jax.jit, static_argnames=("f",))
+def cge_stream(xs: Array, *, f: int) -> Array:
+    """CGE over ``K`` stacked rounds in one fused launch (see
+    ``multi_krum_stream``)."""
+    n = xs.shape[-2]
+    if not 0 <= f < n:
+        raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
+    if _use_stream_kernel(xs):
+        from .pallas_kernels import selection_mean_stream_pallas
+
+        return selection_mean_stream_pallas(xs, f=0, q=n - f, mode="cge")
+    return aggregate_stream(partial(cge, f=f), xs)
+
+
+@partial(jax.jit, static_argnames=("f", "reference_index"))
+def monna_stream(xs: Array, *, f: int, reference_index: int = 0) -> Array:
+    """MoNNA over ``K`` stacked rounds in one fused launch."""
+    n = xs.shape[-2]
+    if 2 * f >= n:
+        raise ValueError(f"Cannot tolerate 2f >= n (got n={n}, f={f})")
+    if _use_stream_kernel(xs):
+        from .pallas_kernels import selection_mean_stream_pallas
+
+        return selection_mean_stream_pallas(
+            xs, f=0, q=n - f, mode="monna", reference_index=reference_index
+        )
+    return aggregate_stream(partial(monna, f=f, reference_index=reference_index), xs)
+
+
+@partial(jax.jit, static_argnames=("f",))
 def cge(x: Array, *, f: int) -> Array:
     """Comparative gradient elimination: drop the ``f`` largest-L2-norm
     vectors, average the rest
@@ -660,7 +689,9 @@ __all__ = [
     "geometric_median",
     "centered_clipping",
     "cge",
+    "cge_stream",
     "monna",
+    "monna_stream",
     "caf",
     "subset_diameters",
     "subset_max_eigvals",

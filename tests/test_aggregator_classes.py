@@ -359,3 +359,18 @@ def test_aggregate_stream_preserves_pytree_structure():
     np.testing.assert_allclose(
         np.asarray(out[1]["b"]), np.asarray(want["b"]), rtol=1e-6
     )
+
+
+def test_cge_monna_stream_overrides_match_per_round():
+    rng = np.random.default_rng(11)
+    rounds = [
+        [jnp.asarray(rng.normal(size=(32,)).astype(np.float32)) for _ in range(8)]
+        for _ in range(2)
+    ]
+    for agg in (ComparativeGradientElimination(f=2), MoNNA(f=2)):
+        got = agg.aggregate_stream(rounds)
+        for k in range(2):
+            want = agg.aggregate(rounds[k])
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want), rtol=1e-5, atol=1e-6
+            )
