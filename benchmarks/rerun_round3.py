@@ -57,6 +57,25 @@ def parity_checks() -> None:
     want = jnp.stack([jnp.mean(xs[0][idx[i]], axis=0) for i in range(16)])
     err = float(jnp.max(jnp.abs(got - want)))
     assert err < 1e-4, f"nnm kernel parity: {err}"
+
+    # sorted-reduce (median + trimmed) and MeaMed kernels, real lowering
+    y = x[:17]  # odd n exercises padding on chip
+    got = robust.coordinate_median(y)  # dispatches at d >= 256k
+    want = jnp.median(y, axis=0)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err == 0.0, f"sorted-reduce median parity: {err}"
+    got = robust.trimmed_mean(y, f=3)
+    s = jnp.sort(y, axis=0)
+    want = jnp.mean(s[3:-3], axis=0)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-5, f"sorted-reduce trimmed parity: {err}"
+    got = robust.mean_of_medians(y, f=3)
+    med = jnp.median(y, axis=0)
+    dev = jnp.abs(y - med[None, :])
+    order = jnp.argsort(dev, axis=0)[: y.shape[0] - 3]  # (k, d) node indices
+    want = jnp.mean(jnp.take_along_axis(y, order, axis=0), axis=0)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-5, f"meamed kernel parity: {err}"
     print("# on-chip kernel parity OK", flush=True)
 
 
