@@ -369,6 +369,9 @@ def sorted_reduce_stream_pallas(
 # ---------------------------------------------------------------------------
 
 
+MEAMED_MAX_DIM = 1 << 21  # (1, d) f32 median scratch must fit VMEM
+
+
 def _meamed_stream_kernel(
     x_ref, o_ref, med_ref, *, n_pad: int, n_real: int, f: int,
 ):
@@ -413,23 +416,7 @@ def _meamed_stream_kernel(
         med = med_ref[0, pl.dslice(c * tile, tile)]
         dev = jnp.abs(blk - med[None, :])
         keys = jnp.where(row_i >= n_real, maxkey, _float_sort_keys(dev))
-        srt = _batcher_sort_rows(keys, n_pad)
-        cut = srt[k - 1]  # (tile,) int32 key of the k-th smallest deviation
-        below = keys < cut[None, :]
-        at_f = jnp.where(keys == cut[None, :], 1.0, 0.0)
-        tri = jnp.where(
-            lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
-            >= lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1),
-            1.0, 0.0,
-        )
-        csum_at = jax.lax.dot_general(
-            tri, at_f, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        quota = jnp.asarray(float(k), jnp.float32) - jnp.sum(
-            jnp.where(below, 1.0, 0.0), axis=0
-        )
-        sel = below | ((at_f > 0.5) & (csum_at <= quota[None, :]))
+        sel, cut = _stable_k_select_mask(keys, n_pad=n_pad, k=k)
         total = jnp.sum(jnp.where(sel, blk, 0.0), axis=0) / k
         # cut is a NaN key iff fewer than k finite deviations exist
         out = jnp.where(cut > _INF_KEY, jnp.nan, total)
@@ -451,6 +438,12 @@ def meamed_stream_pallas(
     K, n, d = xs.shape
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
+    if d > MEAMED_MAX_DIM:
+        raise ValueError(
+            f"meamed_stream_pallas requires d <= {MEAMED_MAX_DIM} (got {d}): "
+            "the (1, d) f32 median scratch must fit scoped VMEM; use "
+            "ops.robust.mean_of_medians (the XLA path) beyond that"
+        )
     if xs.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
         raise ValueError(f"unsupported dtype {xs.dtype}")
     if interpret is None:
@@ -490,9 +483,6 @@ def meamed_stream_pallas(
     return out[:, 0, :d]
 
 
-MEAMED_MAX_DIM = 1 << 21  # (1, d) f32 median scratch must fit VMEM
-
-
 # ---------------------------------------------------------------------------
 # Fused selection-mean (Multi-Krum / CGE / MoNNA in one kernel launch)
 # ---------------------------------------------------------------------------
@@ -517,6 +507,33 @@ def _padded_sort_keys(d2, *, n_pad: int, n_real: int):
     pad = (row_i >= n_real) | (col_i >= n_real)
     keys = _float_sort_keys(d2)
     return jnp.where(pad, jnp.iinfo(jnp.int32).max, keys)
+
+
+def _stable_k_select_mask(keys, *, n_pad: int, k: int):
+    """Boolean mask of the ``k`` smallest-key entries per column of the
+    ``(n_pad, cols)`` sorted-key problem, stable ties in row order: keys
+    strictly below the k-th smallest always select; entries AT the cut
+    fill the remaining quota in row order via a lower-triangular ones
+    matmul (exact for 0/1 counts in f32 at n <= 128). ``keys`` must
+    already carry the pad masking (``_padded_sort_keys``); returns
+    ``(sel, cut)`` where ``cut`` is the per-column k-th smallest key
+    (a NaN key iff fewer than ``k`` finite entries exist)."""
+    srt = _batcher_sort_rows(keys, n_pad)
+    cut = srt[k - 1]
+    below = keys < cut[None, :]
+    at_f = jnp.where(keys == cut[None, :], 1.0, 0.0)
+    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
+    col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
+    tri = jnp.where(row_i >= col_i, 1.0, 0.0)
+    csum_at = jax.lax.dot_general(
+        tri, at_f, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    quota = jnp.asarray(float(k), jnp.float32) - jnp.sum(
+        jnp.where(below, 1.0, 0.0), axis=0
+    )
+    sel = below | ((at_f > 0.5) & (csum_at <= quota[None, :]))
+    return sel, cut
 
 
 def _accumulate_gram(x_block, gram_ref, c):
@@ -764,24 +781,8 @@ def _nnm_weights(g, *, n_pad: int, n_real: int, k: int):
     """
     norms, d2 = _gram_norms_d2(g, n_pad=n_pad)
     keys = _padded_sort_keys(d2, n_pad=n_pad, n_real=n_real)
-    srt = _batcher_sort_rows(keys, n_pad)
-    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
-    col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
-    cut = srt[k - 1]  # (n_pad,): k-th smallest key per column
-    below = keys < cut[None, :]
-    at_f = jnp.where(keys == cut[None, :], 1.0, 0.0)
-    # stable tie fill in row order: cumulative count via a lower-
-    # triangular ones matmul (exact for 0/1 counts in f32 at n <= 128)
-    tri = jnp.where(row_i >= col_i, 1.0, 0.0)
-    csum_at = jax.lax.dot_general(
-        tri, at_f, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    quota = jnp.asarray(float(k), jnp.float32) - jnp.sum(
-        jnp.where(below, 1.0, 0.0), axis=0
-    )
-    take_at = (at_f > 0.5) & (csum_at <= quota[None, :])
-    mask = jnp.where(below | take_at, 1.0, 0.0)
+    sel, _cut = _stable_k_select_mask(keys, n_pad=n_pad, k=k)
+    mask = jnp.where(sel, 1.0, 0.0)
     taint = jnp.where(jnp.isfinite(norms), 0.0, 1.0)
     sel_taint = jnp.where(
         jnp.sum(mask * taint[:, None], axis=0) > 0.5, 1.0, 0.0

@@ -374,3 +374,20 @@ def test_cge_monna_stream_overrides_match_per_round():
             np.testing.assert_allclose(
                 np.asarray(got[k]), np.asarray(want), rtol=1e-5, atol=1e-6
             )
+
+
+def test_smea_large_subset_takes_host_path(monkeypatch):
+    """m > 32 exceeds the fixed-sweep Jacobi precision envelope: the
+    aggregate must route to exact host LAPACK even when the combo count
+    fits the device cap."""
+    from byzpy_tpu.aggregators.geometric_wise import smea as smea_mod
+
+    def boom(*a, **k):
+        raise AssertionError("device Jacobi path used for m > 32")
+
+    monkeypatch.setattr(smea_mod, "_smea_select_mean", boom)
+    rng = np.random.default_rng(6)
+    grads = [jnp.asarray(rng.normal(size=(48,)).astype(np.float32)) for _ in range(36)]
+    agg = SMEA(f=2)  # m = 34 > 32, comb(36, 34) = 630 <= cap
+    out = np.asarray(agg.aggregate(grads))
+    assert np.isfinite(out).all()

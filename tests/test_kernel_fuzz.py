@@ -87,13 +87,17 @@ def test_fuzz_nnm(seed):
     # oracle: the (fixed) XLA path — identical non-finite semantics
     import os
 
+    prev = os.environ.get("BYZPY_TPU_PALLAS")
     os.environ["BYZPY_TPU_PALLAS"] = "0"
     try:
         from byzpy_tpu.ops import preagg
 
         want = np.asarray(preagg.nnm(xa, f=f))
     finally:
-        os.environ["BYZPY_TPU_PALLAS"] = "auto"
+        if prev is None:
+            os.environ.pop("BYZPY_TPU_PALLAS", None)
+        else:
+            os.environ["BYZPY_TPU_PALLAS"] = prev
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5, equal_nan=True)
 
 
