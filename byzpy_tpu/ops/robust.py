@@ -331,7 +331,7 @@ def krum(x: Array, *, f: int) -> Array:
     return multi_krum(x, f=f, q=1)
 
 
-@partial(jax.jit, static_argnames=("max_iter", "init"))
+@partial(jax.jit, static_argnames=("tol", "max_iter", "eps", "init"))
 def geometric_median(
     x: Array,
     *,
@@ -362,19 +362,30 @@ def geometric_median(
         delta = jnp.sqrt(jnp.sum((z - zprev) ** 2))
         return ((it == 0) | (delta > tol)) & (it < max_iter)
 
+    use_kernel = _use_selection_kernel(x)
+
     def body(state):
         z, _, it = state
-        diff = x - z[None, :]
-        dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
-        w = 1.0 / jnp.maximum(dist, eps)
-        z_new = jnp.sum(w[:, None] * x, axis=0) / jnp.sum(w)
+        if use_kernel:
+            # fused two-sweep step: 2 reads of x per iteration vs ~4
+            # passes for the materialized diff/norm/weighted-sum below
+            from .pallas_kernels import weighted_center_step_pallas
+
+            z_new = weighted_center_step_pallas(
+                x, z, mode="weiszfeld", eps=eps
+            )
+        else:
+            diff = x - z[None, :]
+            dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
+            w = 1.0 / jnp.maximum(dist, eps)
+            z_new = jnp.sum(w[:, None] * x, axis=0) / jnp.sum(w)
         return z_new, z, it + 1
 
     z, _, _ = lax.while_loop(cond, body, (z0, z0, 0))
     return z
 
 
-@partial(jax.jit, static_argnames=("M", "init"))
+@partial(jax.jit, static_argnames=("c_tau", "M", "eps", "init"))
 def centered_clipping(
     x: Array,
     *,
@@ -396,7 +407,15 @@ def centered_clipping(
     else:
         raise ValueError("init must be one of {'mean','median','zero'}")
 
+    use_kernel = _use_selection_kernel(x)
+
     def body(_, v):
+        if use_kernel:
+            from .pallas_kernels import weighted_center_step_pallas
+
+            return weighted_center_step_pallas(
+                x, v, mode="clip", eps=eps, c_tau=c_tau
+            )
         diff = x - v[None, :]
         dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
         scale = jnp.minimum(1.0, c_tau / jnp.maximum(dist, eps))

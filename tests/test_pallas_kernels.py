@@ -651,3 +651,72 @@ def test_meamed_stream_and_dispatch(monkeypatch):
         np.asarray(robust.mean_of_medians(x, f=3)),
         _meamed_oracle(x, 3), rtol=1e-5, atol=1e-6,
     )
+
+
+# ---------------------------------------------------------------------------
+# Fused weighted-center step (Weiszfeld / centered clipping)
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_center_weiszfeld_step_matches_xla():
+    from byzpy_tpu.ops.pallas_kernels import weighted_center_step_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (13, 300), jnp.float32)
+    z = jnp.median(x, axis=0)
+    got = weighted_center_step_pallas(x, z, mode="weiszfeld", tile=128,
+                                      interpret=True)
+    diff = x - z[None, :]
+    dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
+    w = 1.0 / jnp.maximum(dist, 1e-12)
+    want = jnp.sum(w[:, None] * x, axis=0) / jnp.sum(w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_weighted_center_clip_step_matches_xla():
+    from byzpy_tpu.ops.pallas_kernels import weighted_center_step_pallas
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (10, 260), jnp.float32) * 3
+    v = jnp.mean(x, axis=0)
+    got = weighted_center_step_pallas(x, v, mode="clip", c_tau=1.5, tile=128,
+                                      interpret=True)
+    diff = x - v[None, :]
+    dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
+    scale = jnp.minimum(1.0, 1.5 / jnp.maximum(dist, 1e-12))
+    want = v + jnp.mean(diff * scale[:, None], axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_geometric_median_and_clipping_dispatch_when_forced(monkeypatch):
+    """Full iterative aggregators through the fused step (forced dispatch,
+    interpret mode) must converge to the XLA-path results."""
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "0")
+    x = jax.random.normal(jax.random.PRNGKey(2), (11, 2304), jnp.float32)
+    want_gm = robust.geometric_median(x, max_iter=64)
+    want_cc = robust.centered_clipping(x, c_tau=2.0, M=6)
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    # fresh shape so the jit cache can't serve the XLA-path trace
+    x2 = jnp.concatenate([x, x[:1]], axis=0)
+    want_gm2 = None
+    got_gm = robust.geometric_median(x2, max_iter=64)
+    got_cc = robust.centered_clipping(x2, c_tau=2.0, M=6)
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "0")
+    # oracle at the same fresh shape via raw numpy Weiszfeld
+    xa = np.asarray(x2, np.float64)
+    z = np.median(xa, axis=0)
+    for _ in range(64):
+        dist = np.sqrt(((xa - z) ** 2).sum(1))
+        w = 1.0 / np.maximum(dist, 1e-12)
+        z_new = (w[:, None] * xa).sum(0) / w.sum()
+        if np.sqrt(((z_new - z) ** 2).sum()) <= 1e-6:
+            z = z_new
+            break
+        z = z_new
+    np.testing.assert_allclose(np.asarray(got_gm), z, rtol=1e-4, atol=1e-4)
+    v = xa.mean(0)
+    for _ in range(6):
+        dist = np.sqrt(((xa - v) ** 2).sum(1))
+        s = np.minimum(1.0, 2.0 / np.maximum(dist, 1e-12))
+        v = v + ((xa - v) * s[:, None]).mean(0)
+    np.testing.assert_allclose(np.asarray(got_cc), v, rtol=1e-4, atol=1e-4)
