@@ -122,3 +122,29 @@ def test_fuzz_bf16_selection(seed):
     assert np.allclose(
         g32[~both_nan], w32[~both_nan], rtol=0.15, atol=0.15 * max(scale, 1e-6)
     ) or not np.isfinite(scale)
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_weighted_center_step(seed):
+    from byzpy_tpu.ops.pallas_kernels import weighted_center_step_pallas
+
+    n, d, x = _random_case(5000 + seed)
+    xa = jnp.asarray(x)
+    z = jnp.median(xa, axis=0)
+    got = weighted_center_step_pallas(xa, z, mode="weiszfeld", tile=128,
+                                      interpret=True)
+    diff = xa - z[None, :]
+    dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
+    w = 1.0 / jnp.maximum(dist, 1e-12)
+    want = jnp.sum(w[:, None] * xa, axis=0) / jnp.sum(w)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4, equal_nan=True
+    )
+    tau = float(np.random.default_rng(seed).uniform(0.5, 3.0))
+    got = weighted_center_step_pallas(xa, z, mode="clip", c_tau=tau, tile=128,
+                                      interpret=True)
+    scale = jnp.minimum(1.0, tau / jnp.maximum(dist, 1e-12))
+    want = z + jnp.mean(diff * scale[:, None], axis=0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4, equal_nan=True
+    )
