@@ -1,0 +1,104 @@
+"""OperatorExecutor unit depth (ref intent: byzpy engine executor tests):
+graph caching, bare-vs-mapping inputs, missing-input errors, pool
+ownership semantics on close, and reuse across runs.
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzpy_tpu.engine.graph import ActorPoolConfig
+from byzpy_tpu.engine.graph.executor import OperatorExecutor, run_operator
+from byzpy_tpu.engine.graph.operator import OpContext, Operator
+
+
+class _SumOp(Operator):
+    name = "sum-op"
+    input_key = "values"
+
+    def compute(self, inputs, *, context: OpContext):
+        return jnp.sum(jnp.stack(list(inputs["values"])), axis=0)
+
+
+class _NoKeyOp(Operator):
+    name = "no-key-op"
+    input_key = None
+
+    def compute(self, inputs, *, context: OpContext):
+        return inputs["a"] + inputs["b"]
+
+
+def test_bare_input_uses_operator_input_key():
+    out = asyncio.run(run_operator(_SumOp(), [jnp.ones(3), jnp.ones(3)]))
+    np.testing.assert_array_equal(np.asarray(out), np.full(3, 2.0))
+
+
+def test_mapping_input_and_no_input_key_error():
+    out = asyncio.run(run_operator(_NoKeyOp(), {"a": 1.0, "b": 2.0}))
+    assert float(out) == 3.0
+    with pytest.raises(ValueError, match="input_key"):
+        asyncio.run(run_operator(_NoKeyOp(), 1.0))
+
+
+def test_executor_reuse_caches_graph():
+    ex = OperatorExecutor(_SumOp())
+    try:
+        out1 = asyncio.run(ex.run([jnp.ones(2)]))
+        assert len(ex._graph_cache) == 1
+        out2 = asyncio.run(ex.run([jnp.ones(2) * 3]))
+        assert len(ex._graph_cache) == 1  # same input-name set -> one graph
+        np.testing.assert_array_equal(np.asarray(out1), np.ones(2))
+        np.testing.assert_array_equal(np.asarray(out2), np.full(2, 3.0))
+        # a different input-name set builds (and caches) a second graph
+        ex2 = OperatorExecutor(_NoKeyOp())
+        asyncio.run(ex2.run({"a": 1.0, "b": 2.0}))
+        asyncio.run(ex2.run({"b": 5.0, "a": 1.0}))  # order-insensitive key
+        assert len(ex2._graph_cache) == 1
+    finally:
+        asyncio.run(ex.close())
+
+
+def test_executor_owns_pool_only_from_config():
+    async def main():
+        ex = OperatorExecutor(
+            _SumOp(), pool_config=ActorPoolConfig(backend="thread", count=1)
+        )
+        assert ex._owns_pool
+        out = await ex.run([jnp.ones(2), jnp.ones(2)])
+        assert ex._pool is not None
+        await ex.close()
+        assert ex._pool is None  # owned pool torn down
+        return out
+
+    out = asyncio.run(main())
+    np.testing.assert_array_equal(np.asarray(out), np.full(2, 2.0))
+
+
+def test_executor_borrowed_pool_not_closed():
+    from byzpy_tpu.engine.graph import ActorPool
+
+    async def main():
+        pool = ActorPool(ActorPoolConfig(backend="thread", count=1))
+        await pool.start()
+        try:
+            ex = OperatorExecutor(_SumOp(), pool=pool)
+            assert not ex._owns_pool
+            await ex.run([jnp.ones(2)])
+            await ex.close()
+            # borrowed pool must still be usable
+            ex2 = OperatorExecutor(_SumOp(), pool=pool)
+            out = await ex2.run([jnp.ones(2) * 4])
+            await ex2.close()
+            return out
+        finally:
+            await pool.close()
+
+    out = asyncio.run(main())
+    np.testing.assert_array_equal(np.asarray(out), np.full(2, 4.0))
+
+
+def test_missing_graph_input_raises():
+    with pytest.raises(Exception):
+        asyncio.run(run_operator(_SumOp(), {"wrong_key": [jnp.ones(2)]}))
