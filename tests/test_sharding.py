@@ -84,3 +84,38 @@ def test_selection_kernel_skipped_for_sharded_inputs(mesh, monkeypatch):
     # thing standing between the two paths)
     with pytest.raises(Exception):
         robust.multi_krum(jax.random.normal(jax.random.PRNGKey(1), (23, 1152)), f=3, q=5)
+
+
+def test_all_fused_dispatchers_skip_sharded_inputs(mesh, monkeypatch):
+    """Every kernel dispatcher added in round 3 (sorted-reduce median /
+    trimmed mean, MeaMed, NNM, Weiszfeld/clip steps) must leave sharded
+    operands on the XLA path — same GSPMD-opacity rationale as the
+    selection kernels."""
+    import byzpy_tpu.ops.pallas_kernels as pk
+
+    def boom(*a, **k):
+        raise AssertionError("fused kernel dispatched for sharded input")
+
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+    for name in (
+        "sorted_reduce_stream_pallas",
+        "meamed_stream_pallas",
+        "nnm_stream_pallas",
+        "weighted_center_step_pallas",
+    ):
+        monkeypatch.setattr(pk, name, boom)
+    # unique shape per op: jit caches don't key on the monkeypatch
+    x = jax.random.normal(jax.random.PRNGKey(1), (21, 1408), jnp.float32)
+    xs = _sharded(mesh, x)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(robust.coordinate_median)(xs)),
+        np.asarray(jnp.median(x, axis=0)), rtol=1e-6,
+    )
+    got = jax.jit(lambda a: robust.trimmed_mean(a, f=4))(xs)
+    s = np.sort(np.asarray(x), axis=0)
+    np.testing.assert_allclose(np.asarray(got), s[4:-4].mean(0), rtol=1e-5,
+                               atol=1e-6)
+    jax.jit(lambda a: robust.mean_of_medians(a, f=4))(xs)  # no boom
+    jax.jit(lambda a: preagg.nnm(a, f=4))(xs)  # no boom
+    jax.jit(lambda a: robust.geometric_median(a, max_iter=4))(xs)  # no boom
+    jax.jit(lambda a: robust.centered_clipping(a, c_tau=1.0, M=2))(xs)  # no boom
