@@ -35,6 +35,34 @@ class PreAggregator(Operator, ABC):
         out = self._transform_matrix(matrix)
         return unstack_rows(out, unravel)
 
+    def pre_aggregate_stream(
+        self, rounds: Sequence[Sequence[Any]]
+    ) -> List[List[Any]]:
+        """Pre-aggregate ``K`` buffered rounds in ONE device dispatch
+        (mirror of ``Aggregator.aggregate_stream``): subclasses whose
+        transform has a fused stream kernel (NNM) override
+        ``_transform_stream_matrix``; the default scans the per-round
+        transform."""
+        if not rounds:
+            return []
+        stacked = []
+        unravel = None
+        for xs in rounds:
+            matrix, unravel = stack_gradients(xs)
+            self.validate_n(matrix.shape[0])
+            stacked.append(matrix)
+        ys = self._transform_stream_matrix(jnp.stack(stacked))
+        return [unstack_rows(ys[i], unravel) for i in range(ys.shape[0])]
+
+    def _transform_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        from jax import lax
+
+        def body(carry, xi):
+            return carry, self._transform_matrix(xi)
+
+        _, ys = lax.scan(body, None, xs)
+        return ys
+
     def validate_n(self, n: int) -> None:
         """Hook for subclasses to validate hyperparameters against n."""
 

@@ -28,5 +28,13 @@ class NearestNeighborMixing(PreAggregator):
     def _transform_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return preagg.nnm(x, f=self.f)
 
+    def _transform_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
+        from ..ops.pallas_kernels import nnm_stream_pallas
+        from ..ops.robust import _use_stream_kernel
+
+        if _use_stream_kernel(xs):
+            return nnm_stream_pallas(xs, f=self.f)
+        return super()._transform_stream_matrix(xs)
+
 
 __all__ = ["NearestNeighborMixing"]

@@ -69,3 +69,29 @@ def test_arc_f0_identity():
     x = randx(5, 6, seed=4)
     got = np.asarray(preagg.arc_clip(jnp.asarray(x), f=0))
     np.testing.assert_allclose(got, x, rtol=1e-6, atol=1e-6)
+
+
+def test_preagg_stream_class_api_matches_per_round():
+    """K buffered rounds through PreAggregator.pre_aggregate_stream must
+    equal per-round pre_aggregate() calls (NNM has a fused stream
+    override; Clipping uses the default scan)."""
+    import jax.numpy as jnp
+
+    from byzpy_tpu.pre_aggregators import Clipping, NearestNeighborMixing
+
+    rng = np.random.default_rng(12)
+    rounds = [
+        [jnp.asarray(rng.normal(size=(24,)).astype(np.float32)) for _ in range(7)]
+        for _ in range(3)
+    ]
+    for pre in (NearestNeighborMixing(f=2), Clipping(threshold=1.5)):
+        got = pre.pre_aggregate_stream(rounds)
+        assert len(got) == 3
+        for k in range(3):
+            want = pre.pre_aggregate(rounds[k])
+            assert len(got[k]) == len(want)
+            for a, b in zip(got[k], want):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+                )
+    assert NearestNeighborMixing(f=1).pre_aggregate_stream([]) == []
