@@ -148,3 +148,88 @@ def test_fuzz_weighted_center_step(seed):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4, equal_nan=True
     )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_mda_matches_bruteforce(seed):
+    """MDA's branch-and-bound + greedy-peel incumbent vs exhaustive
+    enumeration on small instances (diameter ties broken identically:
+    first subset in combination order)."""
+    import itertools
+
+    from byzpy_tpu.aggregators import MinimumDiameterAveraging
+
+    rng = np.random.default_rng(7000 + seed)
+    n = int(rng.integers(6, 11))
+    f = int(rng.integers(1, (n - 1) // 2 + 1))
+    m = n - f
+    x = rng.normal(size=(n, 12)).astype(np.float32)
+    grads = [jnp.asarray(r) for r in x]
+    got = np.asarray(MinimumDiameterAveraging(f=f).aggregate(grads))
+    # oracle uses the implementation's own metric (f32 Gram-trick
+    # distances): a direct-difference f64 metric can crown a different
+    # winner on near-ties, which is a float-representation disagreement,
+    # not an algorithmic one
+    gram = x @ x.T
+    nrm = np.diagonal(gram)
+    d2 = np.maximum(nrm[:, None] + nrm[None, :] - 2.0 * gram, 0.0)
+    combos = list(itertools.combinations(range(n), m))
+    diams = np.array([d2[np.ix_(np.array(c), np.array(c))].max() for c in combos])
+    best_diam = diams.min()
+    # the branch-and-bound may return ANY minimum-diameter subset (ties
+    # are not broken by enumeration order); accept every tied winner
+    winners = [
+        x[list(c)].mean(0)
+        for c, dm in zip(combos, diams)
+        if dm <= best_diam * (1 + 1e-6) + 1e-9
+    ]
+    assert any(
+        np.allclose(got, w, rtol=1e-4, atol=1e-5) for w in winners
+    ), (best_diam, len(winners))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_random_dag_schedulers_agree(seed):
+    """Property: ParallelScheduler and the sequential NodeScheduler give
+    identical results on random DAGs of arithmetic ops."""
+    import asyncio
+
+    from byzpy_tpu.engine.graph.graph import (
+        ComputationGraph,
+        GraphInput,
+        GraphNode,
+    )
+    from byzpy_tpu.engine.graph.ops import CallableOp
+    from byzpy_tpu.engine.graph.parallel_scheduler import ParallelScheduler
+    from byzpy_tpu.engine.graph.scheduler import NodeScheduler
+
+    rng = np.random.default_rng(8000 + seed)
+    n_nodes = int(rng.integers(3, 9))
+    nodes = []
+    names = []
+    for i in range(n_nodes):
+        # each node consumes the graph input and up to 2 earlier nodes
+        deps = {"x": GraphInput("x")}
+        if names:
+            for j, nm in enumerate(
+                rng.choice(names, size=min(len(names), int(rng.integers(0, 3))),
+                           replace=False)
+            ):
+                deps[f"d{j}"] = str(nm)
+        coefs = rng.normal(size=len(deps))
+
+        def fn(_coefs=coefs, **kw):
+            vals = [kw[k] for k in sorted(kw)]
+            return sum(float(c) * v for c, v in zip(_coefs, vals))
+
+        name = f"n{i}"
+        nodes.append(GraphNode(name=name, op=CallableOp(fn), inputs=deps))
+        names.append(name)
+    graph = ComputationGraph(nodes)
+    inputs = {"x": jnp.asarray(rng.normal(size=(16,)).astype(np.float32))}
+    seq = asyncio.run(NodeScheduler(graph).run(inputs))
+    par = asyncio.run(ParallelScheduler(ComputationGraph(nodes)).run(inputs))
+    for k in seq:
+        np.testing.assert_allclose(
+            np.asarray(seq[k]), np.asarray(par[k]), rtol=1e-6, atol=1e-6
+        )
