@@ -233,3 +233,23 @@ def test_fuzz_random_dag_schedulers_agree(seed):
         np.testing.assert_allclose(
             np.asarray(seq[k]), np.asarray(par[k]), rtol=1e-6, atol=1e-6
         )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_caf_downweights_outliers(seed):
+    """Property: with f large outliers, CAF's output stays near the
+    honest mean (closer than the naive mean is) and finite."""
+    rng = np.random.default_rng(9000 + seed)
+    n = int(rng.integers(10, 20))
+    f = max(1, n // 5)
+    d = int(rng.integers(16, 64))
+    honest = rng.normal(size=(n - f, d)).astype(np.float32)
+    outliers = (rng.normal(size=(f, d)) * 100 + 500).astype(np.float32)
+    x = np.concatenate([honest, outliers])
+    out = np.asarray(robust.caf(jnp.asarray(x), f=f))
+    assert np.isfinite(out).all()
+    honest_mean = honest.mean(0)
+    naive_mean = x.mean(0)
+    assert np.linalg.norm(out - honest_mean) < 0.5 * np.linalg.norm(
+        naive_mean - honest_mean
+    )
