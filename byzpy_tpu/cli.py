@@ -145,6 +145,48 @@ def cmd_list(args: argparse.Namespace) -> int:
     return 0
 
 
+def bench_report(*, n: int = 16, d: int = 65_536, repeat: int = 10) -> Dict[str, Any]:
+    """Quick on-device micro-benchmark of the hot aggregators (one JSON
+    row per op, milliseconds per call) — the sanity companion to
+    ``doctor``: is this device delivering the expected order of
+    magnitude? Full methodology and the measured grid live in
+    ``benchmarks/`` (this uses the same chained-timing helper)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ops import robust
+    from .utils.metrics import timed_call_s
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
+    rows: Dict[str, Any] = {
+        "device": str(jax.devices()[0]),
+        "shape": [n, d],
+        "repeat": repeat,
+    }
+    from functools import partial
+
+    f = max(1, n // 8)
+    ops = {
+        "coordinate_median": robust.coordinate_median,
+        "trimmed_mean": partial(robust.trimmed_mean, f=f),
+        "multi_krum": partial(robust.multi_krum, f=f, q=max(1, n // 4)),
+        "geometric_median": partial(robust.geometric_median, max_iter=32),
+    }
+    for name, fn in ops.items():
+        try:
+            ms = timed_call_s(jax.jit(fn), x, warmup=2, repeat=repeat) * 1e3
+            rows[name] = {"ms": round(ms, 3)}
+        except Exception as exc:  # noqa: BLE001 — report, don't crash bench
+            rows[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    return rows
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    report = bench_report(n=args.nodes, d=args.dim, repeat=args.repeat)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="byzpy-tpu",
@@ -164,6 +206,14 @@ def build_parser() -> argparse.ArgumentParser:
         "kind", choices=("aggregators", "attacks", "pre-aggregators")
     )
     p_list.set_defaults(fn=cmd_list)
+
+    p_bench = sub.add_parser(
+        "bench", help="quick on-device micro-benchmark of the hot aggregators"
+    )
+    p_bench.add_argument("--nodes", type=int, default=16)
+    p_bench.add_argument("--dim", type=int, default=65_536)
+    p_bench.add_argument("--repeat", type=int, default=10)
+    p_bench.set_defaults(fn=cmd_bench)
 
     return parser
 

@@ -130,3 +130,18 @@ def test_doctor_device_probe_times_out_instead_of_hanging(monkeypatch):
 
     with pytest.raises(RuntimeError, match="boom"):
         cli._devices_with_timeout(ErrJax)
+
+
+def test_cli_bench_runs_and_reports(capsys):
+    from byzpy_tpu.cli import main
+
+    rc = main(["bench", "--nodes", "8", "--dim", "1024", "--repeat", "2"])
+    assert rc == 0
+    import json
+
+    report = json.loads(capsys.readouterr().out)
+    assert report["shape"] == [8, 1024]
+    for op in ("coordinate_median", "trimmed_mean", "multi_krum",
+               "geometric_median"):
+        assert "ms" in report[op], report[op]
+        assert report[op]["ms"] > 0
