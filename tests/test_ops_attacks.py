@@ -1,6 +1,7 @@
 import math
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from scipy_stub import ndtri_oracle  # noqa: F401  (defined below if scipy absent)
@@ -76,3 +77,38 @@ def test_label_flip_grad():
     ident = jnp.asarray(np.arange(3))
     g_ident = attack_ops.label_flip_grad(grad_fn, w, x, y, mapping=ident)
     np.testing.assert_allclose(np.asarray(g_ident), np.asarray(g_true), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_attack_ops_match_numpy_oracles(seed):
+    """Seeded fuzz: empire / little / mimic / sign_flip against float64
+    numpy oracles across random shapes, scales, and hyper-parameters."""
+    rng = np.random.default_rng(6000 + seed)
+    n = int(rng.integers(4, 20))
+    d = int(rng.integers(8, 200))
+    h64 = rng.normal(size=(n, d)) * 10.0 ** float(rng.integers(-2, 3))
+    h = jnp.asarray(h64.astype(np.float32))
+    scale = float(rng.uniform(-3.0, 3.0))
+    np.testing.assert_allclose(
+        np.asarray(attack_ops.empire(h, scale=scale)),
+        scale * h64.mean(0), rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(attack_ops.sign_flip(h[0], scale=scale)),
+        scale * h64[0], rtol=1e-5, atol=1e-6,
+    )
+    eps = int(rng.integers(0, n))
+    np.testing.assert_array_equal(
+        np.asarray(attack_ops.mimic(h, epsilon=eps)), np.asarray(h[eps])
+    )
+    n_total = n + int(rng.integers(1, 6))
+    f = int(rng.integers(1, n_total // 2 + 1))
+    got = np.asarray(attack_ops.little(h, f=f, n_total=n_total))
+    s = n_total // 2 + 1 - f
+    p = min(max((n_total - s) / n_total, 1e-12), 1 - 1e-12)
+    from statistics import NormalDist
+
+    z = NormalDist().inv_cdf(p)
+    mu = h64.mean(0)
+    sigma = np.sqrt(((h64 - mu) ** 2).mean(0))
+    np.testing.assert_allclose(got, mu + z * sigma, rtol=1e-3, atol=1e-3)
