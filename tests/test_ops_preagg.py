@@ -95,3 +95,40 @@ def test_preagg_stream_class_api_matches_per_round():
                     np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
                 )
     assert NearestNeighborMixing(f=1).pre_aggregate_stream([]) == []
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_preagg_ops_match_numpy_oracles(seed):
+    """Seeded fuzz: clip_rows / bucket_means / arc_clip against float64
+    numpy oracles across random shapes and hyper-parameters."""
+    import math as _math
+
+    rng = np.random.default_rng(6500 + seed)
+    n = int(rng.integers(4, 24))
+    d = int(rng.integers(8, 120))
+    x64 = rng.normal(size=(n, d)) * 10.0 ** float(rng.integers(-1, 3))
+    x = jnp.asarray(x64.astype(np.float32))
+
+    tau = float(rng.uniform(0.1, 50.0))
+    norms = np.sqrt((x64 ** 2).sum(1))
+    want = x64 * np.minimum(1.0, tau / np.maximum(norms, 1e-12))[:, None]
+    np.testing.assert_allclose(
+        np.asarray(preagg.clip_rows(x, threshold=tau)), want, rtol=1e-4,
+        atol=1e-4,
+    )
+
+    b = int(rng.integers(1, n + 1))
+    perm = rng.permutation(n)
+    got = np.asarray(preagg.bucket_means(x, jnp.asarray(perm), bucket_size=b))
+    xp = x64[perm]
+    nb = _math.ceil(n / b)
+    want = np.stack([xp[i * b : (i + 1) * b].mean(0) for i in range(nb)])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    f = int(rng.integers(0, n + 1))
+    got = np.asarray(preagg.arc_clip(x, f=f))
+    nb_clipped = min(max(int(_math.floor((2.0 * f / n) * (n - f))), 0), n - 1)
+    cut_off = n - nb_clipped
+    thr = np.sort(norms)[max(0, cut_off - 1)]
+    want = x64 * np.minimum(1.0, thr / np.maximum(norms, 1e-12))[:, None]
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
