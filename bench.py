@@ -37,6 +37,29 @@ def grads(key, n, d, dtype=jnp.float32):
 
 
 def main() -> None:
+    # Bounded device probe first: a dead accelerator tunnel otherwise hangs
+    # the whole bench. On failure, emit an honest machine-readable line
+    # (value null, the outage named, and the last committed measurement
+    # for context — benchmarks/RESULTS.md has the full methodology).
+    from byzpy_tpu.cli import _devices_with_timeout
+
+    try:
+        _devices_with_timeout(jax, timeout_s=60.0)
+    except Exception as exc:  # noqa: BLE001 — report, don't crash
+        print(json.dumps({
+            "metric": "multi_krum_64x1M_stream_grads_per_sec",
+            "value": None,
+            "unit": "grads/sec",
+            "vs_baseline": None,
+            "error": f"device unavailable: {type(exc).__name__}: {exc}",
+            "last_measured_in_session": {
+                "value": 81191.54, "bf16": 148127.33, "stream_K": 32,
+                "provenance": "benchmarks/results/overrides.jsonl "
+                              "(committed before the tunnel outage)",
+            },
+        }))
+        return
+
     key = jax.random.PRNGKey(0)
 
     # Headline: Krum at 1M-dim (north-star config), measured as a stream of
