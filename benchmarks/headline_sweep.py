@@ -10,7 +10,9 @@ isolates what the round-2 streamed headline (40.7k) was losing to:
 * f32 vs bf16 input (halves both passes' traffic);
 * the d2-sort/rank tail (measured via krum_scores alone).
 
-Usage: python benchmarks/headline_sweep.py [--K 8] [--repeat 30]
+Usage: python benchmarks/headline_sweep.py [--K 8] [--repeat 15]
+(~6-8 min at the defaults through the tunnel; the scan-of-kernel rows
+dominate — budget 10+ min before assuming a hang)
 """
 
 import argparse
@@ -34,7 +36,7 @@ from byzpy_tpu.utils.metrics import timed_call_s
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--K", type=int, default=8)
-    ap.add_argument("--repeat", type=int, default=30)
+    ap.add_argument("--repeat", type=int, default=15)
     ap.add_argument("--n", type=int, default=64)
     ap.add_argument("--d", type=int, default=1_048_576)
     args = ap.parse_args()
