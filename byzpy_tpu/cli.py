@@ -157,9 +157,13 @@ def bench_report(*, n: int = 16, d: int = 65_536, repeat: int = 10) -> Dict[str,
     from .ops import robust
     from .utils.metrics import timed_call_s
 
+    try:
+        devices = _devices_with_timeout(jax)
+    except Exception as exc:  # noqa: BLE001 — report, don't hang/crash bench
+        return {"error": f"device probe failed: {type(exc).__name__}: {exc}"}
     x = jax.random.normal(jax.random.PRNGKey(0), (n, d), jnp.float32)
     rows: Dict[str, Any] = {
-        "device": str(jax.devices()[0]),
+        "device": str(devices[0]),
         "shape": [n, d],
         "repeat": repeat,
     }

@@ -1054,18 +1054,35 @@ def sharding_allows_pallas(x: Array) -> bool:
     try:
         sharding = jax.typeof(x).sharding
         mesh = sharding.mesh
-        if getattr(mesh, "size", 1) <= 1:
-            return True
-        from jax.sharding import AxisType
-
-        axis_types = set(getattr(mesh, "axis_types", ()))
-        if axis_types == {AxisType.Manual}:
-            return True
-        if AxisType.Auto in axis_types:
-            return False
-        return all(p is None for p in sharding.spec)
+    except (AttributeError, TypeError):
+        # The known no-sharding-info shapes: eager arrays / older tracers
+        # where jax.typeof has no .sharding/.mesh. These are per-device
+        # values, safe for a pallas_call.
+        return True
     except Exception:
-        return True  # no sharding info (eager CPU arrays, older tracers)
+        sharding = mesh = None  # unknown failure: fall through to guard
+    try:
+        if mesh is not None:
+            if getattr(mesh, "size", 1) <= 1:
+                return True
+            from jax.sharding import AxisType
+
+            axis_types = set(getattr(mesh, "axis_types", ()))
+            if axis_types == {AxisType.Manual}:
+                return True
+            if AxisType.Auto in axis_types:
+                return False
+            return all(p is None for p in sharding.spec)
+    except Exception:
+        pass
+    # Unknown introspection failure past the typeof access: a genuinely
+    # device-sharded operand must NOT silently take the pallas path (it
+    # would force a full all-gather), so on a multi-device backend stay
+    # on XLA.
+    try:
+        return len(jax.devices()) <= 1
+    except Exception:
+        return True
 
 
 def use_pallas_for(n: int, d: int) -> bool:

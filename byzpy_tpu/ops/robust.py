@@ -613,6 +613,15 @@ def subset_max_eigvals_jacobi(gram: Array, combos: Array, *, sweeps: int = 8) ->
     m = combos.shape[1]
     acc = jnp.float32 if gram.dtype in (jnp.bfloat16, jnp.float16) else gram.dtype
     sub = gram[combos[:, :, None], combos[:, None, :]].astype(acc)  # (c, m, m)
+    if m < 2:
+        # The centered 1x1 (or empty) Gram is identically zero — no
+        # rotation schedule exists, and building one would index an empty
+        # pair array. Non-finite singleton rows still score +inf.
+        zeros = jnp.zeros((combos.shape[0],), dtype=gram.dtype)
+        if m == 0:
+            return zeros
+        bad1 = ~jnp.isfinite(sub[:, 0, 0])
+        return jnp.where(bad1, jnp.inf, zeros).astype(gram.dtype)
     h = jnp.eye(m, dtype=acc) - jnp.full((m, m), 1.0 / m, dtype=acc)
     a = h @ sub @ h
     bad = ~jnp.all(jnp.isfinite(a), axis=(1, 2))

@@ -50,6 +50,31 @@ def test_nnm():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+def test_nnm_nonfinite_rule_documented():
+    """Pin the documented deviation (PARITY.md "Documented deviations"):
+    a mixed row whose k-nearest selection includes a non-finite neighbor
+    becomes ALL-NaN — not coordinate-wise NaN / preserved ±inf as the
+    reference's gather would give. Rows whose selection stays finite must
+    be exactly the finite-neighborhood mean.
+
+    Construction: one tainted row sorts last for every row (inf/NaN
+    distance), so at f=1 each finite row selects exactly the 7 finite
+    rows (one shared, unambiguous mean) while the tainted row itself
+    goes NaN; at f=0 every selection includes the taint -> all NaN."""
+    x = randx(8, 6, seed=7)
+    x[1, 3] = np.inf  # tainted row (non-finite squared norm)
+    finite_mean = np.delete(x, 1, axis=0).mean(0)
+
+    got = np.asarray(preagg.nnm(jnp.asarray(x), f=1))
+    assert np.isnan(got[1]).all(), "tainted row must be all-NaN"
+    for i in (0, 2, 3, 4, 5, 6, 7):
+        assert np.isfinite(got[i]).all(), f"row {i} selection is finite"
+        np.testing.assert_allclose(got[i], finite_mean, rtol=1e-4, atol=1e-5)
+
+    got0 = np.asarray(preagg.nnm(jnp.asarray(x), f=0))
+    assert np.isnan(got0).all(), "f=0: every selection includes the taint"
+
+
 def test_arc_clip():
     x = randx(10, 9, seed=3)
     x[7] *= 30  # large-norm outlier must get clipped

@@ -294,6 +294,27 @@ def test_subset_max_eigvals_jacobi_nonfinite_scores_inf():
     assert np.isfinite(got[~touch]).all()
 
 
+def test_subset_max_eigvals_jacobi_singleton_subsets():
+    """m=1 regression (round-3 advisor, medium): the empty rotation
+    schedule used to IndexError. A centered 1x1 Gram scores 0, except
+    non-finite singletons which still score +inf — matching both the
+    LAPACK path and SMEA(f=0) at n=1."""
+    gram = np.array([[4.0, 0.0], [0.0, np.inf]], np.float32)
+    combos = np.array([[0], [1]], np.int32)
+    got = np.asarray(
+        robust.subset_max_eigvals_jacobi(jnp.asarray(gram), jnp.asarray(combos))
+    )
+    assert got[0] == 0.0
+    assert np.isinf(got[1])
+    # m=1 must agree with the eigvalsh path on finite input
+    finite = np.asarray(
+        robust.subset_max_eigvals(
+            jnp.asarray(np.array([[4.0]], np.float32)), jnp.asarray([[0]], np.int32)
+        )
+    )
+    assert finite[0] == 0.0
+
+
 def test_subset_max_eigvals_jacobi_equal_diagonal_rotation():
     """app == aqq (tau = 0) needs a 45-degree rotation, not the identity:
     a 2x2 constant-diagonal matrix only diagonalizes through that path."""
