@@ -24,6 +24,8 @@ from ..utils.trees import stack_gradients
 
 
 class Aggregator(Operator, ABC):
+    """Robust gradient aggregator ABC: subclasses map an (n, d) stack of per-node gradients to one (d,) vector via ``aggregate`` / ``aggregate_stream``, and schedule on graphs/pools as Operators."""
+
     name = "aggregator"
     input_key = "gradients"
 

@@ -17,6 +17,7 @@ def _meamed_chunk(chunk: np.ndarray, *, f: int) -> jnp.ndarray:
 
 
 class MeanOfMedians(FeatureChunkedAggregator, Aggregator):
+    """MeaMed: per coordinate, average the n - f values closest to the median."""
     name = "mean-of-medians"
     _chunk_fn = staticmethod(_meamed_chunk)
 

@@ -21,6 +21,7 @@ def _median_chunk(chunk: np.ndarray) -> jnp.ndarray:
 
 
 class CoordinateWiseMedian(FeatureChunkedAggregator, Aggregator):
+    """Per-coordinate median over the node axis."""
     name = "coordinate-wise-median"
     _chunk_fn = staticmethod(_median_chunk)
 

@@ -17,6 +17,7 @@ def _trimmed_mean_chunk(chunk: np.ndarray, *, f: int) -> jnp.ndarray:
 
 
 class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
+    """Drop the f largest and f smallest values per coordinate, average the rest."""
     name = "coordinate-wise-trimmed-mean"
     _chunk_fn = staticmethod(_trimmed_mean_chunk)
 

@@ -23,6 +23,7 @@ from ..chunked import BarrieredIterativeAggregator, _weiszfeld_chunk
 
 
 class GeometricMedian(BarrieredIterativeAggregator, Aggregator):
+    """Weiszfeld-iterated geometric median of the gradient rows."""
     name = "geometric-median"
     _barrier_chunk_fn = staticmethod(_weiszfeld_chunk)
 

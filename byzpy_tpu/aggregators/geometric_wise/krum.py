@@ -41,6 +41,7 @@ def _krum_score_rows(host: np.ndarray, start: int, end: int, *, f: int) -> jnp.n
 
 
 class MultiKrum(RowScoredAggregator, Aggregator):
+    """Average the q rows with the best Krum scores (sum of distances to each row's n - f - 1 nearest neighbors)."""
     name = "multi-krum"
     _score_fn = staticmethod(_krum_score_rows)
 

@@ -197,6 +197,7 @@ def _search_seed_group(
 
 
 class MinimumDiameterAveraging(Aggregator):
+    """Average of the (n - f)-subset with the smallest pairwise diameter, found by branch-and-bound over the device-computed distance matrix."""
     name = "minimum-diameter-averaging"
     supports_subtasks = True
 

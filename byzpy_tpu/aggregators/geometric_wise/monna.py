@@ -18,6 +18,7 @@ def _monna_dist_rows(host: np.ndarray, start: int, end: int, *, reference_index:
 
 
 class MoNNA(RowScoredAggregator, Aggregator):
+    """Mean of the n - f nearest neighbors of a trusted pivot row."""
     name = "monna"
     _score_fn = staticmethod(_monna_dist_rows)
 

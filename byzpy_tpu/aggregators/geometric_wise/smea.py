@@ -98,6 +98,7 @@ def _score_combo_range_smea(
 
 
 class SMEA(Aggregator):
+    """Smallest-Maximum-Eigenvalue Averaging: average the (n - f)-subset whose centered Gram has the smallest top eigenvalue (batched-Jacobi scoring on device)."""
     name = "smea"
     supports_subtasks = True
 

@@ -16,6 +16,7 @@ from ..base import Aggregator
 
 
 class CAF(Aggregator):
+    """Covariance-bound Adaptive Filter: iteratively downweights rows along the top covariance eigendirection until the spectral bound holds."""
     name = "caf"
 
     def __init__(self, f: int, *, power_iters: int = 3, seed: int = 0) -> None:

@@ -19,6 +19,7 @@ from ..chunked import BarrieredIterativeAggregator, _centered_clip_chunk
 
 
 class CenteredClipping(BarrieredIterativeAggregator, Aggregator):
+    """Iterative momentum-centered clipping: clip each row to a radius around the running center, then re-center."""
     name = "centered-clipping"
     _barrier_chunk_fn = staticmethod(_centered_clip_chunk)
 

@@ -19,6 +19,7 @@ def _sq_norm_rows(host: np.ndarray, start: int, end: int) -> jnp.ndarray:
 
 
 class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
+    """CGE: drop the f largest-norm rows and average the rest."""
     name = "comparative-gradient-elimination"
     _score_fn = staticmethod(_sq_norm_rows)
 

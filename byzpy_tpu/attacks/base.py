@@ -21,6 +21,8 @@ from ..engine.graph.operator import OpContext, Operator
 
 
 class Attack(Operator, ABC):
+    """Byzantine attack ABC: ``apply`` builds the malicious gradient from whatever the needs-flags request (model/batch, base grad, honest grads)."""
+
     uses_base_grad: bool = False
     uses_model_batch: bool = False
     uses_honest_grads: bool = False

@@ -12,6 +12,7 @@ from .chunked import FeatureChunkedAttack, _empire_chunk
 
 
 class EmpireAttack(FeatureChunkedAttack, Attack):
+    """Send ``scale * mean(honest)`` — inner-product manipulation of the average."""
     name = "empire"
     uses_honest_grads = True
     _chunk_fn = staticmethod(_empire_chunk)

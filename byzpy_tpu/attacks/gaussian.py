@@ -17,6 +17,7 @@ from .chunked import FeatureChunkedAttack, _gaussian_chunk
 
 
 class GaussianAttack(FeatureChunkedAttack, Attack):
+    """Send IID Gaussian noise in place of a gradient."""
     name = "gaussian"
     uses_honest_grads = True
     _chunk_fn = staticmethod(_gaussian_chunk)

@@ -12,6 +12,7 @@ from .chunked import FeatureChunkedAttack, _inf_chunk
 
 
 class InfAttack(FeatureChunkedAttack, Attack):
+    """Send a ``+inf``-filled vector (crash-the-mean probe)."""
     name = "inf"
     uses_honest_grads = True
     _chunk_fn = staticmethod(_inf_chunk)

@@ -17,6 +17,7 @@ from .base import Attack
 
 
 class LabelFlipAttack(Attack):
+    """Train on flipped labels and send the resulting (poisoned) gradient."""
     name = "label-flip"
     uses_model_batch = True
 

@@ -15,6 +15,7 @@ from .chunked import FeatureChunkedAttack, _little_chunk
 
 
 class LittleAttack(FeatureChunkedAttack, Attack):
+    """'A Little Is Enough' (Baruch et al. 2019): shift the mean by z_max standard deviations per coordinate, staying inside the honest spread."""
     name = "little"
     uses_honest_grads = True
     _chunk_fn = staticmethod(_little_chunk)

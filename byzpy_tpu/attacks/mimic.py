@@ -12,6 +12,7 @@ from .chunked import FeatureChunkedAttack, _mimic_chunk
 
 
 class MimicAttack(FeatureChunkedAttack, Attack):
+    """Copy one honest worker's gradient (breaks uniqueness assumptions without being an outlier)."""
     name = "mimic"
     uses_honest_grads = True
     _chunk_fn = staticmethod(_mimic_chunk)

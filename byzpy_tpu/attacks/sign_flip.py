@@ -13,6 +13,7 @@ from .chunked import BaseGradChunkedAttack, _sign_flip_chunk
 
 
 class SignFlipAttack(BaseGradChunkedAttack, Attack):
+    """Send ``scale * base_grad`` — the scaled-negated true gradient."""
     name = "sign-flip"
     uses_base_grad = True
     _chunk_fn = staticmethod(_sign_flip_chunk)

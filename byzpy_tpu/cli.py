@@ -47,6 +47,7 @@ def _collect(kind: str) -> List[Type]:
 
 
 def cmd_version(_args: argparse.Namespace) -> int:
+    """``byzpy-tpu version``: print the package version."""
     print(__version__)
     return 0
 
@@ -129,6 +130,7 @@ def doctor_report() -> Dict[str, Any]:
 
 
 def cmd_doctor(args: argparse.Namespace) -> int:
+    """``byzpy-tpu doctor``: print the environment probe (text or json)."""
     report = doctor_report()
     if args.format == "json":
         print(json.dumps(report, indent=2, sort_keys=True))
@@ -139,6 +141,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
 
 
 def cmd_list(args: argparse.Namespace) -> int:
+    """``byzpy-tpu list``: enumerate registered aggregators/attacks/pre-aggregators."""
     for cls in _collect(args.kind):
         name = getattr(cls, "name", None) or cls.__name__
         print(f"{cls.__name__}\t({name})")
@@ -186,12 +189,14 @@ def bench_report(*, n: int = 16, d: int = 65_536, repeat: int = 10) -> Dict[str,
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
+    """``byzpy-tpu bench``: print the on-device micro-benchmark as JSON."""
     report = bench_report(n=args.nodes, d=args.dim, repeat=args.repeat)
     print(json.dumps(report, indent=2, sort_keys=True))
     return 0
 
 
 def build_parser() -> argparse.ArgumentParser:
+    """Assemble the ``byzpy-tpu`` argument parser (one subcommand per cmd_*)."""
     parser = argparse.ArgumentParser(
         prog="byzpy-tpu",
         description="TPU-native Byzantine-robust distributed learning framework",
@@ -223,6 +228,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: List[str] | None = None) -> int:
+    """Console entry point (``byzpy-tpu`` in pyproject scripts)."""
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -44,6 +44,7 @@ def _validate(spec: str) -> None:
 
 
 def get_actor() -> str:
+    """Current default actor-backend spec string (see ``set_actor``)."""
     return _actor_spec
 
 

@@ -116,6 +116,7 @@ async def _worker_loop(conn) -> None:  # pragma: no cover - runs in child proces
 
 
 class ProcessActorBackend:
+    """Subprocess backend: one spawned process per actor, cloudpickle frames over a pipe with request-id correlation."""
     scheme = "process"
 
     def __init__(

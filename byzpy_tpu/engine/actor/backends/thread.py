@@ -24,6 +24,7 @@ _counter = itertools.count()
 
 
 class ThreadActorBackend:
+    """In-process backend: each actor is a daemon thread draining a mailbox queue."""
     scheme = "thread"
 
     def __init__(self, *, actor_id: str | None = None) -> None:

@@ -31,6 +31,7 @@ _counter = itertools.count()
 
 
 class TpuActorBackend:
+    """Device-pinned backend: one actor per TPU chip; construct/call run with inputs committed to that actor's device and channel payloads pass by reference."""
     scheme = "tpu"
 
     def __init__(

@@ -45,6 +45,7 @@ class ChannelRef:
 
 
 async def open_channel(backend: "ActorBackend", name: str) -> ChannelRef:
+    """Open (or attach to) the named channel on ``backend`` and wrap it as a :class:`ChannelRef`."""
     await backend.chan_open(name)
     return ChannelRef(backend, name)
 

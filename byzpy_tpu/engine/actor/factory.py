@@ -20,6 +20,7 @@ from .backends.tpu import TpuActorBackend
 
 
 def resolve_backend(spec: str = "thread", **kwargs: Any):
+    """Build an actor backend from a spec string: ``thread``, ``process``, ``tpu[:N]``, or ``tcp://host:port``."""
     if not isinstance(spec, str) or not spec:
         raise ValueError(f"invalid backend spec {spec!r}")
     if spec == "thread":

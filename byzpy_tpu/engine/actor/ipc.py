@@ -136,6 +136,7 @@ def unwrap_payload(obj: Any, *, copy: bool = False, close: bool = False) -> Any:
 
 
 def cleanup_handles(handles: List[native_store.SharedTensorHandle]) -> None:
+    """Unlink the shm segments behind ``handles`` (receiver-side teardown)."""
     for handle in handles:
         try:
             native_store.cleanup_tensor(handle)

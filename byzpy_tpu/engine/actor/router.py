@@ -15,6 +15,7 @@ if TYPE_CHECKING:
 
 
 class ChannelRouter:
+    """Fan-in router: forwards items from many source channels into per-destination queues by a key function."""
     def __init__(self) -> None:
         self._backends: Dict[Tuple[str, str], "ActorBackend"] = {}
 

@@ -34,6 +34,7 @@ async def _roundtrip(address: str, msg: dict) -> Any:
 
 
 async def chan_put(endpoint: Endpoint, name: str, payload: Any) -> None:
+    """Send ``payload`` into the remote channel ``name`` over this TCP endpoint."""
     await _roundtrip(
         endpoint.address,
         {
@@ -46,6 +47,7 @@ async def chan_put(endpoint: Endpoint, name: str, payload: Any) -> None:
 
 
 async def chan_get(endpoint: Endpoint, name: str) -> Any:
+    """Receive the next item from the remote channel ``name`` (blocks server-side)."""
     return await _roundtrip(
         endpoint.address, {"op": "chan_get", "actor_id": endpoint.actor_id, "name": name}
     )

@@ -62,6 +62,7 @@ def warn_untrusted_bind(host: str, component: str) -> None:
 
 
 def encode(obj: Any) -> bytes:
+    """Pickle ``obj`` into a length-prefixed (optionally HMAC-signed) frame body."""
     body = cloudpickle.dumps(obj)
     key = _wire_key()
     if key is not None:
@@ -70,6 +71,7 @@ def encode(obj: Any) -> bytes:
 
 
 def decode(body: bytes) -> Any:
+    """Inverse of :func:`encode` (verifies the HMAC when signing is configured)."""
     key = _wire_key()
     if key is not None:
         if len(body) < _SIG_LEN:
@@ -118,11 +120,13 @@ def host_view(obj: Any) -> Any:
 
 
 async def send_obj(writer: asyncio.StreamWriter, obj: Any) -> None:
+    """Write one encoded frame to the stream and drain."""
     writer.write(encode(obj))
     await writer.drain()
 
 
 async def recv_obj(reader: asyncio.StreamReader) -> Any:
+    """Read exactly one frame from the stream and decode it."""
     header = await reader.readexactly(_HEADER.size)
     (length,) = _HEADER.unpack(header)
     if length > MAX_FRAME:

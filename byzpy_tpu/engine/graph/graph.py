@@ -36,6 +36,7 @@ class GraphInput:
 
 
 def graph_input(name: str) -> GraphInput:
+    """Shorthand constructor for a named :class:`GraphInput` placeholder."""
     return GraphInput(name)
 
 

@@ -41,6 +41,7 @@ class LazyNode:
 
 
 class GraphBuilder:
+    """Lazy graph construction: record operator calls as :class:`LazyNode` handles and materialize a ComputationGraph on build()."""
     def __init__(self) -> None:
         self._nodes: List[GraphNode] = []
         self._name_counter = itertools.count()

@@ -35,6 +35,10 @@ class OpContext:
 
 
 class Operator:
+    """Schedulable unit of work: a named compute with optional windowed or
+    barriered subtask fan-out and pool affinity (the graph engine's common
+    currency; aggregators/attacks/pre-aggregators all subclass this)."""
+
     name: str = "operator"
     supports_subtasks: bool = False
     supports_barriered_subtasks: bool = False

@@ -19,6 +19,7 @@ from .scheduler import MessageSource
 
 
 class ParallelScheduler:
+    """Topological wave scheduler: runs every ready node of a ComputationGraph concurrently on the pool, equivalent to NodeScheduler on any DAG (fuzz-pinned)."""
     def __init__(
         self,
         graph: ComputationGraph,

@@ -73,6 +73,7 @@ def unregister_delivery_route(route: DeliveryRoute) -> None:
 
 
 async def route_message(target_id: str, message: Message) -> bool:
+    """Deliver ``message`` to the node registered as ``target_id`` in this process, returning False when unknown."""
     for route in _delivery_routes:
         if await route(target_id, message):
             return True

@@ -21,6 +21,120 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _idx_read(path: str) -> np.ndarray:
+    """Parse one IDX file (the MNIST wire format), gzip or raw.
+
+    Vendored parser — the reference reaches real MNIST through torchvision
+    (ref: ``examples/ps/thread/mnist.py:23-31``); this framework has no
+    torch dependency, so it reads the IDX container directly. Format:
+    big-endian magic ``0x00 0x00 <dtype> <ndim>`` then ``ndim`` uint32
+    dims, then row-major payload.
+    """
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fh:
+        data = fh.read()
+    if len(data) < 4 or data[0] != 0 or data[1] != 0:
+        raise ValueError(f"{path}: not an IDX file (bad magic {data[:4]!r})")
+    dtype = {
+        0x08: np.uint8, 0x09: np.int8, 0x0B: np.dtype(">i2"),
+        0x0C: np.dtype(">i4"), 0x0D: np.dtype(">f4"), 0x0E: np.dtype(">f8"),
+    }.get(data[2])
+    if dtype is None:
+        raise ValueError(f"{path}: unknown IDX dtype code 0x{data[2]:02x}")
+    ndim = data[3]
+    header = 4 + 4 * ndim
+    dims = np.frombuffer(data[4:header], dtype=">u4").astype(np.int64)
+    arr = np.frombuffer(data[header:], dtype=dtype)
+    if arr.size != int(np.prod(dims)):
+        raise ValueError(
+            f"{path}: payload has {arr.size} items, header promises {dims}"
+        )
+    return arr.reshape(dims)
+
+
+def load_mnist_idx(
+    data_dir: str,
+    *,
+    split: str = "train",
+    normalize: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Real MNIST from IDX files in ``data_dir`` (the files torchvision /
+    the original Yann LeCun distribution ship: ``train-images-idx3-ubyte[.gz]``
+    etc. — also found under ``MNIST/raw/`` of a torchvision download).
+
+    Returns ``(x, y)`` with ``x: (n, 28, 28, 1) float32`` (in [0,1] when
+    ``normalize``) and ``y: (n,) int32`` — the same tensors the reference's
+    DataLoader feeds its SmallCNN (ref: ``examples/ps/thread/mnist.py:23-31``).
+    Raises ``FileNotFoundError`` with the expected filenames when absent
+    (this image has no network egress; bring the files).
+    """
+    import os
+
+    prefix = {"train": "train", "test": "t10k"}[split]
+    found: dict = {}
+    for kind, tag in (("images", "idx3"), ("labels", "idx1")):
+        for suffix in (f"{prefix}-{kind}-{tag}-ubyte", f"{prefix}-{kind}.{tag}-ubyte"):
+            for ext in ("", ".gz"):
+                cand = os.path.join(data_dir, suffix + ext)
+                if os.path.exists(cand):
+                    found[kind] = cand
+                    break
+            if kind in found:
+                break
+        if kind not in found:
+            raise FileNotFoundError(
+                f"no {prefix} {kind} IDX file under {data_dir} "
+                f"(expected e.g. {prefix}-{kind}-{tag}-ubyte[.gz])"
+            )
+    x = _idx_read(found["images"]).astype(np.float32)
+    y = _idx_read(found["labels"]).astype(np.int32)
+    if normalize:
+        x /= 255.0
+    return jnp.asarray(x[..., None]), jnp.asarray(y)
+
+
+def load_digits_dataset(
+    *,
+    test_fraction: float = 0.25,
+    normalize: bool = True,
+    seed: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Real handwritten digits (UCI optdigits via ``sklearn.datasets``,
+    1797 8x8 grayscale images, 10 classes) — the real-data stand-in for
+    MNIST in an image with no network egress. Same role as the reference's
+    torchvision MNIST in its accuracy-under-attack studies
+    (ref: ``examples/ps/thread/mnist.py:114-119``, ``benchmarks/byzfl/``).
+
+    Returns ``(x_train, y_train, x_test, y_test)`` with images
+    ``(n, 8, 8, 1) float32`` (in [0,1] when ``normalize``) and int32
+    labels, shuffled with a fixed seed before the split.
+    """
+    try:
+        from sklearn.datasets import load_digits
+    except ImportError as exc:  # pragma: no cover - sklearn is in the image
+        raise ImportError(
+            "load_digits_dataset needs scikit-learn (bundled real data); "
+            "for full MNIST use load_mnist_idx with downloaded IDX files"
+        ) from exc
+
+    bunch = load_digits()
+    x = bunch.data.astype(np.float32).reshape(-1, 8, 8, 1)
+    y = bunch.target.astype(np.int32)
+    if normalize:
+        x /= 16.0
+    order = np.random.default_rng(seed).permutation(x.shape[0])
+    x, y = x[order], y[order]
+    n_test = int(round(test_fraction * x.shape[0]))
+    return (
+        jnp.asarray(x[n_test:]),
+        jnp.asarray(y[n_test:]),
+        jnp.asarray(x[:n_test]),
+        jnp.asarray(y[:n_test]),
+    )
+
+
 def synthetic_classification(
     *,
     n_samples: int = 4096,
@@ -97,6 +211,8 @@ def host_batches(
 
 
 __all__ = [
+    "load_mnist_idx",
+    "load_digits_dataset",
     "synthetic_classification",
     "ShardedDataset",
     "sample_batch",

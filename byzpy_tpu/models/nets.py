@@ -172,18 +172,27 @@ def make_bundle(
 
 
 def mnist_mlp(seed: int = 0, hidden: int = 128) -> ModelBundle:
+    """MLP(hidden, 10) bundle for 28x28x1 inputs (MNIST-shaped)."""
     return make_bundle(MLP(features=(hidden, 10)), (1, 28, 28, 1), seed=seed)
 
 
 def mnist_cnn(seed: int = 0, dtype: Dtype = jnp.float32) -> ModelBundle:
+    """SmallCNN bundle with the reference's MNIST architecture."""
     return make_bundle(SmallCNN(dtype=dtype), (1, 28, 28, 1), seed=seed)
 
 
+def digits_mlp(seed: int = 0, hidden: int = 64) -> ModelBundle:
+    """MLP for the real 8x8 digits dataset (``data.load_digits_dataset``)."""
+    return make_bundle(MLP(features=(hidden, 10)), (1, 8, 8, 1), seed=seed)
+
+
 def cifar_resnet18(seed: int = 0, dtype: Dtype = jnp.float32) -> ModelBundle:
+    """ResNet-18 bundle for 32x32x3 (CIFAR-10-shaped) inputs."""
     return make_bundle(ResNet18(num_classes=10, dtype=dtype), (1, 32, 32, 3), seed=seed)
 
 
 def imagenet_resnet50(seed: int = 0, dtype: Dtype = jnp.bfloat16) -> ModelBundle:
+    """ResNet-50 bundle for 224x224x3 inputs, bf16 activations by default."""
     return make_bundle(
         ResNet50(num_classes=1000, small_input=False, dtype=dtype),
         (1, 224, 224, 3),
@@ -203,6 +212,7 @@ __all__ = [
     "make_bundle",
     "mnist_mlp",
     "mnist_cnn",
+    "digits_mlp",
     "cifar_resnet18",
     "imagenet_resnet50",
 ]

@@ -208,6 +208,7 @@ def tiny_classifier(
     seed: int = 0, *, num_classes: int = 10, dim: int = 64, depth: int = 2,
     num_heads: int = 4, dtype: Dtype = jnp.float32,
 ) -> ModelBundle:
+    """Small TransformerClassifier bundle (token ids -> class logits) for tests/benchmarks."""
     model = TransformerClassifier(
         num_classes=num_classes, dim=dim, depth=depth, num_heads=num_heads,
         dtype=dtype,

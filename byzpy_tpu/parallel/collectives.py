@@ -46,10 +46,12 @@ def all_gather(x: Array, axis_name: str, *, axis: int = 0, tiled: bool = True) -
 
 
 def all_reduce_sum(x: Array, axis_name: str) -> Array:
+    """Sum ``x`` across the axis' devices (replicated result)."""
     return lax.psum(x, axis_name)
 
 
 def all_reduce_mean(x: Array, axis_name: str) -> Array:
+    """Mean of ``x`` across the axis' devices (replicated result)."""
     return lax.pmean(x, axis_name)
 
 

@@ -86,6 +86,7 @@ def sharding(mesh: Mesh, *spec: str | None | Tuple[str, ...]) -> NamedSharding:
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated NamedSharding over ``mesh`` (empty PartitionSpec)."""
     return NamedSharding(mesh, PartitionSpec())
 
 

@@ -10,6 +10,7 @@ from .base import PreAggregator
 
 
 class ARC(PreAggregator):
+    """Adaptive Robust Clipping: clip the largest-norm rows to the next-largest remaining norm."""
     name = "pre-agg/arc"
 
     def __init__(self, f: int = 0) -> None:

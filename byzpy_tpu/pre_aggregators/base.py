@@ -18,6 +18,8 @@ from ..utils.trees import stack_gradients, unstack_rows
 
 
 class PreAggregator(Operator, ABC):
+    """Pre-aggregation ABC: ``pre_aggregate`` transforms the (n, d) stack (clip/bucket/mix) before the aggregator runs."""
+
     name = "pre_aggregator"
     input_key = "vectors"
 

@@ -19,6 +19,7 @@ from .base import PreAggregator
 
 
 class Bucketing(PreAggregator):
+    """Shuffle rows with an explicit jax.random key and average fixed-size buckets, diluting byzantine influence."""
     name = "pre-agg/bucketing"
 
     def __init__(

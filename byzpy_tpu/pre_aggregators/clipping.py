@@ -10,6 +10,7 @@ from .base import PreAggregator
 
 
 class Clipping(PreAggregator):
+    """Static norm clipping: scale every row into an L2 ball."""
     name = "pre-agg/clipping"
 
     def __init__(self, threshold: float) -> None:

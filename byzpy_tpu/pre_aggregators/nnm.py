@@ -14,6 +14,7 @@ from .base import PreAggregator
 
 
 class NearestNeighborMixing(PreAggregator):
+    """Replace each row by the mean of its n - f nearest neighbors (fused Pallas kernel at large d)."""
     name = "pre-agg/nnm"
 
     def __init__(self, f: int) -> None:

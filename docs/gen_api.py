@@ -1,0 +1,181 @@
+"""Autogenerate the full API reference from docstrings.
+
+The reference publishes a Sphinx tree (``/root/reference/docs/source/
+index.rst`` + ``api/*.rst``); this repo's docs are plain markdown, so the
+equivalent is a generator that walks every ``byzpy_tpu`` module's public
+surface (``__all__``, falling back to non-underscore attributes defined in
+the module) and emits one table row per symbol: signature + first docstring
+sentence. Output is committed as ``docs/api_reference.md`` and checked in
+CI (regenerate-and-diff, see ``.github/workflows/tests.yml``) so the page
+cannot rot.
+
+Run: ``python docs/gen_api.py [--check]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import os
+import pkgutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "docs", "api_reference.md")
+
+SKIP_MODULES = {
+    # private/namespace-only modules
+}
+
+
+def public_symbols(mod) -> list:
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [
+            n
+            for n, v in vars(mod).items()
+            if not n.startswith("_")
+            and getattr(v, "__module__", None) == mod.__name__
+        ]
+    out = []
+    for n in names:
+        try:
+            out.append((n, getattr(mod, n)))
+        except AttributeError:
+            out.append((n, None))
+    return out
+
+
+import re as _re
+
+
+def first_sentence(doc: str | None) -> str:
+    if not doc:
+        return ""
+    text = inspect.cleandoc(doc).split("\n\n", 1)[0].replace("\n", " ").strip()
+    for stop in (". ", ".\n"):
+        if stop in text:
+            text = text.split(stop, 1)[0] + "."
+            break
+    # dataclass-generated docstrings repr default objects with their memory
+    # address — nondeterministic across runs, which would make --check flap
+    text = _re.sub(r"at 0x[0-9a-fA-F]+", "at 0x...", text)
+    if text in ("Initialize self.", "str(object='') -> str"):
+        return ""  # inherited object.__init__/str docs carry no information
+    return text.replace("|", "\\|")
+
+
+def signature_of(obj) -> str:
+    try:
+        sig = str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return ""
+    if len(sig) > 80:
+        sig = sig[:77] + "...)"
+    return sig.replace("|", "\\|")
+
+
+def walk_modules(pkg_name: str = "byzpy_tpu"):
+    pkg = importlib.import_module(pkg_name)
+    yield pkg_name, pkg
+    for info in pkgutil.walk_packages(pkg.__path__, prefix=pkg_name + "."):
+        if info.name in SKIP_MODULES or ".legacy." in info.name:
+            continue
+        base = info.name.rsplit(".", 1)[-1]
+        if base.startswith("_"):
+            continue
+        try:
+            yield info.name, importlib.import_module(info.name)
+        except Exception as exc:  # pragma: no cover — broken module = broken docs
+            raise RuntimeError(f"cannot import {info.name}: {exc}") from exc
+
+
+def generate() -> str:
+    lines = [
+        "# API reference (generated)",
+        "",
+        "Every public symbol in `byzpy_tpu`, by module — regenerate with",
+        "`python docs/gen_api.py` (CI diffs this file; see the curated",
+        "by-layer overview in [api.md](api.md)).",
+        "",
+    ]
+    seen_objs: dict = {}
+    missing: list = []
+    for mod_name, mod in walk_modules():
+        syms = public_symbols(mod)
+        if not syms:
+            continue
+        mod_doc = first_sentence(mod.__doc__)
+        lines.append(f"## `{mod_name}`")
+        lines.append("")
+        if mod_doc:
+            lines.append(mod_doc)
+            lines.append("")
+        lines.append("| Symbol | Kind | Summary |")
+        lines.append("|---|---|---|")
+        for name, obj in sorted(syms):
+            kind = (
+                "class"
+                if inspect.isclass(obj)
+                else "function"
+                if callable(obj)
+                else "value"
+            )
+            doc = first_sentence(getattr(obj, "__doc__", "") or "")
+            if (
+                not doc
+                and inspect.isclass(obj)
+                and getattr(obj, "__init__", None) is not None
+            ):
+                doc = first_sentence(obj.__init__.__doc__ or "")
+            home = getattr(obj, "__module__", mod_name)
+            key = id(obj) if obj is not None else (mod_name, name)
+            if (
+                not doc
+                and kind != "value"
+                and home == mod_name
+                and not name.startswith("_")
+            ):
+                missing.append(f"{mod_name}.{name}")
+            if key in seen_objs and home != mod_name:
+                doc = doc or f"re-export of `{home}.{name}`"
+            else:
+                seen_objs[key] = f"{mod_name}.{name}"
+            sig = signature_of(obj) if kind == "function" else ""
+            lines.append(f"| `{name}{sig}` | {kind} | {doc} |")
+        lines.append("")
+    if missing:
+        raise SystemExit(
+            "symbols missing docstrings (add them):\n  " + "\n  ".join(missing)
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="fail if docs/api_reference.md is stale (CI mode)",
+    )
+    args = parser.parse_args()
+    text = generate()
+    if args.check:
+        with open(OUT) as fh:
+            if fh.read() != text:
+                print("docs/api_reference.md is stale: run python docs/gen_api.py")
+                return 1
+        print("api_reference.md up to date")
+        return 0
+    with open(OUT, "w") as fh:
+        fh.write(text)
+    n_rows = text.count("\n| `")
+    print(f"wrote {OUT}: {n_rows} symbols")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,62 @@
+"""Robust *learning* on real data: the accuracy-under-attack contract.
+
+The reference proves its aggregators rescue training on a real dataset
+(MNIST accuracy eval, ``byzpy/examples/ps/thread/mnist.py:114-119``; ByzFL
+sweeps, ``byzpy/benchmarks/byzfl/*_compare.py``). These tests pin the same
+property on the bundled real digits set: an attack that destroys plain
+averaging leaves a robust aggregator learning.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("sklearn", reason="bundled real-digits data needs scikit-learn")
+
+from byzpy_tpu.models.data import load_digits_dataset
+from byzpy_tpu.utils.robust_study import StudyConfig, run_cell
+
+pytestmark = pytest.mark.slow  # full training runs; seconds, not ms
+
+
+@pytest.fixture(scope="module")
+def digits():
+    return load_digits_dataset(seed=0)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return StudyConfig(rounds=120, eval_every=60)
+
+
+def _bundle_factory():
+    from byzpy_tpu.models.nets import digits_mlp
+
+    return digits_mlp(seed=0)
+
+
+def test_real_digits_shapes(digits):
+    x_train, y_train, x_test, y_test = digits
+    assert x_train.shape[1:] == (8, 8, 1)
+    assert x_test.shape[0] + x_train.shape[0] == 1797  # the real dataset
+    assert float(x_train.max()) <= 1.0 and float(x_train.min()) >= 0.0
+    assert set(np.unique(np.asarray(y_train))) == set(range(10))
+
+
+def test_mean_destroyed_by_sign_flip(digits, cfg):
+    cell = run_cell(_bundle_factory, digits, "mean", "sign_flip", cfg)
+    assert cell.final_accuracy < 0.5, cell.row()
+
+
+def test_trimmed_mean_rescues_sign_flip(digits, cfg):
+    cell = run_cell(_bundle_factory, digits, "trimmed_mean", "sign_flip", cfg)
+    assert cell.final_accuracy > 0.8, cell.row()
+
+
+def test_multi_krum_rescues_little(digits, cfg):
+    cell = run_cell(_bundle_factory, digits, "multi_krum", "little", cfg)
+    assert cell.final_accuracy > 0.8, cell.row()
+
+
+def test_clean_baseline_learns(digits, cfg):
+    cell = run_cell(_bundle_factory, digits, "mean", "none", cfg)
+    assert cell.final_accuracy > 0.9, cell.row()
