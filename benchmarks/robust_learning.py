@@ -44,6 +44,10 @@ def main() -> int:
     )
     args = parser.parse_args()
 
+    from byzpy_tpu.utils.platform import apply_env_platform
+
+    apply_env_platform()
+
     import jax
 
     from byzpy_tpu.utils.robust_study import (

@@ -1,0 +1,32 @@
+"""Platform selection helper for script entry points.
+
+A sitecustomize that registers an accelerator PJRT plugin (e.g. a
+tunneled-TPU image) can force its platform at jax import time, at which
+point the ``JAX_PLATFORMS`` environment variable is silently ignored.
+Benchmarks/examples that document ``JAX_PLATFORMS=cpu python ...``
+invocations call :func:`apply_env_platform` first so the documented
+environment override actually wins (tests/conftest.py does the
+unconditional-CPU version of the same dance for the suite).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_env_platform() -> str | None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment through
+    ``jax.config`` (which beats any import-time plugin default). Returns
+    the applied platform string, or None when the env var is unset.
+    Must run before the first jax backend touch (``jax.devices()``,
+    any computation)."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return None
+    import jax
+
+    jax.config.update("jax_platforms", platforms)
+    return platforms
+
+
+__all__ = ["apply_env_platform"]

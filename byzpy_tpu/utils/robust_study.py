@@ -66,7 +66,10 @@ def named_attack(
             attack_ops.sign_flip(jnp.mean(honest, axis=0), scale=-4.0)
         )
     if name == "empire":
-        return lambda honest, key: rows(attack_ops.empire(honest, scale=-1.1))
+        # scale must beat -h/b for the poisoned mean to ascend (h honest,
+        # b byzantine rows); -4 flips it for any b >= n/5, so the study
+        # actually separates robust aggregators from the mean baseline
+        return lambda honest, key: rows(attack_ops.empire(honest, scale=-4.0))
     if name == "little":
         return lambda honest, key: rows(
             attack_ops.little(honest, f=b, n_total=n_nodes)

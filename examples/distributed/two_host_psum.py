@@ -28,15 +28,18 @@ sys.path.insert(0, REPO)
 
 
 def worker(coordinator: str, num_processes: int, process_id: int) -> None:
-    # Platform choice must precede any jax backend touch. One CPU device
-    # per process plays the role of one chip per host.
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Platform choice must precede any jax backend touch — and must go
+    # through jax.config, not the environment: a sitecustomize (or any
+    # earlier import) may already have imported jax, after which env vars
+    # are ignored. One CPU device per process plays one chip per host.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
     from byzpy_tpu.parallel.collectives import initialize_multihost
 
     started = initialize_multihost(coordinator, num_processes, process_id)
 
-    import jax
     import jax.numpy as jnp
     import numpy as np
     from jax import lax
@@ -48,17 +51,21 @@ def worker(coordinator: str, num_processes: int, process_id: int) -> None:
     assert jax.process_count() == num_processes, jax.process_count()
 
     # After initialize, jax.devices() is global: one mesh over every
-    # host's devices. local_devices() is what this host contributes.
+    # host's devices. local_devices() is what this host contributes
+    # (device count per host varies — e.g. XLA_FLAGS can expose several
+    # virtual CPU devices — so everything below is count-agnostic).
+    n_local = len(jax.local_devices())
     mesh = Mesh(np.array(jax.devices()), ("nodes",))
     print(
         f"[proc {process_id}] global devices={len(jax.devices())} "
-        f"local={len(jax.local_devices())}",
+        f"local={n_local}",
         flush=True,
     )
 
-    # Each process contributes one row; the psum crosses the process
-    # boundary over the DCN control plane's data channels.
-    local = np.full((1, 4), float(process_id + 1), np.float32)
+    # Each process contributes one row per local device, filled with its
+    # process id + 1; the psum crosses the process boundary over the DCN
+    # control plane's data channels.
+    local = np.full((n_local, 4), float(process_id + 1), np.float32)
     arr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P("nodes")), local
     )
@@ -68,7 +75,9 @@ def worker(coordinator: str, num_processes: int, process_id: int) -> None:
     )
     out = psum(arr)
     mine = np.asarray(out.addressable_data(0))
-    want = sum(range(1, num_processes + 1))
+    # each global device's row carries (owner process + 1); hosts may
+    # contribute different device counts, so sum over the real ownership
+    want = sum(dev.process_index + 1 for dev in jax.devices())
     assert (mine == want).all(), (mine, want)
     print(f"[proc {process_id}] cross-host psum OK: {mine[0, 0]} == {want}", flush=True)
 

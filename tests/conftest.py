@@ -23,6 +23,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the suite's wall time is dominated by
+# compiles on the 8-virtual-device mesh, and they repeat identically
+# between runs. First run populates tests/.jax_cache (gitignored); later
+# runs — including the driver's repeated green checks — start warm
+# (~40% faster measured on this box). Override/disable with
+# JAX_COMPILATION_CACHE_DIR.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
 import pytest  # noqa: E402
 
 

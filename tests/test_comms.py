@@ -1,0 +1,93 @@
+"""Communication accounting: HLO collective parsing + wire-byte laws."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byzpy_tpu.parallel.comms import (
+    CollectiveOp,
+    collective_traffic,
+    collectives_in_hlo,
+    scaling_model,
+)
+
+
+def test_parse_sync_and_async_collectives():
+    hlo = """
+HloModule m
+
+ENTRY %main (p: f32[8,128]) -> f32[8,128] {
+  %p = f32[8,128] parameter(0)
+  %ar = f32[8,128]{1,0} all-reduce(%p), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+  %ags = f32[8,128]{1,0} all-gather-start(%ar), replica_groups=[1,8]<=[8], dimensions={0}
+  %agd = f32[8,128]{1,0} all-gather-done(%ags)
+  ROOT %out = f32[8,128]{1,0} add(%ar, %agd)
+}
+"""
+    ops = collectives_in_hlo(hlo, default_group=8)
+    kinds = sorted(op.opcode for op in ops)
+    # the -done twin must NOT double count
+    assert kinds == ["all-gather", "all-reduce"], ops
+    by = {op.opcode: op for op in ops}
+    assert by["all-reduce"].group_size == 8
+    assert by["all-gather"].group_size == 8
+    assert by["all-reduce"].result_bytes == 8 * 128 * 4
+    assert all(op.in_entry for op in ops)
+
+
+def test_loop_body_collectives_flagged_not_totalled():
+    hlo = """
+HloModule m
+
+%body (x: f32[64]) -> f32[64] {
+  %x = f32[64] parameter(0)
+  ROOT %cp = f32[64]{0} collective-permute(%x), source_target_pairs={{0,1},{1,0}}
+}
+
+ENTRY %main (p: f32[64]) -> f32[64] {
+  %p = f32[64] parameter(0)
+  ROOT %w = f32[64]{0} while(%p), condition=%cond, body=%body
+}
+"""
+    ops = collectives_in_hlo(hlo, default_group=2)
+    assert len(ops) == 1 and not ops[0].in_entry
+
+
+def test_wire_byte_laws():
+    assert CollectiveOp("all-gather", 1024, 8).wire_bytes_per_device == 1024 * 7 // 8
+    assert CollectiveOp("all-reduce", 1024, 8).wire_bytes_per_device == 2 * 1024 * 7 // 8
+    assert CollectiveOp("reduce-scatter", 128, 8).wire_bytes_per_device == 128 * 7
+    assert CollectiveOp("all-to-all", 1024, 8).wire_bytes_per_device == 1024 * 7 // 8
+    assert CollectiveOp("collective-permute", 1024, 8).wire_bytes_per_device == 1024
+    # degenerate single-device group moves nothing (permute excepted)
+    assert CollectiveOp("all-reduce", 1024, 1).wire_bytes_per_device == 0
+
+
+def test_collective_traffic_measures_gradient_transpose(devices):
+    mesh = Mesh(np.array(devices[:8]), ("nodes",))
+    d = 4096
+
+    @jax.jit
+    def step(x):
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P("nodes", None)))
+        y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(None, "nodes")))
+        return jnp.sum(y, axis=0)
+
+    x = jnp.ones((8, d), jnp.float32)
+    traffic = collective_traffic(step, x)
+    # node->feature transpose must appear as an all-to-all moving ~(g-1)/g
+    # of the (8, d) f32 matrix's per-device share
+    assert traffic["per_opcode_bytes"].get("all-to-all", 0) > 0, traffic
+    assert traffic["wire_bytes_per_device"] > 0
+
+
+def test_scaling_model_efficiency_saturates():
+    pts = scaling_model(
+        flops_per_chip=1e9,
+        wire_bytes_fn=lambda g: 2.0 * 1e6 * 4 * (g - 1) / g,
+        chips=(8, 128),
+    )
+    # comm is ~constant in N: 128-chip efficiency within 3% of 8-chip
+    assert abs(pts[0].efficiency - pts[1].efficiency) < 0.03
+    assert 0.0 < pts[0].efficiency < 1.0

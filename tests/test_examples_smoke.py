@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.heavy  # opt-in lane: see pyproject addopts
+
 EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
 ENV = {
     **os.environ,

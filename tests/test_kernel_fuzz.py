@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.heavy  # opt-in lane: see pyproject addopts
+
 from byzpy_tpu.ops import robust
 from byzpy_tpu.ops.pallas_kernels import (
     nnm_stream_pallas,

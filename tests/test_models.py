@@ -36,6 +36,7 @@ def test_small_cnn_forward_and_grad():
     assert tree_size(g) == tree_size(b.params)
 
 
+@pytest.mark.heavy  # ~30s XLA compile on 1-core CPU
 def test_resnet18_cifar_forward():
     b = cifar_resnet18()
     x = jnp.zeros((2, 32, 32, 3))
@@ -71,6 +72,7 @@ def test_sample_batch_jit_safe():
     assert by.shape == (16,)
 
 
+@pytest.mark.heavy  # ~30s XLA compile on 1-core CPU
 def test_resnet50_imagenet_shape_and_dtype():
     """ResNet-50 bottleneck path at ImageNet shape, bf16 compute with f32
     logits (the BASELINE config-#5 model)."""
@@ -83,6 +85,7 @@ def test_resnet50_imagenet_shape_and_dtype():
     assert logits.dtype == jnp.float32  # classifier head upcasts
 
 
+@pytest.mark.heavy  # ~30s XLA compile on 1-core CPU
 def test_resnet_grads_flow_through_batchnorm_free_path():
     """The training path must produce finite grads for every parameter
     (catches dead branches / stop_gradient mistakes in the blocks)."""
