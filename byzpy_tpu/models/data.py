@@ -193,6 +193,32 @@ def sample_batch(
     return jnp.take(x, idx, axis=0), jnp.take(y, idx, axis=0)
 
 
+def sample_node_batches(
+    xs_all: jnp.ndarray,
+    ys_all: jnp.ndarray,
+    key: jax.Array,
+    batch_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-node uniform batches from stacked shards (jit-safe).
+
+    ``xs_all: (n_nodes, shard, *feature)`` / ``ys_all: (n_nodes, shard)``
+    (from :meth:`ShardedDataset.stacked_shards`) ->
+    ``(n_nodes, batch, *feature)`` / ``(n_nodes, batch)``, each node
+    sampling with replacement from its own shard. The index expansion
+    adapts to the feature rank, so image and flat datasets share one
+    implementation (PS, gossip, and multi-host examples all feed their
+    round steps through this).
+    """
+    n_nodes, shard = ys_all.shape[:2]
+    idx = jax.random.randint(key, (n_nodes, batch_size), 0, shard)
+    feat_dims = xs_all.ndim - 2
+    xs = jnp.take_along_axis(
+        xs_all, idx.reshape(idx.shape + (1,) * feat_dims), axis=1
+    )
+    ys = jnp.take_along_axis(ys_all, idx, axis=1)
+    return xs, ys
+
+
 def host_batches(
     x: np.ndarray,
     y: np.ndarray,
@@ -212,6 +238,7 @@ def host_batches(
 
 __all__ = [
     "load_mnist_idx",
+    "sample_node_batches",
     "load_digits_dataset",
     "synthetic_classification",
     "ShardedDataset",

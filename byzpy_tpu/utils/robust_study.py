@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.bundle import ModelBundle
-from ..models.data import ShardedDataset
+from ..models.data import ShardedDataset, sample_node_batches
 from ..ops import attack_ops, robust
 from ..parallel.ps import PSStepConfig, build_ps_train_step
 
@@ -168,11 +168,7 @@ def run_cell(
     history: List[Tuple[int, float]] = []
     for r in range(cfg.rounds):
         key, bkey, skey = jax.random.split(key, 3)
-        idx = jax.random.randint(
-            bkey, (cfg.n_nodes, cfg.batch_size), 0, sharded.shard_size
-        )
-        xs = jnp.take_along_axis(xs_all, idx[..., None, None, None], axis=1)
-        ys = jnp.take_along_axis(ys_all, idx, axis=1)
+        xs, ys = sample_node_batches(xs_all, ys_all, bkey, cfg.batch_size)
         params, opt_state, _ = jit_step(params, opt_state, xs, ys, skey)
         if (r + 1) % cfg.eval_every == 0 or r == cfg.rounds - 1:
             history.append((r + 1, float(accuracy(params))))

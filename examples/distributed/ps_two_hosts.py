@@ -43,7 +43,11 @@ def worker(coordinator: str, num_processes: int, process_id: int) -> None:
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from byzpy_tpu.models.data import ShardedDataset, load_digits_dataset
+    from byzpy_tpu.models.data import (
+        ShardedDataset,
+        load_digits_dataset,
+        sample_node_batches,
+    )
     from byzpy_tpu.models.nets import digits_mlp
     from byzpy_tpu.ops import attack_ops, robust
     from byzpy_tpu.parallel.ps import PSStepConfig, build_ps_train_step
@@ -83,9 +87,7 @@ def worker(coordinator: str, num_processes: int, process_id: int) -> None:
     batch = 32
     for r in range(ROUNDS):
         key, bkey, skey = jax.random.split(key, 3)
-        idx = jax.random.randint(bkey, (n_nodes, batch), 0, data.shard_size)
-        xs = jnp.take_along_axis(xs_all, idx[..., None, None, None], axis=1)
-        ys = jnp.take_along_axis(ys_all, idx, axis=1)
+        xs, ys = sample_node_batches(xs_all, ys_all, bkey, batch)
         xs = jax.make_array_from_process_local_data(
             node_sh, np.asarray(xs[lo : lo + nodes_here])
         )

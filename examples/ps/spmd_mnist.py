@@ -20,10 +20,18 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 import os
 from functools import partial
 
+from byzpy_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS even under a plugin sitecustomize
+
 import jax
 import jax.numpy as jnp
 
-from byzpy_tpu.models.data import ShardedDataset, synthetic_classification
+from byzpy_tpu.models.data import (
+    ShardedDataset,
+    sample_node_batches,
+    synthetic_classification,
+)
 from byzpy_tpu.models.nets import mnist_mlp
 from byzpy_tpu.ops import attack_ops, preagg, robust
 from byzpy_tpu.parallel.mesh import node_mesh, sharding
@@ -65,9 +73,7 @@ def main():
     key = jax.random.PRNGKey(0)
     for r in range(ROUNDS):
         key, bkey, skey = jax.random.split(key, 3)
-        idx = jax.random.randint(bkey, (n_nodes, BATCH), 0, data.shard_size)
-        xs = jnp.take_along_axis(xs_all, idx[..., None, None, None], axis=1)
-        ys = jnp.take_along_axis(ys_all, idx, axis=1)
+        xs, ys = sample_node_batches(xs_all, ys_all, bkey, BATCH)
         if node_shard is not None:
             xs, ys = jax.device_put(xs, node_shard), jax.device_put(ys, node_shard)
         params, opt_state, metrics = jit_step(params, opt_state, xs, ys, skey)

@@ -59,7 +59,10 @@ def test_actor_demo_runs():
         "long_context_lm.py",
         "ps/thread_mnist.py",
         "ps/spmd_mnist.py",
+        "ps/real_data_robust.py",
         "p2p/gossip_mnist.py",
+        "p2p/real_data_gossip.py",
+        "distributed/two_host_psum.py",
     ],
 )
 def test_training_example_runs(rel):
