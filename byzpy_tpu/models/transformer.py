@@ -7,7 +7,10 @@ first-class in the TPU build. Attention routes through one of two paths:
 * ``attention="full"`` — standard softmax attention (single chip);
 * ``attention="ring"`` — exact ring attention over a sequence-parallel
   mesh axis (`byzpy_tpu.parallel.ring_attention`): activations stay
-  sequence-sharded through the whole block stack, K/V rotate over ICI.
+  sequence-sharded through the whole block stack, K/V rotate over ICI;
+* ``attention="ulysses"`` — exact all-to-all sequence parallelism
+  (`byzpy_tpu.parallel.ulysses`): two head<->sequence exchanges bracket
+  full attention per head subset (needs heads % axis_size == 0).
 
 Design notes: pre-LN blocks, NHWC-free (pure (B, L, D) matmuls on the
 MXU), bf16-friendly via ``dtype``, static shapes.
@@ -52,7 +55,7 @@ class MultiHeadAttention(nn.Module):
 
     num_heads: int
     causal: bool = False
-    attention: str = "full"  # "full" | "ring"
+    attention: str = "full"  # "full" | "ring" | "ulysses"
     ring_axis: str = "sp"
     dtype: Dtype = jnp.float32
 
@@ -65,6 +68,19 @@ class MultiHeadAttention(nn.Module):
         dh = d // h
         qkv = nn.DenseGeneral((3, h, dh), axis=-1, dtype=self.dtype, name="qkv")(x)
         q, k, v = jnp.moveaxis(qkv, -3, 0)  # each (b, l, h, dh)
+
+        if self.attention == "ulysses" and _ring_axis_bound(self.ring_axis):
+            from ..parallel.ulysses import ulysses_attention
+
+            # ulysses takes (L, H, Dh) directly — the heads exchange
+            # across the axis happens inside; vmap batch only
+            attn = jax.vmap(
+                partial(ulysses_attention, axis_name=self.ring_axis,
+                        causal=self.causal)
+            )(q, k, v)  # (b, l, h, dh)
+            attn = attn.reshape(b, l, d)
+            return nn.DenseGeneral(d, axis=-1, dtype=self.dtype, name="out")(attn)
+
         q = jnp.transpose(q, (0, 2, 1, 3))  # (b, h, l, dh)
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
@@ -77,9 +93,9 @@ class MultiHeadAttention(nn.Module):
                         causal=self.causal)
             ))(q, k, v)
         else:
-            # "full", or "ring" outside a mesh binding (init / single
-            # device), where one local block == the whole sequence and full
-            # attention is the exact same computation
+            # "full", or ring/ulysses outside a mesh binding (init /
+            # single device), where one local block == the whole sequence
+            # and full attention is the exact same computation
             from ..parallel.ring_attention import full_attention
 
             attn = full_attention(q, k, v, causal=self.causal)
@@ -127,7 +143,7 @@ class TransformerLM(nn.Module):
         b, l = tokens.shape
         x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype)(tokens)
         positions = jnp.arange(l)
-        if self.attention == "ring":
+        if self.attention in ("ring", "ulysses"):
             # under sequence sharding `l` is the LOCAL block length; global
             # positions are offset by this device's ring index
             positions = positions + _ring_position_offset(self.ring_axis, l)
@@ -225,12 +241,13 @@ def sequence_parallel_forward(
     *,
     axis_name: str = "sp",
 ):
-    """Run a ring-attention model over sequence-sharded tokens.
+    """Run a sequence-parallel model over sequence-sharded tokens.
 
     ``tokens``: ``(B, L)`` with the length axis sharded over ``axis_name``;
     params are replicated (closed over). Returns ``(B, L, vocab)`` logits
     with the same sequence sharding. The model must have been built with
-    ``attention="ring"`` and the same ``ring_axis``.
+    ``attention="ring"`` or ``attention="ulysses"`` and the same
+    ``ring_axis``.
     """
     from jax.sharding import PartitionSpec as P
 

@@ -1,0 +1,104 @@
+"""Ulysses-style sequence parallelism: all-to-all head<->sequence exchange.
+
+The second of the two standard long-context schemes (SURVEY §5 requires
+"ring attention or all-to-all sequence/context parallelism"; this module
+is the all-to-all half, :mod:`byzpy_tpu.parallel.ring_attention` the
+ring half — DeepSpeed-Ulysses, Jacobs et al. 2023). Inputs arrive
+sequence-sharded; one ``all_to_all`` re-shards Q/K/V from
+``(seq/p, heads)`` to ``(seq, heads/p)`` so each device runs EXACT
+attention for its head subset over the full sequence, and a second
+``all_to_all`` restores sequence sharding.
+
+Trade-off vs the ring: Ulysses moves each token's Q/K/V and output once
+(4 tensors x (p-1)/p) in two bursts, the ring moves K/V in n-1 pipelined
+neighbor hops that overlap compute. Ulysses needs ``heads %
+axis_size == 0``; the ring has no head constraint and O(L/p) peak score
+memory. Both are exact — parity is pinned against ``full_attention`` in
+``tests/test_ulysses.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import sharded_fn
+from .ring_attention import full_attention
+
+Array = jnp.ndarray
+
+
+def ulysses_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Array:
+    """Exact multi-head attention over sequence-sharded inputs via two
+    all-to-alls (call inside ``shard_map``).
+
+    ``q, k, v``: ``(L_local, H, Dh)`` — the local sequence block with ALL
+    heads. Requires ``H % axis_size == 0``. Returns ``(L_local, H, Dh)``
+    with the same sequence sharding.
+    """
+    p = lax.axis_size(axis_name)
+    lq, h, dh = q.shape
+    if h % p != 0:
+        raise ValueError(
+            f"ulysses needs heads divisible by the axis size (H={h}, p={p}); "
+            "use ring_attention for odd head counts"
+        )
+
+    def seq_to_heads(x):
+        # (L/p, H, Dh) -> (L, H/p, Dh): split the head axis across
+        # devices, concatenate the sequence axis
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)  # (L, H/p, Dh)
+    # heads leading for the batched single-head oracle: (H/p, L, Dh)
+    out = full_attention(
+        qh.transpose(1, 0, 2),
+        kh.transpose(1, 0, 2),
+        vh.transpose(1, 0, 2),
+        causal=causal,
+        scale=scale,
+    ).transpose(1, 0, 2)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(
+    mesh: Mesh,
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    axis_name: Optional[str] = None,
+    causal: bool = False,
+) -> Array:
+    """Host-level entry: ``(L, H, Dh)`` arrays sharded ``P(axis)`` on the
+    sequence axis. Output keeps the sequence sharding."""
+    axis = axis_name or mesh.axis_names[0]
+    fn = sharded_fn(
+        mesh, axis,
+        partial(_ulysses3, axis, causal),
+        in_spec=(P(axis), P(axis), P(axis)),  # type: ignore[arg-type]
+        out_spec=P(axis),
+    )
+    return fn(q, k, v)
+
+
+def _ulysses3(axis, causal, q, k, v):
+    return ulysses_attention(q, k, v, axis, causal=causal)
+
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]

@@ -84,3 +84,23 @@ def test_ring_lm_init_and_apply_outside_shard_map():
     np.testing.assert_allclose(
         np.asarray(out_ring), np.asarray(out_full), rtol=1e-5, atol=1e-5
     )
+
+
+def test_ulysses_lm_matches_full_lm(devices):
+    """Same params, ulysses all-to-all over 8 sequence shards == full
+    attention (heads == axis size, the divisibility contract)."""
+    vocab, dim, depth, heads, L = 32, 32, 2, 8, 64
+    full = TransformerLM(vocab_size=vocab, dim=dim, depth=depth,
+                         num_heads=heads, attention="full")
+    uly = TransformerLM(vocab_size=vocab, dim=dim, depth=depth,
+                        num_heads=heads, attention="ulysses", ring_axis="sp")
+    params = full.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, L), 0, vocab)
+    oracle = full.apply(params, tokens)
+
+    mesh = make_mesh([8], ("sp",))
+    out = sequence_parallel_forward(mesh, uly.apply, params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=2e-4, atol=2e-4)
+    assert out.sharding.spec[1] == "sp"
