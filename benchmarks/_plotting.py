@@ -1,0 +1,24 @@
+"""Shared plotting helpers for the benchmarks tree (Agg backend + jsonl IO)."""
+
+import json
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: F401,E402 — re-exported for callers
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+RESULTS = os.path.join(HERE, "results")
+
+
+def load_jsonl(path):
+    """All rows of a jsonl file, skipping blanks and '#' comment lines."""
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            rows.append(json.loads(line))
+    return rows

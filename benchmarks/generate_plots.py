@@ -15,26 +15,15 @@ import json
 import os
 import sys
 
-import matplotlib
-
-matplotlib.use("Agg")
-import matplotlib.pyplot as plt
-
-_here = os.path.dirname(os.path.abspath(__file__))
-RESULTS = os.path.join(_here, "results")
+from _plotting import RESULTS, load_jsonl, plt
 
 
 def load_grid(path=None):
     path = path or os.path.join(RESULTS, "grid.jsonl")
-    rows = []
-    with open(path) as fh:
-        for line in fh:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            row = json.loads(line)
-            if "ref_best_pool_ms" in row or "ref_direct_ms" in row:
-                rows.append(row)
+    rows = [
+        row for row in load_jsonl(path)
+        if "ref_best_pool_ms" in row or "ref_direct_ms" in row
+    ]
     # supersede rows with re-measured values (each override carries a
     # provenance note; see results/overrides.jsonl)
     override_path = os.path.join(os.path.dirname(path), "overrides.jsonl")

@@ -1,0 +1,61 @@
+"""Render the accuracy-under-attack trajectories.
+
+Reads ``benchmarks/results/robust_learning.jsonl`` (written by
+``robust_learning.py --write``; the LAST row per (aggregator, attack)
+wins) and produces ``results/robust_learning.png`` — one panel per
+attack, accuracy-vs-round per aggregator. The visual form of the
+reference's ByzFL compare plots (``byzpy/benchmarks/byzfl/*_compare.py``).
+
+Matplotlib only; no seaborn, no style deps.
+"""
+
+import os
+
+from _plotting import RESULTS, load_jsonl, plt
+
+
+def load_cells(path=None):
+    path = path or os.path.join(RESULTS, "robust_learning.jsonl")
+    return {(r["aggregator"], r["attack"]): r for r in load_jsonl(path)}
+
+
+def main() -> int:
+    cells = load_cells()
+    attacks = list(dict.fromkeys(a for _, a in cells))
+    aggs = list(dict.fromkeys(g for g, _ in cells))
+    any_row = next(iter(cells.values()))
+    fig, axes = plt.subplots(
+        1, len(attacks), figsize=(4 * len(attacks), 3.4), sharey=True
+    )
+    if len(attacks) == 1:
+        axes = [axes]
+    for ax, attack in zip(axes, attacks):
+        for agg in aggs:
+            row = cells.get((agg, attack))
+            if row is None:
+                continue
+            rounds = [r for r, _ in row["history"]]
+            acc = [a for _, a in row["history"]]
+            style = dict(linewidth=2.2) if agg == "mean" else dict(linewidth=1.4)
+            ax.plot(rounds, acc, marker="o", markersize=3, label=agg, **style)
+        ax.set_title(f"attack: {attack}")
+        ax.set_xlabel("round")
+        ax.set_ylim(0.0, 1.0)
+        ax.grid(alpha=0.3)
+    axes[0].set_ylabel("held-out accuracy")
+    axes[-1].legend(loc="lower right", fontsize=8)
+    fig.suptitle(
+        "Robust learning on real digits: accuracy under attack "
+        f"({any_row.get('n_nodes', '?')} nodes, "
+        f"{any_row.get('n_byzantine', '?')} byzantine)",
+        y=1.02,
+    )
+    fig.tight_layout()
+    out = os.path.join(RESULTS, "robust_learning.png")
+    fig.savefig(out, dpi=130, bbox_inches="tight")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,7 +29,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--rounds", type=int, default=300)
+    parser.add_argument(
+        "--rounds", type=int, default=240,  # the committed grid/plot provenance
+    )
     parser.add_argument("--nodes", type=int, default=8)
     parser.add_argument("--byzantine", type=int, default=2)
     parser.add_argument("--batch", type=int, default=32)
@@ -102,7 +104,9 @@ def main() -> int:
             "Reference analogue: torchvision-MNIST accuracy eval",
             "(`examples/ps/thread/mnist.py:114-119`) and the ByzFL",
             "aggregator-vs-attack sweeps (`benchmarks/byzfl/*_compare.py`).",
-            "Reproduce: `python benchmarks/robust_learning.py --write`.",
+            "Reproduce: `python benchmarks/robust_learning.py --write`;",
+            "plot: `python benchmarks/plot_robust_learning.py` ->",
+            "![trajectories](results/robust_learning.png)",
             "",
             "## Trajectories (round, held-out accuracy)",
             "",
