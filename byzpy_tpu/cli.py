@@ -195,6 +195,25 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_study(args: argparse.Namespace) -> int:
+    """``byzpy-tpu study``: one accuracy-under-attack cell pair on real
+    data — the 30-second proof that robust aggregation rescues training a
+    byzantine attack destroys (full grid: ``benchmarks/robust_learning.py``)."""
+    from .utils.robust_study import StudyConfig, results_table, run_study
+
+    cfg = StudyConfig(rounds=args.rounds, eval_every=max(1, args.rounds // 3))
+    aggregators = tuple(dict.fromkeys(("mean", args.aggregator)))
+    results = run_study(
+        aggregators=aggregators,
+        attacks=(args.attack,),
+        cfg=cfg,
+        verbose=True,
+    )
+    print()
+    print(results_table(results))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     """Assemble the ``byzpy-tpu`` argument parser (one subcommand per cmd_*)."""
     parser = argparse.ArgumentParser(
@@ -223,6 +242,29 @@ def build_parser() -> argparse.ArgumentParser:
     p_bench.add_argument("--dim", type=int, default=65_536)
     p_bench.add_argument("--repeat", type=int, default=10)
     p_bench.set_defaults(fn=cmd_bench)
+
+    p_study = sub.add_parser(
+        "study",
+        help="robust-learning demo: mean vs a robust aggregator under attack",
+    )
+    # mirrors utils.robust_study.STUDY_AGGREGATORS/STUDY_ATTACKS (kept
+    # literal so `byzpy-tpu version` never imports jax; sync pinned by
+    # tests/test_cli_utils_configs.py)
+    p_study.add_argument(
+        "--aggregator",
+        default="trimmed_mean",
+        choices=(
+            "mean", "median", "trimmed_mean", "multi_krum",
+            "geometric_median", "nnm_trimmed_mean",
+        ),
+    )
+    p_study.add_argument(
+        "--attack",
+        default="sign_flip",
+        choices=("none", "sign_flip", "empire", "little", "gaussian", "mimic"),
+    )
+    p_study.add_argument("--rounds", type=int, default=120)
+    p_study.set_defaults(fn=cmd_study)
 
     return parser
 

@@ -32,6 +32,17 @@ from ..parallel.ps import PSStepConfig, build_ps_train_step
 
 AggFn = Callable[[jnp.ndarray], jnp.ndarray]
 
+#: the study zoo names (CLI `byzpy-tpu study` mirrors these as choices)
+STUDY_AGGREGATORS = (
+    "mean",
+    "median",
+    "trimmed_mean",
+    "multi_krum",
+    "geometric_median",
+    "nnm_trimmed_mean",
+)
+STUDY_ATTACKS = ("none", "sign_flip", "empire", "little", "gaussian", "mimic")
+
 
 @dataclass(frozen=True)
 class StudyConfig:
@@ -123,6 +134,28 @@ class CellResult:
         }
 
 
+def _train_eval_history(
+    step_fn: Callable,
+    state: Any,
+    xs_all: jnp.ndarray,
+    ys_all: jnp.ndarray,
+    accuracy_fn: Callable,
+    cfg: StudyConfig,
+) -> List[Tuple[int, float]]:
+    """The shared round loop: sample per-node batches, step, record
+    held-out accuracy every ``eval_every`` rounds (and the last).
+    ``step_fn(state, xs, ys, key) -> state``; ``accuracy_fn(state)``."""
+    key = jax.random.PRNGKey(cfg.seed)
+    history: List[Tuple[int, float]] = []
+    for r in range(cfg.rounds):
+        key, bkey, skey = jax.random.split(key, 3)
+        xs, ys = sample_node_batches(xs_all, ys_all, bkey, cfg.batch_size)
+        state = step_fn(state, xs, ys, skey)
+        if (r + 1) % cfg.eval_every == 0 or r == cfg.rounds - 1:
+            history.append((r + 1, float(accuracy_fn(state))))
+    return history
+
+
 def run_cell(
     bundle_factory: Callable[[], ModelBundle],
     data: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray],
@@ -163,15 +196,78 @@ def run_cell(
         logits = bundle.apply_fn(params, x_test)
         return jnp.mean(jnp.argmax(logits, -1) == y_test)
 
-    params = bundle.params
-    key = jax.random.PRNGKey(cfg.seed)
-    history: List[Tuple[int, float]] = []
-    for r in range(cfg.rounds):
-        key, bkey, skey = jax.random.split(key, 3)
-        xs, ys = sample_node_batches(xs_all, ys_all, bkey, cfg.batch_size)
-        params, opt_state, _ = jit_step(params, opt_state, xs, ys, skey)
-        if (r + 1) % cfg.eval_every == 0 or r == cfg.rounds - 1:
-            history.append((r + 1, float(accuracy(params))))
+    def step_fn(state, xs, ys, skey):
+        params, opt = state
+        params, opt, _ = jit_step(params, opt, xs, ys, skey)
+        return params, opt
+
+    history = _train_eval_history(
+        step_fn, (bundle.params, opt_state), xs_all, ys_all,
+        lambda state: accuracy(state[0]), cfg,
+    )
+    return CellResult(aggregator, attack, history[-1][1], history)
+
+
+def run_gossip_cell(
+    bundle_factory: Callable[[], ModelBundle],
+    data: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray],
+    aggregator: str,
+    attack: str,
+    cfg: StudyConfig,
+    *,
+    mesh: Any = None,
+) -> CellResult:
+    """Decentralized counterpart of :func:`run_cell`: the same
+    (aggregator, attack) cell trained by P2P gossip — every honest node
+    half-steps on its shard, byzantine nodes broadcast the attack vector
+    over the complete topology, each node robust-aggregates its
+    in-neighborhood (:func:`byzpy_tpu.parallel.gossip.build_gossip_train_step`).
+    Accuracy is node 0's (honest) model on held-out data.
+
+    Note: the gossip half-step is plain SGD by construction (parameters
+    themselves gossip; there is no per-node optimizer state to carry
+    momentum) — ``cfg.momentum`` applies only to the PS cells."""
+    if cfg.rounds < 1:
+        raise ValueError(f"rounds must be >= 1 (got {cfg.rounds})")
+    from ..engine.peer_to_peer import Topology
+    from ..parallel.gossip import GossipStepConfig, build_gossip_train_step
+    from .trees import ravel_pytree_fn
+
+    x_train, y_train, x_test, y_test = data
+    bundle = bundle_factory()
+    gcfg = GossipStepConfig(
+        n_nodes=cfg.n_nodes,
+        n_byzantine=cfg.n_byzantine,
+        learning_rate=cfg.learning_rate,
+    )
+    agg_fn = named_aggregator(
+        aggregator, n_nodes=cfg.n_nodes, n_byzantine=cfg.n_byzantine
+    )
+    step, init = build_gossip_train_step(
+        bundle, agg_fn, Topology.complete(cfg.n_nodes), gcfg,
+        attack=named_attack(
+            attack, n_byzantine=cfg.n_byzantine, n_nodes=cfg.n_nodes
+        ),
+        mesh=mesh,
+    )
+    jit_step = jax.jit(step, donate_argnums=(0,))
+
+    sharded = ShardedDataset(x_train, y_train, cfg.n_nodes)
+    xs_all, ys_all = sharded.stacked_shards()
+    _, unravel = ravel_pytree_fn(bundle.params)
+
+    @jax.jit
+    def accuracy(theta) -> jnp.ndarray:
+        logits = bundle.apply_fn(unravel(theta[0]), x_test)
+        return jnp.mean(jnp.argmax(logits, -1) == y_test)
+
+    def step_fn(theta, xs, ys, skey):
+        theta, _ = jit_step(theta, xs, ys, skey)
+        return theta
+
+    history = _train_eval_history(
+        step_fn, init(), xs_all, ys_all, accuracy, cfg
+    )
     return CellResult(aggregator, attack, history[-1][1], history)
 
 
@@ -234,6 +330,7 @@ __all__ = [
     "named_attack",
     "named_aggregator",
     "run_cell",
+    "run_gossip_cell",
     "run_study",
     "results_table",
 ]

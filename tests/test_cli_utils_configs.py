@@ -145,3 +145,31 @@ def test_cli_bench_runs_and_reports(capsys):
                "geometric_median"):
         assert "ms" in report[op], report[op]
         assert report[op]["ms"] > 0
+
+
+def test_cli_study_parser_and_short_run(capsys):
+    """The study subcommand wires mean-vs-robust through the real study
+    harness (tiny round count; the accuracy contracts live in
+    tests/test_robust_learning.py)."""
+    pytest.importorskip("sklearn")
+    from byzpy_tpu.cli import main
+
+    assert main(["study", "--rounds", "2", "--aggregator", "median"]) == 0
+    out = capsys.readouterr().out
+    assert "| aggregator | sign_flip |" in out
+    assert "median" in out and "mean" in out
+
+
+def test_cli_study_choices_match_study_zoo():
+    """The CLI's literal choices (kept import-light) must track the study
+    module's zoo names."""
+    from byzpy_tpu.cli import build_parser
+    from byzpy_tpu.utils.robust_study import STUDY_AGGREGATORS, STUDY_ATTACKS
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._subparsers._group_actions
+    ).choices["study"]
+    by_dest = {a.dest: a for a in sub._actions}
+    assert tuple(by_dest["aggregator"].choices) == STUDY_AGGREGATORS
+    assert tuple(by_dest["attack"].choices) == STUDY_ATTACKS

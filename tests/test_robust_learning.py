@@ -60,3 +60,16 @@ def test_multi_krum_rescues_little(digits, cfg):
 def test_clean_baseline_learns(digits, cfg):
     cell = run_cell(_bundle_factory, digits, "mean", "none", cfg)
     assert cell.final_accuracy > 0.9, cell.row()
+
+
+def test_gossip_mean_poisoned_robust_rescued(digits, cfg):
+    """Decentralized contract: the same attack that poisons plain-mean
+    gossip leaves trimmed-mean gossip learning (node-0 accuracy)."""
+    from byzpy_tpu.utils.robust_study import run_gossip_cell
+
+    poisoned = run_gossip_cell(_bundle_factory, digits, "mean", "sign_flip", cfg)
+    rescued = run_gossip_cell(
+        _bundle_factory, digits, "trimmed_mean", "sign_flip", cfg
+    )
+    assert poisoned.final_accuracy < 0.5, poisoned.row()
+    assert rescued.final_accuracy > 0.8, rescued.row()
