@@ -13,9 +13,16 @@ from .mesh import (
     replicated,
     sharding,
 )
+from .moe import MoEFFN, moe_ffn, top1_dispatch
+from .pipeline import pipeline_forward, stack_stage_params
 from .ps import PSStepConfig, build_ps_train_step, default_optimizer, jit_ps_train_step
 
 __all__ = [
+    "MoEFFN",
+    "moe_ffn",
+    "top1_dispatch",
+    "pipeline_forward",
+    "stack_stage_params",
     "collectives",
     "make_mesh",
     "node_mesh",

@@ -153,3 +153,17 @@ def test_ring_scale_override(mesh):
     oracle = full_attention(q, k, v, scale=0.25)
     np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_ring_invariant_to_shard_count(devices, n_shards):
+    """Exactness must not depend on how many ways the sequence splits."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devices[:n_shards]), ("sp",))
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (64, 16), jnp.float32) for kk in ks)
+    want = np.asarray(full_attention(q, k, v, causal=True))
+    got = np.asarray(ring_attention_sharded(mesh, q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)

@@ -66,3 +66,13 @@ def test_ulysses_agrees_with_ring(devices):
             )
         )
         np.testing.assert_allclose(uly[:, head, :], ring, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_ulysses_invariant_to_shard_count(devices, n_shards):
+    """Exactness must not depend on how many ways the sequence splits."""
+    mesh = Mesh(np.array(devices[:n_shards]), ("sp",))
+    q, k, v = qkv(l=64, h=8, dh=8, seed=9)
+    want = np.asarray(oracle(q, k, v, True))
+    got = np.asarray(ulysses_attention_sharded(mesh, q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
