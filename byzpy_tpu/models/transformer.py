@@ -104,25 +104,43 @@ class MultiHeadAttention(nn.Module):
 
 
 class TransformerBlock(nn.Module):
+    """Pre-LN block; the FFN is dense by default or a routed MoE
+    (``mlp="moe"`` — top-1 routing over the flattened batch*length token
+    set, experts LOCAL to each shard). Expert-parallel sharding of the
+    experts themselves uses :mod:`byzpy_tpu.parallel.moe` directly inside
+    a ``shard_map`` (init and apply must both run under the axis binding
+    so the per-device expert slices agree — see ``tests/test_moe.py``)."""
+
     num_heads: int
     mlp_ratio: int = 4
     causal: bool = False
     attention: str = "full"
     ring_axis: str = "sp"
+    mlp: str = "dense"  # "dense" | "moe"
+    n_experts: int = 8
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        d = x.shape[-1]
+        b, l, d = x.shape
         y = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadAttention(
             self.num_heads, causal=self.causal, attention=self.attention,
             ring_axis=self.ring_axis, dtype=self.dtype,
         )(y)
         y = nn.LayerNorm(dtype=self.dtype)(x)
-        y = nn.Dense(d * self.mlp_ratio, dtype=self.dtype)(y)
-        y = nn.gelu(y)
-        y = nn.Dense(d, dtype=self.dtype)(y)
+        if self.mlp == "moe":
+            from ..parallel.moe import MoEFFN
+
+            moe = MoEFFN(
+                n_experts=self.n_experts, hidden=d * self.mlp_ratio,
+                dtype=self.dtype,
+            )
+            y = moe(y.reshape(b * l, d)).reshape(b, l, d)
+        else:
+            y = nn.Dense(d * self.mlp_ratio, dtype=self.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(d, dtype=self.dtype)(y)
         return x + y
 
 
@@ -136,6 +154,8 @@ class TransformerLM(nn.Module):
     max_len: int = 1024
     attention: str = "full"
     ring_axis: str = "sp"
+    mlp: str = "dense"  # "dense" | "moe"
+    n_experts: int = 8
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -152,7 +172,8 @@ class TransformerLM(nn.Module):
         for _ in range(self.depth):
             x = TransformerBlock(
                 self.num_heads, causal=True, attention=self.attention,
-                ring_axis=self.ring_axis, dtype=self.dtype,
+                ring_axis=self.ring_axis, mlp=self.mlp,
+                n_experts=self.n_experts, dtype=self.dtype,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype)(x)

@@ -102,8 +102,16 @@ def build_ps_train_step(
     feat_spec = None
     if mesh is not None:
         axis = node_axis(mesh)
-        node_spec = NamedSharding(mesh, P(axis))
-        feat_spec = NamedSharding(mesh, P(None, axis))
+        # extra mesh axes join in: per-node batches shard over the FIRST
+        # extra axis (intra-node data parallelism — XLA psums the
+        # batch-mean gradient automatically), and the aggregation matrix
+        # feature-shards over ALL axes so no chip idles during the
+        # robust reduce (a 1-D mesh degenerates to the plain layout)
+        extra = tuple(
+            a for a in mesh.axis_names if a != axis and mesh.shape[a] > 1
+        )
+        node_spec = NamedSharding(mesh, P(axis, *extra[:1]))
+        feat_spec = NamedSharding(mesh, P(None, (axis, *extra)))
 
     def per_node_grad(params, x, y):
         loss, g = jax.value_and_grad(loss_fn)(params, x, y)

@@ -101,3 +101,34 @@ def test_ps_no_byzantine_plain_mean(setup):
     )
     params, opt, metrics = step(bundle.params, opt0, xs, ys, jax.random.PRNGKey(0))
     assert np.isfinite(float(metrics["agg_grad_norm"]))
+
+
+def test_ps_step_2d_grid_mesh_matches_single_device(setup):
+    """A (nodes, data) 2-D mesh must give the same round as no mesh: the
+    batch axis shards over the data axis and the aggregation matrix
+    feature-shards over ALL axes (no idle chips), changing layout only."""
+    from byzpy_tpu.parallel import grid_mesh
+
+    bundle, xs, ys = setup
+    cfg = PSStepConfig(n_nodes=4, n_byzantine=1)
+    xs4, ys4 = xs[:4], ys[:4]
+    key = jax.random.PRNGKey(2)
+
+    step1, opt1 = build_ps_train_step(
+        bundle, lambda m: robust.coordinate_median(m), cfg, attack=_attack
+    )
+    p1, _, m1 = jax.jit(step1)(bundle.params, opt1, xs4, ys4, key)
+
+    mesh = grid_mesh(4, 2)  # 4 nodes x 2-way intra-node data parallelism
+    step2, opt2 = build_ps_train_step(
+        bundle, lambda m: robust.coordinate_median(m), cfg,
+        attack=_attack, mesh=mesh,
+    )
+    p2, _, m2 = jax.jit(step2)(bundle.params, opt2, xs4, ys4, key)
+
+    f1 = np.concatenate([np.ravel(l) for l in jax.tree_util.tree_leaves(p1)])
+    f2 = np.concatenate([np.ravel(l) for l in jax.tree_util.tree_leaves(p2)])
+    np.testing.assert_allclose(f2, f1, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        float(m2["honest_loss"]), float(m1["honest_loss"]), rtol=1e-4
+    )
