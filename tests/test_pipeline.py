@@ -87,3 +87,34 @@ def test_pipeline_gradients_match_sequential(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
         )
+
+
+def test_pipeline_of_transformer_blocks_matches_sequential(devices):
+    """Model-family composition: a 4-stage pipeline of TransformerBlocks
+    (flax params stacked per stage) reproduces the sequential stack."""
+    from byzpy_tpu.models.transformer import TransformerBlock
+
+    p, b, l, d = 4, 2, 8, 16
+    mesh = Mesh(np.array(devices[:p]), ("pp",))
+    block = TransformerBlock(num_heads=4, causal=True)
+    x0 = jax.random.normal(jax.random.PRNGKey(5), (b, l, d))
+    stage_params = [
+        block.init(jax.random.PRNGKey(10 + i), x0) for i in range(p)
+    ]
+
+    seq = x0
+    for sp in stage_params:
+        seq = block.apply(sp, seq)
+
+    stacked = stack_stage_params(stage_params)
+    micro = x0[None]  # one microbatch
+
+    def local(stacked_p, mb):
+        mine = jax.tree_util.tree_map(lambda a: a[0], stacked_p)
+        return pipeline_forward(block.apply, mine, mb, "pp")
+
+    fn = sharded_fn(mesh, "pp", local, in_spec=(P("pp"), P()), out_spec=P())
+    got = fn(stacked, micro)[0]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(seq), rtol=2e-4, atol=2e-5
+    )
