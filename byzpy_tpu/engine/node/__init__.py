@@ -14,6 +14,7 @@ from .remote import (
     ServerNodeContext,
 )
 from .cluster import DecentralizedCluster
+from .liveness import HeartbeatMonitor, PeerLiveness
 from .context import InProcessContext, NodeContext
 from .decentralized import DecentralizedNode
 from .process_context import ProcessContext
@@ -41,5 +42,7 @@ __all__ = [
     "ProcessContext",
     "DecentralizedNode",
     "DecentralizedCluster",
+    "HeartbeatMonitor",
+    "PeerLiveness",
     "MessageRouter",
 ]

@@ -1,0 +1,173 @@
+"""Heartbeat-based failure detection for the decentralized node fabric.
+
+SURVEY §5 lists failure detection among the auxiliary subsystems; the
+reference's coverage is partial (subtask retry + broken-pipe detection).
+This monitor completes the story for the message-driven fabric: each node
+periodically pings its topology neighbors and a peer that misses
+``max_missed`` consecutive heartbeats is declared suspect — the callback
+then drives whatever policy the application wants (drop from the gossip
+neighborhood, trigger re-election, alert).
+
+Design: pure asyncio over the existing message plane (``ping``/``pong``
+envelopes through :class:`DecentralizedNode` messaging) — no extra
+sockets, works identically over in-process, subprocess, hub-TCP and mesh
+contexts. Detection is deliberately conservative: only CONSECUTIVE
+misses count, one pong resets the counter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+_log = logging.getLogger(__name__)
+
+PING = "__liveness_ping__"
+PONG = "__liveness_pong__"
+
+
+@dataclass
+class PeerLiveness:
+    """Mutable liveness record for one neighbor."""
+
+    missed: int = 0
+    suspect: bool = False
+    pongs: int = 0
+
+
+class HeartbeatMonitor:
+    """Drive heartbeats from one node to its in-topology neighbors.
+
+    ``monitor = HeartbeatMonitor(node, interval=0.2); await monitor.start()``
+    — requires the node to be started and topology-bound. ``on_suspect``
+    fires once per transition to suspect (recovery transitions fire
+    ``on_recover``).
+    """
+
+    def __init__(
+        self,
+        node,
+        *,
+        interval: float = 0.5,
+        max_missed: int = 3,
+        on_suspect: Optional[Callable[[str], None]] = None,
+        on_recover: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        if max_missed < 1:
+            raise ValueError(f"max_missed must be >= 1 (got {max_missed})")
+        self.node = node
+        self.interval = interval
+        self.max_missed = max_missed
+        self.on_suspect = on_suspect
+        self.on_recover = on_recover
+        self.peers: Dict[str, PeerLiveness] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._pending: Dict[str, bool] = {}
+        self._handlers_installed = False
+
+    # -- message plumbing ---------------------------------------------------
+
+    @staticmethod
+    def install_responder(node) -> None:
+        """Install only the ping->pong responder. A node that does not
+        monitor anyone still needs this to be SEEN as alive; starting a
+        full monitor installs it implicitly."""
+
+        async def on_ping(message) -> None:
+            await node.reply_message(message.sender, PONG, {})
+
+        node.register_handler(PING, on_ping)
+
+    def _install_handlers(self) -> None:
+        node = self.node
+        self.install_responder(node)
+
+        async def on_pong(message) -> None:
+            sender = message.sender
+            self._pending.pop(sender, None)
+            rec = self.peers.setdefault(sender, PeerLiveness())
+            rec.pongs += 1
+            rec.missed = 0
+            if rec.suspect:
+                rec.suspect = False
+                self._fire(self.on_recover, sender)
+
+        node.register_handler(PONG, on_pong)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        """Install handlers (once — stop()/start() cycles must not stack
+        duplicate pong handlers) and begin the heartbeat loop."""
+        if self._task is not None:
+            raise RuntimeError("monitor already running; stop() first")
+        if not self._handlers_installed:
+            self._install_handlers()
+            self._handlers_installed = True
+        for peer in self._neighbor_ids():
+            self.peers.setdefault(peer, PeerLiveness())
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def _neighbor_ids(self) -> List[str]:
+        return [
+            peer
+            for peer in self.node.router.out_neighbor_ids()
+            if peer != self.node.node_id
+        ]
+
+    def _fire(self, callback, peer: str) -> None:
+        # a raising policy callback must not kill the heartbeat task —
+        # detection outlives one bad drop/alert attempt
+        if callback is None:
+            return
+        try:
+            callback(peer)
+        except Exception:  # noqa: BLE001 — log, keep monitoring
+            _log.exception("liveness callback failed for peer %r", peer)
+
+    async def _loop(self) -> None:
+        while True:
+            # account the PREVIOUS tick's unanswered pings first, so a
+            # pong has the whole interval to arrive
+            for peer, rec in self.peers.items():
+                if self._pending.get(peer):
+                    rec.missed += 1
+                    if rec.missed >= self.max_missed and not rec.suspect:
+                        rec.suspect = True
+                        self._fire(self.on_suspect, peer)
+            for peer in self._neighbor_ids():
+                # late-bound neighbors join the accounting here, so a dead
+                # peer added after start() still gets declared suspect
+                self.peers.setdefault(peer, PeerLiveness())
+                self._pending[peer] = True
+                try:
+                    await self.node.send_message(peer, PING, {})
+                except Exception:  # noqa: BLE001 — unreachable peer: stays pending
+                    pass
+            await asyncio.sleep(self.interval)
+
+    # -- queries ------------------------------------------------------------
+
+    def suspects(self) -> List[str]:
+        """Peers currently considered failed."""
+        return sorted(p for p, r in self.peers.items() if r.suspect)
+
+    def alive(self) -> List[str]:
+        """Peers that answered at least once and are not suspect."""
+        return sorted(
+            p for p, r in self.peers.items() if r.pongs > 0 and not r.suspect
+        )
+
+
+__all__ = ["HeartbeatMonitor", "PeerLiveness", "PING", "PONG"]

@@ -1,0 +1,91 @@
+"""Heartbeat failure detection over the decentralized message fabric."""
+
+import asyncio
+
+import pytest
+
+from byzpy_tpu.engine.node import HeartbeatMonitor
+from byzpy_tpu.engine.peer_to_peer import Topology
+
+
+async def _wait_until(pred, timeout=6.0, step=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(step)
+    return False
+
+
+def test_heartbeat_all_alive(make_cluster):
+    async def run():
+        cluster = make_cluster(3)
+        await cluster.start_all()
+        monitors = [
+            HeartbeatMonitor(node, interval=0.05, max_missed=3)
+            for node in cluster.nodes.values()
+        ]
+        try:
+            for m in monitors:
+                await m.start()
+            ok = await _wait_until(
+                lambda: all(len(m.alive()) == 2 for m in monitors)
+            )
+            assert ok, [m.alive() for m in monitors]
+            assert all(m.suspects() == [] for m in monitors)
+        finally:
+            for m in monitors:
+                await m.stop()
+            await cluster.shutdown_all()
+
+    asyncio.run(run())
+
+
+def test_heartbeat_detects_dead_peer_and_recovery(make_cluster):
+    async def run():
+        cluster = make_cluster(3, topology=Topology.complete(3))
+        await cluster.start_all()
+        nodes = list(cluster.nodes.values())
+        observer = nodes[0]
+        victim = nodes[2]
+        events = []
+        for passive in nodes[1:]:
+            HeartbeatMonitor.install_responder(passive)
+        mon = HeartbeatMonitor(
+            observer, interval=0.05, max_missed=3,
+            on_suspect=lambda p: events.append(("suspect", p)),
+            on_recover=lambda p: events.append(("recover", p)),
+        )
+        await mon.start()
+        try:
+            ok = await _wait_until(lambda: len(mon.alive()) == 2)
+            assert ok, mon.alive()
+
+            # kill the victim: its context leaves the in-process registry,
+            # so pings go undelivered from now on
+            await victim.shutdown()
+            ok = await _wait_until(lambda: victim.node_id in mon.suspects())
+            assert ok, (mon.suspects(), mon.peers)
+            assert ("suspect", victim.node_id) in events
+            # exactly one suspect transition (no flapping)
+            assert events.count(("suspect", victim.node_id)) == 1
+            assert nodes[1].node_id not in mon.suspects()
+        finally:
+            await mon.stop()
+            await cluster.shutdown_all()
+
+    asyncio.run(run())
+
+
+def test_heartbeat_rejects_bad_config(make_cluster):
+    async def run():
+        cluster = make_cluster(2)
+        await cluster.start_all()
+        node = next(iter(cluster.nodes.values()))
+        try:
+            with pytest.raises(ValueError, match="max_missed"):
+                HeartbeatMonitor(node, max_missed=0)
+        finally:
+            await cluster.shutdown_all()
+
+    asyncio.run(run())

@@ -73,3 +73,49 @@ def test_gossip_mean_poisoned_robust_rescued(digits, cfg):
     )
     assert poisoned.final_accuracy < 0.5, poisoned.row()
     assert rescued.final_accuracy > 0.8, rescued.row()
+
+
+def test_study_checkpoint_resume_bitexact(digits, tmp_path):
+    """Interrupt-and-resume through orbax must reproduce the
+    uninterrupted run exactly: train 40 rounds; separately train 20,
+    checkpoint (params, opt_state, key), restore, train 20 more — the
+    final parameters must match to the bit (the PS step is
+    deterministic given the same key schedule)."""
+    import jax
+    from functools import partial
+
+    from byzpy_tpu.models.data import ShardedDataset, sample_node_batches
+    from byzpy_tpu.ops import robust
+    from byzpy_tpu.parallel.ps import PSStepConfig, build_ps_train_step
+    from byzpy_tpu.utils.checkpoint import CheckpointManager
+
+    x_train, y_train, _, _ = digits
+    bundle = _bundle_factory()
+    ps_cfg = PSStepConfig(n_nodes=8, n_byzantine=2, learning_rate=0.1)
+    step, opt0 = build_ps_train_step(
+        bundle, partial(robust.trimmed_mean, f=2), ps_cfg
+    )
+    jit_step = jax.jit(step)
+    sharded = ShardedDataset(x_train, y_train, 8)
+    xs_all, ys_all = sharded.stacked_shards()
+
+    def run(params, opt, key, rounds):
+        for _ in range(rounds):
+            key, bkey, skey = jax.random.split(key, 3)
+            xs, ys = sample_node_batches(xs_all, ys_all, bkey, 16)
+            params, opt, _ = jit_step(params, opt, xs, ys, skey)
+        return params, opt, key
+
+    key0 = jax.random.PRNGKey(0)
+    p_full, _, _ = run(bundle.params, opt0, key0, 40)
+
+    p_half, o_half, k_half = run(bundle.params, opt0, key0, 20)
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        mgr.save(20, {"params": p_half, "opt": o_half, "key": k_half})
+        state = mgr.restore(like={"params": p_half, "opt": o_half, "key": k_half})
+    p_res, _, _ = run(state["params"], state["opt"], state["key"], 20)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_full), jax.tree_util.tree_leaves(p_res)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
