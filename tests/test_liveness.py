@@ -89,3 +89,58 @@ def test_heartbeat_rejects_bad_config(make_cluster):
             await cluster.shutdown_all()
 
     asyncio.run(run())
+
+
+def test_suspect_callback_drives_topology_rebind(make_cluster):
+    """The intended policy loop: a suspect transition shrinks the live
+    nodes' topology, after which broadcasts no longer target the dead
+    peer and keep flowing among survivors."""
+    async def run():
+        cluster = make_cluster(4, topology=Topology.complete(4))
+        await cluster.start_all()
+        nodes = list(cluster.nodes.values())
+        observer, victim = nodes[0], nodes[3]
+        survivors = nodes[:3]
+        for passive in nodes[1:]:
+            HeartbeatMonitor.install_responder(passive)
+
+        rebound = asyncio.Event()
+        suspected = []
+
+        def on_suspect(peer):
+            # record, don't assert: _fire swallows callback exceptions, so
+            # an in-callback assert would surface only as a timeout
+            suspected.append(peer)
+            ids = {i: n.node_id for i, n in enumerate(survivors)}
+            topo = Topology.complete(3)
+            for n in survivors:
+                n.bind_topology(topo, ids)
+            rebound.set()
+
+        mon = HeartbeatMonitor(
+            observer, interval=0.05, max_missed=3, on_suspect=on_suspect
+        )
+        await mon.start()
+        try:
+            ok = await _wait_until(lambda: len(mon.alive()) == 3)
+            assert ok, mon.alive()
+            await victim.shutdown()
+            ok = await _wait_until(rebound.is_set)
+            assert ok, "suspect callback never fired"
+            assert suspected == [victim.node_id], suspected
+
+            got = []
+
+            async def collect(m):
+                got.append(m.payload)
+
+            survivors[1].register_handler("payload", collect)
+            delivered = await observer.broadcast_message("payload", 42)
+            assert victim.node_id not in delivered
+            ok = await _wait_until(lambda: got == [42])
+            assert ok, got
+        finally:
+            await mon.stop()
+            await cluster.shutdown_all()
+
+    asyncio.run(run())
