@@ -91,3 +91,19 @@ def test_scaling_model_efficiency_saturates():
     # comm is ~constant in N: 128-chip efficiency within 3% of 8-chip
     assert abs(pts[0].efficiency - pts[1].efficiency) < 0.03
     assert 0.0 < pts[0].efficiency < 1.0
+
+
+def test_loop_body_collectives_reported_separately(devices):
+    """ring_all_reduce_sum runs its collective-permutes inside fori_loop
+    bodies; the accounting must flag them as per-iteration lower bounds
+    instead of silently under-counting the per-invocation total."""
+    from byzpy_tpu.parallel.collectives import ring_all_reduce_sum, sharded_fn
+
+    mesh = Mesh(np.array(devices[:8]), ("r",))
+    fn = sharded_fn(
+        mesh, "r", lambda s: ring_all_reduce_sum(s, "r"),
+        in_spec=P("r"), out_spec=P("r"),
+    )
+    x = jnp.ones((8, 256), jnp.float32)
+    traffic = collective_traffic(fn, x)
+    assert traffic["loop_body_bytes_per_iteration"] > 0, traffic
