@@ -16,6 +16,9 @@ no TPU mesh is available); on a TPU slice the same code rides the ICI.
 
     python examples/long_context_lm.py          # 6 nodes, 1 byzantine
     N_NODES=8 N_BYZ=2 ROUNDS=30 python examples/long_context_lm.py
+    # the other sequence-parallel scheme, and sparse FFNs:
+    ATTENTION=ulysses python examples/long_context_lm.py
+    MLP=moe python examples/long_context_lm.py
 """
 
 from __future__ import annotations
@@ -58,16 +61,27 @@ def main() -> None:
     n_byz = int(os.environ.get("N_BYZ", "1"))
     rounds = int(os.environ.get("ROUNDS", "20"))
     L = int(os.environ.get("SEQ_LEN", "256"))  # long context, sharded /8
-    vocab, dim, depth, heads = 64, 64, 2, 4
+    # ATTENTION=ring|ulysses picks the sequence-parallel scheme; MLP=moe
+    # swaps the block FFNs for routed mixtures (experts local per shard).
+    # Invalid values would silently fall back to block-local attention
+    # (no cross-shard mixing), so reject them loudly.
+    attention = os.environ.get("ATTENTION", "ring")
+    mlp = os.environ.get("MLP", "dense")
+    if attention not in ("ring", "ulysses"):
+        raise SystemExit(f"ATTENTION must be ring|ulysses (got {attention!r})")
+    if mlp not in ("dense", "moe"):
+        raise SystemExit(f"MLP must be dense|moe (got {mlp!r})")
+    vocab, dim, depth, heads = 64, 64, 2, 8 if attention == "ulysses" else 4
 
     mesh = make_mesh([8], ("sp",))
     model = TransformerLM(
         vocab_size=vocab, dim=dim, depth=depth, num_heads=heads,
-        max_len=L, attention="ring", ring_axis="sp",
+        max_len=L, attention=attention, ring_axis="sp",
+        mlp=mlp, n_experts=4,
     )
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
     flat0, unravel = stack_gradients([params])
-    print(f"ring LM over L={L} (8 x {L // 8} per device), "
+    print(f"{attention} LM ({mlp} FFN) over L={L} (8 x {L // 8} per device), "
           f"{flat0.shape[1]} params, {n_nodes} honest + {n_byz} byzantine")
 
     # sequence-parallel loss: logits stay sequence-sharded; the per-block
