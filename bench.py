@@ -70,6 +70,11 @@ def main() -> None:
                 "provenance": "benchmarks/results/overrides.jsonl "
                               "(committed before the tunnel outage)",
             },
+            "cpu_measured_this_round": {
+                "robust_learning_mean_vs_trimmed_under_signflip": [0.087, 0.915],
+                "provenance": "benchmarks/ROBUST_LEARNING.md + BREAKDOWN.md "
+                              "(real-data accuracy studies, CPU mesh)",
+            },
         }))
         return
 
