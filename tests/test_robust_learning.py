@@ -119,3 +119,21 @@ def test_study_checkpoint_resume_bitexact(digits, tmp_path):
         jax.tree_util.tree_leaves(p_full), jax.tree_util.tree_leaves(p_res)
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cnn_family_rescued_too(digits):
+    """Model-family diversity: the reference's SmallCNN architecture shows
+    the same contract on real data — mean destroyed, trimmed-mean
+    learning — so the robust-learning result is not an MLP artifact."""
+    from functools import partial
+
+    from byzpy_tpu.models.nets import SmallCNN, make_bundle
+
+    def cnn_factory():
+        return make_bundle(SmallCNN(), (1, 8, 8, 1), seed=0)
+
+    cfg = StudyConfig(rounds=80, eval_every=80, learning_rate=0.05)
+    poisoned = run_cell(cnn_factory, digits, "mean", "sign_flip", cfg)
+    rescued = run_cell(cnn_factory, digits, "trimmed_mean", "sign_flip", cfg)
+    assert poisoned.final_accuracy < 0.5, poisoned.row()
+    assert rescued.final_accuracy > 0.8, rescued.row()
