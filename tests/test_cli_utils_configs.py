@@ -173,3 +173,20 @@ def test_cli_study_choices_match_study_zoo():
     by_dest = {a.dest: a for a in sub._actions}
     assert tuple(by_dest["aggregator"].choices) == STUDY_AGGREGATORS
     assert tuple(by_dest["attack"].choices) == STUDY_ATTACKS
+
+
+def test_apply_env_platform_reasserts_env(monkeypatch):
+    """The helper must push JAX_PLATFORMS through jax.config (plugin
+    sitecustomizes override the env var at import time) and no-op
+    cleanly when unset. The suite already runs on cpu, so re-asserting
+    'cpu' is safe and observable."""
+    from byzpy_tpu.utils.platform import apply_env_platform
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert apply_env_platform() is None
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert apply_env_platform() == "cpu"
+    import jax
+
+    assert jax.config.jax_platforms == "cpu"
