@@ -83,7 +83,9 @@ def main() -> int:
         lines.append(f"| {agg} | {cells} |")
     lines += [
         "",
-        "Reproduce: `python benchmarks/breakdown_study.py --write`.",
+        "Reproduce: `python benchmarks/breakdown_study.py --write`;",
+        "plot: `python benchmarks/plot_robust_learning.py` ->",
+        "![breakdown](results/breakdown.png)",
         "",
     ]
     table = "\n".join(lines)

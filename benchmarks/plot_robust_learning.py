@@ -54,8 +54,40 @@ def main() -> int:
     out = os.path.join(RESULTS, "robust_learning.png")
     fig.savefig(out, dpi=130, bbox_inches="tight")
     print(f"wrote {out}")
+    plot_breakdown()
     return 0
 
 
+
+
+def plot_breakdown(path=None):
+    """Companion panel: accuracy vs byzantine count per aggregator
+    (reads results/breakdown.jsonl; last row per cell wins)."""
+    path = path or os.path.join(RESULTS, "breakdown.jsonl")
+    if not os.path.exists(path):
+        return None
+    cells = {}
+    for r in load_jsonl(path):
+        cells[(r["aggregator"], r["n_byzantine"])] = r
+    aggs = list(dict.fromkeys(a for a, _ in cells))
+    fs = sorted({f for _, f in cells})
+    fig, ax = plt.subplots(figsize=(5, 3.4))
+    for agg in aggs:
+        acc = [cells[(agg, f)]["final_accuracy"] for f in fs if (agg, f) in cells]
+        style = dict(linewidth=2.2) if agg == "mean" else dict(linewidth=1.4)
+        ax.plot(fs[: len(acc)], acc, marker="o", label=agg, **style)
+    any_row = next(iter(cells.values()))
+    ax.set_xlabel("byzantine nodes (of %d)" % any_row.get("n_nodes", 8))
+    ax.set_ylabel("held-out accuracy")
+    ax.set_ylim(0.0, 1.0)
+    ax.set_xticks(fs)
+    ax.grid(alpha=0.3)
+    ax.legend(fontsize=8)
+    ax.set_title(f"breakdown under {any_row.get('attack', '?')}")
+    fig.tight_layout()
+    out = os.path.join(RESULTS, "breakdown.png")
+    fig.savefig(out, dpi=130, bbox_inches="tight")
+    print(f"wrote {out}")
+    return out
 if __name__ == "__main__":
     raise SystemExit(main())
