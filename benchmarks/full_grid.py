@@ -55,15 +55,18 @@ def ps_multi_krum_round_ms(rounds=50):
     SmallCNN-scale gradients (d=21,840 ~= the reference's MNIST SmallCNN).
 
     Node-local gradient computation happens on the HOST (numpy), exactly
-    like the reference's CPU nodes; only the attack + robust aggregate run
-    on device. This matters through a tunneled chip: every device call
-    pays a milliseconds-scale enqueue, so a node model that dispatched 2
-    device ops per node per round (the round-2 bench) measured the
-    tunnel's control-plane (~66 ms/round), not the framework: heterogeneous
-    actor-mode nodes are host-side workers by definition — device-resident
-    nodes belong to the fused SPMD path (parallel/ps.py)."""
+    like the reference's CPU nodes — and so do the attack and the robust
+    aggregate, via the framework's latency-aware placement policy
+    (``utils.placement``): all inputs are host-resident and far below the
+    size cap, so the whole round runs on the CPU backend with ZERO
+    accelerator traffic. Through a network-tunneled chip this is the
+    difference between ~24 ms/round (transfer + dispatch bound, and
+    unstable under tunnel backpressure) and a stable single-digit round.
+    Device-resident nodes belong to the fused SPMD path (parallel/ps.py)."""
     import numpy as np
     import time
+
+    from byzpy_tpu.attacks import EmpireAttack
 
     d = 21_840
 
@@ -79,8 +82,10 @@ def ps_multi_krum_round_ms(rounds=50):
             self.grad = g
 
     class Byz(Node):
+        attack = EmpireAttack(scale=-1.0)
+
         def byzantine_gradient_for_next_batch(self, honest):
-            return [attack_ops.empire(jnp.stack([h[0] for h in honest]), scale=-1.0)]
+            return [self.attack.apply_placed(honest_grads=[h[0] for h in honest])]
 
     ps = ParameterServer(
         honest_nodes=[Node(i) for i in range(10)],

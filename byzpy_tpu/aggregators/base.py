@@ -20,6 +20,7 @@ from typing import Any, Callable, Mapping, Sequence
 import jax.numpy as jnp
 
 from ..engine.graph.operator import OpContext, Operator
+from ..utils import placement
 from ..utils.trees import stack_gradients
 
 
@@ -38,10 +39,16 @@ class Aggregator(Operator, ABC):
         return self.aggregate(gradients)
 
     def aggregate(self, gradients: Sequence[Any]) -> Any:
-        """Reduce a sequence of gradients to one aggregated gradient."""
-        matrix, unravel = stack_gradients(gradients)
-        self.validate_n(matrix.shape[0])
-        return unravel(self._aggregate_matrix(matrix))
+        """Reduce a sequence of gradients to one aggregated gradient.
+
+        Placement: small host-resident inputs (actor-mode nodes hand over
+        numpy arrays) run on the CPU backend instead of paying a
+        host->accelerator round-trip; see ``utils.placement``.
+        """
+        with placement.on(placement.compute_device(gradients)):
+            matrix, unravel = stack_gradients(gradients)
+            self.validate_n(matrix.shape[0])
+            return unravel(self._aggregate_matrix(matrix))
 
     def aggregate_stream(self, rounds: Sequence[Sequence[Any]]) -> list:
         """Aggregate ``K`` buffered rounds in ONE device dispatch.
@@ -56,15 +63,16 @@ class Aggregator(Operator, ABC):
         (``ops.robust.aggregate_stream``)."""
         if not rounds:
             return []
-        stacked = []
-        unravel = None
-        for grads in rounds:
-            matrix, unravel = stack_gradients(grads)
-            self.validate_n(matrix.shape[0])
-            stacked.append(matrix)
-        xs = jnp.stack(stacked)
-        ys = self._aggregate_stream_matrix(xs)
-        return [unravel(ys[i]) for i in range(ys.shape[0])]
+        with placement.on(placement.compute_device(rounds)):
+            stacked = []
+            unravel = None
+            for grads in rounds:
+                matrix, unravel = stack_gradients(grads)
+                self.validate_n(matrix.shape[0])
+                stacked.append(matrix)
+            xs = jnp.stack(stacked)
+            ys = self._aggregate_stream_matrix(xs)
+            return [unravel(ys[i]) for i in range(ys.shape[0])]
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         """Aggregate stacked rounds ``(K, n, d)`` to ``(K, d)``."""

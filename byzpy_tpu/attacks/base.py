@@ -30,7 +30,17 @@ class Attack(Operator, ABC):
     name = "attack"
 
     def compute(self, inputs: Mapping[str, Any], *, context: OpContext) -> Any:
-        return self.apply(**self._collect_inputs(inputs))
+        return self.apply_placed(**self._collect_inputs(inputs))
+
+    def apply_placed(self, **kwargs: Any) -> Any:
+        """``apply`` under the latency-aware placement policy: small
+        host-resident inputs compute on the CPU backend instead of paying
+        a host->accelerator round-trip (see ``utils.placement``; the
+        scheduled/graph path routes through here automatically)."""
+        from ..utils import placement
+
+        with placement.on(placement.compute_device(kwargs)):
+            return self.apply(**kwargs)
 
     @abstractmethod
     def apply(

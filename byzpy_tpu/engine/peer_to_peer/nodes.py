@@ -113,7 +113,7 @@ class AttackP2PWorker(ByzantineP2PWorker):
             kwargs["honest_grads"] = list(honest_vectors)
         if getattr(self.attack, "uses_base_grad", False):
             kwargs["base_grad"] = honest_vectors[0]
-        return self.attack.apply(**kwargs)
+        return self.attack.apply_placed(**kwargs)
 
 
 class FunctionP2PWorker(ByzantineP2PWorker):

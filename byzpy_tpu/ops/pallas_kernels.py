@@ -37,6 +37,14 @@ _SUBLANES = 8
 
 
 def _on_tpu() -> bool:
+    # An active jax.default_device context (e.g. utils.placement routing
+    # a small host-resident aggregate to the CPU backend) overrides the
+    # process default: real Mosaic lowering must not be attempted there.
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        # jax accepts both Device objects and platform strings here.
+        platform = dev if isinstance(dev, str) else getattr(dev, "platform", None)
+        return platform == "tpu"
     return jax.default_backend() == "tpu"
 
 
@@ -953,10 +961,17 @@ def _nnm_stream_kernel(
     def _():
         taint_col = t_ref[0, :][:, None]  # f32 minor-dim insert
         xt = jnp.where(taint_col > 0.5, 0.0, x_ref[0].astype(jnp.float32))
+        # This dot FORMS THE OUTPUT (unlike the Gram, whose ~2^-9 MXU
+        # default-precision error only perturbs distance near-ties), so
+        # it must not truncate xt to bf16: on real Mosaic the MXU's
+        # default single-pass multiply showed 3.3e-3 max error vs the
+        # gather+mean oracle at 16x524288 f32. HIGHEST (bf16x6) restores
+        # full f32 fidelity; the mask side is 0/1 and exact either way.
         mixed = jax.lax.dot_general(
             w_ref[:], xt,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
         sel_taint_col = t_ref[1, :][:, None]
         out = jnp.where(sel_taint_col > 0.5, jnp.nan, mixed / k)

@@ -14,6 +14,7 @@ from typing import Any, List, Mapping, Sequence
 import jax.numpy as jnp
 
 from ..engine.graph.operator import OpContext, Operator
+from ..utils import placement
 from ..utils.trees import stack_gradients, unstack_rows
 
 
@@ -32,10 +33,12 @@ class PreAggregator(Operator, ABC):
         return self.pre_aggregate(values)
 
     def pre_aggregate(self, xs: Sequence[Any]) -> List[Any]:
-        matrix, unravel = stack_gradients(xs)
-        self.validate_n(matrix.shape[0])
-        out = self._transform_matrix(matrix)
-        return unstack_rows(out, unravel)
+        # Placement: see Aggregator.aggregate / utils.placement.
+        with placement.on(placement.compute_device(xs)):
+            matrix, unravel = stack_gradients(xs)
+            self.validate_n(matrix.shape[0])
+            out = self._transform_matrix(matrix)
+            return unstack_rows(out, unravel)
 
     def pre_aggregate_stream(
         self, rounds: Sequence[Sequence[Any]]
@@ -47,14 +50,15 @@ class PreAggregator(Operator, ABC):
         transform."""
         if not rounds:
             return []
-        stacked = []
-        unravel = None
-        for xs in rounds:
-            matrix, unravel = stack_gradients(xs)
-            self.validate_n(matrix.shape[0])
-            stacked.append(matrix)
-        ys = self._transform_stream_matrix(jnp.stack(stacked))
-        return [unstack_rows(ys[i], unravel) for i in range(ys.shape[0])]
+        with placement.on(placement.compute_device(rounds)):
+            stacked = []
+            unravel = None
+            for xs in rounds:
+                matrix, unravel = stack_gradients(xs)
+                self.validate_n(matrix.shape[0])
+                stacked.append(matrix)
+            ys = self._transform_stream_matrix(jnp.stack(stacked))
+            return [unstack_rows(ys[i], unravel) for i in range(ys.shape[0])]
 
     def _transform_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         from jax import lax

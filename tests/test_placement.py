@@ -1,0 +1,118 @@
+"""Latency-aware placement policy (``utils.placement``).
+
+The suite runs on the CPU backend (conftest), where the policy is
+deliberately inert — so the decision function is exercised by
+monkeypatching the backend probe, and the *mechanics* (context manager,
+leaf classification, env cap) are tested directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byzpy_tpu.utils import placement
+
+
+def _pretend_accelerator(monkeypatch):
+    monkeypatch.setattr(placement.jax, "default_backend", lambda: "tpu")
+
+
+def test_inert_on_cpu_backend():
+    # Real environment here: default backend IS cpu -> never narrows.
+    assert placement.compute_device([np.zeros(4, np.float32)]) is None
+
+
+def test_host_numpy_inputs_place_on_cpu(monkeypatch):
+    _pretend_accelerator(monkeypatch)
+    dev = placement.compute_device([np.zeros(4, np.float32), 1.5, None])
+    assert dev is not None and dev.platform == "cpu"
+
+
+def test_cpu_jax_arrays_count_as_host(monkeypatch):
+    _pretend_accelerator(monkeypatch)
+    x = jnp.zeros(8)  # on the CPU backend in this suite
+    assert placement.compute_device([x, np.ones(2)]) is not None
+
+
+def test_accelerator_resident_leaf_blocks_host_placement(monkeypatch):
+    _pretend_accelerator(monkeypatch)
+
+    class _OpaqueDeviceHandle:
+        """Not host-classifiable -> the policy must refuse to narrow."""
+
+    assert (
+        placement.compute_device([np.zeros(2, np.float32), _OpaqueDeviceHandle()])
+        is None
+    )
+
+
+def test_size_cap_and_env_override(monkeypatch):
+    _pretend_accelerator(monkeypatch)
+    big = np.zeros(placement.DEFAULT_HOST_COMPUTE_BYTES // 4 + 1, np.float32)
+    assert placement.compute_device([big]) is None
+    monkeypatch.setenv("BYZPY_TPU_HOST_COMPUTE_BYTES", "0")
+    assert placement.host_compute_max_bytes() == 0
+    assert placement.compute_device([np.zeros(1, np.float32)]) is None
+    monkeypatch.setenv("BYZPY_TPU_HOST_COMPUTE_BYTES", "not-a-number")
+    assert placement.host_compute_max_bytes() == placement.DEFAULT_HOST_COMPUTE_BYTES
+
+
+def test_explicit_default_device_context_wins(monkeypatch):
+    _pretend_accelerator(monkeypatch)
+    with jax.default_device(jax.devices("cpu")[0]):
+        assert placement.compute_device([np.zeros(2, np.float32)]) is None
+
+
+def test_on_context_manager_noop_and_device():
+    with placement.on(None):
+        pass
+    cpu = jax.devices("cpu")[0]
+    with placement.on(cpu):
+        assert jax.config.jax_default_device is cpu
+
+
+def test_aggregate_runs_correctly_through_placement(monkeypatch):
+    # End-to-end: policy says host; the aggregate must be numerically
+    # identical to the unplaced path.
+    from byzpy_tpu.aggregators import MultiKrum
+
+    grads = [np.random.default_rng(i).standard_normal(64).astype(np.float32)
+             for i in range(8)]
+    agg = MultiKrum(f=2, q=3)
+    want = np.asarray(agg.aggregate(grads))
+    _pretend_accelerator(monkeypatch)
+    got = np.asarray(agg.aggregate(grads))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_attack_apply_placed(monkeypatch):
+    from byzpy_tpu.attacks import EmpireAttack
+
+    grads = [np.ones(16, np.float32) * (i + 1) for i in range(4)]
+    atk = EmpireAttack(scale=-1.0)
+    want = np.asarray(atk.apply(honest_grads=grads))
+    _pretend_accelerator(monkeypatch)
+    got = np.asarray(atk.apply_placed(honest_grads=grads))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_on_tpu_gate_respects_default_device_context():
+    from byzpy_tpu.ops import pallas_kernels as pk
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        assert pk._on_tpu() is False
+
+
+def test_preaggregate_through_placement(monkeypatch):
+    from byzpy_tpu.pre_aggregators import Clipping
+
+    xs = [np.full(8, 10.0, np.float32) for _ in range(3)]
+    pre = Clipping(threshold=1.0)
+    want = [np.asarray(v) for v in pre.pre_aggregate(xs)]
+    _pretend_accelerator(monkeypatch)
+    got = [np.asarray(v) for v in pre.pre_aggregate(xs)]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-6)
