@@ -415,28 +415,31 @@ def _weighted_center_step_kernel(
 
         diff = x_ref[:].astype(jnp.float32) - z_ref[:].astype(jnp.float32)
         dist2_ref[:] += jnp.sum(diff * diff, axis=1, keepdims=True)
-        o_ref[:] = jnp.zeros_like(o_ref)
 
     @pl.when((p == 1) & (c == 0))
     def _():
         row_i = lax.broadcasted_iota(jnp.int32, (n_pad, 1), 0)
         dist = jnp.sqrt(dist2_ref[:])
+        # Mosaic cannot store (or reliably load) scalars in VMEM — keep
+        # alpha as a (1, 1) vector value end to end (scalar-indexed
+        # ``alpha_ref[0, 0] = ...`` fails real lowering; interpret mode
+        # accepted it silently).
         if mode == "weiszfeld":
             w = 1.0 / jnp.maximum(dist, eps)
             w = jnp.where(row_i < n_real, w, 0.0)
             w_ref[:] = w / jnp.sum(w)
-            alpha_ref[0, 0] = 0.0
+            alpha_ref[:, :] = jnp.zeros((1, 1), jnp.float32)
         else:  # clip
             w = jnp.minimum(1.0, c_tau / jnp.maximum(dist, eps)) / n_real
             w = jnp.where(row_i < n_real, w, 0.0)
             w_ref[:] = w
-            alpha_ref[0, 0] = 1.0 - jnp.sum(w)
+            alpha_ref[:, :] = 1.0 - jnp.sum(w, axis=0, keepdims=True)
 
     @pl.when(p == 1)
     def _():
         zt = z_ref[:].astype(jnp.float32)
         xt = x_ref[:].astype(jnp.float32)
-        out = alpha_ref[0, 0] * zt + jnp.sum(
+        out = alpha_ref[0:1, 0:1] * zt + jnp.sum(
             xt * w_ref[:], axis=0, keepdims=True
         )
         o_ref[:] = out.astype(o_ref.dtype)
@@ -494,8 +497,10 @@ def weighted_center_step_pallas(
                 (1, tile), lambda p, c: (0, c), memory_space=pltpu.VMEM
             ),
         ],
+        # ``c * p`` parks the output on block (0, 0) through phase 0 (see
+        # _nnm_stream_kernel's out_specs note).
         out_specs=pl.BlockSpec(
-            (1, tile), lambda p, c: (0, c), memory_space=pltpu.VMEM
+            (1, tile), lambda p, c: (0, c * p), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
             pltpu.VMEM((n_pad, 1), jnp.float32),
@@ -545,7 +550,6 @@ def _meamed_stream_kernel(
         has_nan = srt[n_real - 1] > _INF_KEY
         med = jnp.where(has_nan, jnp.nan, med)
         med_ref[0, pl.dslice(c * x_ref.shape[-1], x_ref.shape[-1])] = med
-        o_ref[0] = jnp.zeros_like(o_ref[0])
 
     @pl.when(p == 1)
     def _():
@@ -612,8 +616,11 @@ def meamed_stream_pallas(
                 memory_space=pltpu.VMEM,
             )
         ],
+        # ``c * p`` parks the output on block (k, 0, 0) through phase 0 so
+        # the median sweep writes nothing to HBM (see _nnm_stream_kernel's
+        # out_specs note); phase 1 fully overwrites every block.
         out_specs=pl.BlockSpec(
-            (1, 1, tile), lambda k, p, c: (k, 0, c), memory_space=pltpu.VMEM
+            (1, 1, tile), lambda k, p, c: (k, 0, c * p), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[pltpu.VMEM((1, d_pad), jnp.float32)],
         interpret=interpret,
@@ -779,7 +786,6 @@ def _selection_mean_stream_kernel(
     @pl.when(p == 0)
     def _():
         _accumulate_gram(x_ref[0], gram_ref, c)
-        o_ref[0] = jnp.zeros_like(o_ref[0])
 
     @pl.when((p == 1) & (c == 0))
     def _():
@@ -853,8 +859,11 @@ def selection_mean_stream_pallas(
                 memory_space=pltpu.VMEM,
             )
         ],
+        # ``c * p`` parks the output on block (k, 0, 0) through phase 0 —
+        # no HBM output traffic during the Gram sweep (see
+        # _nnm_stream_kernel's out_specs note).
         out_specs=pl.BlockSpec(
-            (1, 1, tile), lambda k, p, c: (k, 0, c), memory_space=pltpu.VMEM
+            (1, 1, tile), lambda k, p, c: (k, 0, c * p), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
             pltpu.VMEM((n_pad, n_pad), jnp.float32),
@@ -946,7 +955,6 @@ def _nnm_stream_kernel(
     @pl.when(p == 0)
     def _():
         _accumulate_gram(x_ref[0], gram_ref, c)
-        o_ref[0] = jnp.zeros_like(o_ref[0])
 
     @pl.when((p == 1) & (c == 0))
     def _():
@@ -1018,8 +1026,16 @@ def nnm_stream_pallas(
                 memory_space=pltpu.VMEM,
             )
         ],
+        # Output map parks on block (kk, 0, 0) through all of phase 0
+        # (``c * p`` = 0 there): Mosaic only DMAs a block when its index
+        # changes between steps, so the Gram phase writes NOTHING to HBM
+        # — without this the kernel paid a full garbage (n, d) output
+        # pass during phase 0 (4 HBM sweeps, measured slower than XLA's
+        # einsum path at 64x1M; 3 sweeps beat it). Block (kk, 0, 0) is
+        # fully overwritten by the phase-1 c=0 step before its index
+        # ever advances, so the parked visits never leak garbage.
         out_specs=pl.BlockSpec(
-            (1, n_pad, tile), lambda kk, p, c: (kk, 0, c),
+            (1, n_pad, tile), lambda kk, p, c: (kk, 0, c * p),
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
