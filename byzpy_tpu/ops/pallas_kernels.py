@@ -345,7 +345,7 @@ def sorted_reduce_stream_pallas(
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
         # sort happens on f32 rows in VMEM regardless of input dtype
-        tile = _auto_selection_tile(d, n_pad, 4)
+        tile = _auto_sort_tile(d, n_pad)
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -592,14 +592,9 @@ def meamed_stream_pallas(
         interpret = not _on_tpu()
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
-        tile = _auto_selection_tile(d, n_pad, 4)
-        # unlike the other kernels, the (1, d) f32 median scratch also
-        # lives in scoped VMEM — shrink the input tile until the double-
-        # buffered block plus the scratch fit the ~16 MiB budget
-        while tile > _LANES and (
-            2 * n_pad * tile * 4 + 4 * _round_up(d, tile) > 13 * 1024 * 1024
-        ):
-            tile //= 2
+        # sort-aware budget, minus the (1, d) f32 median scratch that
+        # also lives in scoped VMEM
+        tile = _auto_sort_tile(d, n_pad, extra_bytes=4 * d)
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -754,6 +749,30 @@ def _auto_selection_tile(d: int, n_pad: int = 64, itemsize: int = 4) -> int:
         if d % t == 0 and 2 * n_pad * t * itemsize <= budget:
             return t
     return 4096
+
+
+def _auto_sort_tile(d: int, n_pad: int, extra_bytes: int = 0) -> int:
+    """Feature tile for the SORT-based kernels (sorted-reduce, MeaMed).
+
+    A Batcher network's live working set is far larger than the input
+    block — the f32 up-cast, int32 keys, and the network's stage
+    temporaries put Mosaic's measured scoped-stack allocation at ~8-9x
+    ``n_pad * tile * 4`` (34.35 MiB at 64x16384, observed on v5e; the
+    compile-time scoped-VMEM limit is 16 MiB, and interpret mode never
+    checks it). Budget 10 copies plus the caller's ``extra_bytes``
+    (MeaMed's (1, d) median scratch) against a 14 MiB cap."""
+    budget = 14 * 1024 * 1024 - extra_bytes
+    candidates = (16384, 8192, 4096, 2048, 1024, 512, 256, 128)
+    for t in candidates:
+        if d % t == 0 and 10 * n_pad * t * 4 <= budget:
+            return t
+    # No exact divisor fits: take the largest budget-fitting tile and let
+    # the caller pad d up to it (a pad copy beats hundreds of tiny
+    # grid steps).
+    for t in candidates:
+        if 10 * n_pad * t * 4 <= budget:
+            return t
+    return 128
 
 
 def _selection_mean_stream_kernel(

@@ -726,3 +726,52 @@ def test_geometric_median_and_clipping_dispatch_when_forced(monkeypatch):
         s = np.minimum(1.0, 2.0 / np.maximum(dist, 1e-12))
         v = v + ((xa - v) * s[:, None]).mean(0)
     np.testing.assert_allclose(np.asarray(got_cc), v, rtol=1e-4, atol=1e-4)
+
+
+def test_sort_tile_budget_respects_scoped_vmem():
+    """Regression: the sort-based kernels' working set is ~8-9x the input
+    block (f32 up-cast + int32 keys + Batcher stage temporaries), and
+    Mosaic's scoped-VMEM limit is 16 MiB — a 64x16384 tile measured a
+    34.35 MiB scoped stack on v5e (compile-time OOM that interpret mode
+    never sees). The budget must keep 10 f32 copies of the block under
+    ~14 MiB for every (d, n_pad) the dispatch gates admit."""
+    from byzpy_tpu.ops.pallas_kernels import (
+        MAX_NETWORK_ROWS, _auto_sort_tile, _round_up, _SUBLANES,
+    )
+
+    for n in (8, 16, 17, 24, 64, 100, MAX_NETWORK_ROWS):
+        n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+        for d in (65_536, 262_144, 1_048_576, 2_097_152):
+            tile = _auto_sort_tile(d, n_pad)
+            assert 10 * n_pad * tile * 4 <= 14 * 1024 * 1024, (n, d, tile)
+            assert d % tile == 0
+    # MeaMed also charges its (1, d) f32 median scratch to the budget
+    tile = _auto_sort_tile(1_048_576, 64, extra_bytes=4 * 1_048_576)
+    assert 10 * 64 * tile * 4 + 4 * 1_048_576 <= 14 * 1024 * 1024
+
+
+def test_phase_parked_kernels_interpret_parity():
+    """The ``c * p`` phase-parked output maps (no HBM output traffic
+    during the Gram/median sweep) must leave no unwritten garbage blocks
+    at any (K, C) combination, including C == 1 where phase 0 and phase 1
+    share a single block index."""
+    from byzpy_tpu.ops import pallas_kernels as pk
+    from byzpy_tpu.ops import preagg
+
+    for d in (256, 1024):  # C = 2 and 8 at tile=128; plus C=1 via tile=d
+        for tile in (128, d):
+            xs = jax.random.normal(jax.random.PRNGKey(3), (3, 10, d))
+            got = pk.nnm_stream_pallas(xs, f=3, tile=tile, interpret=True)
+            want = jax.vmap(lambda x: preagg.nnm(x, f=3))(xs)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+            )
+            got = pk.selection_mean_stream_pallas(
+                xs, f=3, q=4, tile=tile, interpret=True
+            )
+            want = jax.vmap(
+                lambda x: robust.ranked_mean(x, robust.krum_scores(x, f=3), 4)
+            )(xs)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+            )
