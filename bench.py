@@ -66,9 +66,10 @@ def main() -> None:
             "vs_baseline": None,
             "error": f"device unavailable: {type(exc).__name__}: {exc}",
             "last_measured_in_session": {
-                "value": 81191.54, "bf16": 148127.33, "stream_K": 32,
+                "value": 81704.0, "bf16": 150281.0, "stream_K": 32,
                 "provenance": "benchmarks/results/overrides.jsonl "
-                              "(committed before the tunnel outage)",
+                              "(round-4 driver-session tunnel measurement, "
+                              "post phase-parked output maps)",
             },
             "cpu_measured_this_round": {
                 "robust_learning_mean_vs_trimmed_under_signflip": [0.087, 0.915],

@@ -1,0 +1,122 @@
+"""Round-4 on-chip re-measurement bundle (the work queued behind the
+mid-session tunnel outage; the first half of the round-4 on-chip work —
+kernel parity, SMEA/PS grid rows, headline — landed before it and is
+recorded in ``results/overrides.jsonl``).
+
+Runs, printing one JSON line per row:
+
+* 64x1M real-lowering parity for the sort-based kernels under the new
+  ``_auto_sort_tile`` budget (the old tile OOM'd Mosaic's scoped VMEM at
+  this shape — never reachable before the fix)
+* per-kernel roofline cells at 64x1M f32, K=32 stream amortization
+  (docs/performance.md pending cells): sorted-reduce median/trimmed,
+  MeaMed, NNM, weighted-center (32 fori_loop iterations per dispatch)
+* north-star refresh: cw_median single-dispatch + stream (the 6.90 ms
+  grid.jsonl row predates the fused kernel)
+
+Usage: python benchmarks/rerun_round4.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import jax.numpy as jnp
+
+from byzpy_tpu.ops import robust
+from byzpy_tpu.ops.pallas_kernels import (
+    meamed_stream_pallas,
+    nnm_stream_pallas,
+    selection_mean_stream_pallas,
+    sorted_reduce_stream_pallas,
+    weighted_center_step_pallas,
+)
+from byzpy_tpu.utils.metrics import timed_call_s
+
+
+def emit(**row) -> None:
+    print(json.dumps(row), flush=True)
+
+
+def parity_64x1m(x) -> None:
+    got = sorted_reduce_stream_pallas(x[None], mode="median")[0]
+    want = jnp.median(x, axis=0)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err == 0.0, f"sorted-reduce median 64x1M: {err}"
+    got = sorted_reduce_stream_pallas(x[None], mode="trimmed", f=8)[0]
+    s = jnp.sort(x, axis=0)
+    want = jnp.mean(s[8:-8], axis=0)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-5, f"sorted-reduce trimmed 64x1M: {err}"
+    got = meamed_stream_pallas(x[None], f=8)[0]
+    med = jnp.median(x, axis=0)
+    order = jnp.argsort(jnp.abs(x - med[None, :]), axis=0)[:56]
+    want = jnp.mean(jnp.take_along_axis(x, order, axis=0), axis=0)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-5, f"meamed 64x1M: {err}"
+    emit(check="sort_kernels_64x1M_parity", ok=True)
+
+
+def main() -> None:
+    print(f"# device={jax.devices()[0]}", file=sys.stderr)
+    K = 32
+    xs = jax.random.normal(jax.random.PRNGKey(5), (K, 64, 1 << 20), jnp.float32)
+    parity_64x1m(xs[0])
+
+    def run(name, fn, *args, per_round=K, repeat=10):
+        t = timed_call_s(jax.jit(fn), *args, warmup=2, repeat=repeat)
+        t = t / per_round * 1e3
+        emit(kernel=name, ms_per_round=round(t, 3))
+        return t
+
+    run("selection_mean_stream_pallas",
+        functools.partial(selection_mean_stream_pallas, f=8, q=12), xs)
+    run("sorted_reduce_stream_pallas_median",
+        functools.partial(sorted_reduce_stream_pallas, mode="median"), xs)
+    run("sorted_reduce_stream_pallas_trimmed",
+        functools.partial(sorted_reduce_stream_pallas, mode="trimmed", f=8), xs)
+    run("meamed_stream_pallas", functools.partial(meamed_stream_pallas, f=8), xs)
+    run("nnm_stream_pallas", functools.partial(nnm_stream_pallas, f=8), xs)
+
+    x1, z0 = xs[0], jnp.mean(xs[0], axis=0)
+
+    def iter_center(mode):
+        def fn(x, z):
+            body = lambda i, zz: weighted_center_step_pallas(  # noqa: E731
+                x, zz, mode=mode, c_tau=1.0)
+            return jax.lax.fori_loop(0, 32, body, z)
+        return fn
+
+    run("weighted_center_step_pallas_weiszfeld", iter_center("weiszfeld"),
+        x1, z0, per_round=32, repeat=5)
+    run("weighted_center_step_pallas_clip", iter_center("clip"),
+        x1, z0, per_round=32, repeat=5)
+
+    # north-star refresh (grid.jsonl cw_median_64x1M predates the kernel)
+    t = timed_call_s(jax.jit(robust.coordinate_median), x1, warmup=2,
+                     repeat=20) * 1e3
+    emit(workload="cw_median_64x1M", ms=round(t, 3),
+         note="fused sorted-reduce kernel (round-4 tile fix)")
+    t = timed_call_s(
+        jax.jit(functools.partial(robust.coordinate_median_stream)), xs,
+        warmup=2, repeat=10,
+    ) / K * 1e3
+    emit(workload="cw_median_64x1M_stream32", ms_per_round=round(t, 3),
+         grads_per_sec=round(64 / (t / 1e3), 1))
+
+
+if __name__ == "__main__":
+    main()
