@@ -1,3 +1,10 @@
+from .elastic import ElasticPolicy, ElasticState, QuorumLostError, SuspectRecord
 from .ps import ParameterServer
 
-__all__ = ["ParameterServer"]
+__all__ = [
+    "ElasticPolicy",
+    "ElasticState",
+    "ParameterServer",
+    "QuorumLostError",
+    "SuspectRecord",
+]

@@ -1,0 +1,218 @@
+"""Elastic membership for parameter-server rounds.
+
+The reference's failure handling is partial — per-subtask retry and
+broken-pipe detection (SURVEY §5 "failure detection / elastic recovery:
+no elastic membership") — and its PS round fails outright if any node
+raises mid-round (``byzpy/engine/parameter_server/ps.py:103-144``
+gathers node calls without isolation). This module adds what it lacks:
+
+* **Per-node fault isolation** — a node that raises (or exceeds
+  ``call_timeout``) loses its slot for the round instead of killing the
+  round; its gradient is simply absent from the aggregate. Byzantine
+  *statistical* faults stay the aggregator's job; this layer handles
+  *crash/omission* faults.
+* **Suspicion + re-admission** — a failed node is suspected and skipped;
+  every ``readmit_every`` rounds it is probed again and re-admitted on
+  the first success (matching the conservative one-pong-resets rule of
+  :class:`~byzpy_tpu.engine.node.liveness.HeartbeatMonitor`).
+* **Quorum** — the round raises :class:`QuorumLostError` when fewer than
+  ``min_quorum`` honest gradients arrive: a robust aggregator's f-out-of-n
+  guarantee silently degrades as n shrinks, so the application must pick
+  the floor (e.g. ``2 f + 1`` for Krum-family guarantees).
+* **External suspicion bridge** — ``external_suspects`` proactively
+  skips nodes the fabric already knows are dead (gradient gather and
+  apply fan-out both), saving the round their ``call_timeout``. An
+  external monitor such as
+  :class:`~byzpy_tpu.engine.node.liveness.HeartbeatMonitor` reports its
+  own peer ids — map them to this module's ``node_id`` strings (see
+  :class:`ElasticPolicy`).
+
+Usage::
+
+    ps = ParameterServer(honest, byz, aggregator=MultiKrum(f=3, q=5),
+                         elastic=ElasticPolicy(min_quorum=7,
+                                               call_timeout=5.0))
+    await ps.round()          # survives node crashes
+    ps.elastic_state.suspects # {"honest:2": SuspectRecord(...)}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+MAX_EVENTS = 4096  # elastic_state.events ring size (long-lived servers)
+
+
+class QuorumLostError(RuntimeError):
+    """Fewer honest gradients arrived than ``ElasticPolicy.min_quorum``."""
+
+
+@dataclass(frozen=True)
+class ElasticPolicy:
+    """Round-level elasticity knobs (immutable; state lives in
+    :class:`ElasticState`).
+
+    ``min_quorum``
+        Minimum count of honest gradients per round; below it the round
+        raises :class:`QuorumLostError`. Default 1 (any progress).
+    ``call_timeout``
+        Per-node-call timeout in seconds; ``None`` waits forever (only
+        raised exceptions then count as failures).
+    ``readmit_every``
+        Probe suspected nodes every this many rounds (1 = every round);
+        0 disables re-admission (suspects stay out).
+    ``external_suspects``
+        Optional callable returning ids the fabric already suspects —
+        those are skipped without burning a timeout (excluded from the
+        gradient gather AND the apply fan-out). Ids must be this
+        module's ``node_id`` strings (``"honest:3"``); an external
+        monitor speaks its own peer-id namespace, so bridge it with a
+        mapping, e.g.::
+
+            peer_to_slot = {"worker-a": "honest:0", "worker-b": "honest:1"}
+            policy = ElasticPolicy(external_suspects=lambda: [
+                peer_to_slot[p] for p in monitor.suspects()
+                if p in peer_to_slot
+            ])
+    """
+
+    min_quorum: int = 1
+    call_timeout: Optional[float] = None
+    readmit_every: int = 1
+    external_suspects: Optional[Callable[[], Sequence[str]]] = None
+
+    def __post_init__(self) -> None:
+        if self.min_quorum < 1:
+            raise ValueError(f"min_quorum must be >= 1 (got {self.min_quorum})")
+        if self.readmit_every < 0:
+            raise ValueError(
+                f"readmit_every must be >= 0 (got {self.readmit_every})"
+            )
+
+
+@dataclass
+class SuspectRecord:
+    """Why and since when a node is out of the round rotation."""
+
+    since_round: int
+    failures: int = 1
+    last_error: str = ""
+    probes: int = 0
+
+
+@dataclass
+class ElasticState:
+    """Mutable suspicion bookkeeping, exposed as ``ps.elastic_state``."""
+
+    suspects: Dict[str, SuspectRecord] = field(default_factory=dict)
+    # (round, node_id, "failed" | "suspected" | "readmitted" |
+    # "skipped_external"); bounded ring — a permanently-dead node emits
+    # one entry per round for the server's whole life otherwise
+    events: Deque[Tuple[int, str, str]] = field(
+        default_factory=lambda: deque(maxlen=MAX_EVENTS)
+    )
+
+    def note(self, round_no: int, node_id: str, kind: str) -> None:
+        self.events.append((round_no, node_id, kind))
+
+    def fail(self, round_no: int, node_id: str, err: BaseException) -> None:
+        rec = self.suspects.get(node_id)
+        msg = f"{type(err).__name__}: {err}"
+        if rec is None:
+            self.suspects[node_id] = SuspectRecord(
+                since_round=round_no, last_error=msg
+            )
+            self.note(round_no, node_id, "suspected")
+        else:
+            rec.failures += 1
+            rec.last_error = msg
+        self.note(round_no, node_id, "failed")
+
+    def readmit(self, round_no: int, node_id: str) -> None:
+        if node_id in self.suspects:
+            del self.suspects[node_id]
+            self.note(round_no, node_id, "readmitted")
+
+    def due_for_probe(self, node_id: str, policy: ElasticPolicy) -> bool:
+        rec = self.suspects.get(node_id)
+        if rec is None:
+            return True
+        if policy.readmit_every == 0:
+            return False
+        rec.probes += 1
+        return rec.probes % policy.readmit_every == 0
+
+
+def node_id(role: str, index: int) -> str:
+    """Stable id for a PS node: list position within its role
+    (``"honest:3"`` / ``"byzantine:0"``)."""
+    return f"{role}:{index}"
+
+
+async def call_node(
+    obj: Any, method: str, args: tuple = (), *,
+    timeout: Optional[float] = None,
+) -> Any:
+    """``obj.method(*args)``, awaited if it returns an awaitable — nodes
+    may be plain local objects (sync) or actor handles (async). The one
+    implementation of the PS calling convention; the non-elastic round
+    path (``ps._invoke``) delegates here."""
+    fn = getattr(obj, method)
+    out = fn(*args)
+    if inspect.isawaitable(out):
+        if timeout is not None:
+            out = await asyncio.wait_for(out, timeout=timeout)
+        else:
+            out = await out
+    return out
+
+
+async def elastic_gather(
+    nodes: Sequence[Tuple[str, Any]],
+    method: str,
+    args: tuple,
+    *,
+    policy: ElasticPolicy,
+    state: ElasticState,
+    round_no: int,
+) -> List[Tuple[str, Any]]:
+    """Fan ``method`` out to ``nodes`` (pairs of ``(node_id, node)``),
+    isolating per-node failures.
+
+    Returns ``(node_id, result)`` pairs for the survivors, in input
+    order. Failures (raise or timeout) are recorded in ``state`` and the
+    node becomes suspect; previously-suspected nodes that succeed are
+    re-admitted.
+    """
+    results = await asyncio.gather(
+        *(
+            call_node(node, method, args, timeout=policy.call_timeout)
+            for _, node in nodes
+        ),
+        return_exceptions=True,
+    )
+    alive: List[Tuple[str, Any]] = []
+    for (nid, _), res in zip(nodes, results):
+        if isinstance(res, BaseException):
+            if isinstance(res, (KeyboardInterrupt, SystemExit)):
+                raise res
+            state.fail(round_no, nid, res)
+        else:
+            state.readmit(round_no, nid)
+            alive.append((nid, res))
+    return alive
+
+
+__all__ = [
+    "ElasticPolicy",
+    "ElasticState",
+    "QuorumLostError",
+    "SuspectRecord",
+    "call_node",
+    "elastic_gather",
+    "node_id",
+]

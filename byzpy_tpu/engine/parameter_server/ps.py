@@ -24,16 +24,22 @@ from ...aggregators.base import Aggregator
 from ...pre_aggregators.base import PreAggregator
 from ..graph.executor import OperatorExecutor
 from ..graph.pool import ActorPool, ActorPoolConfig
+from .elastic import (
+    ElasticPolicy,
+    ElasticState,
+    QuorumLostError,
+    call_node,
+    elastic_gather,
+    node_id,
+)
 
 
 async def _invoke(obj: Any, method: str, *args: Any) -> Any:
     """Call ``obj.method(*args)``, awaiting if it returns an awaitable —
-    nodes may be plain local objects (sync) or :class:`NodeActor`s (async)."""
-    fn = getattr(obj, method)
-    out = fn(*args)
-    if inspect.isawaitable(out):
-        out = await out
-    return out
+    nodes may be plain local objects (sync) or :class:`NodeActor`s
+    (async). Delegates to :func:`elastic.call_node`, the single
+    implementation of the node calling convention."""
+    return await call_node(obj, method, args)
 
 
 async def _gather_all(coros) -> List[Any]:
@@ -69,6 +75,14 @@ class ParameterServer:
         aggregators); without one it runs inline as a single jitted call.
     pre_aggregator:
         Optional :class:`PreAggregator` applied to the gradient list first.
+    elastic:
+        Optional :class:`~byzpy_tpu.engine.parameter_server.elastic.ElasticPolicy`.
+        When set, node crashes/timeouts cost the node its slot for the
+        round instead of failing the round; suspects are probed for
+        re-admission and ``min_quorum`` guards the aggregator's f-of-n
+        assumption (raises :class:`QuorumLostError` below it). Without
+        it, any node failure fails the round (the reference's semantics,
+        ``byzpy/engine/parameter_server/ps.py:103-144``).
     """
 
     def __init__(
@@ -80,13 +94,21 @@ class ParameterServer:
         pre_aggregator: Optional[PreAggregator] = None,
         pool: Optional[ActorPool] = None,
         pool_config: Optional[ActorPoolConfig | Sequence[ActorPoolConfig]] = None,
+        elastic: Optional[ElasticPolicy] = None,
     ) -> None:
         if not honest_nodes:
             raise ValueError("ParameterServer needs at least one honest node")
+        if elastic is not None and elastic.min_quorum > len(honest_nodes):
+            raise ValueError(
+                f"min_quorum={elastic.min_quorum} exceeds the honest node "
+                f"count ({len(honest_nodes)}) — no round could ever meet it"
+            )
         self.honest_nodes = list(honest_nodes)
         self.byzantine_nodes = list(byzantine_nodes)
         self.aggregator = aggregator
         self.pre_aggregator = pre_aggregator
+        self.elastic = elastic
+        self.elastic_state = ElasticState()
         self._executor = (
             OperatorExecutor(aggregator, pool=pool, pool_config=pool_config)
             if (pool is not None or pool_config is not None)
@@ -121,11 +143,79 @@ class ParameterServer:
             return await self._executor.run(gradients)
         return self.aggregator.aggregate(gradients)
 
+    # -- elastic round pieces -------------------------------------------------
+
+    def _rotation(self, role: str, nodes: Sequence[Any], external: set):
+        """(node_id, node) pairs participating this round: non-suspects
+        plus suspects due for a re-admission probe; external suspects are
+        skipped outright."""
+        policy, state = self.elastic, self.elastic_state
+        out = []
+        for i, node in enumerate(nodes):
+            nid = node_id(role, i)
+            if nid in external:
+                state.note(self.rounds_completed, nid, "skipped_external")
+                continue
+            if state.due_for_probe(nid, policy):
+                out.append((nid, node))
+        return out
+
+    async def _elastic_round(self) -> Any:
+        policy, state = self.elastic, self.elastic_state
+        rnd = self.rounds_completed
+        external = (
+            set(policy.external_suspects())
+            if policy.external_suspects is not None
+            else set()
+        )
+        honest_pairs = await elastic_gather(
+            self._rotation("honest", self.honest_nodes, external),
+            "honest_gradient_for_next_batch", (),
+            policy=policy, state=state, round_no=rnd,
+        )
+        if len(honest_pairs) < policy.min_quorum:
+            raise QuorumLostError(
+                f"round {rnd}: {len(honest_pairs)} honest gradients < "
+                f"min_quorum={policy.min_quorum} "
+                f"(suspects: {sorted(state.suspects)})"
+            )
+        honest = [g for _, g in honest_pairs]
+        byz_pairs = await elastic_gather(
+            self._rotation("byzantine", self.byzantine_nodes, external),
+            "byzantine_gradient_for_next_batch", (honest,),
+            policy=policy, state=state, round_no=rnd,
+        )
+        aggregated = await self._aggregate(honest + [g for _, g in byz_pairs])
+        # fan-out is best-effort: a node that cannot take the update is
+        # suspected like any other failure, but the round's result stands.
+        # Internal AND external suspects are excluded — delivering the
+        # update to a node the fabric knows is dead would hang the round
+        # for call_timeout (forever, with the default None).
+        all_pairs = [
+            (node_id("honest", i), n) for i, n in enumerate(self.honest_nodes)
+        ] + [
+            (node_id("byzantine", i), n)
+            for i, n in enumerate(self.byzantine_nodes)
+        ]
+        live = [
+            (nid, n) for nid, n in all_pairs
+            if nid not in state.suspects and nid not in external
+        ]
+        await elastic_gather(
+            live, "apply_server_gradient", (aggregated,),
+            policy=policy, state=state, round_no=rnd,
+        )
+        self.rounds_completed += 1
+        return aggregated
+
     # -- public API ----------------------------------------------------------
 
     async def round(self) -> Any:
         """One training round; returns the aggregated gradient
-        (ref: ``ps.py:103-144``)."""
+        (ref: ``ps.py:103-144``). With an :class:`ElasticPolicy`, node
+        crash/omission failures shrink the round instead of failing it."""
+        if self.elastic is not None:
+            return await self._elastic_round()
         honest = await self._stream_honest()
         byz = await self._stream_byzantine(honest)
         aggregated = await self._aggregate(honest + byz)

@@ -1,0 +1,269 @@
+"""Elastic parameter-server rounds (crash/omission fault tolerance).
+
+The reference's PS round fails outright when any node raises
+(``byzpy/engine/parameter_server/ps.py:103-144``); with an
+``ElasticPolicy`` a failure costs the node its slot, suspects are
+probed for re-admission, and ``min_quorum`` guards the aggregator's
+f-of-n assumption.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean, MultiKrum
+from byzpy_tpu.engine.parameter_server import (
+    ElasticPolicy,
+    ParameterServer,
+    QuorumLostError,
+)
+
+
+class Node:
+    def __init__(self, value, d=64):
+        self.value = float(value)
+        self.d = d
+        self.applied = []
+
+    def honest_gradient_for_next_batch(self):
+        return [np.full(self.d, self.value, np.float32)]
+
+    def apply_server_gradient(self, g):
+        self.applied.append(g)
+
+
+class CrashingNode(Node):
+    """Fails for ``fail_rounds`` calls, then recovers."""
+
+    def __init__(self, value, fail_rounds=10**9, **kw):
+        super().__init__(value, **kw)
+        self.fail_rounds = fail_rounds
+        self.calls = 0
+
+    def honest_gradient_for_next_batch(self):
+        self.calls += 1
+        if self.calls <= self.fail_rounds:
+            raise ConnectionError("node down")
+        return super().honest_gradient_for_next_batch()
+
+
+class HangingNode(Node):
+    async def honest_gradient_for_next_batch(self):
+        await asyncio.sleep(30.0)
+        return [np.full(self.d, self.value, np.float32)]
+
+
+class ApplyFailsNode(Node):
+    def apply_server_gradient(self, g):
+        raise RuntimeError("disk full")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_default_semantics_unchanged_failure_raises():
+    ps = ParameterServer(
+        honest_nodes=[Node(1.0), CrashingNode(2.0)],
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+    )
+    with pytest.raises(ConnectionError):
+        run(ps.round())
+
+
+def test_crash_excludes_node_and_round_succeeds():
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0)] + [CrashingNode(100.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2),
+    )
+    out = run(ps.round())
+    # mean of the three alive values; the crasher contributed nothing
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
+    assert "honest:3" in ps.elastic_state.suspects
+    assert ps.rounds_completed == 1
+    # the suspect got no apply fan-out; alive nodes did
+    assert nodes[3].applied == []
+    assert len(nodes[0].applied) == 1
+
+
+def test_recovery_readmits_node():
+    flaky = CrashingNode(4.0, fail_rounds=2)
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0)] + [flaky]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, readmit_every=1),
+    )
+    run(ps.round())  # fails, suspected
+    run(ps.round())  # probe fails again
+    assert "honest:3" in ps.elastic_state.suspects
+    out = run(ps.round())  # probe succeeds -> readmitted, contributes
+    assert "honest:3" not in ps.elastic_state.suspects
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.5), rtol=1e-6)
+    kinds = [k for _, nid, k in ps.elastic_state.events if nid == "honest:3"]
+    assert "suspected" in kinds and "readmitted" in kinds
+
+
+def test_readmit_every_zero_never_probes():
+    flaky = CrashingNode(4.0, fail_rounds=1)
+    ps = ParameterServer(
+        honest_nodes=[Node(1.0), flaky],
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=1, readmit_every=0),
+    )
+    run(ps.round())
+    run(ps.round())
+    run(ps.round())
+    assert "honest:1" in ps.elastic_state.suspects
+    assert flaky.calls == 1  # never probed again
+
+
+def test_quorum_lost_raises():
+    ps = ParameterServer(
+        honest_nodes=[Node(1.0), CrashingNode(2.0), CrashingNode(3.0)],
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2),
+    )
+    with pytest.raises(QuorumLostError, match="min_quorum=2"):
+        run(ps.round())
+    assert ps.rounds_completed == 0
+
+
+def test_min_quorum_validated_against_node_count():
+    with pytest.raises(ValueError, match="min_quorum"):
+        ParameterServer(
+            honest_nodes=[Node(1.0)],
+            aggregator=CoordinateWiseTrimmedMean(f=0),
+            elastic=ElasticPolicy(min_quorum=2),
+        )
+
+
+def test_call_timeout_excludes_hanging_node():
+    nodes = [Node(1.0), Node(3.0), HangingNode(100.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, call_timeout=0.2),
+    )
+    out = run(ps.round())
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
+    assert "honest:2" in ps.elastic_state.suspects
+
+
+def test_apply_failure_tolerated_and_suspected():
+    nodes = [Node(1.0), Node(3.0), ApplyFailsNode(2.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=1),
+    )
+    out = run(ps.round())  # round result stands despite the apply failure
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
+    assert "honest:2" in ps.elastic_state.suspects
+
+
+def test_byzantine_crash_is_tolerated():
+    class ByzCrash:
+        def byzantine_gradient_for_next_batch(self, honest):
+            raise OSError("gone")
+
+        def apply_server_gradient(self, g):
+            pass
+
+    ps = ParameterServer(
+        honest_nodes=[Node(v) for v in (1.0, 2.0, 3.0, 4.0)],
+        byzantine_nodes=[ByzCrash()],
+        aggregator=MultiKrum(f=1, q=2),
+        elastic=ElasticPolicy(min_quorum=3),
+    )
+    out = run(ps.round())
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert "byzantine:0" in ps.elastic_state.suspects
+
+
+def test_external_suspects_skipped_without_probe():
+    flagged = Node(100.0)
+    ps = ParameterServer(
+        honest_nodes=[Node(1.0), Node(3.0), flagged],
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(
+            min_quorum=1, external_suspects=lambda: ["honest:2"]
+        ),
+    )
+    out = run(ps.round())
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
+    kinds = [k for _, nid, k in ps.elastic_state.events if nid == "honest:2"]
+    assert kinds == ["skipped_external"]
+    # the flagged node is out of the apply fan-out too — delivering the
+    # update to a node the fabric knows is dead would hang the round
+    assert flagged.applied == []
+
+
+def test_hanging_external_suspect_does_not_block_round():
+    """A dead node flagged externally must not cost the round anything —
+    not even the call_timeout (here: no timeout is set at all, so any
+    contact with the hung node would block forever)."""
+    class HungEverywhere(Node):
+        async def honest_gradient_for_next_batch(self):
+            await asyncio.sleep(30.0)
+
+        async def apply_server_gradient(self, g):
+            await asyncio.sleep(30.0)
+
+    ps = ParameterServer(
+        honest_nodes=[Node(1.0), Node(3.0), HungEverywhere(9.0)],
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(
+            min_quorum=1, external_suspects=lambda: ["honest:2"]
+        ),
+    )
+    async def bounded():
+        return await asyncio.wait_for(ps.round(), timeout=5.0)
+    out = run(bounded())
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
+
+
+def test_events_ring_is_bounded():
+    from byzpy_tpu.engine.parameter_server.elastic import MAX_EVENTS
+
+    ps = ParameterServer(
+        honest_nodes=[Node(1.0), CrashingNode(2.0)],
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=1),
+    )
+    for _ in range(60):
+        run(ps.round())
+    assert len(ps.elastic_state.events) <= MAX_EVENTS
+    assert ps.elastic_state.events.maxlen == MAX_EVENTS
+
+
+def test_elastic_training_converges_through_crashes():
+    """10-round run where one node dies at round 3 and recovers at round
+    6: every round still aggregates, and the suspect set ends empty."""
+    class Intermittent(Node):
+        def __init__(self, value):
+            super().__init__(value)
+            self.round_no = 0
+
+        def honest_gradient_for_next_batch(self):
+            self.round_no += 1
+            if 3 <= self.round_no <= 5:
+                raise ConnectionError("flaky link")
+            return super().honest_gradient_for_next_batch()
+
+    nodes = [Node(v) for v in (1.0, 2.0)] + [Intermittent(3.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2),
+    )
+    for _ in range(10):
+        out = run(ps.round())
+        assert np.isfinite(np.asarray(out[0])).all()
+    assert ps.rounds_completed == 10
+    assert ps.elastic_state.suspects == {}
