@@ -92,11 +92,14 @@ async def main() -> None:
                 f"round {r + 1:3d}  loss={np.mean(alive):.5f}  "
                 f"suspects={sorted(ps.elastic_state.suspects) or '-'}"
             )
-    assert ps.elastic_state.suspects == {}, "node 2 should have re-admitted"
-    kinds = {k for _, nid, k in ps.elastic_state.events if nid == "honest:2"}
-    assert {"suspected", "readmitted"} <= kinds
-    print("\nnode 2 died rounds 10-19, re-admitted on recovery; "
-          f"final mean loss {np.mean([n.loss() for n in nodes]):.5f}")
+    if ROUNDS >= 20:  # smoke runs use PS_ROUNDS=2 and never reach the crash
+        assert ps.elastic_state.suspects == {}, "node 2 should have re-admitted"
+        kinds = {
+            k for _, nid, k in ps.elastic_state.events if nid == "honest:2"
+        }
+        assert {"suspected", "readmitted"} <= kinds
+        print("\nnode 2 died rounds 10-19, re-admitted on recovery; "
+              f"final mean loss {np.mean([n.loss() for n in nodes]):.5f}")
 
 
 if __name__ == "__main__":
