@@ -105,6 +105,17 @@ def main() -> None:
     run("weighted_center_step_pallas_clip", iter_center("clip"),
         x1, z0, per_round=32, repeat=5)
 
+    # SMEA grid row under the parallel-order Jacobi (sequential rotation
+    # depth 55 -> 11 per sweep at m=11; prior cyclic-order row: 28.0 ms)
+    from byzpy_tpu.aggregators import SMEA
+
+    smea = SMEA(f=5)
+    ks = jax.random.split(jax.random.PRNGKey(0), 16)
+    g16 = [jax.random.normal(k, (4096,), jnp.float32) for k in ks]
+    t = timed_call_s(lambda: smea.aggregate(g16), warmup=2, repeat=20) * 1e3
+    emit(workload="smea_16x4096_f5", ms=round(t, 2), ref_best_pool_ms=48.0,
+         note="parallel-order Jacobi")
+
     # north-star refresh (grid.jsonl cw_median_64x1M predates the kernel)
     t = timed_call_s(jax.jit(robust.coordinate_median), x1, warmup=2,
                      repeat=20) * 1e3

@@ -594,21 +594,63 @@ def subset_max_eigvals(gram: Array, combos: Array) -> Array:
     return jax.vmap(one)(combos)
 
 
+def _parallel_jacobi_schedule(m: int):
+    """Round-robin (circle-method) rotation schedule: ``m_pad - 1``
+    rounds of ``m_pad // 2`` DISJOINT (p, q) pairs covering every pair
+    exactly once per sweep. Disjointness lets one loop step apply all
+    its rotations at once — m=11 runs 11 vectorized steps per sweep
+    instead of 55 sequential ones. Odd ``m`` pads with a dummy player;
+    the bye pair is encoded ``(b, b)`` with valid=0 (its rotation is
+    forced to the identity, and ``b`` appears nowhere else that round,
+    so the row/col scatters never collide)."""
+    m_pad = m + (m & 1)
+    half = m_pad // 2
+    players = list(range(m_pad))
+    p_rounds, q_rounds, valid = [], [], []
+    for _ in range(m_pad - 1):
+        ps, qs, vs = [], [], []
+        for i in range(half):
+            a_, b_ = players[i], players[m_pad - 1 - i]
+            lo, hi = min(a_, b_), max(a_, b_)
+            if hi >= m:  # bye: partner sits this round out
+                ps.append(lo)
+                qs.append(lo)
+                vs.append(0.0)
+            else:
+                ps.append(lo)
+                qs.append(hi)
+                vs.append(1.0)
+        p_rounds.append(ps)
+        q_rounds.append(qs)
+        valid.append(vs)
+        players = [players[0]] + [players[-1]] + players[1:-1]
+    import numpy as np
+
+    return (
+        np.asarray(p_rounds, np.int32),
+        np.asarray(q_rounds, np.int32),
+        np.asarray(valid, np.float32),
+    )
+
+
 @partial(jax.jit, static_argnames=("sweeps",))
 def subset_max_eigvals_jacobi(gram: Array, combos: Array, *, sweeps: int = 8) -> Array:
     """SMEA score per subset — identical quantity to
-    ``subset_max_eigvals`` — computed with batched cyclic Jacobi instead
-    of ``eigvalsh``.
+    ``subset_max_eigvals`` — computed with batched parallel-order Jacobi
+    instead of ``eigvalsh``.
 
     XLA lowers ``eigvalsh`` on TPU to a serialized QR iteration: 380 ms
     for the C(16,11)=4368 batch of 11x11 problems in the reference's SMEA
-    workload, where this unrolled Jacobi needs ~1 ms of batched VPU work.
-    ``sweeps`` cyclic sweeps of all m(m-1)/2 rotations give quadratic
-    convergence — 8 sweeps reach f32 machine precision at m <= 32, pinned
-    against the LAPACK oracle in tests. Subsets touching a non-finite
-    Gram row score ``+inf`` (an adversary must not crash — or win — the
-    selection; same rule as the host path in
-    ``aggregators/geometric_wise/smea.py``).
+    workload. Jacobi sweeps are batched VPU work instead; rotations are
+    scheduled round-robin (``_parallel_jacobi_schedule``) so each loop
+    step applies ``m // 2`` disjoint rotations at once — the sequential
+    rotation count, which bounds the wall time of the ``fori_loop``,
+    drops from m(m-1)/2 to m-1 per sweep (55 -> 11 at m=11). ``sweeps``
+    sweeps give quadratic convergence — 8 reach f32 precision at m <= 32
+    under both cyclic and parallel orderings, pinned against the LAPACK
+    oracle in tests. Subsets touching a non-finite Gram row score
+    ``+inf`` (an adversary must not crash — or win — the selection; same
+    rule as the host path in ``aggregators/geometric_wise/smea.py``).
     """
     m = combos.shape[1]
     acc = jnp.float32 if gram.dtype in (jnp.bfloat16, jnp.float16) else gram.dtype
@@ -627,27 +669,28 @@ def subset_max_eigvals_jacobi(gram: Array, combos: Array, *, sweeps: int = 8) ->
     bad = ~jnp.all(jnp.isfinite(a), axis=(1, 2))
     a = jnp.where(bad[:, None, None], jnp.eye(m, dtype=acc), a)
 
-    # Static cyclic rotation schedule, walked by a fori_loop with dynamic
-    # row/column slices: unrolling all sweeps * m(m-1)/2 rotations inline
-    # (~1.8k update ops at m=11, sweeps=8) explodes TPU compile time; the
-    # loop body compiles once and runs the schedule at runtime.
-    pairs = jnp.asarray(
-        [(p, q) for p in range(m - 1) for q in range(p + 1, m)], dtype=jnp.int32
-    )
-    n_pairs = pairs.shape[0]
+    # Static round-robin schedule walked by a fori_loop: each step applies
+    # ALL of one round's disjoint rotations as (c, P)-batched vector ops —
+    # the loop's sequential depth (what bounds wall time on the chip) is
+    # sweeps * (m_pad - 1) instead of the cyclic order's
+    # sweeps * m(m-1)/2. Unrolling inline instead would explode TPU
+    # compile time (~1.8k update ops at m=11, sweeps=8).
+    p_r, q_r, v_r = _parallel_jacobi_schedule(m)
+    p_r, q_r, v_r = jnp.asarray(p_r), jnp.asarray(q_r), jnp.asarray(v_r)
+    n_rounds = p_r.shape[0]
 
-    def rotate(i, a):
-        # One batched Jacobi rotation zeroing a[:, p, q] (Golub & Van Loan
-        # 8.4): stable c/s from the quadratic in t, then row and column
-        # updates as (c,)-batched vector ops.
-        p = pairs[i % n_pairs, 0]
-        q = pairs[i % n_pairs, 1]
-        rp = lax.dynamic_slice_in_dim(a, p, 1, axis=1)  # (c, 1, m)
-        rq = lax.dynamic_slice_in_dim(a, q, 1, axis=1)
-        app = lax.dynamic_slice_in_dim(rp, p, 1, axis=2)[:, 0, 0]
-        aqq = lax.dynamic_slice_in_dim(rq, q, 1, axis=2)[:, 0, 0]
-        apq = lax.dynamic_slice_in_dim(rp, q, 1, axis=2)[:, 0, 0]
-        safe = jnp.abs(apq) > 1e-30
+    def rotate_round(i, a):
+        # One parallel Jacobi round (Golub & Van Loan 8.4 rotations over
+        # disjoint pairs): stable c/s from the quadratic in t, rows and
+        # columns updated through gather/scatter on the pair vectors.
+        r = i % n_rounds
+        p = lax.dynamic_index_in_dim(p_r, r, keepdims=False)  # (P,)
+        q = lax.dynamic_index_in_dim(q_r, r, keepdims=False)
+        v = lax.dynamic_index_in_dim(v_r, r, keepdims=False)
+        app = a[:, p, p]  # (c, P)
+        aqq = a[:, q, q]
+        apq = a[:, p, q]
+        safe = (jnp.abs(apq) > 1e-30) & (v > 0.5)
         tau = (aqq - app) / jnp.where(safe, 2.0 * apq, 1.0)
         # sign(0) must be +1 here: tau == 0 (app == aqq) wants a 45-degree
         # rotation, not the identity jnp.sign's zero would produce.
@@ -656,17 +699,24 @@ def subset_max_eigvals_jacobi(gram: Array, combos: Array, *, sweeps: int = 8) ->
         t = jnp.where(safe, t, 0.0)
         c = 1.0 / jnp.sqrt(1.0 + t * t)
         s = t * c
-        c_ = c[:, None, None]
-        s_ = s[:, None, None]
-        a = lax.dynamic_update_slice_in_dim(a, c_ * rp - s_ * rq, p, axis=1)
-        a = lax.dynamic_update_slice_in_dim(a, s_ * rp + c_ * rq, q, axis=1)
-        cp = lax.dynamic_slice_in_dim(a, p, 1, axis=2)  # (c, m, 1)
-        cq = lax.dynamic_slice_in_dim(a, q, 1, axis=2)
-        a = lax.dynamic_update_slice_in_dim(a, c_ * cp - s_ * cq, p, axis=2)
-        a = lax.dynamic_update_slice_in_dim(a, s_ * cp + c_ * cq, q, axis=2)
+        c_ = c[:, :, None]  # (c, P, 1)
+        s_ = s[:, :, None]
+        rp = a[:, p, :]  # (c, P, m)
+        rq = a[:, q, :]
+        # within a round p ∪ q has no duplicates (bye pairs repeat their
+        # index only across the two separate scatters), so the updates
+        # can't collide
+        a = a.at[:, p, :].set(c_ * rp - s_ * rq)
+        a = a.at[:, q, :].set(s_ * rp + c_ * rq)
+        cp = a[:, :, p]  # (c, m, P)
+        cq = a[:, :, q]
+        c2 = c[:, None, :]
+        s2 = s[:, None, :]
+        a = a.at[:, :, p].set(c2 * cp - s2 * cq)
+        a = a.at[:, :, q].set(s2 * cp + c2 * cq)
         return a
 
-    a = lax.fori_loop(0, sweeps * n_pairs, rotate, a)
+    a = lax.fori_loop(0, sweeps * n_rounds, rotate_round, a)
     top = jnp.max(jnp.diagonal(a, axis1=1, axis2=2), axis=1)
     scores = jnp.maximum(top, 0.0) / m
     return jnp.where(bad, jnp.inf, scores).astype(gram.dtype)

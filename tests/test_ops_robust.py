@@ -332,3 +332,48 @@ def test_subset_max_eigvals_jacobi_equal_diagonal_rotation():
         for c in combos
     ]
     np.testing.assert_allclose(got, np.array(want), rtol=1e-5, atol=1e-6)
+
+
+def test_subset_max_eigvals_jacobi_parallel_order_even_m():
+    """The round-robin parallel ordering (round-4: one fori step applies
+    all disjoint rotations of a round) must converge exactly like the
+    cyclic order did — even m exercises the no-bye schedule, and m=12
+    the largest-tested dense round structure."""
+    x = randx(14, 128, seed=31)
+    gram = x @ x.T
+    m = 12
+    combos = np.array(
+        list(itertools.combinations(range(14), m))[:91], dtype=np.int32
+    )
+    got = np.asarray(
+        robust.subset_max_eigvals_jacobi(jnp.asarray(gram), jnp.asarray(combos))
+    )
+    h = np.eye(m) - np.full((m, m), 1.0 / m)
+    sub = gram[combos[:, :, None], combos[:, None, :]]
+    want = np.maximum(np.linalg.eigvalsh(h @ sub @ h)[:, -1], 0.0) / m
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_parallel_jacobi_schedule_structure():
+    """Every unordered pair appears exactly once per sweep; within a
+    round all indices are disjoint (bye pairs repeat their own index
+    only), so the vectorized scatters cannot collide."""
+    from byzpy_tpu.ops.robust import _parallel_jacobi_schedule
+
+    for m in (2, 3, 5, 8, 11, 12):
+        p_r, q_r, v_r = _parallel_jacobi_schedule(m)
+        seen = set()
+        for ps, qs, vs in zip(p_r, q_r, v_r):
+            touched = []
+            for p, q, v in zip(ps, qs, vs):
+                if v > 0.5:
+                    assert p < q
+                    seen.add((int(p), int(q)))
+                    touched += [int(p), int(q)]
+                else:
+                    assert p == q  # bye encodes (b, b)
+                    touched.append(int(p))
+            assert len(touched) == len(set(touched)), (m, ps, qs)
+        assert seen == {
+            (i, j) for i in range(m) for j in range(i + 1, m)
+        }, f"m={m}"
