@@ -105,6 +105,22 @@ def main() -> None:
     run("weighted_center_step_pallas_clip", iter_center("clip"),
         x1, z0, per_round=32, repeat=5)
 
+    # MeaMed grid row (weakest non-SMEA multiplier at 41.8 ms / 1.4x):
+    # measure the XLA path it currently dispatches to at d=65k AND the
+    # fused kernel at the same shape — if the kernel wins by more than
+    # the dispatch floor, MIN_PALLAS_DIM should drop for meamed
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas as _mm
+
+    x64 = jax.random.normal(jax.random.PRNGKey(7), (64, 65_536), jnp.float32)
+    t_xla = timed_call_s(
+        jax.jit(functools.partial(robust.mean_of_medians, f=8)), x64,
+        warmup=2, repeat=20) * 1e3
+    t_fused = timed_call_s(
+        jax.jit(lambda a: _mm(a[None], f=8)[0]), x64, warmup=2, repeat=20
+    ) * 1e3
+    emit(workload="meamed_64x65536_f8", xla_ms=round(t_xla, 2),
+         fused_ms=round(t_fused, 2))
+
     # SMEA grid row under the parallel-order Jacobi (sequential rotation
     # depth 55 -> 11 per sweep at m=11; prior cyclic-order row: 28.0 ms)
     from byzpy_tpu.aggregators import SMEA
