@@ -25,6 +25,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, REPO)
 
+
 ROUNDS = int(os.environ.get("PS_ROUNDS", 40))
 
 
@@ -99,7 +100,10 @@ def worker(coordinator: str, num_processes: int, process_id: int) -> None:
     logits = bundle.apply_fn(params, x_test)
     acc = float(jnp.mean(jnp.argmax(logits, -1) == y_test))
     print(f"[proc {process_id}] final held-out accuracy {acc:.3f}", flush=True)
-    assert acc > 0.7, "robust aggregation should learn under attack across hosts"
+    if ROUNDS >= 30:  # smoke runs use PS_ROUNDS=2 — too few to learn
+        assert acc > 0.7, (
+            "robust aggregation should learn under attack across hosts"
+        )
 
 
 def launch(num_processes: int, port: int) -> int:

@@ -27,6 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 sys.path.insert(0, REPO)
 
 
+
 def worker(coordinator: str, num_processes: int, process_id: int) -> None:
     # Platform choice must precede any jax backend touch — and must go
     # through jax.config, not the environment: a sitecustomize (or any

@@ -28,6 +28,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from byzpy_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS even under a plugin sitecustomize
+
 if __name__ == "__main__":
     # virtual 8-device CPU mesh when this host has fewer than 8 devices
     # (set BYZPY_TPU_PLATFORM=cpu to skip probing an accelerator at all)

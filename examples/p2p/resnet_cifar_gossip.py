@@ -25,6 +25,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
+from byzpy_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS even under a plugin sitecustomize
+
 import jax
 
 if os.environ.get("BYZPY_TPU_PLATFORM"):
