@@ -1,0 +1,129 @@
+"""Elastic PS against a REAL dying remote node (loopback TCP actor).
+
+``tests/test_elastic_ps.py`` exercises the policy with in-process fakes;
+this is the failure mode elasticity exists for: a node lives in a
+:class:`RemoteActorServer` across a socket, the server dies mid-training,
+and the round must survive on the local survivors with the remote node
+suspected — where the default (reference-semantics) path fails the
+round outright.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+from byzpy_tpu.engine.actor.backends.remote import RemoteActorServer
+from byzpy_tpu.engine.node.actors import HonestNodeActor
+from byzpy_tpu.engine.node.base import HonestNode
+from byzpy_tpu.engine.parameter_server import ElasticPolicy, ParameterServer
+
+D = 32
+
+
+class LocalNode:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def honest_gradient_for_next_batch(self):
+        return [np.full(D, self.value, np.float32)]
+
+    def apply_server_gradient(self, g):
+        self.applied = g
+
+
+class RemoteNode(HonestNode):
+    """Lives inside the RemoteActorServer process-side backend."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def next_batch(self):
+        return None, None
+
+    def honest_gradient(self, x, y):
+        return [np.full(D, self.value, np.float32)]
+
+    def apply_server_gradient(self, g):
+        self.applied = g
+
+
+def test_remote_node_death_survived_and_suspected():
+    asyncio.run(_run_survival())
+
+
+async def _run_survival():
+    server = RemoteActorServer("127.0.0.1", 0)
+    await server.start()
+    try:
+        remote = await HonestNodeActor.spawn(
+            RemoteNode, 3.0, backend=f"tcp://127.0.0.1:{server.port}"
+        )
+        nodes = [LocalNode(1.0), LocalNode(2.0), remote]
+        ps = ParameterServer(
+            honest_nodes=nodes,
+            aggregator=CoordinateWiseTrimmedMean(f=0),
+            elastic=ElasticPolicy(min_quorum=2, call_timeout=5.0),
+        )
+        out = await ps.round()
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.full(D, 2.0), rtol=1e-6
+        )
+        assert ps.elastic_state.suspects == {}
+
+        # the remote host dies between rounds
+        await server.close()
+        out = await ps.round()
+        # survivors carry the round; the dead remote is suspected
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.full(D, 1.5), rtol=1e-6
+        )
+        assert "honest:2" in ps.elastic_state.suspects
+        assert ps.rounds_completed == 2
+        await remote.close()
+
+        # ... and stays out without failing subsequent rounds either
+        out = await ps.round()
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.full(D, 1.5), rtol=1e-6
+        )
+    finally:
+        await server.close()
+
+
+def test_remote_node_death_fails_default_round():
+    asyncio.run(_run_default_fails())
+
+
+async def _run_default_fails():
+    """Reference semantics without the policy: the same dead remote node
+    fails the whole round."""
+    server = RemoteActorServer("127.0.0.1", 0)
+    await server.start()
+    try:
+        remote = await HonestNodeActor.spawn(
+            RemoteNode, 3.0, backend=f"tcp://127.0.0.1:{server.port}"
+        )
+        ps = ParameterServer(
+            honest_nodes=[LocalNode(1.0), remote],
+            aggregator=CoordinateWiseTrimmedMean(f=0),
+        )
+        await ps.round()
+        await server.close()
+        # a hang would surface as TimeoutError — that is a different
+        # failure (round neither succeeded nor failed), so only accept
+        # a genuine error from the dead connection
+        try:
+            await asyncio.wait_for(ps.round(), timeout=10.0)
+        except asyncio.TimeoutError:
+            raise AssertionError("round hung instead of failing fast")
+        except Exception:
+            pass  # expected: the dead remote fails the round
+        else:
+            raise AssertionError("round succeeded against a dead remote")
+        await remote.close()
+    finally:
+        await server.close()
