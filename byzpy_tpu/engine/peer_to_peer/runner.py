@@ -133,6 +133,12 @@ class DecentralizedPeerToPeer:
                 f"a {n}-node topology"
             )
         self.topology = topology
+        # live view: starts as the full topology under the identity map and
+        # shrinks as remove_node() excises dead peers
+        self._live_topology = topology
+        self._live_to_global = {i: i for i in range(n)}
+        self._global_to_live = {i: i for i in range(n)}
+        self._round_lock = asyncio.Lock()
         self.learning_rate = learning_rate
         self._timeout = gossip_timeout
         if byzantine_indices is None:
@@ -205,8 +211,12 @@ class DecentralizedPeerToPeer:
         from ..node.cluster import DecentralizedCluster
 
         honest_ids = [self.node_ids[i] for i in self.honest_indices]
-        self._cluster = DecentralizedCluster(self.topology)
-        for i in range(self.topology.n_nodes):
+        # Build from the LIVE view: after remove_node() + shutdown(), a
+        # re-setup must bring up only the surviving fabric (sorted global
+        # order matches the induced topology's local index mapping).
+        live = sorted(self._workers)
+        self._cluster = DecentralizedCluster(self._live_topology)
+        for i in live:
             nid = self.node_ids[i]
             node = DecentralizedNode(nid, self._ctx_factory(nid))
             self._install(i, node, honest_ids)
@@ -231,19 +241,79 @@ class DecentralizedPeerToPeer:
     async def __aexit__(self, *exc: Any) -> None:
         await self.shutdown()
 
+    # -- elastic membership ---------------------------------------------------
+
+    async def remove_node(self, i: int) -> None:
+        """Drop node ``i`` from the gossip fabric mid-training.
+
+        The elastic policy loop for P2P (PS analogue:
+        ``ParameterServer(elastic=...)``): wire a
+        :class:`~byzpy_tpu.engine.node.liveness.HeartbeatMonitor`'s
+        ``on_suspect`` to this method and training rounds keep flowing
+        among survivors after a peer dies — the survivors re-bind the
+        induced sub-topology (same edges, dead node excised) and every
+        per-round expected-message count shrinks to match. The departing
+        node's runtime is shut down best-effort (it may already be gone).
+        """
+        if i not in self.nodes and i not in self._workers:
+            raise KeyError(f"node index {i} is not part of the fabric")
+        if i in self.honest_indices and len(self.honest_indices) <= 1:
+            raise ValueError("cannot remove the last honest node")
+        # Serialize against rounds: a round in flight while membership
+        # shifts underneath it would wait on the dead peer's gossip until
+        # its timeout. The whole live-view mutation below is await-free
+        # (atomic on the event loop); the departing node's shutdown —
+        # the only await — happens after the fabric is consistent.
+        async with self._round_lock:
+            node = self.nodes.pop(i, None)
+            self.honest_indices = [j for j in self.honest_indices if j != i]
+            self.byzantine_indices = [
+                j for j in self.byzantine_indices if j != i
+            ]
+            self._workers.pop(i, None)
+            # membership source of truth is the worker map (self.nodes only
+            # mirrors it once started)
+            remaining = sorted(self._workers)
+            pos = {g: k for k, g in enumerate(remaining)}
+            induced = Topology(len(remaining))
+            for a, b in self._live_topology.edges:
+                ga, gb = self._live_to_global[a], self._live_to_global[b]
+                if ga in pos and gb in pos:
+                    induced.add_edge(pos[ga], pos[gb])
+            ids = {pos[g]: self.node_ids[g] for g in remaining}
+            self._live_topology = induced
+            self._live_to_global = {k: g for g, k in pos.items()}
+            self._global_to_live = pos
+            for g in remaining:
+                if g in self.nodes:  # rebind live runtimes only
+                    self.nodes[g].bind_topology(induced, ids)
+        if node is not None:
+            try:
+                await asyncio.wait_for(node.shutdown(), timeout=2.0)
+            except Exception:  # noqa: BLE001 — the node may be the dead one
+                pass
+
     # -- training ------------------------------------------------------------
 
     def _honest_expected(self, i: int) -> int:
-        return len(self.topology.in_neighbors(i))
+        return len(self._live_topology.in_neighbors(self._global_to_live[i]))
 
     def _byz_expected(self, i: int) -> int:
         honest = set(self.honest_indices)
-        return len([j for j in self.topology.in_neighbors(i) if j in honest])
+        return len([
+            self._live_to_global[j]
+            for j in self._live_topology.in_neighbors(self._global_to_live[i])
+            if self._live_to_global[j] in honest
+        ])
 
     async def run_round_async(self) -> Dict[int, Any]:
         """One gossip round; returns each honest node's aggregated vector."""
         if not self._started:
             await self.setup()
+        async with self._round_lock:
+            return await self._round_locked()
+
+    async def _round_locked(self) -> Dict[int, Any]:
         lr = self.learning_rate
 
         # 1. half steps (concurrently; ref: runner.py:295-298)
