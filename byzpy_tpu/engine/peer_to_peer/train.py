@@ -66,6 +66,11 @@ class PeerToPeer:
     async def run_async(self, rounds: int) -> None:
         await self.runner.run_async(rounds)
 
+    async def remove_node(self, i: int) -> None:
+        """Excise node ``i`` from the gossip fabric mid-training (elastic
+        membership; see :meth:`DecentralizedPeerToPeer.remove_node`)."""
+        await self.runner.remove_node(i)
+
     async def shutdown_async(self) -> None:
         await self.runner.shutdown()
 
