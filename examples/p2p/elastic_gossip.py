@@ -1,0 +1,105 @@
+"""Elastic gossip: P2P training through a peer death (no reference analogue).
+
+The decentralized twin of ``examples/ps/elastic_crash_recovery.py``:
+four peers gossip toward consensus under coordinate-wise median; peer 3
+dies unannounced mid-training; the observer's heartbeat monitor suspects
+it and excises it from the fabric (``PeerToPeer.remove_node``), after
+which rounds keep completing over the induced 3-node topology and
+consensus re-forms WITHOUT the dead peer's (outlier) target.
+
+Run: ``python examples/p2p/elastic_gossip.py``.
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from byzpy_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()  # honor JAX_PLATFORMS even under a plugin sitecustomize
+
+import jax.numpy as jnp
+import numpy as np
+
+from byzpy_tpu.aggregators import CoordinateWiseMedian
+from byzpy_tpu.engine.node.liveness import HeartbeatMonitor
+from byzpy_tpu.engine.peer_to_peer import PeerToPeer, Topology
+from byzpy_tpu.engine.peer_to_peer.nodes import HonestP2PWorker
+
+ROUNDS = int(os.environ.get("P2P_ROUNDS", 30))
+DIM = 8
+
+
+class QuadWorker(HonestP2PWorker):
+    """Descends ||w - target||^2; gossip payload is the half-stepped w."""
+
+    def __init__(self, target):
+        self.target = jnp.full((DIM,), float(target), jnp.float32)
+        self.w = jnp.zeros((DIM,), jnp.float32)
+
+    def half_step(self, lr):
+        self.w = self.w - lr * 2.0 * (self.w - self.target)
+        return self.w
+
+    def parameters(self):
+        return self.w
+
+    def apply_aggregate(self, vector):
+        self.w = jnp.asarray(vector)
+
+
+async def main() -> None:
+    workers = [QuadWorker(t) for t in (0.0, 1.0, 2.0, 50.0)]
+    p2p = PeerToPeer(
+        workers, aggregator=CoordinateWiseMedian(),
+        topology=Topology.complete(4), learning_rate=0.3,
+    )
+    runner = p2p.runner
+    async with runner:
+        removed = asyncio.Event()
+
+        def on_suspect(peer_id):
+            victim = next(
+                gi for gi, nid in runner.node_ids.items() if nid == peer_id
+            )
+
+            async def act():
+                await p2p.remove_node(victim)
+                removed.set()
+                print(f"  [monitor] suspected {peer_id} -> excised")
+
+            asyncio.get_running_loop().create_task(act())
+
+        for gi, node in runner.nodes.items():
+            if gi != 0:
+                HeartbeatMonitor.install_responder(node)
+        mon = HeartbeatMonitor(
+            runner.nodes[0], interval=0.1, max_missed=3, on_suspect=on_suspect
+        )
+        await mon.start()
+        try:
+            for r in range(ROUNDS):
+                await p2p.round()
+                if r == ROUNDS // 3 and 3 in runner.nodes:
+                    print(f"round {r + 1}: killing peer node-3 (target 50)")
+                    await runner.nodes[3].shutdown()
+                    await asyncio.wait_for(removed.wait(), timeout=15.0)
+                if (r + 1) % 10 == 0:
+                    ws = [float(np.mean(workers[i].w)) for i in (0, 1, 2)]
+                    print(f"round {r + 1:3d}: survivor means "
+                          f"{['%.3f' % v for v in ws]}")
+        finally:
+            await mon.stop()
+
+    if ROUNDS >= 20:
+        for i in (0, 1, 2):
+            err = abs(float(np.mean(workers[i].w)) - 1.0)
+            assert err < 0.2, (i, workers[i].w)
+        print("consensus re-formed at the survivors' median target (1.0), "
+              "free of the dead peer's outlier (50.0)")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -61,6 +61,7 @@ def test_actor_demo_runs():
         "ps/spmd_mnist.py",
         "ps/real_data_robust.py",
         "ps/elastic_crash_recovery.py",
+        "p2p/elastic_gossip.py",
         "p2p/gossip_mnist.py",
         "p2p/real_data_gossip.py",
         "distributed/two_host_psum.py",
