@@ -105,6 +105,37 @@ def main() -> None:
     run("weighted_center_step_pallas_clip", iter_center("clip"),
         x1, z0, per_round=32, repeat=5)
 
+    # Fused NNM->Multi-Krum pipeline: on-chip parity vs the two-step
+    # composition, then per-round cost vs running the two steps
+    from byzpy_tpu.ops import preagg
+    from byzpy_tpu.ops.pallas_kernels import nnm_selection_mean_stream_pallas
+
+    xpar = xs[0][:16, :524_288]
+    got = nnm_selection_mean_stream_pallas(xpar[None], f_nnm=4, f=3, q=5)[0]
+    mixed = preagg.nnm(xpar, f=4)
+    want = robust.ranked_mean(mixed, robust.krum_scores(mixed, f=3), 5)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-4, f"fused nnm->krum parity: {err}"
+    emit(check="nnm_selection_fused_parity", ok=True, max_err=err)
+
+    t_fused = timed_call_s(
+        jax.jit(functools.partial(
+            robust.nnm_multi_krum_stream, f_nnm=8, f=8, q=12)),
+        xs, warmup=2, repeat=10) / K * 1e3
+
+    def two_step(a):
+        mixed = jax.vmap(functools.partial(preagg.nnm, f=8))(a)
+        return jax.vmap(
+            functools.partial(robust.multi_krum, f=8, q=12))(mixed)
+
+    import os as _os
+    _os.environ["BYZPY_TPU_PALLAS"] = "0"
+    t_two = timed_call_s(jax.jit(two_step), xs, warmup=2, repeat=10) / K * 1e3
+    _os.environ["BYZPY_TPU_PALLAS"] = "auto"
+    emit(workload="nnm_multi_krum_64x1M_stream32", fused_ms=round(t_fused, 3),
+         two_step_xla_ms=round(t_two, 3),
+         speedup=round(t_two / t_fused, 2))
+
     # MeaMed grid row (weakest non-SMEA multiplier at 41.8 ms / 1.4x):
     # measure the XLA path it currently dispatches to at d=65k AND the
     # fused kernel at the same shape — if the kernel wins by more than

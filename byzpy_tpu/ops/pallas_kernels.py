@@ -1079,6 +1079,179 @@ def nnm_pallas(
 
 
 # ---------------------------------------------------------------------------
+# Fused NNM -> selection-mean pipeline kernel (pre-aggregate + aggregate)
+# ---------------------------------------------------------------------------
+
+
+def _nnm_selection_stream_kernel(
+    x_ref, o_ref, gram_ref, w_ref, t_ref, *,
+    n_pad: int, n_real: int, k_nnm: int, f_sel: int, q: int, mode: str,
+    reference_index: int,
+):
+    """The canonical robust pipeline — Nearest-Neighbor Mixing feeding a
+    score-select-average aggregator (NNM was designed as exactly this
+    pre-mixer; ref: ``byzpy/pre_aggregators/nnm.py`` +
+    ``aggregators/geometric_wise/krum.py``) — in the SAME two HBM sweeps
+    a lone aggregator needs.
+
+    The trick: the mixed matrix never has to exist. With ``A`` the
+    (source, mixer) 0/1 selection mask and ``x̃`` the taint-zeroed data,
+    ``mixed = Aᵀ x̃ / k``, so the mixed rows' Gram is
+    ``Gm = Aᵀ G̃ A / k²`` — computable from the raw Gram entirely in
+    VMEM — and the final mean of the ``q`` selected mixed rows collapses
+    to source-space weights ``w_eff = A w_sel / k``. Phase 1 therefore
+    streams ``x`` once with a weight VECTOR, identical in cost to
+    ``_selection_mean_stream_kernel``. The two-step path pays ~5 sweeps
+    (NNM's 2 reads + (n, d) write, then the aggregator re-reading the
+    mixed matrix twice); this kernel pays 2 reads + a (1, d) write.
+
+    Non-finite rule matches the two-step composition: mixed rows that
+    selected a tainted source are NaN rows downstream — their Gm
+    rows/columns are set NaN so distances/norms/ranking poison exactly
+    like the materialized NaN rows would; if such a row is nonetheless
+    selected (NaN scores rank last, so only when q exceeds the finite
+    count), the output is NaN (folded into ``w_eff``)."""
+    p = pl.program_id(1)
+    c = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        _accumulate_gram(x_ref[0], gram_ref, c)
+
+    @pl.when((p == 1) & (c == 0))
+    def _():
+        mask_clean, taint, sel_taint = _nnm_weights(
+            gram_ref[:], n_pad=n_pad, n_real=n_real, k=k_nnm
+        )
+        g = gram_ref[:]
+        bad_src = (taint[:, None] > 0.5) | (taint[None, :] > 0.5)
+        g = jnp.where(bad_src, 0.0, g)  # Gram of the taint-zeroed data
+        # Gm = Aᵀ G̃ A / k² — (n, n) VMEM matmuls; HIGHEST keeps the
+        # derived distances closest to the analytic composition (cheap
+        # at this size; the big data-streaming dots are elsewhere)
+        ga = jax.lax.dot_general(
+            g, mask_clean,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        gm = jax.lax.dot_general(
+            mask_clean, ga,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) / jnp.asarray(float(k_nnm * k_nnm), jnp.float32)
+        bad_mix = (sel_taint[:, None] > 0.5) | (sel_taint[None, :] > 0.5)
+        gm = jnp.where(bad_mix, jnp.nan, gm)
+        scores = _selection_scores(
+            gm, mode=mode, n_pad=n_pad, n_real=n_real, f=f_sel,
+            reference_index=reference_index,
+        )
+        w_sel = _selection_weights(scores, n_pad=n_pad, n_real=n_real, q=q)
+        picked_nan = jnp.sum(
+            jnp.where((w_sel[:, 0] > 0.0) & (sel_taint > 0.5), 1.0, 0.0)
+        ) > 0.5
+        w_eff = jax.lax.dot_general(
+            mask_clean, w_sel,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) / jnp.asarray(float(k_nnm), jnp.float32)
+        w_ref[:] = jnp.where(picked_nan, jnp.nan, w_eff)
+        t_ref[0, :] = taint
+
+    @pl.when(p == 1)
+    def _():
+        taint_col = t_ref[0, :][:, None]
+        xt = jnp.where(taint_col > 0.5, 0.0, x_ref[0].astype(jnp.float32))
+        o_ref[0] = jnp.sum(xt * w_ref[:], axis=0, keepdims=True).astype(
+            o_ref.dtype
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "f_nnm", "f", "q", "mode", "reference_index", "tile", "interpret"
+    ),
+)
+def nnm_selection_mean_stream_pallas(
+    xs: Array,
+    *,
+    f_nnm: int,
+    f: int,
+    q: int,
+    mode: str = "krum",
+    reference_index: int = 0,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """NNM pre-aggregation + score-select-average aggregation over ``K``
+    stacked rounds ``xs: (K, n, d)`` in ONE fused launch, returning
+    ``(K, d)``; equals ``selection_mean(nnm(x, f=f_nnm), f=f, q=q)`` per
+    round at 2 HBM reads + a (1, d) write — the two-step path moves ~5
+    full-matrix passes. See ``_nnm_selection_stream_kernel``.
+
+    16-bit inputs: the two-step path rounds the MATERIALIZED mixed
+    matrix back to the input dtype before scoring, while this kernel
+    scores from the full-f32 derived Gram — strictly higher fidelity,
+    but a near-tie in krum scores (within ~2^-8 relative for bf16) may
+    select a different row than the rounded two-step would. f32 inputs
+    match the composition to float precision."""
+    if mode not in {"krum", "cge", "monna"}:
+        raise ValueError(f"unknown mode {mode!r}")
+    K, n, d = xs.shape
+    if not 0 <= f_nnm < n:
+        raise ValueError(f"f_nnm must satisfy 0 <= f_nnm < n (got {f_nnm})")
+    if mode == "krum" and not (0 <= f < n - 1 and 1 <= q <= n - f):
+        raise ValueError(f"invalid (n={n}, f={f}, q={q}) for krum")
+    if not 1 <= q <= n:
+        raise ValueError(f"q must be in [1, n] (got q={q}, n={n})")
+    if not 0 <= reference_index < n:
+        raise ValueError(f"reference_index out of range (got {reference_index})")
+    if xs.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        raise ValueError(f"unsupported dtype {xs.dtype}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    if tile is None:
+        tile = _auto_selection_tile(d, n_pad, jnp.dtype(xs.dtype).itemsize)
+    d_pad = _round_up(max(d, 1), tile)
+    if (n_pad, d_pad) == (n, d):
+        xp = xs
+    else:
+        xp = jnp.zeros((K, n_pad, d_pad), xs.dtype).at[:, :n, :d].set(xs)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _nnm_selection_stream_kernel, n_pad=n_pad, n_real=n,
+            k_nnm=n - f_nnm, f_sel=f, q=q, mode=mode,
+            reference_index=reference_index,
+        ),
+        out_shape=jax.ShapeDtypeStruct((K, 1, d_pad), xs.dtype),
+        grid=(K, 2, d_pad // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, n_pad, tile), lambda k, p, c: (k, 0, c),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        # phase-parked output (see _nnm_stream_kernel's out_specs note)
+        out_specs=pl.BlockSpec(
+            (1, 1, tile), lambda k, p, c: (k, 0, c * p),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_pad, n_pad), jnp.float32),
+            pltpu.VMEM((n_pad, 1), jnp.float32),
+            pltpu.VMEM((1, n_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp)
+    return out[:, 0, :d]
+
+
+# ---------------------------------------------------------------------------
 # Dispatch policy
 # ---------------------------------------------------------------------------
 
@@ -1158,6 +1331,7 @@ __all__ = [
     "meamed_stream_pallas",
     "nnm_pallas",
     "nnm_stream_pallas",
+    "nnm_selection_mean_stream_pallas",
     "selection_mean_pallas",
     "sorted_reduce_stream_pallas",
     "selection_mean_stream_pallas",
