@@ -1169,6 +1169,146 @@ def _nnm_selection_stream_kernel(
         )
 
 
+def _clip_selection_stream_kernel(
+    x_ref, o_ref, gram_ref, w_ref, t_ref, *,
+    n_pad: int, n_real: int, tau: float, f_sel: int, q: int, mode: str,
+    reference_index: int,
+):
+    """Static L2 clipping feeding a score-select-average aggregator, in
+    two HBM sweeps — the diagonal instance of the same Gram-collapse
+    that fuses NNM (``_nnm_selection_stream_kernel``): clipping is the
+    row scaling ``x' = diag(c) x`` with ``c_i = min(1, τ/‖x_i‖)`` and
+    the norms ARE the Gram diagonal, so the clipped Gram is
+    ``c_i c_j G_ij`` in VMEM and the selected mean collapses to weights
+    ``w_sel ⊙ c``. Non-finite rule: a NaN norm propagates NaN through
+    its factor (rows rank last, NaN output if selected, matching the
+    materialized path); an inf norm clips to factor 0 — its Gm row is
+    NaN (0·inf), ranks last, and selection of it emits a whole-NaN
+    output (the materialized path is NaN only at the non-finite
+    coordinates; documented deviation, same class as NNM's PARITY
+    note). An inf norm is ambiguous from the Gram alone: it can also
+    arise from a FINITE row whose squared norm overflows f32
+    (‖x‖ > ~1.8e19). The materialized path clips such a row to the
+    all-zero vector (which then competes in scoring near the origin);
+    this kernel excludes it like non-finite data. The InfAttack-style
+    case is the security-relevant one and matches; the finite-overflow
+    divergence is pinned in tests."""
+    p = pl.program_id(1)
+    c = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _():
+        _accumulate_gram(x_ref[0], gram_ref, c)
+
+    @pl.when((p == 1) & (c == 0))
+    def _():
+        g = gram_ref[:]
+        row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
+        col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
+        norms2 = jnp.sum(jnp.where(row_i == col_i, g, 0.0), axis=0)
+        norms = jnp.sqrt(jnp.maximum(norms2, 0.0))
+        cfac = jnp.minimum(
+            1.0, jnp.asarray(tau, jnp.float32) / jnp.maximum(norms, 1e-12)
+        )
+        gm = cfac[:, None] * cfac[None, :] * g
+        scores = _selection_scores(
+            gm, mode=mode, n_pad=n_pad, n_real=n_real, f=f_sel,
+            reference_index=reference_index,
+        )
+        w_sel = _selection_weights(scores, n_pad=n_pad, n_real=n_real, q=q)
+        bad = jnp.where(jnp.isfinite(norms), 0.0, 1.0)
+        picked_bad = jnp.sum(
+            jnp.where((w_sel[:, 0] > 0.0) & (bad > 0.5), 1.0, 0.0)
+        ) > 0.5
+        # zero bad rows' weights BEFORE scaling: an unselected NaN-norm
+        # row otherwise contributes 0 * NaN = NaN to the weighted sum
+        w_eff = jnp.where(bad[:, None] > 0.5, 0.0, w_sel * cfac[:, None])
+        w_ref[:] = jnp.where(picked_bad, jnp.nan, w_eff)
+        t_ref[0, :] = bad
+
+    @pl.when(p == 1)
+    def _():
+        bad_col = t_ref[0, :][:, None]
+        xt = jnp.where(bad_col > 0.5, 0.0, x_ref[0].astype(jnp.float32))
+        o_ref[0] = jnp.sum(xt * w_ref[:], axis=0, keepdims=True).astype(
+            o_ref.dtype
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tau", "f", "q", "mode", "reference_index", "tile", "interpret"
+    ),
+)
+def clip_selection_mean_stream_pallas(
+    xs: Array,
+    *,
+    tau: float,
+    f: int,
+    q: int,
+    mode: str = "krum",
+    reference_index: int = 0,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Static clipping + score-select-average over ``K`` stacked rounds
+    ``xs: (K, n, d)`` in ONE fused launch; equals
+    ``selection_mean(clip_rows(x, threshold=tau), f=f, q=q)`` per round
+    at 2 HBM reads + a (1, d) write. See
+    ``_clip_selection_stream_kernel`` (and its non-finite note)."""
+    if mode not in {"krum", "cge", "monna"}:
+        raise ValueError(f"unknown mode {mode!r}")
+    K, n, d = xs.shape
+    if not tau > 0:
+        raise ValueError(f"tau must be positive (got {tau})")
+    if mode == "krum" and not (0 <= f < n - 1 and 1 <= q <= n - f):
+        raise ValueError(f"invalid (n={n}, f={f}, q={q}) for krum")
+    if not 1 <= q <= n:
+        raise ValueError(f"q must be in [1, n] (got q={q}, n={n})")
+    if not 0 <= reference_index < n:
+        raise ValueError(f"reference_index out of range (got {reference_index})")
+    if xs.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        raise ValueError(f"unsupported dtype {xs.dtype}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    if tile is None:
+        tile = _auto_selection_tile(d, n_pad, jnp.dtype(xs.dtype).itemsize)
+    d_pad = _round_up(max(d, 1), tile)
+    if (n_pad, d_pad) == (n, d):
+        xp = xs
+    else:
+        xp = jnp.zeros((K, n_pad, d_pad), xs.dtype).at[:, :n, :d].set(xs)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _clip_selection_stream_kernel, n_pad=n_pad, n_real=n,
+            tau=float(tau), f_sel=f, q=q, mode=mode,
+            reference_index=reference_index,
+        ),
+        out_shape=jax.ShapeDtypeStruct((K, 1, d_pad), xs.dtype),
+        grid=(K, 2, d_pad // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, n_pad, tile), lambda k, p, c: (k, 0, c),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, tile), lambda k, p, c: (k, 0, c * p),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_pad, n_pad), jnp.float32),
+            pltpu.VMEM((n_pad, 1), jnp.float32),
+            pltpu.VMEM((1, n_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp)
+    return out[:, 0, :d]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -1329,6 +1469,7 @@ __all__ = [
     "gram_pallas",
     "pairwise_sq_dists_pallas",
     "meamed_stream_pallas",
+    "clip_selection_mean_stream_pallas",
     "nnm_pallas",
     "nnm_stream_pallas",
     "nnm_selection_mean_stream_pallas",

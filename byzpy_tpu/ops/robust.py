@@ -368,6 +368,45 @@ def nnm_multi_krum_stream(xs: Array, *, f_nnm: int, f: int, q: int) -> Array:
     return aggregate_stream(partial(nnm_multi_krum, f_nnm=f_nnm, f=f, q=q), xs)
 
 
+def clipped_multi_krum(x: Array, *, tau: float, f: int, q: int) -> Array:
+    """Static L2 clipping feeding Multi-Krum, fused when the dispatch
+    gates allow — the diagonal instance of the Gram-collapse that fuses
+    NNM (see ``nnm_multi_krum``): the clip factors come off the Gram
+    diagonal, the clipped Gram is ``c_i c_j G_ij`` in VMEM, and the
+    selected mean collapses to weights ``w_sel * c``
+    (``pallas_kernels.clip_selection_mean_stream_pallas``)."""
+    if not tau > 0:
+        # validate BEFORE dispatch: the fallback's clip_rows would accept
+        # tau <= 0 and silently sign-flip/zero every row
+        raise ValueError(f"tau must be positive (got {tau})")
+    if _use_selection_kernel(x):
+        from .pallas_kernels import clip_selection_mean_stream_pallas
+
+        return clip_selection_mean_stream_pallas(
+            x[None], tau=tau, f=f, q=q, mode="krum"
+        )[0]
+    from .preagg import clip_rows
+
+    return multi_krum(clip_rows(x, threshold=tau), f=f, q=q)
+
+
+@partial(jax.jit, static_argnames=("tau", "f", "q"))
+def clipped_multi_krum_stream(
+    xs: Array, *, tau: float, f: int, q: int
+) -> Array:
+    """``clipped_multi_krum`` over ``K`` stacked rounds ``(K, n, d)`` in
+    one dispatch (see ``aggregate_stream``)."""
+    if not tau > 0:
+        raise ValueError(f"tau must be positive (got {tau})")
+    if xs.ndim == 3 and _use_selection_kernel(xs):
+        from .pallas_kernels import clip_selection_mean_stream_pallas
+
+        return clip_selection_mean_stream_pallas(
+            xs, tau=tau, f=f, q=q, mode="krum"
+        )
+    return aggregate_stream(partial(clipped_multi_krum, tau=tau, f=f, q=q), xs)
+
+
 @partial(jax.jit, static_argnames=("tol", "max_iter", "eps", "init"))
 def geometric_median(
     x: Array,
@@ -802,6 +841,8 @@ __all__ = [
     "multi_krum_stream",
     "nnm_multi_krum",
     "nnm_multi_krum_stream",
+    "clipped_multi_krum",
+    "clipped_multi_krum_stream",
     "krum",
     "geometric_median",
     "centered_clipping",

@@ -914,3 +914,121 @@ class TestFusedNnmSelection:
             nnm_selection_mean_stream_pallas(
                 xs, f_nnm=2, f=1, q=2, mode="bogus"
             )
+
+
+class TestFusedClipSelection:
+    """clip_selection_mean_stream_pallas == clip_rows -> selection."""
+
+    @staticmethod
+    def _oracle(x, tau, f, q):
+        from byzpy_tpu.ops.preagg import clip_rows
+
+        clipped = clip_rows(x, threshold=tau)
+        return robust.ranked_mean(clipped, robust.krum_scores(clipped, f=f), q)
+
+    def test_matches_two_step_composition(self):
+        from byzpy_tpu.ops.pallas_kernels import (
+            clip_selection_mean_stream_pallas,
+        )
+
+        for seed, (n, d, tau, f, q) in enumerate(
+            [(10, 512, 8.0, 2, 4), (16, 1024, 20.0, 3, 5), (9, 384, 1.5, 2, 3)]
+        ):
+            # mixed magnitudes so some rows clip and some do not
+            x = jax.random.normal(jax.random.PRNGKey(seed), (n, d))
+            x = x.at[::3].multiply(10.0)
+            got = clip_selection_mean_stream_pallas(
+                x[None], tau=tau, f=f, q=q, interpret=True
+            )[0]
+            want = self._oracle(x, tau, f, q)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+    def test_stream_and_ops_wrappers(self, monkeypatch):
+        monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+        xs = jax.random.normal(jax.random.PRNGKey(7), (3, 12, 640))
+        xs = xs.at[:, ::2].multiply(7.0)
+        got = robust.clipped_multi_krum_stream(xs, tau=5.0, f=2, q=4)
+        want = jnp.stack([self._oracle(xs[k], 5.0, 2, 4) for k in range(3)])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+        got1 = robust.clipped_multi_krum(xs[0], tau=5.0, f=2, q=4)
+        np.testing.assert_allclose(
+            np.asarray(got1), np.asarray(want[0]), rtol=2e-4, atol=2e-4
+        )
+        # gated-off path at a fresh shape agrees with the same oracle
+        monkeypatch.setenv("BYZPY_TPU_PALLAS", "0")
+        x2 = jax.random.normal(jax.random.PRNGKey(9), (11, 768)) * 4.0
+        np.testing.assert_allclose(
+            np.asarray(robust.clipped_multi_krum(x2, tau=5.0, f=2, q=4)),
+            np.asarray(self._oracle(x2, 5.0, 2, 4)),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_nonfinite_norm_rows_rank_last(self):
+        from byzpy_tpu.ops.pallas_kernels import (
+            clip_selection_mean_stream_pallas,
+        )
+
+        n, d = 12, 512
+        x = np.asarray(jax.random.normal(jax.random.PRNGKey(5), (n, d))).copy()
+        x[4] = np.inf   # inf norm -> factor 0 -> NaN Gm row
+        x[7, 0] = np.nan  # NaN norm -> NaN factor
+        x = jnp.asarray(x)
+        got = clip_selection_mean_stream_pallas(
+            x[None], tau=3.0, f=2, q=4, interpret=True
+        )[0]
+        want = self._oracle(x, 3.0, 2, 4)
+        if bool(jnp.isnan(want).any()):
+            assert bool(jnp.isnan(got).any())
+        else:
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+    def test_validation(self):
+        from byzpy_tpu.ops.pallas_kernels import (
+            clip_selection_mean_stream_pallas,
+        )
+
+        xs = jnp.zeros((1, 8, 256))
+        with pytest.raises(ValueError, match="tau"):
+            clip_selection_mean_stream_pallas(xs, tau=0.0, f=1, q=2)
+        with pytest.raises(ValueError, match="krum"):
+            clip_selection_mean_stream_pallas(xs, tau=1.0, f=7, q=2)
+
+
+def test_clipped_multi_krum_validates_tau_on_both_paths(monkeypatch):
+    x = jnp.ones((8, 256))
+    for flag in ("0", "1"):
+        monkeypatch.setenv("BYZPY_TPU_PALLAS", flag)
+        with pytest.raises(ValueError, match="tau"):
+            robust.clipped_multi_krum(x, tau=-1.0, f=1, q=2)
+        with pytest.raises(ValueError, match="tau"):
+            robust.clipped_multi_krum_stream(x[None], tau=0.0, f=1, q=2)
+
+
+def test_clip_fused_finite_norm_overflow_documented_divergence():
+    """Pin the documented deviation: a FINITE row whose squared norm
+    overflows f32 is excluded by the fused kernel (inf norm is
+    indistinguishable from inf data in the Gram), while the materialized
+    path clips it to the all-zero vector. Both outputs must be finite
+    and robust; they need not be equal."""
+    from byzpy_tpu.ops.pallas_kernels import clip_selection_mean_stream_pallas
+    from byzpy_tpu.ops.preagg import clip_rows
+
+    n, d = 10, 512
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(2), (n, d))).copy()
+    x[3] = 1e18  # finite, but sum of squares overflows f32
+    x = jnp.asarray(x)
+    got = clip_selection_mean_stream_pallas(
+        x[None], tau=3.0, f=2, q=4, interpret=True
+    )[0]
+    clipped = clip_rows(x, threshold=3.0)
+    want = robust.ranked_mean(clipped, robust.krum_scores(clipped, f=2), 4)
+    assert bool(jnp.isfinite(got).all())
+    assert bool(jnp.isfinite(want).all())
+    # the kernel's aggregate stays in the honest cluster's scale
+    assert float(jnp.max(jnp.abs(got))) < 10.0
