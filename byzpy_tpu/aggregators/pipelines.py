@@ -1,0 +1,55 @@
+"""Pipeline fusion: recognize (pre-aggregator, aggregator) combinations
+with a Gram-collapse kernel.
+
+Reference-style training code spells the robust pipeline as two objects
+(``ParameterServer(pre_aggregator=NearestNeighborMixing(f),
+aggregator=MultiKrum(f, q))`` — ref:
+``byzpy/engine/parameter_server/ps.py:127-137``). For combinations where
+the pre-aggregation is a linear row operator with Gram-derivable
+coefficients, the composition runs as ONE fused two-sweep kernel instead
+of two materialized steps (see ``docs/performance.md`` "pipeline rows"):
+the orchestrators consult :func:`fused_pipeline_matrix_fn` and fall back
+to the two-step path whenever it returns ``None`` — semantics are
+identical either way (documented deviations: non-finite corner rules,
+``PARITY.md``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+
+def fused_pipeline_matrix_fn(
+    pre: Any, agg: Any
+) -> Optional[Callable[[jnp.ndarray], jnp.ndarray]]:
+    """A fused ``(n, d) -> (d,)`` function for the (pre, agg) pair, or
+    ``None`` when no fused kernel exists (callers then run the ordinary
+    two-step path)."""
+    from ..ops import robust
+    from ..pre_aggregators.clipping import Clipping
+    from ..pre_aggregators.nnm import NearestNeighborMixing
+    from .geometric_wise.krum import Krum, MultiKrum
+
+    # EXACT-type matching on purpose: a subclass may override the
+    # documented extension hooks (_aggregate_matrix / _transform_matrix)
+    # and the fused kernel would silently bypass the override. Krum is
+    # admitted explicitly (it only pins q=1).
+    if type(agg) not in (MultiKrum, Krum):
+        return None
+    if type(pre) is NearestNeighborMixing:
+        return partial(
+            robust.nnm_multi_krum, f_nnm=pre.f, f=agg.f, q=agg.q
+        )
+    if type(pre) is Clipping and pre.threshold > 0:
+        # threshold == 0 is degenerate (every row clips to zero); keep it
+        # on the materialized path, whose semantics are the contract
+        return partial(
+            robust.clipped_multi_krum, tau=pre.threshold, f=agg.f, q=agg.q
+        )
+    return None
+
+
+__all__ = ["fused_pipeline_matrix_fn"]

@@ -114,6 +114,17 @@ class ParameterServer:
             if (pool is not None or pool_config is not None)
             else None
         )
+        # pipeline fusion, resolved ONCE: (NNM | Clipping) -> Multi-Krum
+        # runs as one Gram-collapse kernel (aggregators.pipelines); every
+        # other combination keeps the two-step path. Pool-scheduled
+        # aggregation is excluded — the executor owns that flow.
+        self._fused_pipeline = None
+        if self._executor is None and pre_aggregator is not None:
+            from ...aggregators.pipelines import fused_pipeline_matrix_fn
+
+            self._fused_pipeline = fused_pipeline_matrix_fn(
+                pre_aggregator, aggregator
+            )
         self.rounds_completed = 0
 
     # -- round pieces (ref: ps.py:89-101) ------------------------------------
@@ -138,6 +149,15 @@ class ParameterServer:
 
     async def _aggregate(self, gradients: List[Any]) -> Any:
         if self.pre_aggregator is not None:
+            if self._fused_pipeline is not None:
+                from ...utils import placement
+                from ...utils.trees import stack_gradients
+
+                with placement.on(placement.compute_device(gradients)):
+                    matrix, unravel = stack_gradients(gradients)
+                    self.pre_aggregator.validate_n(matrix.shape[0])
+                    self.aggregator.validate_n(matrix.shape[0])
+                    return unravel(self._fused_pipeline(matrix))
             gradients = self.pre_aggregator.pre_aggregate(gradients)
         if self._executor is not None:
             return await self._executor.run(gradients)

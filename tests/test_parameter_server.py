@@ -185,3 +185,73 @@ def test_ps_round_failure_retrieves_all_sibling_exceptions(caplog):
     assert set(completed) == {"boom-a", "boom-b"}  # raise waited for ALL
     dropped = [r for r in caplog.records if "never retrieved" in r.getMessage()]
     assert not dropped, dropped
+
+
+def test_ps_fused_pipeline_matches_two_step():
+    """ParameterServer(pre_aggregator=NNM/Clipping, aggregator=MultiKrum)
+    routes through the fused Gram-collapse kernel (when available) and
+    must equal the materialized two-step composition."""
+    import numpy as np
+
+    from byzpy_tpu.aggregators import MultiKrum
+    from byzpy_tpu.aggregators.pipelines import fused_pipeline_matrix_fn
+    from byzpy_tpu.pre_aggregators import Clipping, NearestNeighborMixing
+
+    class Node:
+        def __init__(self, seed):
+            self.rng = np.random.default_rng(seed)
+
+        def honest_gradient_for_next_batch(self):
+            return [self.rng.standard_normal(96).astype(np.float32)]
+
+        def apply_server_gradient(self, g):
+            self.grad = g
+
+    for pre in (NearestNeighborMixing(f=2), Clipping(threshold=3.0)):
+        agg = MultiKrum(f=2, q=3)
+        nodes = [Node(i) for i in range(9)]
+        grads = [n.honest_gradient_for_next_batch() for n in nodes]
+        ps = ParameterServer(
+            honest_nodes=nodes, aggregator=agg, pre_aggregator=pre
+        )
+        # prove the fused path is the one that runs (not a silent
+        # fall-through to the two-step composition)
+        assert ps._fused_pipeline is not None
+        calls = []
+        real = ps._fused_pipeline
+
+        def recording(matrix):
+            calls.append(matrix.shape)
+            return real(matrix)
+
+        ps._fused_pipeline = recording
+        got = asyncio.run(ps._aggregate(list(grads)))
+        assert calls == [(9, 96)]
+        want = agg.aggregate(pre.pre_aggregate(list(grads)))
+        np.testing.assert_allclose(
+            np.asarray(got[0]), np.asarray(want[0]), rtol=2e-4, atol=2e-4
+        )
+        assert fused_pipeline_matrix_fn(pre, agg) is not None
+
+
+def test_fused_pipeline_matcher_scope():
+    from byzpy_tpu.aggregators import CoordinateWiseMedian, Krum, MultiKrum
+    from byzpy_tpu.aggregators.pipelines import fused_pipeline_matrix_fn
+    from byzpy_tpu.pre_aggregators import Bucketing, Clipping, NearestNeighborMixing
+
+    assert fused_pipeline_matrix_fn(NearestNeighborMixing(f=1), Krum(f=1)) is not None
+    assert fused_pipeline_matrix_fn(Clipping(threshold=0.0), MultiKrum(f=1, q=2)) is None
+    assert fused_pipeline_matrix_fn(Bucketing(bucket_size=2), MultiKrum(f=1, q=2)) is None
+    assert fused_pipeline_matrix_fn(NearestNeighborMixing(f=1), CoordinateWiseMedian()) is None
+
+    # subclasses overriding the documented hooks must NOT fuse
+    class MyKrum(MultiKrum):
+        def _aggregate_matrix(self, x):
+            return super()._aggregate_matrix(x) * 2.0
+
+    class MyNNM(NearestNeighborMixing):
+        def _transform_matrix(self, x):
+            return super()._transform_matrix(x) + 1.0
+
+    assert fused_pipeline_matrix_fn(NearestNeighborMixing(f=1), MyKrum(f=1, q=2)) is None
+    assert fused_pipeline_matrix_fn(MyNNM(f=1), MultiKrum(f=1, q=2)) is None
