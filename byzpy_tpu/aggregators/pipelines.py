@@ -29,6 +29,7 @@ def fused_pipeline_matrix_fn(
     ``None`` when no fused kernel exists (callers then run the ordinary
     two-step path)."""
     from ..ops import robust
+    from ..pre_aggregators.arc import ARC
     from ..pre_aggregators.clipping import Clipping
     from ..pre_aggregators.nnm import NearestNeighborMixing
     from .geometric_wise.krum import Krum, MultiKrum
@@ -48,6 +49,10 @@ def fused_pipeline_matrix_fn(
         # on the materialized path, whose semantics are the contract
         return partial(
             robust.clipped_multi_krum, tau=pre.threshold, f=agg.f, q=agg.q
+        )
+    if type(pre) is ARC:
+        return partial(
+            robust.arc_multi_krum, f_arc=pre.f, f=agg.f, q=agg.q
         )
     return None
 

@@ -1172,7 +1172,7 @@ def _nnm_selection_stream_kernel(
 def _clip_selection_stream_kernel(
     x_ref, o_ref, gram_ref, w_ref, t_ref, *,
     n_pad: int, n_real: int, tau: float, f_sel: int, q: int, mode: str,
-    reference_index: int,
+    reference_index: int, pre: str = "clip", cut_off: int = 0,
 ):
     """Static L2 clipping feeding a score-select-average aggregator, in
     two HBM sweeps — the diagonal instance of the same Gram-collapse
@@ -1207,8 +1207,31 @@ def _clip_selection_stream_kernel(
         col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
         norms2 = jnp.sum(jnp.where(row_i == col_i, g, 0.0), axis=0)
         norms = jnp.sqrt(jnp.maximum(norms2, 0.0))
+        if pre == "clip":
+            threshold = jnp.asarray(tau, jnp.float32)
+        else:  # arc: threshold = sorted(real norms)[cut_off - 1]
+            # stable rank in int32 key space (jnp.sort total order incl.
+            # non-finite); padded rows carry the max key so they rank
+            # strictly after every real norm and never shift the cut
+            keys = _float_sort_keys(norms)
+            idx = lax.broadcasted_iota(jnp.int32, (1, n_pad), 1)[0]
+            keys = jnp.where(idx >= n_real, jnp.iinfo(jnp.int32).max, keys)
+            kr = keys[:, None]
+            kc = keys[None, :]
+            ir = idx[:, None]
+            ic = idx[None, :]
+            rank = jnp.sum(
+                jnp.where((kc < kr) | ((kc == kr) & (ic < ir)), 1, 0), axis=1
+            )
+            # exactly one row has the cut rank; all other summands are 0.
+            # Kept (1,)-shaped: Mosaic bitcasts want vectors, not scalars.
+            th_key = jnp.sum(
+                jnp.where(rank == cut_off - 1, keys, jnp.zeros_like(keys)),
+                keepdims=True,
+            )
+            threshold = _keys_to_float(th_key, jnp.float32)
         cfac = jnp.minimum(
-            1.0, jnp.asarray(tau, jnp.float32) / jnp.maximum(norms, 1e-12)
+            1.0, threshold / jnp.maximum(norms, 1e-12)
         )
         gm = cfac[:, None] * cfac[None, :] * g
         scores = _selection_scores(
@@ -1286,6 +1309,85 @@ def clip_selection_mean_stream_pallas(
             _clip_selection_stream_kernel, n_pad=n_pad, n_real=n,
             tau=float(tau), f_sel=f, q=q, mode=mode,
             reference_index=reference_index,
+        ),
+        out_shape=jax.ShapeDtypeStruct((K, 1, d_pad), xs.dtype),
+        grid=(K, 2, d_pad // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (1, n_pad, tile), lambda k, p, c: (k, 0, c),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, tile), lambda k, p, c: (k, 0, c * p),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_pad, n_pad), jnp.float32),
+            pltpu.VMEM((n_pad, 1), jnp.float32),
+            pltpu.VMEM((1, n_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xp)
+    return out[:, 0, :d]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "f_arc", "f", "q", "mode", "reference_index", "tile", "interpret"
+    ),
+)
+def arc_selection_mean_stream_pallas(
+    xs: Array,
+    *,
+    f_arc: int,
+    f: int,
+    q: int,
+    mode: str = "krum",
+    reference_index: int = 0,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Adaptive Robust Clipping + score-select-average over ``K`` stacked
+    rounds in ONE fused launch; equals
+    ``selection_mean(arc_clip(x, f=f_arc), f=f, q=q)`` per round. ARC's
+    factors are norm-derived like static clipping's — the data-dependent
+    threshold (the ``cut_off``-th smallest norm) computes by stable rank
+    counting in int32 key space inside VMEM — so the same Gram-collapse
+    applies (see ``_clip_selection_stream_kernel``, ``pre='arc'``)."""
+    if mode not in {"krum", "cge", "monna"}:
+        raise ValueError(f"unknown mode {mode!r}")
+    K, n, d = xs.shape
+    if not 0 <= f_arc <= n:
+        raise ValueError(f"f_arc must satisfy 0 <= f_arc <= n (got {f_arc})")
+    if mode == "krum" and not (0 <= f < n - 1 and 1 <= q <= n - f):
+        raise ValueError(f"invalid (n={n}, f={f}, q={q}) for krum")
+    if not 1 <= q <= n:
+        raise ValueError(f"q must be in [1, n] (got q={q}, n={n})")
+    if not 0 <= reference_index < n:
+        raise ValueError(f"reference_index out of range (got {reference_index})")
+    if xs.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        raise ValueError(f"unsupported dtype {xs.dtype}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    from .preagg import arc_cut_off
+
+    cut_off = arc_cut_off(n, f_arc)  # 1-based rank of the threshold norm
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    if tile is None:
+        tile = _auto_selection_tile(d, n_pad, jnp.dtype(xs.dtype).itemsize)
+    d_pad = _round_up(max(d, 1), tile)
+    if (n_pad, d_pad) == (n, d):
+        xp = xs
+    else:
+        xp = jnp.zeros((K, n_pad, d_pad), xs.dtype).at[:, :n, :d].set(xs)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _clip_selection_stream_kernel, n_pad=n_pad, n_real=n,
+            tau=0.0, f_sel=f, q=q, mode=mode,
+            reference_index=reference_index, pre="arc", cut_off=cut_off,
         ),
         out_shape=jax.ShapeDtypeStruct((K, 1, d_pad), xs.dtype),
         grid=(K, 2, d_pad // tile),
@@ -1469,6 +1571,7 @@ __all__ = [
     "gram_pallas",
     "pairwise_sq_dists_pallas",
     "meamed_stream_pallas",
+    "arc_selection_mean_stream_pallas",
     "clip_selection_mean_stream_pallas",
     "nnm_pallas",
     "nnm_stream_pallas",

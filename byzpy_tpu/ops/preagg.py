@@ -103,6 +103,17 @@ def nnm(x: Array, *, f: int) -> Array:
     ).astype(x.dtype)
 
 
+def arc_cut_off(n: int, f: int) -> int:
+    """ARC's 1-based rank of the threshold norm: clip the
+    ``floor(2f/n * (n-f))`` largest-norm rows to the ``cut_off``-th
+    smallest norm. THE single implementation of the formula — the fused
+    pipeline kernel (``pallas_kernels.arc_selection_mean_stream_pallas``)
+    must clip at exactly the same rank as the materialized path here."""
+    nb_clipped = int(math.floor((2.0 * f / n) * (n - f)))
+    nb_clipped = max(0, min(nb_clipped, n - 1))
+    return max(1, n - nb_clipped)
+
+
 @partial(jax.jit, static_argnames=("f",))
 def arc_clip(x: Array, *, f: int) -> Array:
     """Adaptive Robust Clipping: clip the ``floor(2f/n * (n-f))`` largest-norm
@@ -112,13 +123,11 @@ def arc_clip(x: Array, *, f: int) -> Array:
     n = x.shape[0]
     if f > n:
         raise ValueError(f"f must be <= n (got f={f}, n={n})")
-    nb_clipped = int(math.floor((2.0 * f / n) * (n - f)))
-    nb_clipped = max(0, min(nb_clipped, n - 1))
-    cut_off = n - nb_clipped
+    cut_off = arc_cut_off(n, f)
     norms = jnp.sqrt(jnp.sum(x * x, axis=1))
-    threshold = jnp.sort(norms)[max(0, cut_off - 1)]
+    threshold = jnp.sort(norms)[cut_off - 1]
     factors = jnp.minimum(1.0, threshold / jnp.maximum(norms, 1e-12))
     return x * factors[:, None]
 
 
-__all__ = ["clip_rows", "bucket_means", "nnm", "arc_clip"]
+__all__ = ["clip_rows", "bucket_means", "nnm", "arc_clip", "arc_cut_off"]

@@ -407,6 +407,47 @@ def clipped_multi_krum_stream(
     return aggregate_stream(partial(clipped_multi_krum, tau=tau, f=f, q=q), xs)
 
 
+def arc_multi_krum(x: Array, *, f_arc: int, f: int, q: int) -> Array:
+    """Adaptive Robust Clipping feeding Multi-Krum, fused when the
+    dispatch gates allow — ARC's factors are norm-derived like static
+    clipping's (its threshold is the ``cut_off``-th smallest norm,
+    rank-counted in VMEM), so the same Gram-collapse applies
+    (``pallas_kernels.arc_selection_mean_stream_pallas``)."""
+    if not 0 <= f_arc <= x.shape[0]:
+        # validate BEFORE dispatch: the fallback's arc_clip would clamp a
+        # negative f_arc to "no clipping" silently
+        raise ValueError(
+            f"f_arc must satisfy 0 <= f_arc <= n (got {f_arc}, n={x.shape[0]})"
+        )
+    if _use_selection_kernel(x):
+        from .pallas_kernels import arc_selection_mean_stream_pallas
+
+        return arc_selection_mean_stream_pallas(
+            x[None], f_arc=f_arc, f=f, q=q, mode="krum"
+        )[0]
+    from .preagg import arc_clip
+
+    return multi_krum(arc_clip(x, f=f_arc), f=f, q=q)
+
+
+@partial(jax.jit, static_argnames=("f_arc", "f", "q"))
+def arc_multi_krum_stream(xs: Array, *, f_arc: int, f: int, q: int) -> Array:
+    """``arc_multi_krum`` over ``K`` stacked rounds ``(K, n, d)`` in one
+    dispatch (see ``aggregate_stream``)."""
+    if not 0 <= f_arc <= xs.shape[-2]:
+        raise ValueError(
+            f"f_arc must satisfy 0 <= f_arc <= n (got {f_arc}, "
+            f"n={xs.shape[-2]})"
+        )
+    if xs.ndim == 3 and _use_selection_kernel(xs):
+        from .pallas_kernels import arc_selection_mean_stream_pallas
+
+        return arc_selection_mean_stream_pallas(
+            xs, f_arc=f_arc, f=f, q=q, mode="krum"
+        )
+    return aggregate_stream(partial(arc_multi_krum, f_arc=f_arc, f=f, q=q), xs)
+
+
 @partial(jax.jit, static_argnames=("tol", "max_iter", "eps", "init"))
 def geometric_median(
     x: Array,
@@ -843,6 +884,8 @@ __all__ = [
     "nnm_multi_krum_stream",
     "clipped_multi_krum",
     "clipped_multi_krum_stream",
+    "arc_multi_krum",
+    "arc_multi_krum_stream",
     "krum",
     "geometric_median",
     "centered_clipping",

@@ -1032,3 +1032,77 @@ def test_clip_fused_finite_norm_overflow_documented_divergence():
     assert bool(jnp.isfinite(want).all())
     # the kernel's aggregate stays in the honest cluster's scale
     assert float(jnp.max(jnp.abs(got))) < 10.0
+
+
+class TestFusedArcSelection:
+    """arc_selection_mean_stream_pallas == arc_clip -> selection."""
+
+    @staticmethod
+    def _oracle(x, f_arc, f, q):
+        from byzpy_tpu.ops.preagg import arc_clip
+
+        clipped = arc_clip(x, f=f_arc)
+        return robust.ranked_mean(clipped, robust.krum_scores(clipped, f=f), q)
+
+    def test_matches_two_step_composition(self):
+        from byzpy_tpu.ops.pallas_kernels import (
+            arc_selection_mean_stream_pallas,
+        )
+
+        for seed, (n, d, f_arc, f, q) in enumerate(
+            [(10, 512, 2, 2, 4), (16, 1024, 4, 3, 5), (9, 384, 0, 2, 3),
+             (12, 640, 5, 2, 4)]
+        ):
+            x = jax.random.normal(jax.random.PRNGKey(seed), (n, d))
+            x = x.at[::3].multiply(9.0)  # spread norms so ARC clips some
+            got = arc_selection_mean_stream_pallas(
+                x[None], f_arc=f_arc, f=f, q=q, interpret=True
+            )[0]
+            want = self._oracle(x, f_arc, f, q)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+    def test_ops_wrappers(self, monkeypatch):
+        monkeypatch.setenv("BYZPY_TPU_PALLAS", "1")
+        xs = jax.random.normal(jax.random.PRNGKey(3), (3, 12, 640))
+        xs = xs.at[:, ::2].multiply(6.0)
+        got = robust.arc_multi_krum_stream(xs, f_arc=3, f=2, q=4)
+        want = jnp.stack([self._oracle(xs[k], 3, 2, 4) for k in range(3)])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+        monkeypatch.setenv("BYZPY_TPU_PALLAS", "0")
+        x2 = jax.random.normal(jax.random.PRNGKey(5), (11, 768)) * 3.0
+        np.testing.assert_allclose(
+            np.asarray(robust.arc_multi_krum(x2, f_arc=3, f=2, q=4)),
+            np.asarray(self._oracle(x2, 3, 2, 4)),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    def test_tie_norms_match_sort_semantics(self):
+        from byzpy_tpu.ops.pallas_kernels import (
+            arc_selection_mean_stream_pallas,
+        )
+
+        # identical norms everywhere: the threshold is that norm, nothing
+        # clips, and the fused path must agree with the oracle exactly
+        x = jax.random.normal(jax.random.PRNGKey(8), (8, 256))
+        x = x / jnp.linalg.norm(x, axis=1, keepdims=True) * 5.0
+        got = arc_selection_mean_stream_pallas(
+            x[None], f_arc=3, f=2, q=3, interpret=True
+        )[0]
+        want = self._oracle(x, 3, 2, 3)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_arc_multi_krum_validates_f_arc_on_both_paths(monkeypatch):
+    x = jnp.ones((8, 256))
+    for flag in ("0", "1"):
+        monkeypatch.setenv("BYZPY_TPU_PALLAS", flag)
+        with pytest.raises(ValueError, match="f_arc"):
+            robust.arc_multi_krum(x, f_arc=-1, f=1, q=2)
+        with pytest.raises(ValueError, match="f_arc"):
+            robust.arc_multi_krum_stream(x[None], f_arc=9, f=1, q=2)

@@ -207,7 +207,9 @@ def test_ps_fused_pipeline_matches_two_step():
         def apply_server_gradient(self, g):
             self.grad = g
 
-    for pre in (NearestNeighborMixing(f=2), Clipping(threshold=3.0)):
+    from byzpy_tpu.pre_aggregators import ARC
+
+    for pre in (NearestNeighborMixing(f=2), Clipping(threshold=3.0), ARC(f=2)):
         agg = MultiKrum(f=2, q=3)
         nodes = [Node(i) for i in range(9)]
         grads = [n.honest_gradient_for_next_batch() for n in nodes]
