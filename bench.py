@@ -76,6 +76,13 @@ def main() -> None:
                 "provenance": "benchmarks/ROBUST_LEARNING.md + BREAKDOWN.md "
                               "(real-data accuracy studies, CPU mesh)",
             },
+            "second_metric": {
+                "metric": "ps_mnist_trimmed_mean_steps_per_sec",
+                "value": None,
+                "unit": "steps/sec",
+                "vs_baseline": None,
+                "error": "device unavailable (same outage as headline)",
+            },
         }))
         return
 
@@ -128,7 +135,104 @@ def main() -> None:
         "stream_kernel": stream_kernel,
         "bf16_stream_grads_per_sec": round(64 / t_bf16, 2),
         "single_dispatch_grads_per_sec": round(64 / t_single, 2),
+        "second_metric": _ps_steps_metric(),
     }))
+
+
+def _ps_steps_metric() -> dict:
+    """BASELINE.json's second north-star metric: PS steps/sec (MNIST MLP,
+    trimmed mean, sign-flip — BASELINE config #3), measured single-chip
+    on the fused SPMD round, with the HLO-derived 8→128-chip weak-scaling
+    projection attached (``benchmarks/ps_scaling_probe.py`` runs the
+    collective accounting on a CPU-mesh subprocess so this process keeps
+    its accelerator backend untouched)."""
+    import subprocess
+    import sys
+
+    from byzpy_tpu.models import mnist_mlp, synthetic_classification
+    from byzpy_tpu.ops import attack_ops, robust as robust_ops
+    from byzpy_tpu.parallel.ps import PSStepConfig, jit_ps_train_step
+
+    try:
+        n, n_byz, batch = 8, 2, 64
+        bundle = mnist_mlp()
+        x, y = synthetic_classification(n_samples=n * batch, seed=3)
+        xs = x.reshape(n, batch, 28, 28, 1)
+        ys = y.reshape(n, batch)
+        cfg = PSStepConfig(n_nodes=n, n_byzantine=n_byz)
+        step, opt0 = jit_ps_train_step(
+            bundle,
+            lambda m: robust_ops.trimmed_mean(m, f=n_byz),
+            cfg,
+            attack=lambda honest, key: attack_ops.sign_flip(
+                jnp.mean(honest, axis=0)
+            ),
+            donate=False,
+        )
+        key = jax.random.PRNGKey(0)
+        t_round = timed(step, bundle.params, opt0, xs, ys, key, repeat=30)
+        steps_per_sec = 1.0 / t_round
+    except Exception as exc:  # noqa: BLE001 — report, keep the headline
+        return {
+            "metric": "ps_mnist_trimmed_mean_steps_per_sec",
+            "value": None,
+            "unit": "steps/sec",
+            "vs_baseline": None,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+
+    projection = None
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # probe pins cpu itself
+        probe = subprocess.run(
+            [sys.executable, os.path.join(here, "benchmarks", "ps_scaling_probe.py")],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        if probe.returncode != 0:
+            raise RuntimeError(
+                f"probe exited {probe.returncode}: {probe.stderr[-400:]}"
+            )
+        info = json.loads(probe.stdout.strip().splitlines()[-1])
+        comm = {int(k): v for k, v in info["comm_seconds_per_round"].items()}
+        # Weak scaling: the measured 1-chip round computes all n nodes'
+        # gradients serially; on n>=8 chips each chip computes one node's
+        # share, so per-chip compute is t_measured/8 and the round time
+        # adds the (pessimistic, unoverlapped) HLO-derived comm term.
+        compute_s = t_round / 8.0
+        eff = {
+            nn: compute_s / (compute_s + c) for nn, c in sorted(comm.items())
+        }
+        projection = {
+            "hlo_wire_bytes_per_device_n8": info["hlo_wire_bytes_per_device_n8"],
+            "per_opcode_bytes_n8": info["per_opcode_bytes_n8"],
+            "assumptions": info["assumptions"],
+            "projected_steps_per_sec": {
+                str(nn): round(8.0 * steps_per_sec * e, 2)
+                for nn, e in eff.items()
+            },
+            "efficiency_vs_linear": {
+                str(nn): round(e, 4) for nn, e in eff.items()
+            },
+            "retention_8_to_128": round(eff[128] / eff[8], 4),
+        }
+    except Exception as exc:  # noqa: BLE001 — projection is best-effort
+        projection = {"error": f"{type(exc).__name__}: {exc}"}
+
+    return {
+        "metric": "ps_mnist_trimmed_mean_steps_per_sec",
+        "value": round(steps_per_sec, 2),
+        "unit": "steps/sec",
+        # ref: actor-mode PS MNIST round, best measured 42 ms/round
+        # (BASELINE.md; reference benchmarks) -> 23.8 steps/sec
+        "vs_baseline": round(steps_per_sec / (1.0 / 42e-3), 2),
+        "round_ms": round(t_round * 1e3, 3),
+        "config": "MNIST MLP 784-128-10, n=8 nodes (2 byzantine), "
+                  "trimmed-mean f=2, sign-flip, batch 64/node, "
+                  "fused SPMD round on one chip",
+        "scaling_8_to_128": projection,
+    }
 
 
 if __name__ == "__main__":

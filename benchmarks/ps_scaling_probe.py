@@ -1,0 +1,98 @@
+"""HLO-derived 8→128-chip scaling projection for the fused PS round.
+
+Runs on an 8-virtual-device CPU mesh, compiles the BASELINE config-#3
+round (MNIST MLP, coordinate-wise trimmed mean, sign-flip attack) and
+parses its per-device collective bytes out of the OPTIMIZED HLO
+(:mod:`byzpy_tpu.parallel.comms`). The per-device payload of the round's
+collectives follows the saturating ``(g-1)/g`` law, so the n=8
+measurement extrapolates exactly to larger meshes; v5e ICI bandwidth and
+the MLP's per-chip FLOPs then give the weak-scaling efficiency table.
+
+Prints ONE JSON object (consumed by ``bench.py`` to attach the
+``ps_mnist_trimmed_mean_steps_per_sec`` projection; also runnable
+standalone). Designed to run in a SUBPROCESS of the TPU-facing bench —
+the CPU platform pin below happens before any backend touch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from byzpy_tpu.utils.platform import apply_env_platform
+
+apply_env_platform()
+
+import jax
+import jax.numpy as jnp
+
+from byzpy_tpu.models import mnist_mlp
+from byzpy_tpu.ops import attack_ops, robust
+from byzpy_tpu.parallel.comms import collective_traffic
+from byzpy_tpu.parallel.mesh import node_mesh
+from byzpy_tpu.parallel.ps import PSStepConfig, build_ps_train_step
+
+N = 8
+BATCH = 64
+
+
+def main() -> None:
+    assert len(jax.devices()) == N, jax.devices()
+    mesh = node_mesh(N)
+    bundle = mnist_mlp()  # 784-128-10, ~101k params — BASELINE config #3
+    n_byz = 2
+    cfg = PSStepConfig(n_nodes=N, n_byzantine=n_byz)
+    step, opt0 = build_ps_train_step(
+        bundle,
+        lambda m: robust.trimmed_mean(m, f=n_byz),
+        cfg,
+        attack=lambda honest, key: attack_ops.sign_flip(
+            jnp.mean(honest, axis=0)
+        ),
+        mesh=mesh,
+    )
+    xs = jnp.zeros((N, BATCH, 28, 28, 1), jnp.float32)
+    ys = jnp.zeros((N, BATCH), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    traffic = collective_traffic(step, bundle.params, opt0, xs, ys, key)
+    wire8 = float(traffic["wire_bytes_per_device"])
+
+    # Per-device collective payloads in this round all carry the
+    # saturating (g-1)/g factor (gradient transpose all-to-all + update
+    # all-gather), so bytes(n) = bytes(8) * ((n-1)/n) / (7/8).
+    def wire_fn(n: int) -> float:
+        return wire8 * ((n - 1) / n) / (7 / 8)
+
+    d = sum(x.size for x in jax.tree_util.tree_leaves(bundle.params))
+    ici = 4.5e10  # v5e: 45 GB/s per direction per link
+    chips = (8, 16, 32, 64, 128)
+    out = {
+        "config": "PS MNIST MLP (784-128-10) + trimmed-mean + sign-flip, "
+                  f"n_nodes=n_chips, batch {BATCH}/node",
+        "params": int(d),
+        "hlo_wire_bytes_per_device_n8": wire8,
+        "per_opcode_bytes_n8": {
+            k: float(v) for k, v in traffic["per_opcode_bytes"].items()
+        },
+        "assumptions": "weak scaling (n_nodes grows with chips); "
+                       "v5e ICI 45 GB/s/dir; no compute/comm overlap "
+                       "(pessimistic); per-device collective bytes follow "
+                       "the (g-1)/g law measured at n=8",
+        "wire_bytes_per_device": {str(n): round(wire_fn(n), 1) for n in chips},
+        "comm_seconds_per_round": {
+            str(n): wire_fn(n) / ici for n in chips
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,18 +54,31 @@ class HeartbeatMonitor:
         max_missed: int = 3,
         on_suspect: Optional[Callable[[str], None]] = None,
         on_recover: Optional[Callable[[str], None]] = None,
+        startup_grace: float = 0.0,
     ) -> None:
         if max_missed < 1:
             raise ValueError(f"max_missed must be >= 1 (got {max_missed})")
+        if startup_grace < 0:
+            raise ValueError(
+                f"startup_grace must be >= 0 (got {startup_grace})"
+            )
         self.node = node
         self.interval = interval
         self.max_missed = max_missed
         self.on_suspect = on_suspect
         self.on_recover = on_recover
+        # A peer that has NEVER ponged is not suspected until this many
+        # seconds after start(): a slow-starting peer (e.g. a subprocess
+        # context importing jax) would otherwise be declared dead before
+        # its first reply could possibly arrive. Peers that HAVE ponged
+        # are unaffected — a genuine death is still caught in
+        # max_missed * interval.
+        self.startup_grace = startup_grace
         self.peers: Dict[str, PeerLiveness] = {}
         self._task: Optional[asyncio.Task] = None
         self._pending: Dict[str, bool] = {}
         self._handlers_installed = False
+        self._started_at: Optional[float] = None
 
     # -- message plumbing ---------------------------------------------------
 
@@ -108,6 +121,7 @@ class HeartbeatMonitor:
             self._handlers_installed = True
         for peer in self._neighbor_ids():
             self.peers.setdefault(peer, PeerLiveness())
+        self._started_at = asyncio.get_running_loop().time()
         self._task = asyncio.ensure_future(self._loop())
 
     async def stop(self) -> None:
@@ -137,11 +151,18 @@ class HeartbeatMonitor:
             _log.exception("liveness callback failed for peer %r", peer)
 
     async def _loop(self) -> None:
+        loop = asyncio.get_running_loop()
         while True:
             # account the PREVIOUS tick's unanswered pings first, so a
             # pong has the whole interval to arrive
+            in_grace = (
+                self._started_at is not None
+                and loop.time() - self._started_at < self.startup_grace
+            )
             for peer, rec in self.peers.items():
                 if self._pending.get(peer):
+                    if rec.pongs == 0 and in_grace:
+                        continue  # still booting; see startup_grace
                     rec.missed += 1
                     if rec.missed >= self.max_missed and not rec.suspect:
                         rec.suspect = True

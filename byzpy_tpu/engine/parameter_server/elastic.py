@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Tuple
@@ -162,13 +163,91 @@ async def call_node(
     implementation of the PS calling convention; the non-elastic round
     path (``ps._invoke``) delegates here."""
     fn = getattr(obj, method)
-    out = fn(*args)
-    if inspect.isawaitable(out):
-        if timeout is not None:
-            out = await asyncio.wait_for(out, timeout=timeout)
-        else:
+    if timeout is not None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        if inspect.iscoroutinefunction(fn):
+            # async-def dispatch cannot block the loop; no thread needed
+            return await asyncio.wait_for(fn(*args), timeout=timeout)
+        # Run the call itself off the event loop: a hung *sync* node (a
+        # plain local object, no actor backend) would otherwise block the
+        # loop indefinitely and the timeout could never fire — defeating
+        # the per-node isolation this module promises. A *daemon* thread
+        # (not asyncio.to_thread: the default executor's non-daemon
+        # threads are joined at loop shutdown, so one hung node would
+        # stall ``asyncio.run`` exit for its full sleep) — the hung call
+        # is not interruptible, but the round and the process move on.
+        out = await asyncio.wait_for(
+            _call_in_daemon_thread(obj, fn, args), timeout=timeout
+        )
+        if inspect.isawaitable(out):
+            # remaining budget, not a fresh timeout: a sync dispatch that
+            # returns an awaitable must still fit the whole call in ONE
+            # call_timeout (ElasticPolicy documents a per-node-CALL bound)
+            remaining = deadline - asyncio.get_running_loop().time()
+            out = await asyncio.wait_for(out, timeout=max(remaining, 0.0))
+    else:
+        out = fn(*args)
+        if inspect.isawaitable(out):
             out = await out
     return out
+
+
+class NodeBusyError(RuntimeError):
+    """A previous, timed-out call to this node is still executing.
+
+    A timed-out sync call keeps running in its (uninterruptible) daemon
+    thread; dispatching another call to the same node object would
+    interleave two threads in non-thread-safe node state. The probe that
+    hits this window fails like any other node failure — the node stays
+    suspected and is retried once the zombie call finishes.
+    """
+
+
+# Node objects with a sync call still executing in a daemon thread. Keyed
+# by id(): the bound method in the thread keeps the object alive until
+# the entry is discarded, so ids cannot be recycled while present.
+_inflight_lock = threading.Lock()
+_inflight_ids: set = set()
+
+
+async def _call_in_daemon_thread(obj: Any, fn: Any, args: tuple) -> Any:
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+    key = id(obj)
+    with _inflight_lock:
+        if key in _inflight_ids:
+            raise NodeBusyError(
+                f"a previous timed-out call to {fn!r} is still running; "
+                "refusing concurrent entry into the node"
+            )
+        _inflight_ids.add(key)
+
+    def _finish(setter: Any, value: Any) -> None:
+        if not fut.done():  # wait_for may have cancelled it already
+            setter(value)
+
+    def _runner() -> None:
+        try:
+            res = fn(*args)
+        except BaseException as exc:  # noqa: BLE001 — forwarded to caller
+            result, payload = fut.set_exception, exc
+        else:
+            result, payload = fut.set_result, res
+        finally:
+            with _inflight_lock:
+                _inflight_ids.discard(key)
+        try:
+            loop.call_soon_threadsafe(_finish, result, payload)
+        except RuntimeError:
+            # the loop already closed (the timed-out round — and perhaps
+            # the whole asyncio.run — finished long ago); nobody is
+            # waiting for this result anymore
+            pass
+
+    threading.Thread(
+        target=_runner, daemon=True, name="byzpy-elastic-call"
+    ).start()
+    return await fut
 
 
 async def elastic_gather(
@@ -210,6 +289,7 @@ async def elastic_gather(
 __all__ = [
     "ElasticPolicy",
     "ElasticState",
+    "NodeBusyError",
     "QuorumLostError",
     "SuspectRecord",
     "call_node",

@@ -5,6 +5,7 @@ from .nodes import (
     HonestP2PWorker,
     SGDModelWorker,
 )
+from .elastic import HeartbeatPolicy
 from .runner import DecentralizedPeerToPeer
 from .topology import Topology
 from .train import PeerToPeer
@@ -13,6 +14,7 @@ __all__ = [
     "Topology",
     "PeerToPeer",
     "DecentralizedPeerToPeer",
+    "HeartbeatPolicy",
     "HonestP2PWorker",
     "ByzantineP2PWorker",
     "SGDModelWorker",

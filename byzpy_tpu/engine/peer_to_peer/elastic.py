@@ -1,0 +1,71 @@
+"""Default liveness→membership policy for the gossip fabric.
+
+The PS side ships a turnkey elastic loop
+(``ParameterServer(elastic=ElasticPolicy(...))``); until round 5 the P2P
+side only shipped the *mechanisms* — :class:`HeartbeatMonitor` for
+detection and ``remove_node`` for excision — and left the wiring to the
+caller. :class:`HeartbeatPolicy` closes that loop out of the box::
+
+    p2p = PeerToPeer(honest, byz, aggregator=Krum(f=1),
+                     topology=Topology.complete(5),
+                     elastic=HeartbeatPolicy(interval=0.5, max_missed=3))
+
+On ``setup()`` the runner installs ping responders on every node, starts
+one monitor on the observer node (default: the first honest index), and
+excises any peer the monitor declares suspect. Removal outcomes land in
+``runner.elastic_events`` as ``(peer_id, outcome)`` pairs so the
+application can audit what the policy did.
+
+Detection scope is the observer's gossip neighborhood (the monitor pings
+``out_neighbors``): on a complete topology that is everyone; on sparse
+topologies peers outside the observer's neighborhood are not watched —
+run additional monitors for wider coverage (the reference has no
+analogue at all; SURVEY §5 "failure detection: partial").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class HeartbeatPolicy:
+    """Knobs for the built-in suspect→excise loop.
+
+    ``interval``
+        Seconds between heartbeat ticks (pings to all watched peers).
+    ``max_missed``
+        Consecutive unanswered pings before a peer is declared suspect
+        and removed (conservative: one pong resets the counter, matching
+        :class:`~byzpy_tpu.engine.node.liveness.HeartbeatMonitor`).
+    ``observer``
+        Global node index that runs the monitor; ``None`` = first honest
+        index. The observer watches its own gossip neighborhood.
+    ``startup_grace``
+        Seconds after setup during which a peer that has NEVER answered a
+        ping is not suspected — subprocess/remote peers take seconds to
+        boot (importing jax alone), and without the grace the policy
+        would excise a healthy-but-slow peer before its first pong.
+        Peers that have ponged once are unaffected.
+    """
+
+    interval: float = 0.5
+    max_missed: int = 3
+    observer: Optional[int] = None
+    startup_grace: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.interval <= 0:
+            raise ValueError(f"interval must be > 0 (got {self.interval})")
+        if self.max_missed < 1:
+            raise ValueError(
+                f"max_missed must be >= 1 (got {self.max_missed})"
+            )
+        if self.startup_grace < 0:
+            raise ValueError(
+                f"startup_grace must be >= 0 (got {self.startup_grace})"
+            )
+
+
+__all__ = ["HeartbeatPolicy"]

@@ -23,7 +23,16 @@ from __future__ import annotations
 
 import asyncio
 from functools import partial
-from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Sequence
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import jax.numpy as jnp
 
@@ -35,6 +44,7 @@ from ..node.decentralized import DecentralizedNode
 
 if TYPE_CHECKING:  # pragma: no cover — avoids node.cluster -> topology cycle
     from ..node.cluster import DecentralizedCluster
+from .elastic import HeartbeatPolicy
 from .nodes import ByzantineP2PWorker, HonestP2PWorker
 from .topology import Topology
 
@@ -46,8 +56,11 @@ def _configure_honest(
     worker: HonestP2PWorker,
     aggregator: Aggregator,
     timeout: Optional[float],
+    liveness: bool = False,
 ) -> None:
     """Install half_step/aggregate pipelines on an honest node."""
+    if liveness:
+        _install_liveness_responder(node)
 
     def half_step(lr):
         return worker.half_step(float(lr))
@@ -78,15 +91,33 @@ def _configure_honest(
     )
 
 
+def _install_liveness_responder(node: DecentralizedNode) -> None:
+    """Ping→pong responder, installed where the node actually RUNS.
+
+    For a :class:`ProcessContext` node the configure hook executes in the
+    child process and inbound messages are routed there — a responder
+    registered on the parent-side façade would never see a ping, so the
+    elastic policy would declare every process peer dead. Registering in
+    the configure hook puts the responder child-side; for local contexts
+    the hook runs on the same node object the monitor pings.
+    """
+    from ..node.liveness import HeartbeatMonitor
+
+    HeartbeatMonitor.install_responder(node)
+
+
 def _configure_byzantine(
     node: DecentralizedNode,
     worker: ByzantineP2PWorker,
     honest_ids: Sequence[str],
     timeout: Optional[float],
+    liveness: bool = False,
 ) -> None:
     """Install the attack pipeline on a byzantine node. It waits for
     ``expected`` *honest* vectors; frames from other byzantine peers
     (including stale ones from earlier rounds) are consumed and discarded."""
+    if liveness:
+        _install_liveness_responder(node)
     honest_set = set(honest_ids)
 
     async def attack(expected):
@@ -125,8 +156,24 @@ class DecentralizedPeerToPeer:
         context_factory: Optional[Callable[[str], NodeContext]] = None,
         byzantine_indices: Optional[Sequence[int]] = None,
         gossip_timeout: Optional[float] = 30.0,
+        elastic: Optional["HeartbeatPolicy"] = None,
     ) -> None:
         n = topology.n_nodes
+        if elastic is not None and gossip_timeout is None:
+            raise ValueError(
+                "elastic membership requires a finite gossip_timeout "
+                "(removal waits out an in-flight round's dead-peer gossip; "
+                "None would make that wait unbounded)"
+            )
+        if (
+            elastic is not None
+            and elastic.observer is not None
+            and not 0 <= elastic.observer < n
+        ):
+            raise ValueError(
+                f"elastic observer index {elastic.observer} is outside the "
+                f"{n}-node topology"
+            )
         if len(honest_workers) + len(byzantine_workers) != n:
             raise ValueError(
                 f"{len(honest_workers)}+{len(byzantine_workers)} workers for "
@@ -164,6 +211,11 @@ class DecentralizedPeerToPeer:
         self._cluster: Optional["DecentralizedCluster"] = None
         self._started = False
         self.rounds_completed = 0
+        self._elastic = elastic
+        self._monitor: Optional[Any] = None
+        self._removal_tasks: set = set()
+        # audit trail of what the built-in policy did: (peer_id, outcome)
+        self.elastic_events: List[Tuple[str, str]] = []
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -178,6 +230,7 @@ class DecentralizedPeerToPeer:
                 worker=self._workers[i],
                 honest_ids=honest_ids,
                 timeout=self._timeout,
+                liveness=self._elastic is not None,
             )
         else:
             configure = partial(
@@ -185,6 +238,7 @@ class DecentralizedPeerToPeer:
                 worker=self._workers[i],
                 aggregator=self.aggregator,
                 timeout=self._timeout,
+                liveness=self._elastic is not None,
             )
         ctx = node.context
         if hasattr(ctx, "remote_execute_pipeline"):
@@ -226,8 +280,85 @@ class DecentralizedPeerToPeer:
         # start rollback on partial failure
         await self._cluster.start_all()
         self._started = True
+        if self._elastic is not None:
+            try:
+                await self._start_elastic()
+            except Exception:
+                # don't leak a started cluster behind a failed policy
+                # bring-up (and leave _started False so setup can retry)
+                await self.shutdown()
+                raise
+
+    async def _start_elastic(self) -> None:
+        """Start the built-in suspect→excise loop (see
+        :class:`~byzpy_tpu.engine.peer_to_peer.elastic.HeartbeatPolicy`)."""
+        from ..node.liveness import HeartbeatMonitor
+
+        pol = self._elastic
+        obs = pol.observer
+        if obs is None:
+            obs = self.honest_indices[0]
+        if obs not in self.nodes:
+            raise ValueError(
+                f"elastic observer index {obs} is not a live node"
+            )
+        if hasattr(self.nodes[obs].context, "remote_execute_pipeline"):
+            raise ValueError(
+                f"elastic observer index {obs} lives in a remote/subprocess "
+                "context; the monitor must run where its pong handler can "
+                "fire — pick an in-process node as observer"
+            )
+        # ping responders are installed by the configure hooks (child-side
+        # for subprocess nodes — see _install_liveness_responder)
+        id_to_global = {nid: gi for gi, nid in self.node_ids.items()}
+
+        def on_suspect(peer_id: str) -> None:
+            gi = id_to_global.get(peer_id)
+            if gi is None or gi not in self._workers:
+                return  # unknown or already excised
+            # keep a strong reference: an unreferenced task may be GC'd
+            # before it runs, and shutdown() must be able to settle it
+            task = asyncio.get_running_loop().create_task(
+                self._elastic_remove(gi, peer_id)
+            )
+            self._removal_tasks.add(task)
+            task.add_done_callback(self._removal_tasks.discard)
+
+        self._monitor = HeartbeatMonitor(
+            self.nodes[obs],
+            interval=pol.interval,
+            max_missed=pol.max_missed,
+            on_suspect=on_suspect,
+            startup_grace=pol.startup_grace,
+        )
+        await self._monitor.start()
+
+    async def _elastic_remove(self, gi: int, peer_id: str) -> None:
+        try:
+            await self.remove_node(gi)
+        except KeyError:
+            self.elastic_events.append((peer_id, "already-removed"))
+        except ValueError as exc:
+            # e.g. "cannot remove the last honest node" — policy declines
+            self.elastic_events.append((peer_id, f"refused: {exc}"))
+        except Exception as exc:  # noqa: BLE001 — audit, keep monitoring
+            self.elastic_events.append((peer_id, f"error: {exc}"))
+        else:
+            self.elastic_events.append((peer_id, "removed"))
 
     async def shutdown(self) -> None:
+        if self._monitor is not None:
+            await self._monitor.stop()
+            self._monitor = None
+        # settle in-flight excisions before tearing the fabric down (a
+        # removal racing cluster shutdown would act on dead runtimes)
+        while self._removal_tasks:
+            task = next(iter(self._removal_tasks))
+            try:
+                await asyncio.wait_for(task, timeout=(self._timeout or 0) + 5)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                task.cancel()
+            self._removal_tasks.discard(task)
         if self._cluster is not None:
             await self._cluster.shutdown_all()
             self._cluster = None
@@ -254,7 +385,22 @@ class DecentralizedPeerToPeer:
         induced sub-topology (same edges, dead node excised) and every
         per-round expected-message count shrinks to match. The departing
         node's runtime is shut down best-effort (it may already be gone).
+
+        Blocks for up to ``gossip_timeout`` when a round is in flight:
+        the in-flight round holds the round lock while waiting on the
+        dead peer's gossip, and this method must wait for it to time out
+        before mutating membership. A fabric built with
+        ``gossip_timeout=None`` therefore cannot support elastic removal
+        (the wait would be unbounded) and this method refuses it.
         """
+        if self._timeout is None:
+            raise ValueError(
+                "remove_node requires a finite gossip_timeout: with "
+                "gossip_timeout=None an in-flight round waits on the dead "
+                "peer forever while holding the round lock, so removal "
+                "would deadlock. Construct the fabric with a bounded "
+                "gossip_timeout (default 30.0) to use elastic membership."
+            )
         if i not in self.nodes and i not in self._workers:
             raise KeyError(f"node index {i} is not part of the fabric")
         if i in self.honest_indices and len(self.honest_indices) <= 1:

@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 from ...aggregators.base import Aggregator
 from ..node.context import NodeContext
+from .elastic import HeartbeatPolicy
 from .nodes import ByzantineP2PWorker, HonestP2PWorker
 from .runner import DecentralizedPeerToPeer
 from .topology import Topology
@@ -39,6 +40,7 @@ class PeerToPeer:
         context_factory: Optional[Callable[[str], NodeContext]] = None,
         byzantine_indices: Optional[Sequence[int]] = None,
         gossip_timeout: Optional[float] = 30.0,
+        elastic: Optional[HeartbeatPolicy] = None,
     ) -> None:
         self.runner = DecentralizedPeerToPeer(
             honest_workers,
@@ -49,6 +51,7 @@ class PeerToPeer:
             context_factory=context_factory,
             byzantine_indices=byzantine_indices,
             gossip_timeout=gossip_timeout,
+            elastic=elastic,
         )
 
     @property

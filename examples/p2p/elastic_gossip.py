@@ -2,10 +2,12 @@
 
 The decentralized twin of ``examples/ps/elastic_crash_recovery.py``:
 four peers gossip toward consensus under coordinate-wise median; peer 3
-dies unannounced mid-training; the observer's heartbeat monitor suspects
-it and excises it from the fabric (``PeerToPeer.remove_node``), after
-which rounds keep completing over the induced 3-node topology and
-consensus re-forms WITHOUT the dead peer's (outlier) target.
+dies unannounced mid-training; the built-in elastic policy
+(``PeerToPeer(..., elastic=HeartbeatPolicy(...))``) suspects it via
+heartbeats and excises it from the fabric, after which rounds keep
+completing over the induced 3-node topology and consensus re-forms
+WITHOUT the dead peer's (outlier) target. No monitor/callback wiring in
+application code — detection and excision ship as one constructor knob.
 
 Run: ``python examples/p2p/elastic_gossip.py``.
 """
@@ -24,8 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from byzpy_tpu.aggregators import CoordinateWiseMedian
-from byzpy_tpu.engine.node.liveness import HeartbeatMonitor
-from byzpy_tpu.engine.peer_to_peer import PeerToPeer, Topology
+from byzpy_tpu.engine.peer_to_peer import HeartbeatPolicy, PeerToPeer, Topology
 from byzpy_tpu.engine.peer_to_peer.nodes import HonestP2PWorker
 
 ROUNDS = int(os.environ.get("P2P_ROUNDS", 30))
@@ -55,43 +56,28 @@ async def main() -> None:
     p2p = PeerToPeer(
         workers, aggregator=CoordinateWiseMedian(),
         topology=Topology.complete(4), learning_rate=0.3,
+        elastic=HeartbeatPolicy(interval=0.1, max_missed=3),
     )
     runner = p2p.runner
     async with runner:
-        removed = asyncio.Event()
-
-        def on_suspect(peer_id):
-            victim = next(
-                gi for gi, nid in runner.node_ids.items() if nid == peer_id
-            )
-
-            async def act():
-                await p2p.remove_node(victim)
-                removed.set()
-                print(f"  [monitor] suspected {peer_id} -> excised")
-
-            asyncio.get_running_loop().create_task(act())
-
-        for gi, node in runner.nodes.items():
-            if gi != 0:
-                HeartbeatMonitor.install_responder(node)
-        mon = HeartbeatMonitor(
-            runner.nodes[0], interval=0.1, max_missed=3, on_suspect=on_suspect
-        )
-        await mon.start()
-        try:
-            for r in range(ROUNDS):
-                await p2p.round()
-                if r == ROUNDS // 3 and 3 in runner.nodes:
-                    print(f"round {r + 1}: killing peer node-3 (target 50)")
-                    await runner.nodes[3].shutdown()
-                    await asyncio.wait_for(removed.wait(), timeout=15.0)
-                if (r + 1) % 10 == 0:
-                    ws = [float(np.mean(workers[i].w)) for i in (0, 1, 2)]
-                    print(f"round {r + 1:3d}: survivor means "
-                          f"{['%.3f' % v for v in ws]}")
-        finally:
-            await mon.stop()
+        for r in range(ROUNDS):
+            await p2p.round()
+            if r == ROUNDS // 3 and 3 in runner.nodes:
+                victim_id = runner.node_ids[3]
+                print(f"round {r + 1}: killing peer {victim_id} (target 50)")
+                await runner.nodes[3].shutdown()
+                # the shipped policy notices and excises — just wait for it
+                for _ in range(300):
+                    if (victim_id, "removed") in runner.elastic_events:
+                        print(f"  [policy] suspected {victim_id} -> excised")
+                        break
+                    await asyncio.sleep(0.05)
+                else:
+                    raise TimeoutError("policy never excised the dead peer")
+            if (r + 1) % 10 == 0:
+                ws = [float(np.mean(workers[i].w)) for i in (0, 1, 2)]
+                print(f"round {r + 1:3d}: survivor means "
+                      f"{['%.3f' % v for v in ws]}")
 
     if ROUNDS >= 20:
         for i in (0, 1, 2):

@@ -18,7 +18,7 @@ import pytest
 from byzpy_tpu.aggregators import CoordinateWiseMedian
 from byzpy_tpu.engine.node.context import InProcessContext
 from byzpy_tpu.engine.node.liveness import HeartbeatMonitor
-from byzpy_tpu.engine.peer_to_peer import Topology
+from byzpy_tpu.engine.peer_to_peer import HeartbeatPolicy, Topology
 from byzpy_tpu.engine.peer_to_peer.nodes import HonestP2PWorker
 from byzpy_tpu.engine.peer_to_peer.runner import DecentralizedPeerToPeer
 
@@ -91,6 +91,22 @@ def test_remove_node_guards():
     asyncio.run(run())
 
 
+def test_remove_node_rejects_unbounded_gossip_timeout():
+    """gossip_timeout=None would make removal wait forever on an
+    in-flight round's dead-peer gossip (advisor r4) — refused up front."""
+    async def run():
+        workers = [QuadWorker(t) for t in (0.0, 1.0, 2.0)]
+        p2p = DecentralizedPeerToPeer(
+            workers, [], aggregator=CoordinateWiseMedian(),
+            topology=Topology.complete(3), learning_rate=0.3,
+            gossip_timeout=None,
+        )
+        async with p2p:
+            with pytest.raises(ValueError, match="finite gossip_timeout"):
+                await p2p.remove_node(2)
+    asyncio.run(run())
+
+
 def test_heartbeat_drives_removal_end_to_end():
     """The full policy loop: a peer DIES (shutdown, no goodbye), the
     observer's heartbeat monitor suspects it, on_suspect excises it from
@@ -140,6 +156,46 @@ def test_heartbeat_drives_removal_end_to_end():
             finally:
                 await mon.stop()
     asyncio.run(run())
+
+
+def test_heartbeat_policy_excises_dead_peer_without_wiring():
+    """The shipped default policy (VERDICT r4 #7): construct with
+    ``elastic=HeartbeatPolicy(...)`` and a dead peer is excised with NO
+    test-side monitor/responder/callback wiring at all."""
+    async def run():
+        workers = [QuadWorker(t) for t in (0.0, 1.0, 2.0, 9.0)]
+        p2p = DecentralizedPeerToPeer(
+            workers, [], aggregator=CoordinateWiseMedian(),
+            topology=Topology.complete(4), learning_rate=0.3,
+            elastic=HeartbeatPolicy(interval=0.05, max_missed=3),
+        )
+        async with p2p:
+            await p2p.run_round_async()
+            victim_id = p2p.node_ids[3]
+            await p2p.nodes[3].shutdown()  # dies, no goodbye
+            for _ in range(200):
+                if (victim_id, "removed") in p2p.elastic_events:
+                    break
+                await asyncio.sleep(0.05)
+            assert (victim_id, "removed") in p2p.elastic_events
+            assert p2p.honest_indices == [0, 1, 2]
+            for _ in range(20):
+                await p2p.run_round_async()
+            for i in (0, 1, 2):
+                np.testing.assert_allclose(
+                    np.asarray(workers[i].w), 1.0, atol=0.15
+                )
+    asyncio.run(run())
+
+
+def test_heartbeat_policy_requires_finite_gossip_timeout():
+    with pytest.raises(ValueError, match="finite gossip_timeout"):
+        DecentralizedPeerToPeer(
+            [QuadWorker(0.0), QuadWorker(1.0)], [],
+            aggregator=CoordinateWiseMedian(),
+            topology=Topology.complete(2), gossip_timeout=None,
+            elastic=HeartbeatPolicy(),
+        )
 
 
 def test_resetup_after_removal_uses_shrunken_fabric():

@@ -56,6 +56,19 @@ class HangingNode(Node):
         return [np.full(self.d, self.value, np.float32)]
 
 
+class HangingSyncNode(Node):
+    """A *plain sync* node that hangs — no awaitable for the loop to
+    time out; only the to_thread dispatch in ``call_node`` lets
+    ``call_timeout`` fire (the hang previously blocked the event loop
+    itself)."""
+
+    def honest_gradient_for_next_batch(self):
+        import time
+
+        time.sleep(5.0)
+        return [np.full(self.d, self.value, np.float32)]
+
+
 class ApplyFailsNode(Node):
     def apply_server_gradient(self, g):
         raise RuntimeError("disk full")
@@ -151,6 +164,25 @@ def test_call_timeout_excludes_hanging_node():
         elastic=ElasticPolicy(min_quorum=2, call_timeout=0.2),
     )
     out = run(ps.round())
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
+    assert "honest:2" in ps.elastic_state.suspects
+
+
+def test_call_timeout_excludes_hanging_sync_node():
+    """call_timeout must interrupt plain sync nodes too (advisor r4):
+    the hung call runs in a worker thread, the round completes without
+    it well before the node's 5 s sleep ends."""
+    import time
+
+    nodes = [Node(1.0), Node(3.0), HangingSyncNode(100.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, call_timeout=0.2),
+    )
+    t0 = time.monotonic()
+    out = run(ps.round())
+    assert time.monotonic() - t0 < 4.0
     np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
     assert "honest:2" in ps.elastic_state.suspects
 
@@ -267,3 +299,49 @@ def test_elastic_training_converges_through_crashes():
         assert np.isfinite(np.asarray(out[0])).all()
     assert ps.rounds_completed == 10
     assert ps.elastic_state.suspects == {}
+
+
+def test_timed_out_sync_node_is_never_reentered_concurrently():
+    """A timed-out sync call keeps running in its daemon thread; the next
+    round's re-admission probe must NOT dispatch a second thread into the
+    same (non-thread-safe) node object — it fails fast with NodeBusyError
+    and the node stays suspected until the zombie call drains."""
+    import threading
+    import time
+
+    class StallingNode(Node):
+        def __init__(self, value):
+            super().__init__(value)
+            self.concurrent = 0
+            self.max_concurrent = 0
+            self._lock = threading.Lock()
+
+        def honest_gradient_for_next_batch(self):
+            with self._lock:
+                self.concurrent += 1
+                self.max_concurrent = max(self.max_concurrent, self.concurrent)
+            try:
+                time.sleep(1.5)
+                return [np.full(self.d, self.value, np.float32)]
+            finally:
+                with self._lock:
+                    self.concurrent -= 1
+
+    stalling = StallingNode(100.0)
+    ps = ParameterServer(
+        honest_nodes=[Node(1.0), Node(3.0), stalling],
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, call_timeout=0.2,
+                              readmit_every=1),
+    )
+
+    async def rounds():
+        for _ in range(4):  # probes re-hit the stalling node every round
+            out = await ps.round()
+            np.testing.assert_allclose(
+                np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6
+            )
+
+    run(rounds())
+    assert "honest:2" in ps.elastic_state.suspects
+    assert stalling.max_concurrent == 1, stalling.max_concurrent

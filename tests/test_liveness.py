@@ -144,3 +144,38 @@ def test_suspect_callback_drives_topology_rebind(make_cluster):
             await cluster.shutdown_all()
 
     asyncio.run(run())
+
+
+def test_startup_grace_shields_never_ponged_peer(make_cluster):
+    """A peer that has never answered (e.g. a subprocess still importing
+    jax) is not suspected inside startup_grace; one that HAS answered is
+    still caught at max_missed * interval after it dies."""
+    async def run():
+        cluster = make_cluster(3)
+        await cluster.start_all()
+        nodes = list(cluster.nodes.values())
+        observer, mute, responsive = nodes
+        # 'mute' never responds: strip its ping handler after install by
+        # monitoring from observer only — responders are installed by the
+        # monitor on its own node; the others have none yet, so only
+        # 'responsive' gets one explicitly.
+        HeartbeatMonitor.install_responder(responsive)
+        mon = HeartbeatMonitor(
+            observer, interval=0.05, max_missed=3, startup_grace=2.0
+        )
+        await mon.start()
+        try:
+            ok = await _wait_until(lambda: responsive.node_id in mon.alive())
+            assert ok, mon.alive()
+            # well past max_missed * interval, still inside the grace:
+            # the never-ponged peer is NOT suspect
+            await asyncio.sleep(0.5)
+            assert mon.suspects() == [], mon.suspects()
+            # after the grace expires it is suspected like any dead peer
+            ok = await _wait_until(lambda: mon.suspects() == [mute.node_id])
+            assert ok, mon.suspects()
+        finally:
+            await mon.stop()
+            await cluster.shutdown_all()
+
+    asyncio.run(run())

@@ -132,3 +132,91 @@ def test_ps_step_2d_grid_mesh_matches_single_device(setup):
     np.testing.assert_allclose(
         float(m2["honest_loss"]), float(m1["honest_loss"]), rtol=1e-4
     )
+
+
+class _ActorHonestNode:
+    """Actor-mode honest node holding its own (replicated) params; applies
+    the server gradient with the same optax chain the SPMD step uses."""
+
+    def __init__(self, bundle, opt, x, y):
+        self.bundle = bundle
+        self.opt = opt
+        self.params = bundle.params
+        self.opt_state = opt.init(bundle.params)
+        self.x, self.y = x, y
+        from byzpy_tpu.utils.trees import ravel_pytree_fn
+
+        self._ravel, self._unravel = ravel_pytree_fn(bundle.params)
+
+    def honest_gradient_for_next_batch(self):
+        g = jax.grad(self.bundle.loss_fn)(self.params, self.x, self.y)
+        return [self._ravel(g)]
+
+    def apply_server_gradient(self, g):
+        import optax
+
+        update = self._unravel(jnp.asarray(g[0]))
+        updates, self.opt_state = self.opt.update(
+            update, self.opt_state, self.params
+        )
+        self.params = optax.apply_updates(self.params, updates)
+
+
+class _ActorEmpireNode(_ActorHonestNode):
+    def byzantine_gradient_for_next_batch(self, honest):
+        stacked = jnp.stack([jnp.asarray(h[0]) for h in honest])
+        return [attack_ops.empire(stacked)]
+
+
+def test_actor_ps_matches_fused_spmd_ps(setup):
+    """The one seam between the two PS implementations (VERDICT r4 #10):
+    actor-mode rounds (engine/parameter_server/ps.py) and the fused SPMD
+    step (parallel/ps.py) must produce the same trajectory on a fixed
+    seed — same shards, same empire attack, same trimmed-mean, same
+    SGD+momentum."""
+    import asyncio
+
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+    from byzpy_tpu.engine.parameter_server import ParameterServer
+    from byzpy_tpu.parallel.ps import default_optimizer
+
+    bundle, xs, ys = setup
+    cfg = PSStepConfig(n_nodes=N_NODES, n_byzantine=N_BYZ, learning_rate=0.05)
+    rounds = 5
+
+    # -- fused SPMD trajectory
+    step, opt0 = jit_ps_train_step(
+        bundle, lambda m: robust.trimmed_mean(m, f=N_BYZ), cfg,
+        attack=_attack, donate=False,
+    )
+    params = bundle.params
+    opt_state = opt0
+    key = jax.random.PRNGKey(0)  # empire ignores the key; fixed for form
+    for _ in range(rounds):
+        params, opt_state, _ = step(params, opt_state, xs, ys, key)
+
+    # -- actor-mode trajectory over the SAME shards
+    opt = default_optimizer(cfg)
+    h = cfg.n_honest
+    honest_nodes = [
+        _ActorHonestNode(bundle, opt, xs[i], ys[i]) for i in range(h)
+    ]
+    byz_nodes = [
+        _ActorEmpireNode(bundle, opt, xs[h + j], ys[h + j])
+        for j in range(N_BYZ)
+    ]
+    ps = ParameterServer(
+        honest_nodes, byz_nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=N_BYZ),
+    )
+    for _ in range(rounds):
+        asyncio.run(ps.round())
+
+    f_spmd = np.concatenate(
+        [np.ravel(l) for l in jax.tree_util.tree_leaves(params)]
+    )
+    for node in honest_nodes + byz_nodes:
+        f_actor = np.concatenate(
+            [np.ravel(l) for l in jax.tree_util.tree_leaves(node.params)]
+        )
+        np.testing.assert_allclose(f_actor, f_spmd, rtol=2e-4, atol=2e-5)
