@@ -1,0 +1,80 @@
+"""On-chip MeaMed dispatch-gate tuner (VERDICT r4 #2).
+
+The generic Pallas dispatch floor (``MIN_PALLAS_DIM`` = 256k dims) was
+tuned for single-sort kernels; MeaMed's XLA fallback pays ~7 HBM passes,
+so the fused two-sweep kernel plausibly wins much earlier. This script
+measures BOTH paths at a shape sweep around the grid row (64×65,536) and
+prints the crossover — set ``MEAMED_MIN_DIM`` in
+``byzpy_tpu/ops/pallas_kernels.py`` to the recommendation, then refresh
+the grid row with ``python benchmarks/full_grid.py`` (or the single row
+via ``aggregators_bench.py``).
+
+Run on the real chip (fresh process, compile cache on):
+    python benchmarks/meamed_gate_tune.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import jax.numpy as jnp
+
+from byzpy_tpu.ops import robust
+from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+from byzpy_tpu.utils.metrics import timed_call_s
+
+SHAPES = [
+    (64, 16_384),
+    (64, 65_536),
+    (64, 262_144),
+    (64, 1_048_576),
+]
+
+
+def main() -> None:
+    crossover = None
+    for n, d in SHAPES:
+        x = jax.random.normal(jax.random.PRNGKey(7), (n, d), jnp.float32)
+        # XLA path, forced (the gate may already prefer the kernel)
+        os.environ["BYZPY_TPU_PALLAS"] = "0"
+        t_xla = timed_call_s(
+            jax.jit(functools.partial(robust.mean_of_medians, f=8)), x,
+            warmup=2, repeat=20,
+        ) * 1e3
+        os.environ["BYZPY_TPU_PALLAS"] = "auto"
+        t_fused = timed_call_s(
+            jax.jit(lambda a: meamed_stream_pallas(a[None], f=8)[0]), x,
+            warmup=2, repeat=20,
+        ) * 1e3
+        win = t_fused < t_xla
+        if win and crossover is None:
+            crossover = d
+        print(json.dumps({
+            "workload": f"meamed_{n}x{d}_f8",
+            "xla_ms": round(t_xla, 2),
+            "fused_ms": round(t_fused, 2),
+            "fused_wins": bool(win),
+        }))
+    print(json.dumps({
+        "recommended_MEAMED_MIN_DIM": crossover if crossover else "keep",
+        "note": "set byzpy_tpu/ops/pallas_kernels.py MEAMED_MIN_DIM to the "
+                "smallest d where the fused kernel wins, then refresh the "
+                "grid row",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -244,9 +244,16 @@ async def _call_in_daemon_thread(obj: Any, fn: Any, args: tuple) -> Any:
             # waiting for this result anymore
             pass
 
-    threading.Thread(
-        target=_runner, daemon=True, name="byzpy-elastic-call"
-    ).start()
+    try:
+        threading.Thread(
+            target=_runner, daemon=True, name="byzpy-elastic-call"
+        ).start()
+    except BaseException:
+        # thread never started -> _runner's finally will never discard
+        # the key; without this the node would be NodeBusy forever
+        with _inflight_lock:
+            _inflight_ids.discard(key)
+        raise
     return await fut
 
 

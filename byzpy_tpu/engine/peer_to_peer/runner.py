@@ -356,8 +356,19 @@ class DecentralizedPeerToPeer:
             task = next(iter(self._removal_tasks))
             try:
                 await asyncio.wait_for(task, timeout=(self._timeout or 0) + 5)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
+            except asyncio.TimeoutError:
                 task.cancel()
+            except asyncio.CancelledError:
+                cur = asyncio.current_task()
+                if cur is not None and cur.cancelling() > 0:
+                    # shutdown ITSELF was cancelled — don't swallow it;
+                    # drop pending removals and let cancellation propagate
+                    for t in self._removal_tasks:
+                        t.cancel()
+                    self._removal_tasks.clear()
+                    raise
+                # only the awaited removal task was cancelled (elsewhere);
+                # teardown proceeds
             self._removal_tasks.discard(task)
         if self._cluster is not None:
             await self._cluster.shutdown_all()

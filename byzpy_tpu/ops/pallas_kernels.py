@@ -1503,6 +1503,21 @@ def nnm_selection_mean_stream_pallas(
 # the padding copy + grid overhead eat the win, so dispatch needs d large.
 MAX_NETWORK_ROWS = 128
 MIN_PALLAS_DIM = 256 * 1024
+# MeaMed's fused kernel amortizes differently from the single-sort
+# kernels: the XLA fallback pays ~7 HBM passes (median sort, deviations,
+# second sort, masked selection) where CwTM/median pay ~2-3, so the
+# fused two-sweep kernel can win well below the generic floor. Tuned on
+# chip via benchmarks/meamed_gate_tune.py.
+MEAMED_MIN_DIM = MIN_PALLAS_DIM
+
+
+def meamed_min_dim() -> int:
+    """MeaMed's dispatch floor; ``BYZPY_TPU_MEAMED_MIN_DIM`` overrides
+    per call (read here, not at import, so tuning harnesses can flip it
+    after the package is imported)."""
+    import os
+
+    return int(os.environ.get("BYZPY_TPU_MEAMED_MIN_DIM", MEAMED_MIN_DIM))
 
 
 def sharding_allows_pallas(x: Array) -> bool:
@@ -1550,9 +1565,11 @@ def sharding_allows_pallas(x: Array) -> bool:
         return True
 
 
-def use_pallas_for(n: int, d: int) -> bool:
+def use_pallas_for(n: int, d: int, *, min_dim: int = None) -> bool:
     """True when the Pallas path should serve a coordinate-wise selection
-    over an ``(n, d)`` matrix on this backend."""
+    over an ``(n, d)`` matrix on this backend. ``min_dim`` overrides the
+    generic dispatch floor for kernels with a different amortization
+    profile (e.g. ``MEAMED_MIN_DIM``)."""
     import os
 
     flag = os.environ.get("BYZPY_TPU_PALLAS", "auto")
@@ -1560,7 +1577,8 @@ def use_pallas_for(n: int, d: int) -> bool:
         return False
     if flag == "1":
         return n <= MAX_NETWORK_ROWS
-    return _on_tpu() and n <= MAX_NETWORK_ROWS and d >= MIN_PALLAS_DIM
+    floor = MIN_PALLAS_DIM if min_dim is None else min_dim
+    return _on_tpu() and n <= MAX_NETWORK_ROWS and d >= floor
 
 
 __all__ = [

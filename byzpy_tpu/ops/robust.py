@@ -184,6 +184,7 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
     k = n - f
     from .pallas_kernels import (
         MEAMED_MAX_DIM,
+        meamed_min_dim,
         meamed_stream_pallas,
         sharding_allows_pallas,
         use_pallas_for,
@@ -192,7 +193,7 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
     if (
         x.ndim == 2
         and x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
-        and use_pallas_for(*x.shape)
+        and use_pallas_for(*x.shape, min_dim=meamed_min_dim())
         and x.shape[1] <= MEAMED_MAX_DIM
         and sharding_allows_pallas(x)
     ):

@@ -54,6 +54,11 @@ class StudyConfig:
     momentum: float = 0.9
     eval_every: int = 50
     seed: int = 0
+    # dtype the per-node gradients are cast to BEFORE attack +
+    # aggregation (None = keep f32). "bfloat16" halves the robust
+    # pipeline's HBM traffic on TPU; params/optimizer stay f32 (the
+    # aggregated update is cast back — mixed-precision trainer shape).
+    grad_dtype: Optional[str] = None
 
 
 def named_attack(
@@ -185,6 +190,7 @@ def run_cell(
             attack, n_byzantine=cfg.n_byzantine, n_nodes=cfg.n_nodes
         ),
         mesh=mesh,
+        grad_dtype=None if cfg.grad_dtype is None else jnp.dtype(cfg.grad_dtype),
     )
     jit_step = jax.jit(step, donate_argnums=(0, 1))
 
@@ -229,6 +235,12 @@ def run_gossip_cell(
     momentum) — ``cfg.momentum`` applies only to the PS cells."""
     if cfg.rounds < 1:
         raise ValueError(f"rounds must be >= 1 (got {cfg.rounds})")
+    if cfg.grad_dtype is not None:
+        raise ValueError(
+            "grad_dtype is a PS-study knob (the gossip step exchanges "
+            "parameters, not gradients — there is no gradient cast point); "
+            "run the gossip cell with grad_dtype=None"
+        )
     from ..engine.peer_to_peer import Topology
     from ..parallel.gossip import GossipStepConfig, build_gossip_train_step
     from .trees import ravel_pytree_fn
