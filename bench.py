@@ -186,6 +186,10 @@ def _ps_steps_metric() -> dict:
         here = os.path.dirname(os.path.abspath(__file__))
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)  # probe pins cpu itself
+        # the chip admits ONE process: a child that registers the
+        # accelerator plugin while this process holds the device can
+        # deadlock at import (same guard ProcessContext applies)
+        env["PALLAS_AXON_POOL_IPS"] = ""
         probe = subprocess.run(
             [sys.executable, os.path.join(here, "benchmarks", "ps_scaling_probe.py")],
             capture_output=True, text=True, timeout=600, env=env,

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 on-chip recovery bundle: run EVERYTHING queued behind the
+# tunnel outage, each row in a fresh process (tunnel backpressure — see
+# ROUND4_NOTES gotchas), results to benchmarks/results/round5_onchip.jsonl.
+set -u
+cd /root/repo
+OUT=benchmarks/results/round5_onchip.jsonl
+mkdir -p benchmarks/results
+probe() {
+  timeout 60 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu'; print(d)" >/dev/null 2>&1
+}
+if ! probe; then echo "tunnel down, aborting bundle"; exit 1; fi
+echo "# bundle start $(date -u)" >> "$OUT"
+# 1. round-4 leftovers: 64x1M sort-kernel parity, roofline cells, cw_median refresh
+timeout 3000 python benchmarks/rerun_round4.py >> "$OUT" 2>/tmp/r5_rerun4.err
+# 2. MeaMed gate tune (fresh process)
+timeout 1800 python benchmarks/meamed_gate_tune.py >> "$OUT" 2>/tmp/r5_meamed.err
+# 3. headline bench (fresh process — exactly what the driver will run)
+timeout 1800 python bench.py >> "$OUT" 2>/tmp/r5_bench.err
+echo "# bundle end $(date -u)" >> "$OUT"
+echo "bundle complete: $OUT"

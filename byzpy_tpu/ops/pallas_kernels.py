@@ -512,57 +512,71 @@ def weighted_center_step_pallas(
     return out[0, :d]
 
 
-MEAMED_MAX_DIM = 1 << 21  # (1, d) f32 median scratch must fit VMEM
+# Dispatch-gate cap for meamed_stream_pallas (the tested envelope of the
+# sort-kernel family; the single-phase kernel has no (1, d) scratch, so
+# this is no longer a VMEM constraint — the headline 1M-dim shape sits
+# well inside it either way)
+MEAMED_MAX_DIM = 1 << 21
 
 
 def _meamed_stream_kernel(
-    x_ref, o_ref, med_ref, *, n_pad: int, n_real: int, f: int,
+    x_ref, o_ref, *, n_pad: int, n_real: int, f: int,
 ):
-    """Two sweeps per round, everything between them in VMEM.
+    """ONE sweep per round: the whole column block computes locally.
 
-    Phase 0 per tile: key-sort the column block, write the coordinate
-    median into the ``(1, d)`` VMEM scratch (``med_ref``). Phase 1 per
-    tile: re-read the block, deviations ``|x - med|``, key-sort them,
-    threshold-select the ``k = n - f`` closest values per coordinate
-    (stable ties in node order via a triangular-matmul cumulative count —
-    exactly ``ops.robust.mean_of_medians``'s rule), and write the
-    selected mean. Total traffic: 2 reads of ``x`` + a (1, d) write; the
-    XLA path pays ~7 passes (median sort write+read, a materialized
-    deviation matrix, its sort write+read, then the masked sums).
-    A column with fewer than ``k`` finite deviations emits NaN (the cut
-    is NaN), matching the gather-based tie rule."""
-    p = pl.program_id(1)
-    c = pl.program_id(2)
+    The ``k = n - f`` values closest to the median are a contiguous
+    window of the sorted column, so a single key-sort yields BOTH
+    statistics: the median (middle rows) and the cut deviation (minimum
+    over window starts ``s`` of ``max(med - xs[s], xs[s+k-1] - med)`` —
+    the k-th smallest ``|x - med|``, bit-identical to sorting the
+    deviations since the window edges reuse the same f32 subtractions).
+    Threshold-select against the cut with stable ties in node order via
+    a triangular-matmul cumulative count — exactly
+    ``ops.robust.mean_of_medians``'s rule. Total traffic: 1 read of
+    ``x`` + a (1, d) write (the previous two-phase kernel paid 2 reads
+    and a SECOND Batcher sort of the deviations; the XLA path pays ~4
+    passes). A column containing NaN emits NaN (median semantics),
+    matching the reference's propagation."""
     k = n_real - f
-    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, x_ref.shape[-1]), 0)
+    tile = x_ref.shape[-1]
+    row_i = lax.broadcasted_iota(jnp.int32, (n_pad, tile), 0)
     maxkey = jnp.iinfo(jnp.int32).max
 
-    @pl.when(p == 0)
-    def _():
-        blk = x_ref[0].astype(jnp.float32)
-        keys = jnp.where(row_i >= n_real, maxkey, _float_sort_keys(blk))
-        srt = _batcher_sort_rows(keys, n_pad)
-        lo, hi = (n_real - 1) // 2, n_real // 2
+    blk = x_ref[0].astype(jnp.float32)
+    keys = jnp.where(row_i >= n_real, maxkey, _float_sort_keys(blk))
+    srt = _batcher_sort_rows(keys, n_pad)
+    lo, hi = (n_real - 1) // 2, n_real // 2
+    if lo == hi:
+        med = _keys_to_float(srt[lo], jnp.float32)  # odd n: no overflow
+    else:
+        # 0.5*a + 0.5*b: summing two near-max values first overflows
         med = (
-            _keys_to_float(srt[lo], jnp.float32)
-            + _keys_to_float(srt[hi], jnp.float32)
-        ) * 0.5
-        has_nan = srt[n_real - 1] > _INF_KEY
-        med = jnp.where(has_nan, jnp.nan, med)
-        med_ref[0, pl.dslice(c * x_ref.shape[-1], x_ref.shape[-1])] = med
+            _keys_to_float(srt[lo], jnp.float32) * 0.5
+            + _keys_to_float(srt[hi], jnp.float32) * 0.5
+        )
+    has_nan = srt[n_real - 1] > _INF_KEY
+    med = jnp.where(has_nan, jnp.nan, med)
 
-    @pl.when(p == 1)
-    def _():
-        tile = x_ref.shape[-1]
-        blk = x_ref[0].astype(jnp.float32)
-        med = med_ref[0, pl.dslice(c * tile, tile)]
-        dev = jnp.abs(blk - med[None, :])
-        keys = jnp.where(row_i >= n_real, maxkey, _float_sort_keys(dev))
-        sel, cut = _stable_k_select_mask(keys, n_pad=n_pad, k=k)
-        total = jnp.sum(jnp.where(sel, blk, 0.0), axis=0) / k
-        # cut is a NaN key iff fewer than k finite deviations exist
-        out = jnp.where(cut > _INF_KEY, jnp.nan, total)
-        o_ref[0] = out[None, :].astype(o_ref.dtype)
+    # window-minimum cut: rows s in [0, n_real - k] are valid window
+    # starts; their edges xs[s], xs[s+k-1] never touch pad rows
+    # (s + k - 1 <= n_real - 1), so decoding pad keys is irrelevant.
+    xsf = _keys_to_float(srt, jnp.float32)
+    upper = jnp.concatenate(
+        [xsf[k - 1:], jnp.full((k - 1, tile), jnp.inf, jnp.float32)], axis=0
+    )
+    radius = jnp.maximum(med[None, :] - xsf, upper - med[None, :])
+    radius = jnp.where(row_i > n_real - k, jnp.inf, radius)
+    cut = jnp.min(radius, axis=0)
+
+    # threshold-select on the ORIGINAL block (still in VMEM) with the
+    # stable node-order tie rule, in float space — the cut value is
+    # identical to the sorted-deviation cut, so comparisons agree exactly
+    dev = jnp.abs(blk - med[None, :])
+    dev = jnp.where(row_i >= n_real, jnp.inf, dev)
+    sel = _stable_threshold_select(dev, cut, k=k)
+    total = jnp.sum(jnp.where(sel, blk, 0.0), axis=0) / k
+    out = jnp.where(jnp.isnan(cut) | jnp.isnan(med), jnp.nan, total)
+    o_ref[0] = out[None, :].astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("f", "tile", "interpret"))
@@ -575,16 +589,18 @@ def meamed_stream_pallas(
 ) -> Array:
     """MeaMed over ``K`` stacked rounds ``xs: (K, n, d)`` in one fused
     launch, returning ``(K, d)`` — equals ``ops.robust.mean_of_medians``
-    per round. Float dtypes; ``d`` capped by the VMEM median scratch
-    (``(1, d)`` f32), so the dispatch gate requires ``d <= 2**21``."""
+    per round. Float dtypes. Single-phase: each column block is read
+    from HBM exactly ONCE (median, window-minimum cut, and the selected
+    mean all compute from one in-VMEM sort — see the kernel docstring);
+    ``MEAMED_MAX_DIM`` is retained as a dispatch-gate cap for parity
+    with the other fused kernels' tested envelope."""
     K, n, d = xs.shape
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
     if d > MEAMED_MAX_DIM:
         raise ValueError(
             f"meamed_stream_pallas requires d <= {MEAMED_MAX_DIM} (got {d}): "
-            "the (1, d) f32 median scratch must fit scoped VMEM; use "
-            "ops.robust.mean_of_medians (the XLA path) beyond that"
+            "use ops.robust.mean_of_medians (the XLA path) beyond that"
         )
     if xs.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
         raise ValueError(f"unsupported dtype {xs.dtype}")
@@ -592,9 +608,10 @@ def meamed_stream_pallas(
         interpret = not _on_tpu()
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
-        # sort-aware budget, minus the (1, d) f32 median scratch that
-        # also lives in scoped VMEM
-        tile = _auto_sort_tile(d, n_pad, extra_bytes=4 * d)
+        # sort-aware budget; the kernel additionally keeps the original
+        # block, the decoded sorted floats, and the deviation/mask
+        # temporaries live across the sort, so budget 3 extra copies
+        tile = _auto_sort_tile(d, n_pad, copies=13)
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -604,20 +621,16 @@ def meamed_stream_pallas(
     out = pl.pallas_call(
         functools.partial(_meamed_stream_kernel, n_pad=n_pad, n_real=n, f=f),
         out_shape=jax.ShapeDtypeStruct((K, 1, d_pad), xs.dtype),
-        grid=(K, 2, d_pad // tile),
+        grid=(K, d_pad // tile),
         in_specs=[
             pl.BlockSpec(
-                (1, n_pad, tile), lambda k, p, c: (k, 0, c),
+                (1, n_pad, tile), lambda k, c: (k, 0, c),
                 memory_space=pltpu.VMEM,
             )
         ],
-        # ``c * p`` parks the output on block (k, 0, 0) through phase 0 so
-        # the median sweep writes nothing to HBM (see _nnm_stream_kernel's
-        # out_specs note); phase 1 fully overwrites every block.
         out_specs=pl.BlockSpec(
-            (1, 1, tile), lambda k, p, c: (k, 0, c * p), memory_space=pltpu.VMEM
+            (1, 1, tile), lambda k, c: (k, 0, c), memory_space=pltpu.VMEM
         ),
-        scratch_shapes=[pltpu.VMEM((1, d_pad), jnp.float32)],
         interpret=interpret,
     )(xp)
     return out[:, 0, :d]
@@ -649,19 +662,17 @@ def _padded_sort_keys(d2, *, n_pad: int, n_real: int):
     return jnp.where(pad, jnp.iinfo(jnp.int32).max, keys)
 
 
-def _stable_k_select_mask(keys, *, n_pad: int, k: int):
-    """Boolean mask of the ``k`` smallest-key entries per column of the
-    ``(n_pad, cols)`` sorted-key problem, stable ties in row order: keys
-    strictly below the k-th smallest always select; entries AT the cut
-    fill the remaining quota in row order via a lower-triangular ones
-    matmul (exact for 0/1 counts in f32 at n <= 128). ``keys`` must
-    already carry the pad masking (``_padded_sort_keys``); returns
-    ``(sel, cut)`` where ``cut`` is the per-column k-th smallest key
-    (a NaN key iff fewer than ``k`` finite entries exist)."""
-    srt = _batcher_sort_rows(keys, n_pad)
-    cut = srt[k - 1]
-    below = keys < cut[None, :]
-    at_f = jnp.where(keys == cut[None, :], 1.0, 0.0)
+def _stable_threshold_select(vals, cut, *, k: int):
+    """Boolean mask selecting, per column, everything strictly below
+    ``cut`` plus enough entries AT the cut — filled in ROW order — to
+    reach ``k`` total: the stable-argsort tie rule, without a gather.
+    The row-order fill is a lower-triangular ones matmul (exact for 0/1
+    counts in f32 at n <= 128). Works in any totally-ordered value
+    space (int sort keys or raw floats) as long as ``vals`` carries pad
+    masking that sorts past every real entry."""
+    n_pad = vals.shape[0]
+    below = vals < cut[None, :]
+    at_f = jnp.where(vals == cut[None, :], 1.0, 0.0)
     row_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 0)
     col_i = lax.broadcasted_iota(jnp.int32, (n_pad, n_pad), 1)
     tri = jnp.where(row_i >= col_i, 1.0, 0.0)
@@ -672,8 +683,19 @@ def _stable_k_select_mask(keys, *, n_pad: int, k: int):
     quota = jnp.asarray(float(k), jnp.float32) - jnp.sum(
         jnp.where(below, 1.0, 0.0), axis=0
     )
-    sel = below | ((at_f > 0.5) & (csum_at <= quota[None, :]))
-    return sel, cut
+    return below | ((at_f > 0.5) & (csum_at <= quota[None, :]))
+
+
+def _stable_k_select_mask(keys, *, n_pad: int, k: int):
+    """Boolean mask of the ``k`` smallest-key entries per column of the
+    ``(n_pad, cols)`` sorted-key problem, stable ties in row order
+    (see :func:`_stable_threshold_select`). ``keys`` must already carry
+    the pad masking (``_padded_sort_keys``); returns ``(sel, cut)``
+    where ``cut`` is the per-column k-th smallest key (a NaN key iff
+    fewer than ``k`` finite entries exist)."""
+    srt = _batcher_sort_rows(keys, n_pad)
+    cut = srt[k - 1]
+    return _stable_threshold_select(keys, cut, k=k), cut
 
 
 def _accumulate_gram(x_block, gram_ref, c):
@@ -751,7 +773,9 @@ def _auto_selection_tile(d: int, n_pad: int = 64, itemsize: int = 4) -> int:
     return 4096
 
 
-def _auto_sort_tile(d: int, n_pad: int, extra_bytes: int = 0) -> int:
+def _auto_sort_tile(
+    d: int, n_pad: int, extra_bytes: int = 0, copies: int = 10
+) -> int:
     """Feature tile for the SORT-based kernels (sorted-reduce, MeaMed).
 
     A Batcher network's live working set is far larger than the input
@@ -759,18 +783,19 @@ def _auto_sort_tile(d: int, n_pad: int, extra_bytes: int = 0) -> int:
     temporaries put Mosaic's measured scoped-stack allocation at ~8-9x
     ``n_pad * tile * 4`` (34.35 MiB at 64x16384, observed on v5e; the
     compile-time scoped-VMEM limit is 16 MiB, and interpret mode never
-    checks it). Budget 10 copies plus the caller's ``extra_bytes``
-    (MeaMed's (1, d) median scratch) against a 14 MiB cap."""
+    checks it). Budget ``copies`` block copies (default 10; kernels that
+    keep extra block-sized temporaries alive across the sort pass more)
+    plus the caller's ``extra_bytes`` against a 14 MiB cap."""
     budget = 14 * 1024 * 1024 - extra_bytes
     candidates = (16384, 8192, 4096, 2048, 1024, 512, 256, 128)
     for t in candidates:
-        if d % t == 0 and 10 * n_pad * t * 4 <= budget:
+        if d % t == 0 and copies * n_pad * t * 4 <= budget:
             return t
     # No exact divisor fits: take the largest budget-fitting tile and let
     # the caller pad d up to it (a pad copy beats hundreds of tiny
     # grid steps).
     for t in candidates:
-        if 10 * n_pad * t * 4 <= budget:
+        if copies * n_pad * t * 4 <= budget:
             return t
     return 128
 

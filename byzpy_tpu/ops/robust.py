@@ -171,12 +171,17 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
     """MeaMed: per coordinate keep the ``n - f`` values closest to the median
     and average them (ref: ``aggregators/coordinate_wise/mean_of_medians.py:28-82``).
 
-    Selection is threshold-based instead of ``argsort`` + gather (measured
-    ~10x slower than its HBM cost at 64x65,536 on v5e): sort the
-    deviations (Pallas network when profitable), read the (n-f)-th
-    smallest as the cut, keep everything strictly below it, and break
-    ties AT the cut by node order via a cumulative count — exactly the
-    stable-argsort tie rule. Everything fuses into elementwise+cumsum.
+    ONE sort serves both statistics: the ``k`` values closest to the
+    median are a contiguous window of the sorted column, so the cut
+    deviation (the k-th smallest ``|x - med|``) is the minimum over
+    window starts ``s`` of ``max(med - xs[s], xs[s+k-1] - med)`` — no
+    second sort of a materialized deviation matrix (the old pipeline
+    paid median-sort + deviation-sort, ~7 HBM passes; this is ~4).
+    Selection then stays threshold-based (not ``argsort`` + gather,
+    measured ~10x slower than its HBM cost at 64x65,536 on v5e): keep
+    everything strictly below the cut and break ties AT the cut by node
+    order via a cumulative count — exactly the stable-argsort tie rule
+    (the cut VALUE is identical, so tie semantics are unchanged).
     """
     n = x.shape[0]
     if not 0 <= f < n:
@@ -197,18 +202,36 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
         and x.shape[1] <= MEAMED_MAX_DIM
         and sharding_allows_pallas(x)
     ):
-        # one fused launch: 2 HBM reads + a (1, d) write, vs ~7 passes for
-        # the sort/deviation/sort/mask pipeline below
+        # one fused launch: 1 HBM read + a (1, d) write, vs ~4 passes for
+        # the sort/window/mask pipeline below
         return meamed_stream_pallas(x[None], f=f)[0]
-    med = jnp.median(x, axis=0)
-    dev = jnp.abs(x - med[None, :])
-    from .pallas_kernels import sort_columns, use_pallas_for
+    from .pallas_kernels import sort_columns
 
     if x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.floating) and use_pallas_for(*x.shape):
-        dev_sorted = sort_columns(dev)
+        xs = sort_columns(x)
     else:
-        dev_sorted = jnp.sort(dev, axis=0)
-    cut = dev_sorted[k - 1]
+        xs = jnp.sort(x, axis=0)
+    lo, hi = (n - 1) // 2, n // 2
+    if lo == hi:
+        med = xs[lo]  # odd n: the element itself — no sum to overflow
+    else:
+        # 0.5*a + 0.5*b, not (a+b)*0.5: the sum of two near-max values
+        # overflows f32/bf16 where the true median is representable
+        half = jnp.asarray(0.5, x.dtype)
+        med = xs[lo] * half + xs[hi] * half
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        # NaNs sort last: the middle rows would read finite, but the
+        # reference's jnp.median semantics propagate NaN column-wide
+        med = jnp.where(jnp.isnan(xs[n - 1]), jnp.asarray(jnp.nan, x.dtype), med)
+    # k-th smallest deviation via the contiguous-window identity
+    # (|xs[s]-med| = med - xs[s] and |xs[s+k-1]-med| = xs[s+k-1] - med
+    # are the same f32 subtractions as |x - med|, so the cut is
+    # bit-identical to sorting the deviations)
+    radius = jnp.maximum(
+        med[None, :] - xs[: n - k + 1], xs[k - 1 :] - med[None, :]
+    )
+    cut = jnp.min(radius, axis=0)
+    dev = jnp.abs(x - med[None, :])
     below = dev < cut[None, :]
     at = dev == cut[None, :]
     # how many at-cut entries still fit, filled in node order (stable ties)

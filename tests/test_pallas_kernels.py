@@ -643,6 +643,56 @@ def test_meamed_pallas_matches_xla_path_with_nonfinite():
     )
 
 
+def test_meamed_pallas_stable_ties_match_node_order_rule():
+    """Quantized values force exact ties in |x - med|, including the
+    adversarial med-r / med+r pairs (equal deviation, DIFFERENT values):
+    the single-phase window kernel must reproduce the stable node-order
+    tie rule exactly, not just pick any k-closest set."""
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+
+    rng = np.random.default_rng(11)
+    for trial in range(10):
+        n = int(rng.integers(5, 14))
+        f = int(rng.integers(0, n))
+        x = (np.round(rng.normal(size=(n, 256)) * 2) / 2).astype(np.float32)
+        got = meamed_stream_pallas(
+            jnp.asarray(x)[None], f=f, tile=128, interpret=True
+        )[0]
+        np.testing.assert_allclose(
+            np.asarray(got), _meamed_oracle(x, f), rtol=1e-5, atol=1e-6,
+            err_msg=f"trial={trial} n={n} f={f}",
+        )
+
+
+def test_meamed_median_near_float_max_no_overflow():
+    """Odd-n median must be the middle element itself and even-n must
+    average as 0.5a + 0.5b: forming a+b first overflows f32 for
+    near-max values where the true median is representable (review
+    finding, round 5). k=1 isolates the median path from the
+    (independent, pre-existing) selection-sum overflow."""
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+
+    x = jnp.asarray(np.full((3, 4), 3e38, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(robust.mean_of_medians(x, f=2)), 3e38, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(
+            meamed_stream_pallas(x[None], f=2, tile=128, interpret=True)[0]
+        ),
+        3e38, rtol=1e-6,
+    )
+    x2 = jnp.asarray(
+        np.array([[2e38], [3e38], [3.2e38], [3.3e38]], np.float32)
+    )
+    out = np.asarray(robust.mean_of_medians(x2, f=3))
+    assert np.isfinite(out).all(), out
+    got = np.asarray(
+        meamed_stream_pallas(x2[None], f=3, tile=128, interpret=True)[0]
+    )
+    np.testing.assert_allclose(got, out, rtol=1e-6)
+
+
 def test_meamed_stream_and_dispatch(monkeypatch):
     from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
 
