@@ -14,9 +14,19 @@ import os
 from _plotting import RESULTS, load_jsonl, plt
 
 
-def load_cells(path=None):
+def load_cells(path=None, *, mode="ps", grad_dtype="float32"):
+    """Last row per (aggregator, attack) cell for ONE study variant —
+    the jsonl also accumulates the bf16 and gossip variants' rows
+    (tagged ``grad_dtype`` / ``mode``; absent on pre-round-5 rows, which
+    were all f32 PS), and mixing variants in a trajectory plot would be
+    silently wrong."""
     path = path or os.path.join(RESULTS, "robust_learning.jsonl")
-    return {(r["aggregator"], r["attack"]): r for r in load_jsonl(path)}
+    return {
+        (r["aggregator"], r["attack"]): r
+        for r in load_jsonl(path)
+        if r.get("mode", "ps") == mode
+        and r.get("grad_dtype", "float32") == grad_dtype
+    }
 
 
 def main() -> int:

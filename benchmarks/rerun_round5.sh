@@ -3,7 +3,7 @@
 # tunnel outage, each row in a fresh process (tunnel backpressure — see
 # ROUND4_NOTES gotchas), results to benchmarks/results/round5_onchip.jsonl.
 set -u
-cd /root/repo
+cd "$(dirname "$0")/.."
 OUT=benchmarks/results/round5_onchip.jsonl
 mkdir -p benchmarks/results
 probe() {

@@ -27,6 +27,26 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_APPENDIX_MARKERS = ("\n## BF16 gradients", "\n## Decentralized (gossip)")
+
+
+def _replace_section(md_path: str, marker: str, section_text: str) -> None:
+    """Idempotently install ``marker``'s appendix section in the study
+    doc: replace it in place if present (up to the next appendix marker
+    or EOF), append otherwise."""
+    existing = open(md_path).read() if os.path.exists(md_path) else ""
+    starts = {m: existing.index(m) for m in _APPENDIX_MARKERS if m in existing}
+    if marker in starts:
+        s = starts[marker]
+        later = [i for i in starts.values() if i > s]
+        e = min(later) if later else len(existing)
+        new = existing[:s] + section_text + existing[e:]
+    else:
+        new = existing + section_text
+    with open(md_path, "w") as fh:
+        fh.write(new)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -51,7 +71,17 @@ def main() -> int:
              "With --write, a bfloat16 run appends the BF16 section to "
              "ROBUST_LEARNING.md instead of rewriting it.",
     )
+    parser.add_argument(
+        "--mode", default="ps", choices=["ps", "gossip"],
+        help="training fabric per cell: fused SPMD parameter-server round "
+             "or decentralized gossip (complete topology). With --write, "
+             "a gossip run appends the Decentralized section to "
+             "ROBUST_LEARNING.md instead of rewriting it.",
+    )
     args = parser.parse_args()
+    if args.mode == "gossip" and args.grad_dtype is not None:
+        parser.error("--grad-dtype is a PS-mode knob (gossip exchanges "
+                     "parameters, not gradients)")
 
     from byzpy_tpu.utils.platform import apply_env_platform
 
@@ -77,6 +107,7 @@ def main() -> int:
         aggregators=tuple(args.aggregators.split(",")),
         attacks=tuple(args.attacks.split(",")),
         cfg=cfg,
+        mode=args.mode,
     )
     table = results_table(results)
     print(table)
@@ -93,18 +124,11 @@ def main() -> int:
                     n_nodes=cfg.n_nodes,
                     n_byzantine=cfg.n_byzantine,
                     grad_dtype=cfg.grad_dtype or "float32",
+                    mode=args.mode,
                 )
                 fh.write(json.dumps(row) + "\n")
+        md_path = os.path.join(here, "ROBUST_LEARNING.md")
         if args.grad_dtype == "bfloat16":
-            # append the BF16 section to the (f32) study doc, replacing
-            # any previous BF16 section (idempotent re-runs)
-            md_path = os.path.join(here, "ROBUST_LEARNING.md")
-            if os.path.exists(md_path):
-                existing = open(md_path).read()
-                marker = "\n## BF16 gradients"
-                if marker in existing:
-                    with open(md_path, "w") as fh:
-                        fh.write(existing[: existing.index(marker)])
             section = [
                 "",
                 "## BF16 gradients (robustness survives the cast)",
@@ -122,9 +146,36 @@ def main() -> int:
                 "Reproduce: `python benchmarks/robust_learning.py "
                 "--grad-dtype bfloat16 --write`.",
             ]
-            with open(md_path, "a") as fh:
-                fh.write("\n".join(section) + "\n")
-            print("appended BF16 section to ROBUST_LEARNING.md")
+            _replace_section(
+                md_path, "\n## BF16 gradients", "\n".join(section) + "\n"
+            )
+            print("updated BF16 section in ROBUST_LEARNING.md")
+            return 0
+        if args.mode == "gossip":
+            section = [
+                "",
+                "## Decentralized (gossip) cells",
+                "",
+                "Same grid trained by P2P gossip instead of the PS round:",
+                "complete topology, every honest node half-steps on its",
+                "shard and robust-aggregates its in-neighborhood; byzantine",
+                "nodes broadcast the attack vector. Plain SGD by",
+                "construction (parameters themselves gossip — no per-node",
+                "momentum state), so absolute accuracies differ slightly",
+                "from the PS table; the robust-vs-mean story is the same.",
+                f"{cfg.rounds} rounds, {cfg.n_nodes} nodes, "
+                f"{cfg.n_byzantine} byzantine. Accuracy is node 0's model.",
+                "",
+                table,
+                "",
+                "Reproduce: `python benchmarks/robust_learning.py "
+                "--mode gossip --write`.",
+            ]
+            _replace_section(
+                md_path, "\n## Decentralized (gossip)",
+                "\n".join(section) + "\n",
+            )
+            print("updated Decentralized section in ROBUST_LEARNING.md")
             return 0
         md = [
             "# Robust learning on real data (accuracy under attack)",
@@ -156,17 +207,18 @@ def main() -> int:
                 f"- **{r.aggregator}** vs **{r.attack}**: "
                 + ", ".join(f"({n}, {a:.3f})" for n, a in r.history)
             )
-        # the f32 rewrite must not destroy a previously-appended BF16
-        # section (the two documented reproduce commands are independent)
-        md_path = os.path.join(here, "ROBUST_LEARNING.md")
-        bf16_section = ""
+        # the base (f32 PS) rewrite must not destroy appended variant
+        # sections (each documented reproduce command is independent)
+        appendix = ""
         if os.path.exists(md_path):
             existing = open(md_path).read()
-            marker = "\n## BF16 gradients"
-            if marker in existing:
-                bf16_section = existing[existing.index(marker):]
+            starts = [
+                existing.index(m) for m in _APPENDIX_MARKERS if m in existing
+            ]
+            if starts:
+                appendix = existing[min(starts):]
         with open(md_path, "w") as fh:
-            fh.write("\n".join(md) + "\n" + bf16_section)
+            fh.write("\n".join(md) + "\n" + appendix)
         print("wrote ROBUST_LEARNING.md")
     return 0
 

@@ -566,7 +566,18 @@ def _meamed_stream_kernel(
     )
     radius = jnp.maximum(med[None, :] - xsf, upper - med[None, :])
     radius = jnp.where(row_i > n_real - k, jnp.inf, radius)
-    cut = jnp.min(radius, axis=0)
+    dev_all = jnp.abs(blk - med[None, :])
+    dev_all = jnp.where(row_i >= n_real, jnp.nan, dev_all)
+    # non-finite median: inf - inf = NaN poisons the window arithmetic;
+    # there every deviation is inf-or-NaN, so the k-th smallest is inf
+    # iff >= k deviations are non-NaN (see ops.robust.mean_of_medians)
+    cut_nonfinite = jnp.where(
+        jnp.sum(jnp.where(jnp.isnan(dev_all), 0.0, 1.0), axis=0) >= k,
+        jnp.inf, jnp.nan,
+    )
+    cut = jnp.where(
+        jnp.isfinite(med), jnp.min(radius, axis=0), cut_nonfinite
+    )
 
     # threshold-select on the ORIGINAL block (still in VMEM) with the
     # stable node-order tie rule, in float space — the cut value is

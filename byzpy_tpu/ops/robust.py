@@ -207,7 +207,16 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
         return meamed_stream_pallas(x[None], f=f)[0]
     from .pallas_kernels import sort_columns
 
-    if x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.floating) and use_pallas_for(*x.shape):
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        # jnp.median promotes ints to float; a literal 0.5 in an int
+        # dtype would silently truncate the midpoint to zero
+        x = x.astype(
+            jax.eval_shape(
+                lambda a: jnp.median(a, axis=0),
+                jax.ShapeDtypeStruct(x.shape, x.dtype),
+            ).dtype
+        )
+    if x.ndim == 2 and use_pallas_for(*x.shape):
         xs = sort_columns(x)
     else:
         xs = jnp.sort(x, axis=0)
@@ -219,10 +228,9 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
         # overflows f32/bf16 where the true median is representable
         half = jnp.asarray(0.5, x.dtype)
         med = xs[lo] * half + xs[hi] * half
-    if jnp.issubdtype(x.dtype, jnp.floating):
-        # NaNs sort last: the middle rows would read finite, but the
-        # reference's jnp.median semantics propagate NaN column-wide
-        med = jnp.where(jnp.isnan(xs[n - 1]), jnp.asarray(jnp.nan, x.dtype), med)
+    # NaNs sort last: the middle rows would read finite, but the
+    # reference's jnp.median semantics propagate NaN column-wide
+    med = jnp.where(jnp.isnan(xs[n - 1]), jnp.asarray(jnp.nan, x.dtype), med)
     # k-th smallest deviation via the contiguous-window identity
     # (|xs[s]-med| = med - xs[s] and |xs[s+k-1]-med| = xs[s+k-1] - med
     # are the same f32 subtractions as |x - med|, so the cut is
@@ -230,8 +238,20 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
     radius = jnp.maximum(
         med[None, :] - xs[: n - k + 1], xs[k - 1 :] - med[None, :]
     )
-    cut = jnp.min(radius, axis=0)
     dev = jnp.abs(x - med[None, :])
+    # a NON-finite median breaks the window arithmetic (inf - inf = NaN
+    # inside radius); there every deviation is inf-or-NaN, so the k-th
+    # smallest is inf iff at least k deviations are non-NaN — the old
+    # deviation-sort cut (finite x vs an inf median selects the k
+    # finite-deviation rows, matching the gather-based reference)
+    cut_nonfinite = jnp.where(
+        jnp.sum(jnp.where(jnp.isnan(dev), 0, 1), axis=0) >= k,
+        jnp.asarray(jnp.inf, x.dtype),
+        jnp.asarray(jnp.nan, x.dtype),
+    )
+    cut = jnp.where(
+        jnp.isfinite(med), jnp.min(radius, axis=0), cut_nonfinite
+    )
     below = dev < cut[None, :]
     at = dev == cut[None, :]
     # how many at-cut entries still fit, filled in node order (stable ties)

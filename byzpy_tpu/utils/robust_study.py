@@ -298,8 +298,16 @@ def run_study(
     data: Optional[Tuple[jnp.ndarray, ...]] = None,
     mesh: Any = None,
     verbose: bool = True,
+    mode: str = "ps",
 ) -> List[CellResult]:
-    """The full accuracy-under-attack grid on real data."""
+    """The full accuracy-under-attack grid on real data.
+
+    ``mode="ps"`` trains each cell through the fused SPMD
+    parameter-server round; ``mode="gossip"`` through the decentralized
+    gossip step (complete topology, parameters themselves gossip — see
+    :func:`run_gossip_cell` for the semantic differences)."""
+    if mode not in ("ps", "gossip"):
+        raise ValueError(f"mode must be 'ps' or 'gossip' (got {mode!r})")
     if data is None:
         from ..models.data import load_digits_dataset
 
@@ -308,10 +316,11 @@ def run_study(
         from ..models.nets import digits_mlp
 
         bundle_factory = partial(digits_mlp, seed=cfg.seed)
+    cell_fn = run_cell if mode == "ps" else run_gossip_cell
     results: List[CellResult] = []
     for attack in attacks:
         for agg in aggregators:
-            cell = run_cell(bundle_factory, data, agg, attack, cfg, mesh=mesh)
+            cell = cell_fn(bundle_factory, data, agg, attack, cfg, mesh=mesh)
             results.append(cell)
             if verbose:
                 print(

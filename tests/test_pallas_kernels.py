@@ -1156,3 +1156,38 @@ def test_arc_multi_krum_validates_f_arc_on_both_paths(monkeypatch):
             robust.arc_multi_krum(x, f_arc=-1, f=1, q=2)
         with pytest.raises(ValueError, match="f_arc"):
             robust.arc_multi_krum_stream(x[None], f_arc=9, f=1, q=2)
+
+
+def test_meamed_majority_inf_column_selects_finite_rows():
+    """A majority-inf column drives the median itself to inf; the window
+    arithmetic (inf - inf = NaN) must not poison the cut — the k
+    finite-deviation rows are selected, matching the gather oracle
+    (review finding, round 5)."""
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+
+    x = np.zeros((5, 256), np.float32)
+    x[0], x[1] = 0.0, 1.0
+    x[2:] = np.inf
+    want = np.full(256, 0.5, np.float32)  # mean of the two finite rows
+    got_xla = np.asarray(robust.mean_of_medians(jnp.asarray(x), f=3))
+    np.testing.assert_allclose(got_xla, want, rtol=1e-6)
+    got_k = np.asarray(
+        meamed_stream_pallas(jnp.asarray(x)[None], f=3, tile=128,
+                             interpret=True)[0]
+    )
+    np.testing.assert_allclose(got_k, want, rtol=1e-6)
+    # fewer than k finite-or-inf deviations (NaN med) still yields NaN
+    x2 = x.copy()
+    x2[0, :] = np.nan
+    out2 = np.asarray(robust.mean_of_medians(jnp.asarray(x2), f=3))
+    assert np.isnan(out2).all()
+
+
+def test_meamed_integer_input_promotes_like_median():
+    """Integer gradients must promote to float (jnp.median semantics) —
+    a 0.5 literal in an int dtype silently truncated the midpoint to
+    zero (review finding, round 5)."""
+    x = jnp.asarray(np.array([[100], [110], [120], [2]], np.int32))
+    out = np.asarray(robust.mean_of_medians(x, f=1))
+    # med = 110, deviations [10, 0, 10, 108]; keep 3 closest -> 110
+    np.testing.assert_allclose(out, [110.0], rtol=1e-6)

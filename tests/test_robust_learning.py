@@ -137,3 +137,20 @@ def test_cnn_family_rescued_too(digits):
     rescued = run_cell(cnn_factory, digits, "trimmed_mean", "sign_flip", cfg)
     assert poisoned.final_accuracy < 0.5, poisoned.row()
     assert rescued.final_accuracy > 0.8, rescued.row()
+
+
+def test_run_study_gossip_mode_dispatch(digits):
+    """run_study(mode=\"gossip\") routes cells through the gossip step
+    (and validates the mode string)."""
+    from byzpy_tpu.utils.robust_study import run_study
+
+    quick = StudyConfig(rounds=2, eval_every=1)
+    results = run_study(
+        aggregators=("median",), attacks=("none",), cfg=quick,
+        bundle_factory=_bundle_factory, data=digits, verbose=False,
+        mode="gossip",
+    )
+    assert len(results) == 1
+    assert 0.0 <= results[0].final_accuracy <= 1.0
+    with pytest.raises(ValueError, match="mode"):
+        run_study(cfg=quick, data=digits, mode="ring")
