@@ -255,3 +255,31 @@ def test_fuzz_caf_downweights_outliers(seed):
     assert np.linalg.norm(out - honest_mean) < 0.5 * np.linalg.norm(
         naive_mean - honest_mean
     )
+
+
+@pytest.mark.parametrize("seed", range(N_CASES))
+def test_fuzz_meamed_window_vs_gather_oracle(seed, monkeypatch):
+    """The single-phase window kernel AND the XLA window path vs the
+    gather-rule oracle (shared with test_pallas_kernels), under random
+    shapes/f and non-finite injection — whole-inf rows can drive the
+    median itself to ±inf, the regime the round-5 review found broken.
+    Non-finite outputs must match exactly (kind AND sign)."""
+    from test_pallas_kernels import _meamed_oracle
+
+    from byzpy_tpu.ops.pallas_kernels import meamed_stream_pallas
+
+    n, d, x = _random_case(7000 + seed)
+    rng = np.random.default_rng(seed)
+    f = int(rng.integers(0, n))
+    want = _meamed_oracle(x, f)
+    xa = jnp.asarray(x)
+    got_kernel = np.asarray(
+        meamed_stream_pallas(xa[None], f=f, tile=128, interpret=True)[0]
+    )
+    monkeypatch.setenv("BYZPY_TPU_PALLAS", "0")
+    got_xla = np.asarray(robust.mean_of_medians(xa, f=f))
+    for got, label in ((got_kernel, "kernel"), (got_xla, "xla")):
+        np.testing.assert_allclose(
+            got, want, rtol=1e-4, atol=1e-5, equal_nan=True,
+            err_msg=f"{label} n={n} f={f} seed={seed}",
+        )

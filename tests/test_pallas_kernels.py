@@ -600,11 +600,17 @@ def _meamed_oracle(x, f):
     x = np.asarray(x, np.float64)
     n, d = x.shape
     k = n - f
-    med = np.median(x, axis=0)
+    med = np.median(x, axis=0)  # NaN if the column contains NaN
     dev = np.abs(x - med[None, :])
     out = np.empty(d)
     for j in range(d):
+        if np.isnan(med[j]):
+            out[j] = np.nan
+            continue
         order = np.argsort(dev[:, j], kind="stable")[:k]
+        if np.isnan(dev[order, j]).any():
+            out[j] = np.nan
+            continue
         out[j] = x[order, j].mean()
     return out
 
