@@ -1,0 +1,226 @@
+"""Overlapped-round benchmark: serial vs streaming/prefetch PS rounds.
+
+Measures what the overlapped round engine (``byzpy_tpu.engine.overlap``)
+buys on a straggler-skewed CPU workload: honest nodes whose
+``compute_gradient`` and ``apply_server_gradient`` RPCs each carry
+per-(node, round) delay jitter — a base latency, an exponential jitter
+term, and one rotating straggler spike per round per leg, the
+decorrelated-straggler shape of real fleets (network RTT both
+directions, GC pauses, contention). All modes replay the *same*
+pre-drawn delay schedule, so steps/sec differences are purely the round
+engine's.
+
+Modes:
+
+* ``serial``   — barrier ingestion, no prefetch (the legacy round loop;
+  run through ``OverlapConfig(stream=False, prefetch_depth=0)`` so
+  ingestion lag is recorded — wall-clock is identical to ``overlap=None``).
+* ``stream``   — arrival-order folding only.
+* ``prefetch`` — cross-round apply→compute chaining only.
+* ``both``     — the full overlapped engine (the default config).
+
+Reports steps/sec per mode, speedup vs serial, and ingestion-lag
+percentiles (the time each gradient sits between arrival and
+aggregation consuming it — the straggler tax the barrier forces every
+early gradient to pay). Appends one provenance-stamped JSON line per
+mode to ``results/overlap.jsonl``.
+
+Run: ``JAX_PLATFORMS=cpu python benchmarks/overlap_bench.py``
+(``--smoke`` for the CI-sized run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean  # noqa: E402
+from byzpy_tpu.engine.overlap import (  # noqa: E402
+    OverlapConfig,
+    RoundOverlapStats,
+)
+from byzpy_tpu.engine.parameter_server import ParameterServer  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+MODES = {
+    "serial": OverlapConfig(stream=False, prefetch_depth=0),
+    "stream": OverlapConfig(stream=True, prefetch_depth=0),
+    "prefetch": OverlapConfig(stream=False, prefetch_depth=1),
+    "both": OverlapConfig(stream=True, prefetch_depth=1),
+}
+
+
+class JitterNode:
+    """Honest node whose two RPC legs sleep through a pre-drawn
+    per-round delay schedule (seconds)."""
+
+    def __init__(self, value: float, d: int, compute_s, apply_s) -> None:
+        self.grad = np.full(d, value, np.float32)
+        self.compute_s = compute_s
+        self.apply_s = apply_s
+        self.computes = 0
+        self.applies = 0
+
+    async def honest_gradient_for_next_batch(self):
+        r = min(self.computes, len(self.compute_s) - 1)
+        self.computes += 1
+        await asyncio.sleep(self.compute_s[r])
+        return self.grad
+
+    async def apply_server_gradient(self, g):
+        r = min(self.applies, len(self.apply_s) - 1)
+        self.applies += 1
+        await asyncio.sleep(self.apply_s[r])
+
+
+def draw_delays(
+    rng: np.random.Generator,
+    *,
+    nodes: int,
+    rounds: int,
+    base_ms: float,
+    jitter_ms: float,
+    straggler_ms: float,
+) -> np.ndarray:
+    """``(rounds, nodes)`` delay schedule: base + Exp(jitter) + one
+    uniformly-drawn straggler per round."""
+    d = base_ms + rng.exponential(jitter_ms, size=(rounds, nodes))
+    stragglers = rng.integers(0, nodes, size=rounds)
+    d[np.arange(rounds), stragglers] += straggler_ms
+    return d / 1e3
+
+
+async def run_mode(
+    mode: str,
+    cfg: OverlapConfig,
+    *,
+    nodes: int,
+    rounds: int,
+    dim: int,
+    compute_s: np.ndarray,
+    apply_s: np.ndarray,
+) -> dict:
+    node_objs = [
+        JitterNode(float(i + 1), dim, compute_s[:, i], apply_s[:, i])
+        for i in range(nodes)
+    ]
+    ps = ParameterServer(
+        honest_nodes=node_objs,
+        aggregator=CoordinateWiseTrimmedMean(f=1),
+        overlap=cfg,
+    )
+    lags: list = []
+
+    def on_round(i, aggregated):
+        if ps.last_overlap_stats is not None:
+            lags.extend(ps.last_overlap_stats.ingest_lags_s)
+
+    t0 = time.perf_counter()
+    await ps.run(rounds, on_round=on_round)
+    elapsed = time.perf_counter() - t0
+    await ps.close()
+    # the library's own percentile definition, over all rounds' lags
+    agg_stats = RoundOverlapStats(mode=mode, ingest_lags_s=lags)
+
+    def pct_ms(p):
+        return 1e3 * agg_stats.lag_percentile(p)
+
+    return {
+        "mode": mode,
+        "steps_per_sec": rounds / elapsed,
+        "elapsed_s": round(elapsed, 3),
+        "rounds": rounds,
+        "ingest_lag_ms_p50": round(pct_ms(50), 2),
+        "ingest_lag_ms_p90": round(pct_ms(90), 2),
+        "ingest_lag_ms_p99": round(pct_ms(99), 2),
+    }
+
+
+async def main_async(args) -> int:
+    rng = np.random.default_rng(args.seed)
+    # +1 round of compute delays: prefetch reaches into round r+1
+    compute_s = draw_delays(
+        rng, nodes=args.nodes, rounds=args.rounds + 1,
+        base_ms=args.base_ms, jitter_ms=args.jitter_ms,
+        straggler_ms=args.straggler_ms,
+    )
+    apply_s = draw_delays(
+        rng, nodes=args.nodes, rounds=args.rounds + 1,
+        base_ms=args.base_ms, jitter_ms=args.jitter_ms,
+        straggler_ms=args.straggler_ms,
+    )
+    rows = []
+    for mode in args.modes:
+        rows.append(
+            await run_mode(
+                mode, MODES[mode],
+                nodes=args.nodes, rounds=args.rounds, dim=args.dim,
+                compute_s=compute_s, apply_s=apply_s,
+            )
+        )
+    serial = next((r for r in rows if r["mode"] == "serial"), rows[0])
+    out_path = os.path.join(HERE, "results", "overlap.jsonl")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(out_path, "a") as sink:
+        for r in rows:
+            r["speedup_vs_serial"] = round(
+                r["steps_per_sec"] / serial["steps_per_sec"], 3
+            )
+            r["steps_per_sec"] = round(r["steps_per_sec"], 2)
+            r.update({
+                "nodes": args.nodes, "dim": args.dim,
+                "base_ms": args.base_ms, "jitter_ms": args.jitter_ms,
+                "straggler_ms": args.straggler_ms, "seed": args.seed,
+                "device": "cpu",
+                "provenance": "benchmarks/overlap_bench.py", "ts": stamp,
+            })
+            sink.write(json.dumps(r) + "\n")
+    print(f"{'mode':<9} {'steps/s':>8} {'vs serial':>9} "
+          f"{'lag p50':>8} {'lag p90':>8} {'lag p99':>8}  (lag in ms)")
+    for r in rows:
+        print(f"{r['mode']:<9} {r['steps_per_sec']:>8.2f} "
+              f"{r['speedup_vs_serial']:>8.2f}x "
+              f"{r['ingest_lag_ms_p50']:>8.2f} {r['ingest_lag_ms_p90']:>8.2f} "
+              f"{r['ingest_lag_ms_p99']:>8.2f}")
+    both = next((r for r in rows if r["mode"] == "both"), None)
+    if both is not None:
+        print(f"overlapped-vs-serial speedup: {both['speedup_vs_serial']}x "
+              f"(results appended to {os.path.relpath(out_path, HERE)})")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=6)
+    parser.add_argument("--rounds", type=int, default=30)
+    parser.add_argument("--dim", type=int, default=8192)
+    parser.add_argument("--base-ms", type=float, default=5.0)
+    parser.add_argument("--jitter-ms", type=float, default=5.0,
+                        help="mean of the exponential jitter term")
+    parser.add_argument("--straggler-ms", type=float, default=60.0,
+                        help="extra delay for the per-round straggler")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--modes", nargs="*", default=list(MODES),
+                        choices=list(MODES))
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI-sized run (tiny delays, few rounds)")
+    args = parser.parse_args()
+    if args.smoke:
+        args.rounds = min(args.rounds, 6)
+        args.base_ms, args.jitter_ms, args.straggler_ms = 1.0, 1.0, 10.0
+        args.dim = min(args.dim, 1024)
+    return asyncio.run(main_async(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

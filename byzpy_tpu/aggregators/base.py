@@ -15,13 +15,80 @@ actor pools (the reference's shm-chunk pattern, minus the shm).
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import Any, Callable, Mapping, Sequence
+from typing import Any, Callable, Mapping, Optional, Sequence
 
 import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
 
 from ..engine.graph.operator import OpContext, Operator
 from ..utils import placement
 from ..utils.trees import stack_gradients
+
+
+def ravel_gradient(gradient: Any) -> tuple:
+    """Flatten one gradient pytree/array to a ``(d,)`` row the way
+    :func:`~byzpy_tpu.utils.trees.stack_gradients` would, deciding host/
+    device placement from this gradient alone (streaming ingestion sees
+    one gradient at a time; the barrier path decides from the full
+    list). Returns ``(row, unravel)``."""
+    with placement.on(placement.compute_device(gradient)):
+        row, unravel = ravel_pytree(gradient)
+        if not jnp.issubdtype(row.dtype, jnp.floating):
+            row = row.astype(jnp.float32)
+    return row, unravel
+
+
+class SlotFoldState:
+    """Default streaming-fold state: an arrival-order ingestion buffer.
+
+    Each gradient is flattened the moment it arrives (``fold``) and
+    parked in its canonical node slot; ``fold_finalize`` stacks the
+    filled slots *in slot order* and runs the normal matrix aggregate.
+    Because the stacked matrix is identical to the barrier path's —
+    same per-row flatten, same order — the result is bit-identical for
+    every aggregator, regardless of arrival order. The overlap win is
+    that the per-gradient host work (pytree ravel, dtype cast, host/
+    device placement) happens inside the straggler window.
+    """
+
+    __slots__ = ("n", "rows", "unravel", "dim", "filled")
+
+    def __init__(self, n: int) -> None:
+        # the one capacity guard for every fold state (the incremental
+        # folds all embed a slot buffer)
+        if n <= 0:
+            raise ValueError(f"fold_init needs n >= 1 (got {n})")
+        self.n = n
+        self.rows: list = [None] * n
+        self.unravel: Optional[Callable[[jnp.ndarray], Any]] = None
+        self.dim: Optional[int] = None
+        self.filled = 0
+
+    def insert(self, index: int, gradient: Any) -> jnp.ndarray:
+        """Flatten ``gradient`` into slot ``index``; returns the row."""
+        if not 0 <= index < self.n:
+            raise IndexError(f"slot {index} outside [0, {self.n})")
+        if self.rows[index] is not None:
+            raise ValueError(f"slot {index} folded twice")
+        row, unravel = ravel_gradient(gradient)
+        if self.dim is None:
+            self.dim = int(row.shape[0])
+            self.unravel = unravel
+        elif int(row.shape[0]) != self.dim:
+            raise ValueError(
+                f"all gradients must flatten to the same length "
+                f"(got {row.shape[0]} != {self.dim})"
+            )
+        self.rows[index] = row
+        self.filled += 1
+        return row
+
+    def stacked(self) -> tuple:
+        """``(matrix, unravel)`` over the filled slots, in slot order."""
+        rows = [r for r in self.rows if r is not None]
+        if not rows:
+            raise ValueError("fold_finalize before any gradient was folded")
+        return jnp.stack(rows, axis=0), self.unravel
 
 
 class Aggregator(Operator, ABC):
@@ -29,6 +96,15 @@ class Aggregator(Operator, ABC):
 
     name = "aggregator"
     input_key = "gradients"
+
+    #: Arrival-order streaming capability: when True the orchestrators
+    #: may feed gradients through ``fold``/``fold_finalize`` as they
+    #: land instead of barriering on the full list. The base
+    #: implementation (slot buffer + canonical-order stack) is
+    #: bit-identical to ``aggregate`` for any subclass; subclasses with
+    #: genuinely incremental math (running sums, extreme buffers, Gram
+    #: rows) override the hooks. Set False to force the barrier path.
+    supports_streaming: bool = True
 
     def compute(self, inputs: Mapping[str, Any], *, context: OpContext) -> Any:
         if self.input_key not in inputs:
@@ -80,6 +156,34 @@ class Aggregator(Operator, ABC):
 
         return robust.aggregate_stream(self._aggregate_matrix, xs)
 
+    # -- arrival-order streaming (overlapped rounds) ----------------------
+
+    def fold_init(self, n: int) -> Any:
+        """Create streaming-fold state for up to ``n`` gradients.
+
+        Slots are canonical node positions (honest nodes first, then
+        byzantine, matching the barrier path's list order), NOT arrival
+        ranks — finalize reassembles canonical order so selection tie
+        rules see the same row indices as ``aggregate``.
+        """
+        return SlotFoldState(n)
+
+    def fold(self, state: Any, index: int, gradient: Any) -> None:
+        """Ingest one gradient the moment it arrives (slot ``index``)."""
+        state.insert(index, gradient)
+
+    def fold_finalize(self, state: Any) -> Any:
+        """Finish the round: aggregate everything folded so far.
+
+        The default stacks the filled slots in canonical order and runs
+        ``_aggregate_matrix`` — bit-identical to ``aggregate`` on the
+        same gradients in slot order, for any arrival order.
+        """
+        with placement.on(placement.compute_device(state.rows)):
+            matrix, unravel = state.stacked()
+            self.validate_n(matrix.shape[0])
+            return unravel(self._aggregate_matrix(matrix))
+
     def validate_n(self, n: int) -> None:
         """Hook for subclasses to validate hyperparameters against n."""
 
@@ -93,4 +197,4 @@ class Aggregator(Operator, ABC):
         return self._aggregate_matrix
 
 
-__all__ = ["Aggregator"]
+__all__ = ["Aggregator", "SlotFoldState", "ravel_gradient"]

@@ -4,16 +4,39 @@
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
 import jax.numpy as jnp
 
 from ...ops import robust
-from ..base import Aggregator
+from ...utils import placement
+from ..base import Aggregator, SlotFoldState
 from ..chunked import FeatureChunkedAggregator
 
 
 def _trimmed_mean_chunk(chunk: np.ndarray, *, f: int) -> jnp.ndarray:
     return robust.trimmed_mean(jnp.asarray(chunk), f=f)
+
+
+class _TrimmedMeanFoldState:
+    """Incremental trimmed-mean state: running coordinate sum + folded
+    ``f``-smallest/``f``-largest buffers (``ops.robust
+    .extremes_fold_update``), so per-arrival work is O(f·d) and finalize
+    is O(f·d) — the sort cost streams over the round. Raw rows are kept
+    in a slot buffer as the exact fallback: a non-finite gradient (an
+    adversary's NaN/inf) would corrupt the extreme buffers, so finalize
+    detects it (one flag, no per-arrival host sync) and reruns the
+    barrier-identical sorted path on the kept rows."""
+
+    __slots__ = ("slots", "total", "low", "high", "nonfinite")
+
+    def __init__(self, n: int) -> None:
+        self.slots = SlotFoldState(n)
+        self.total = None
+        self.low = None
+        self.high = None
+        self.nonfinite = None
 
 
 class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
@@ -43,6 +66,45 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.trimmed_mean_stream(xs, f=self.f)
+
+    # -- arrival-order streaming fold ------------------------------------
+
+    def fold_init(self, n: int) -> Any:
+        return _TrimmedMeanFoldState(n)
+
+    def fold(self, state: Any, index: int, gradient: Any) -> None:
+        row = state.slots.insert(index, gradient)
+        f = self.f
+        with placement.on(placement.compute_device(row)):
+            state.total = row if state.total is None else state.total + row
+            bad = ~jnp.all(jnp.isfinite(row))
+            state.nonfinite = (
+                bad if state.nonfinite is None else state.nonfinite | bad
+            )
+            if f > 0:
+                if state.low is None:
+                    d = row.shape[0]
+                    state.low = jnp.full((f, d), jnp.inf, row.dtype)
+                    state.high = jnp.full((f, d), -jnp.inf, row.dtype)
+                state.low = robust.extremes_fold_update(
+                    state.low, row, largest=False
+                )
+                state.high = robust.extremes_fold_update(
+                    state.high, row, largest=True
+                )
+
+    def fold_finalize(self, state: Any) -> Any:
+        n = state.slots.filled
+        self.validate_n(n)
+        if state.nonfinite is None or bool(state.nonfinite):
+            # exact sorted path on the kept rows (matches the barrier's
+            # NaN-propagation / inf-trimming semantics bit for bit)
+            return Aggregator.fold_finalize(self, state.slots)
+        with placement.on(placement.compute_device(state.slots.rows)):
+            vec = robust.trimmed_mean_from_extremes(
+                state.total, state.low, state.high, n, f=self.f
+            )
+            return state.slots.unravel(vec)
 
 
 __all__ = ["CoordinateWiseTrimmedMean"]

@@ -13,12 +13,33 @@ reference's subtask layout (``krum.py:371-475``) without the shm handles.
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
 import jax.numpy as jnp
 
 from ...ops import robust
-from ..base import Aggregator
+from ...utils import placement
+from ..base import Aggregator, SlotFoldState
 from ..chunked import RowScoredAggregator
+
+
+class _GramFoldState:
+    """Incremental Gram state for streaming Multi-Krum: each arriving
+    gradient contributes its dot products against the rows already in
+    hand (O(k·d) work on arrival ``k``), so the O(n²·d) Gram — the
+    dominant cost of Krum scoring — is complete the moment the last
+    straggler lands. Finalize assembles the ``(n, n)`` Gram in canonical
+    slot order (selection tie rules see the same row indices as the
+    barrier path) and runs score + masked-mean selection
+    (``ops.robust.multi_krum_from_gram``)."""
+
+    __slots__ = ("slots", "arrival", "dots")
+
+    def __init__(self, n: int) -> None:
+        self.slots = SlotFoldState(n)
+        self.arrival: list = []  # slot indices in arrival order
+        self.dots: list = []  # k-th entry: (k+1,) dots vs arrivals 0..k
 
 
 def _krum_score_rows(host: np.ndarray, start: int, end: int, *, f: int) -> jnp.ndarray:
@@ -75,6 +96,53 @@ class MultiKrum(RowScoredAggregator, Aggregator):
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum_stream(xs, f=self.f, q=self.q)
+
+    # -- arrival-order streaming fold ------------------------------------
+
+    def fold_init(self, n: int) -> Any:
+        return _GramFoldState(n)
+
+    def fold(self, state: Any, index: int, gradient: Any) -> None:
+        row = state.slots.insert(index, gradient)
+        with placement.on(placement.compute_device(row)):
+            acc = (
+                jnp.float32
+                if row.dtype in (jnp.bfloat16, jnp.float16)
+                else row.dtype
+            )
+            dots = [
+                jnp.einsum(
+                    "d,d->", state.slots.rows[j], row,
+                    preferred_element_type=acc,
+                )
+                for j in state.arrival
+            ]
+            dots.append(
+                jnp.einsum("d,d->", row, row, preferred_element_type=acc)
+            )
+            state.dots.append(jnp.stack(dots).astype(acc))
+        state.arrival.append(index)
+
+    def fold_finalize(self, state: Any) -> Any:
+        m = len(state.arrival)
+        self.validate_n(m)
+        # arrival rank of each canonical (slot-sorted) row
+        rank = {slot: k for k, slot in enumerate(state.arrival)}
+        perm = np.asarray(
+            [rank[s] for s in sorted(state.arrival)], dtype=np.int32
+        )
+        with placement.on(placement.compute_device(state.slots.rows)):
+            matrix, unravel = state.slots.stacked()
+            acc = state.dots[0].dtype if state.dots else matrix.dtype
+            gram = jnp.zeros((m, m), acc)
+            for k, dvec in enumerate(state.dots):
+                gram = gram.at[k, : k + 1].set(dvec)
+            # mirror the lower triangle (diagonal already in place)
+            gram = gram + jnp.tril(gram, -1).T
+            gram = gram[perm][:, perm]
+            return unravel(
+                robust.multi_krum_from_gram(matrix, gram, f=self.f, q=self.q)
+            )
 
 
 class Krum(MultiKrum):

@@ -5,17 +5,36 @@
 
 from __future__ import annotations
 
+from typing import Any
+
 import numpy as np
 import jax.numpy as jnp
 
 from ...ops import robust
-from ..base import Aggregator
+from ...utils import placement
+from ..base import Aggregator, SlotFoldState
 from ..chunked import RowScoredAggregator
 
 
 def _sq_norm_rows(host: np.ndarray, start: int, end: int) -> jnp.ndarray:
     block = jnp.asarray(host[start:end])
     return jnp.sum(block * block, axis=1)
+
+
+class _NormFoldState:
+    """Incremental CGE state: each node's squared norm is computed the
+    moment its gradient arrives. Per-node norms are arrival-order
+    independent (one reduction over that row alone), so streaming CGE is
+    deterministic for any arrival order; parity with the barrier path is
+    to float tolerance (the barrier runs norms + selection as one jitted
+    program whose fused codegen rounds ~1 ulp differently from the eager
+    finalize here)."""
+
+    __slots__ = ("slots", "norms")
+
+    def __init__(self, n: int) -> None:
+        self.slots = SlotFoldState(n)
+        self.norms: dict = {}
 
 
 class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
@@ -43,6 +62,26 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.cge_stream(xs, f=self.f)
+
+    # -- arrival-order streaming fold ------------------------------------
+
+    def fold_init(self, n: int) -> Any:
+        return _NormFoldState(n)
+
+    def fold(self, state: Any, index: int, gradient: Any) -> None:
+        row = state.slots.insert(index, gradient)
+        with placement.on(placement.compute_device(row)):
+            state.norms[index] = jnp.sum(row * row)
+
+    def fold_finalize(self, state: Any) -> Any:
+        m = state.slots.filled
+        self.validate_n(m)
+        with placement.on(placement.compute_device(state.slots.rows)):
+            matrix, unravel = state.slots.stacked()
+            scores = jnp.stack(
+                [state.norms[s] for s in sorted(state.norms)]
+            )
+            return unravel(robust.ranked_mean(matrix, scores, m - self.f))
 
 
 __all__ = ["ComparativeGradientElimination"]

@@ -1,0 +1,175 @@
+"""Overlapped round machinery shared by the PS and P2P orchestrators.
+
+The actor-layer round loop used to be fully serial: barrier on every
+honest gradient, then every byzantine gradient, then aggregate, then
+broadcast (``parameter_server/ps.py``), with the same phase barriers in
+the gossip runner. Two orthogonal mechanisms remove the barriers without
+changing per-node semantics:
+
+* **Arrival-order streaming aggregation** — gradients are folded into a
+  running aggregator state the moment they land
+  (:func:`gather_arrival_order` + the ``fold``/``fold_finalize`` hooks on
+  :class:`~byzpy_tpu.aggregators.base.Aggregator`), so flattening,
+  device placement, and the aggregator's incremental work (running
+  sums, extreme buffers, Gram rows) hide inside the straggler window
+  instead of executing after it.
+* **Cross-round prefetch** — round ``r+1``'s honest
+  ``compute_gradient`` RPCs are dispatched the moment each node's round
+  ``r`` ``apply_server_gradient`` resolves, so the apply fan-out and the
+  next round's compute pipeline across nodes instead of running as two
+  global barriers. Per-node program order (apply ``r`` strictly before
+  compute ``r+1`` on the same node) is preserved, so this is *not*
+  stale-gradient async-SGD: results are identical to the serial
+  schedule, only the wall-clock interleaving across nodes changes.
+
+``OverlapConfig`` is the single knob surface for both orchestrators;
+``benchmarks/overlap_bench.py`` measures the two mechanisms separately
+and together on a straggler-skewed CPU workload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, List, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class OverlapConfig:
+    """Knobs for the overlapped round engine.
+
+    ``stream``
+        Fold gradients into the aggregator in arrival order (streaming
+        aggregation). Applies only when the aggregator declares
+        ``supports_streaming`` and no pre-aggregator / actor-pool
+        executor is configured — those paths need the full gradient
+        list and keep the barrier.
+    ``prefetch_depth``
+        How many rounds of honest ``compute_gradient`` calls may be in
+        flight beyond the round being aggregated. ``0`` disables
+        cross-round prefetch; the default ``1`` double-buffers rounds.
+        Because per-node program order is preserved (a node's round-
+        ``r+1`` compute is chained behind its round-``r`` apply), depths
+        beyond 1 cannot add overlap and are accepted but behave as 1.
+    """
+
+    stream: bool = True
+    prefetch_depth: int = 1
+
+    def __post_init__(self) -> None:
+        if self.prefetch_depth < 0:
+            raise ValueError(
+                f"prefetch_depth must be >= 0 (got {self.prefetch_depth})"
+            )
+
+
+@dataclass
+class RoundOverlapStats:
+    """Per-round ingestion accounting, exposed as
+    ``ParameterServer.last_overlap_stats``.
+
+    ``ingest_lags_s`` holds, per gradient, the time between its arrival
+    at the orchestrator and the moment aggregation consumed it (fold
+    completion when streaming; aggregate start on the barrier path) —
+    the straggler tax each early gradient pays. ``mode`` records which
+    ingestion path served the round.
+    """
+
+    mode: str = "barrier"
+    ingest_lags_s: List[float] = field(default_factory=list)
+    round_seconds: float = 0.0
+
+    def lag_percentile(self, pct: float) -> float:
+        """Ingestion-lag percentile (nearest-rank) in seconds."""
+        if not self.ingest_lags_s:
+            return 0.0
+        lags = sorted(self.ingest_lags_s)
+        rank = max(0, min(len(lags) - 1, int(round(pct / 100.0 * (len(lags) - 1)))))
+        return lags[rank]
+
+
+async def gather_arrival_order(
+    aws: Sequence[Awaitable[Any]],
+    *,
+    on_item: Optional[Callable[[int, Any], None]] = None,
+) -> List[Any]:
+    """Run awaitables concurrently, invoking ``on_item(index, result)``
+    the moment each one completes (arrival order), and return results in
+    input order.
+
+    Error semantics match the serial barrier helper (``ps._gather_all``):
+    every awaitable settles before the first failure — by *input* order,
+    so which exception surfaces does not depend on arrival timing — is
+    raised, with sibling exceptions already retrieved. ``on_item`` is
+    only called for successes; an exception *from* ``on_item`` (e.g. a
+    fold rejecting a malformed gradient) counts as that item's failure
+    and still waits for the siblings. Cancelling this coroutine cancels
+    every in-flight awaitable (the ``asyncio.gather`` contract the
+    serial path relies on) before the cancellation propagates.
+    """
+    tasks = [asyncio.ensure_future(a) for a in aws]
+    results: List[Any] = [None] * len(tasks)
+    failed: List[Optional[BaseException]] = [None] * len(tasks)
+    pending = set(tasks)
+    index_of = {t: i for i, t in enumerate(tasks)}
+    try:
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            # sort by completion within the batch is unknowable; iterate
+            # the settled set — each was "just arrived" at this wakeup
+            for t in done:
+                i = index_of[t]
+                if t.cancelled():
+                    failed[i] = asyncio.CancelledError()
+                    continue
+                exc = t.exception()
+                if exc is not None:
+                    failed[i] = exc
+                    continue
+                results[i] = t.result()
+                if on_item is not None:
+                    try:
+                        on_item(i, results[i])
+                    except BaseException as cb_exc:  # noqa: BLE001
+                        failed[i] = cb_exc
+    except asyncio.CancelledError:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
+    for exc in failed:
+        if exc is not None:
+            raise exc
+    return results
+
+
+async def settle_all(aws: Sequence[Awaitable[Any]]) -> List[Any]:
+    """Await ALL awaitables, then raise the first failure (input order)
+    with every sibling exception already retrieved — the barrier
+    counterpart of :func:`gather_arrival_order`, shared by the PS
+    round's ``_gather_all``, prefetch-chain flushing, and the P2P
+    overlapped round. Plain ``asyncio.wait`` + ``t.result()`` would
+    surface one error and leave siblings' exceptions unretrieved; bare
+    ``gather`` would abandon still-running siblings mid-round."""
+    results = await asyncio.gather(*aws, return_exceptions=True)
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
+    return results
+
+
+def now() -> float:
+    """Monotonic stamp used for ingestion-lag accounting."""
+    return time.perf_counter()
+
+
+__all__ = [
+    "OverlapConfig",
+    "RoundOverlapStats",
+    "gather_arrival_order",
+    "now",
+    "settle_all",
+]

@@ -1,10 +1,13 @@
+from ..overlap import OverlapConfig, RoundOverlapStats
 from .elastic import ElasticPolicy, ElasticState, QuorumLostError, SuspectRecord
 from .ps import ParameterServer
 
 __all__ = [
     "ElasticPolicy",
     "ElasticState",
+    "OverlapConfig",
     "ParameterServer",
     "QuorumLostError",
+    "RoundOverlapStats",
     "SuspectRecord",
 ]

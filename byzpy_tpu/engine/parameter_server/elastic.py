@@ -281,6 +281,18 @@ async def elastic_gather(
         ),
         return_exceptions=True,
     )
+    return _record_results(nodes, results, state, round_no)
+
+
+def _record_results(
+    nodes: Sequence[Tuple[str, Any]],
+    results: Sequence[Any],
+    state: ElasticState,
+    round_no: int,
+) -> List[Tuple[str, Any]]:
+    """Fold gathered per-node outcomes into the suspicion state: the
+    shared second half of :func:`elastic_gather` and
+    :func:`elastic_settle`."""
     alive: List[Tuple[str, Any]] = []
     for (nid, _), res in zip(nodes, results):
         if isinstance(res, BaseException):
@@ -293,6 +305,25 @@ async def elastic_gather(
     return alive
 
 
+async def elastic_settle(
+    pairs: Sequence[Tuple[str, Any]],
+    *,
+    state: ElasticState,
+    round_no: int,
+) -> List[Tuple[str, Any]]:
+    """Settle already-dispatched per-node awaitables (the cross-round
+    prefetch path: round ``r+1`` collects chains dispatched during round
+    ``r``) with :func:`elastic_gather`'s isolation semantics. Timeouts
+    are NOT applied here — the prefetch dispatch baked
+    ``policy.call_timeout`` into each chained :func:`call_node` leg, so
+    a settled awaitable has already either produced, failed, or timed
+    out on its own clock."""
+    results = await asyncio.gather(
+        *(aw for _, aw in pairs), return_exceptions=True
+    )
+    return _record_results(pairs, results, state, round_no)
+
+
 __all__ = [
     "ElasticPolicy",
     "ElasticState",
@@ -301,5 +332,6 @@ __all__ = [
     "SuspectRecord",
     "call_node",
     "elastic_gather",
+    "elastic_settle",
     "node_id",
 ]

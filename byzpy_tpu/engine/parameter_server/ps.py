@@ -24,12 +24,20 @@ from ...aggregators.base import Aggregator
 from ...pre_aggregators.base import PreAggregator
 from ..graph.executor import OperatorExecutor
 from ..graph.pool import ActorPool, ActorPoolConfig
+from ..overlap import (
+    OverlapConfig,
+    RoundOverlapStats,
+    gather_arrival_order,
+    now,
+    settle_all,
+)
 from .elastic import (
     ElasticPolicy,
     ElasticState,
     QuorumLostError,
     call_node,
     elastic_gather,
+    elastic_settle,
     node_id,
 )
 
@@ -43,17 +51,11 @@ async def _invoke(obj: Any, method: str, *args: Any) -> Any:
 
 
 async def _gather_all(coros) -> List[Any]:
-    """Run coroutines concurrently; wait for ALL to settle, then raise the
-    first failure (if any) with every sibling exception already retrieved.
-    Plain ``asyncio.wait`` + ``t.result()`` would surface one error and
-    leave the siblings' exceptions unretrieved (logged as warnings at GC,
-    lost for debugging); bare ``gather`` would abandon still-running
-    siblings mid-round."""
-    results = await asyncio.gather(*coros, return_exceptions=True)
-    for r in results:
-        if isinstance(r, BaseException):
-            raise r
-    return results
+    """Run coroutines concurrently; wait for ALL to settle, then raise
+    the first failure (if any) with every sibling exception already
+    retrieved (see :func:`~byzpy_tpu.engine.overlap.settle_all`, the one
+    implementation of this contract)."""
+    return await settle_all(list(coros))
 
 
 class ParameterServer:
@@ -83,6 +85,20 @@ class ParameterServer:
         assumption (raises :class:`QuorumLostError` below it). Without
         it, any node failure fails the round (the reference's semantics,
         ``byzpy/engine/parameter_server/ps.py:103-144``).
+    overlap:
+        Optional :class:`~byzpy_tpu.engine.overlap.OverlapConfig`. Turns
+        on the overlapped round engine: arrival-order streaming
+        aggregation (gradients fold into the aggregator the moment they
+        land, for aggregators with ``supports_streaming``; pre-
+        aggregation and pool-scheduled paths keep the barrier) and
+        cross-round prefetch (each node's next-round compute is
+        dispatched the moment its apply lands, so apply fan-out and the
+        next gather pipeline across nodes). Per-node program order is
+        preserved — results match the serial schedule; only wall-clock
+        interleaving changes. Under prefetch a node's apply failure
+        surfaces when its chain is collected, i.e. one round late (or at
+        :meth:`flush`). Ingestion accounting for the last round is
+        exposed as ``last_overlap_stats``.
     """
 
     def __init__(
@@ -95,6 +111,7 @@ class ParameterServer:
         pool: Optional[ActorPool] = None,
         pool_config: Optional[ActorPoolConfig | Sequence[ActorPoolConfig]] = None,
         elastic: Optional[ElasticPolicy] = None,
+        overlap: Optional[OverlapConfig] = None,
     ) -> None:
         if not honest_nodes:
             raise ValueError("ParameterServer needs at least one honest node")
@@ -109,6 +126,15 @@ class ParameterServer:
         self.pre_aggregator = pre_aggregator
         self.elastic = elastic
         self.elastic_state = ElasticState()
+        self.overlap = overlap
+        self.last_overlap_stats: Optional[RoundOverlapStats] = None
+        # cross-round prefetch buffers: apply→compute chains dispatched
+        # at the end of round r, collected at the start of round r+1
+        self._pending_honest: Optional[List["asyncio.Task"]] = None
+        self._pending_elastic: Optional[Dict[str, "asyncio.Task"]] = None
+        # run() raises this for its final round so training consumes
+        # exactly the serial schedule's batches (no dangling prefetch)
+        self._suppress_prefetch = False
         self._executor = (
             OperatorExecutor(aggregator, pool=pool, pool_config=pool_config)
             if (pool is not None or pool_config is not None)
@@ -180,6 +206,20 @@ class ParameterServer:
                 out.append((nid, node))
         return out
 
+    async def _elastic_chain_apply_compute(self, node: Any, aggregated: Any) -> Any:
+        """Prefetch chain with elastic timeouts baked into each leg (see
+        :func:`~byzpy_tpu.engine.parameter_server.elastic.elastic_settle`):
+        apply round ``r``'s update, then compute round ``r+1``'s
+        gradient. A failure in either leg costs the node its next-round
+        slot when the chain is collected."""
+        timeout = self.elastic.call_timeout
+        await call_node(
+            node, "apply_server_gradient", (aggregated,), timeout=timeout
+        )
+        return await call_node(
+            node, "honest_gradient_for_next_batch", (), timeout=timeout
+        )
+
     async def _elastic_round(self) -> Any:
         policy, state = self.elastic, self.elastic_state
         rnd = self.rounds_completed
@@ -188,11 +228,39 @@ class ParameterServer:
             if policy.external_suspects is not None
             else set()
         )
-        honest_pairs = await elastic_gather(
-            self._rotation("honest", self.honest_nodes, external),
-            "honest_gradient_for_next_batch", (),
-            policy=policy, state=state, round_no=rnd,
+        rotation = self._rotation("honest", self.honest_nodes, external)
+        pending = self._pending_elastic or {}
+        self._pending_elastic = None
+        settle_pairs: List[Any] = []
+        fresh_pairs: List[Any] = []
+        for nid, node in rotation:
+            task = pending.pop(nid, None)
+            if task is not None:
+                settle_pairs.append((nid, task))
+            else:
+                fresh_pairs.append((nid, node))
+        # chains for nodes that dropped out of the rotation meanwhile
+        # (newly external suspects): abandon without waiting out their
+        # timeout; exceptions are retrieved so nothing warns at GC
+        for task in pending.values():
+            task.cancel()
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
+        collected: Dict[str, Any] = dict(
+            await elastic_settle(settle_pairs, state=state, round_no=rnd)
         )
+        collected.update(
+            await elastic_gather(
+                fresh_pairs, "honest_gradient_for_next_batch", (),
+                policy=policy, state=state, round_no=rnd,
+            )
+        )
+        # rotation order, so aggregation input order (and selection tie
+        # rules) match the non-prefetch path
+        honest_pairs = [
+            (nid, collected[nid]) for nid, _ in rotation if nid in collected
+        ]
         if len(honest_pairs) < policy.min_quorum:
             raise QuorumLostError(
                 f"round {rnd}: {len(honest_pairs)} honest gradients < "
@@ -221,21 +289,163 @@ class ParameterServer:
             (nid, n) for nid, n in all_pairs
             if nid not in state.suspects and nid not in external
         ]
-        await elastic_gather(
-            live, "apply_server_gradient", (aggregated,),
-            policy=policy, state=state, round_no=rnd,
-        )
+        if self._prefetch_depth() > 0:
+            honest_ids = {
+                node_id("honest", i) for i in range(len(self.honest_nodes))
+            }
+            live_honest = [(nid, n) for nid, n in live if nid in honest_ids]
+            live_byz = [(nid, n) for nid, n in live if nid not in honest_ids]
+            self._pending_elastic = {
+                nid: asyncio.ensure_future(
+                    self._elastic_chain_apply_compute(n, aggregated)
+                )
+                for nid, n in live_honest
+            }
+            await elastic_gather(
+                live_byz, "apply_server_gradient", (aggregated,),
+                policy=policy, state=state, round_no=rnd,
+            )
+        else:
+            await elastic_gather(
+                live, "apply_server_gradient", (aggregated,),
+                policy=policy, state=state, round_no=rnd,
+            )
         self.rounds_completed += 1
         return aggregated
+
+    # -- overlapped round engine ---------------------------------------------
+
+    def _prefetch_depth(self) -> int:
+        if self.overlap is None or self._suppress_prefetch:
+            return 0
+        return self.overlap.prefetch_depth
+
+    def _stream_enabled(self) -> bool:
+        """Arrival-order folding applies only when the aggregator owns
+        the whole reduction: pre-aggregation and pool-scheduled paths
+        consume the full gradient list and keep the barrier."""
+        return (
+            self.overlap is not None
+            and self.overlap.stream
+            and self.pre_aggregator is None
+            and self._executor is None
+            and getattr(self.aggregator, "supports_streaming", False)
+        )
+
+    async def _chain_apply_compute(self, node: Any, aggregated: Any) -> Any:
+        """Round-boundary pipeline unit: this node's round-``r`` apply,
+        then immediately its round-``r+1`` gradient — without waiting
+        for any other node. Per-node program order is exactly the serial
+        schedule's; across nodes, a slow apply overlaps other nodes'
+        next compute."""
+        await _invoke(node, "apply_server_gradient", aggregated)
+        return await _invoke(node, "honest_gradient_for_next_batch")
+
+    async def _plain_round(self) -> Any:
+        """Non-elastic round under an :class:`OverlapConfig`: arrival-
+        order ingestion (with optional streaming fold) + prefetch-aware
+        fan-out."""
+        stream = self._stream_enabled()
+        stats = RoundOverlapStats(mode="stream" if stream else "barrier")
+        t0 = now()
+        n_h = len(self.honest_nodes)
+        fold_state = (
+            self.aggregator.fold_init(n_h + len(self.byzantine_nodes))
+            if stream
+            else None
+        )
+        arrivals: Dict[int, float] = {}
+
+        def ingest(offset: int):
+            def cb(i: int, grad: Any) -> None:
+                slot = offset + i
+                arrivals[slot] = now()
+                if fold_state is not None:
+                    self.aggregator.fold(fold_state, slot, grad)
+                    stats.ingest_lags_s.append(now() - arrivals[slot])
+            return cb
+
+        pending = self._pending_honest
+        self._pending_honest = None
+        honest_aws = (
+            pending
+            if pending is not None
+            else [
+                _invoke(node, "honest_gradient_for_next_batch")
+                for node in self.honest_nodes
+            ]
+        )
+        honest = await gather_arrival_order(honest_aws, on_item=ingest(0))
+        byz: List[Any] = []
+        if self.byzantine_nodes:
+            byz = await gather_arrival_order(
+                [
+                    _invoke(node, "byzantine_gradient_for_next_batch", honest)
+                    for node in self.byzantine_nodes
+                ],
+                on_item=ingest(n_h),
+            )
+        if stream:
+            aggregated = self.aggregator.fold_finalize(fold_state)
+        else:
+            t_consume = now()
+            stats.ingest_lags_s.extend(
+                t_consume - t for t in arrivals.values()
+            )
+            aggregated = await self._aggregate(honest + byz)
+        if self._prefetch_depth() > 0:
+            self._pending_honest = [
+                asyncio.ensure_future(
+                    self._chain_apply_compute(node, aggregated)
+                )
+                for node in self.honest_nodes
+            ]
+            if self.byzantine_nodes:
+                await _gather_all(
+                    _invoke(node, "apply_server_gradient", aggregated)
+                    for node in self.byzantine_nodes
+                )
+        else:
+            await _gather_all(
+                _invoke(node, "apply_server_gradient", aggregated)
+                for node in self.honest_nodes + self.byzantine_nodes
+            )
+        stats.round_seconds = now() - t0
+        self.last_overlap_stats = stats
+        self.rounds_completed += 1
+        return aggregated
+
+    async def flush(self) -> None:
+        """Settle outstanding prefetched apply→compute chains.
+
+        After this, every node has applied the last aggregate (chain
+        failures raise here, like the serial apply barrier would have
+        one round earlier). The already-computed next-round gradients
+        stay buffered and are consumed by the next ``round()`` — no
+        recompute, no lost batches.
+        """
+        if self._pending_honest:
+            await settle_all(self._pending_honest)
+        if self._pending_elastic:
+            # settle, but don't raise: elastic failures are suspicion
+            # events, recorded when the next round collects these chains
+            # (awaiting a settled task again returns the same outcome)
+            await asyncio.gather(
+                *self._pending_elastic.values(), return_exceptions=True
+            )
 
     # -- public API ----------------------------------------------------------
 
     async def round(self) -> Any:
         """One training round; returns the aggregated gradient
         (ref: ``ps.py:103-144``). With an :class:`ElasticPolicy`, node
-        crash/omission failures shrink the round instead of failing it."""
+        crash/omission failures shrink the round instead of failing it;
+        with an :class:`OverlapConfig`, ingestion streams in arrival
+        order and the apply fan-out pipelines into the next round."""
         if self.elastic is not None:
             return await self._elastic_round()
+        if self.overlap is not None:
+            return await self._plain_round()
         honest = await self._stream_honest()
         byz = await self._stream_byzantine(honest)
         aggregated = await self._aggregate(honest + byz)
@@ -252,15 +462,34 @@ class ParameterServer:
         *,
         on_round: Optional[Callable[[int, Any], Optional[Awaitable[None]]]] = None,
     ) -> None:
-        """Run ``rounds`` rounds; ``on_round(i, aggregated)`` fires after each."""
+        """Run ``rounds`` rounds; ``on_round(i, aggregated)`` fires after
+        each. Under prefetch the final round runs without dispatching
+        ahead (and any chains left over from direct ``round()`` calls
+        are flushed), so post-``run`` node state — applies landed,
+        batches consumed — is exactly the serial schedule's."""
         for i in range(rounds):
-            aggregated = await self.round()
+            self._suppress_prefetch = i == rounds - 1
+            try:
+                aggregated = await self.round()
+            finally:
+                self._suppress_prefetch = False
             if on_round is not None:
                 out = on_round(i, aggregated)
                 if inspect.isawaitable(out):
                     await out
+        await self.flush()
 
     async def close(self) -> None:
+        for task in (self._pending_honest or []) + list(
+            (self._pending_elastic or {}).values()
+        ):
+            task.cancel()
+            try:
+                await task
+            except BaseException:  # noqa: BLE001 — teardown, best effort
+                pass
+        self._pending_honest = None
+        self._pending_elastic = None
         if self._executor is not None:
             await self._executor.close()
 

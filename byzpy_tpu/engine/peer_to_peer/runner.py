@@ -44,6 +44,7 @@ from ..node.decentralized import DecentralizedNode
 
 if TYPE_CHECKING:  # pragma: no cover — avoids node.cluster -> topology cycle
     from ..node.cluster import DecentralizedCluster
+from ..overlap import OverlapConfig, settle_all
 from .elastic import HeartbeatPolicy
 from .nodes import ByzantineP2PWorker, HonestP2PWorker
 from .topology import Topology
@@ -57,8 +58,14 @@ def _configure_honest(
     aggregator: Aggregator,
     timeout: Optional[float],
     liveness: bool = False,
+    stream: bool = False,
 ) -> None:
-    """Install half_step/aggregate pipelines on an honest node."""
+    """Install half_step/aggregate pipelines on an honest node. With
+    ``stream`` (and a streaming-capable aggregator) each gossip frame is
+    folded into the aggregator the moment it arrives instead of
+    buffering the full neighborhood first — the vector order the
+    aggregator sees (own θ½ first, then frames in arrival order) is the
+    same in both paths, so results match the barrier path."""
     if liveness:
         _install_liveness_responder(node)
 
@@ -66,12 +73,21 @@ def _configure_honest(
         return worker.half_step(float(lr))
 
     async def aggregate(expected):
-        received = []
-        for _ in range(int(expected)):
-            msg = await node.wait_for_message(GOSSIP_TYPE, timeout=timeout)
-            received.append(jnp.asarray(msg.payload))
-        vectors = [worker.parameters()] + received
-        result = aggregator.aggregate(vectors)
+        expected = int(expected)
+        if stream and getattr(aggregator, "supports_streaming", False):
+            state = aggregator.fold_init(expected + 1)
+            aggregator.fold(state, 0, worker.parameters())
+            for k in range(expected):
+                msg = await node.wait_for_message(GOSSIP_TYPE, timeout=timeout)
+                aggregator.fold(state, k + 1, jnp.asarray(msg.payload))
+            result = aggregator.fold_finalize(state)
+        else:
+            received = []
+            for _ in range(expected):
+                msg = await node.wait_for_message(GOSSIP_TYPE, timeout=timeout)
+                received.append(jnp.asarray(msg.payload))
+            vectors = [worker.parameters()] + received
+            result = aggregator.aggregate(vectors)
         worker.apply_aggregate(result)
         return result
 
@@ -157,6 +173,7 @@ class DecentralizedPeerToPeer:
         byzantine_indices: Optional[Sequence[int]] = None,
         gossip_timeout: Optional[float] = 30.0,
         elastic: Optional["HeartbeatPolicy"] = None,
+        overlap: Optional["OverlapConfig"] = None,
     ) -> None:
         n = topology.n_nodes
         if elastic is not None and gossip_timeout is None:
@@ -212,6 +229,7 @@ class DecentralizedPeerToPeer:
         self._started = False
         self.rounds_completed = 0
         self._elastic = elastic
+        self._overlap = overlap
         self._monitor: Optional[Any] = None
         self._removal_tasks: set = set()
         # audit trail of what the built-in policy did: (peer_id, outcome)
@@ -239,6 +257,7 @@ class DecentralizedPeerToPeer:
                 aggregator=self.aggregator,
                 timeout=self._timeout,
                 liveness=self._elastic is not None,
+                stream=self._overlap is not None and self._overlap.stream,
             )
         ctx = node.context
         if hasattr(ctx, "remote_execute_pipeline"):
@@ -360,7 +379,16 @@ class DecentralizedPeerToPeer:
                 task.cancel()
             except asyncio.CancelledError:
                 cur = asyncio.current_task()
-                if cur is not None and cur.cancelling() > 0:
+                # Task.cancelling() is 3.11+; on 3.10 there is no way to
+                # distinguish "the awaited removal task was cancelled
+                # elsewhere" from "shutdown itself was cancelled", so
+                # treat the CancelledError as aimed at us and propagate
+                # (the conservative reading — a swallowed cancellation
+                # would break caller timeouts).
+                cancelling = getattr(cur, "cancelling", None)
+                if cur is not None and (
+                    cancelling is None or cancelling() > 0
+                ):
                     # shutdown ITSELF was cancelled — don't swallow it;
                     # drop pending removals and let cancellation propagate
                     for t in self._removal_tasks:
@@ -513,9 +541,120 @@ class DecentralizedPeerToPeer:
             for i, out in zip(self.honest_indices, aggregated)
         }
 
+    async def _round_locked_overlap(
+        self,
+        pending_half: Dict[int, "asyncio.Task"],
+        *,
+        prefetch: bool,
+    ) -> Dict[int, Any]:
+        """One gossip round as per-node chains instead of phase barriers.
+
+        Each honest node runs half_step → broadcast → aggregate as its
+        own chain (a slow neighbor only delays nodes that actually wait
+        on its frames), byzantine nodes run attack → broadcast chains,
+        and with ``prefetch`` a node's next-round half_step is
+        dispatched the moment its aggregate lands. Per-node program
+        order is exactly the serial schedule's — only cross-node
+        interleaving changes. Next-round *broadcasts* stay in the next
+        round's body (after every aggregate here settled), so frames
+        can never leak across round boundaries.
+        """
+        lr = self.learning_rate
+
+        # drop prefetched half-steps for peers excised since last round
+        live = set(self.honest_indices)
+        for i in [j for j in pending_half if j not in live]:
+            task = pending_half.pop(i)
+            task.cancel()
+            task.add_done_callback(lambda t: t.cancelled() or t.exception())
+
+        async def half_and_cast(i: int) -> None:
+            task = pending_half.pop(i, None)
+            if task is None:
+                out = await self.nodes[i].execute_pipeline(
+                    "half_step", {"lr": lr}
+                )
+            else:
+                out = await task
+            await self.nodes[i].broadcast_message(
+                GOSSIP_TYPE, out["half_step"]
+            )
+
+        async def attack_and_cast(i: int) -> None:
+            out = await self.nodes[i].execute_pipeline(
+                "attack", {"expected": self._byz_expected(i)}
+            )
+            await self.nodes[i].broadcast_message(GOSSIP_TYPE, out["attack"])
+
+        half_tasks = {
+            i: asyncio.ensure_future(half_and_cast(i))
+            for i in self.honest_indices
+        }
+
+        async def aggregate_then_prefetch(i: int) -> Any:
+            # strict per-node order: own half_step (and broadcast) first,
+            # or the aggregate would fold pre-half-step parameters on
+            # nodes whose pipelines execute asynchronously
+            await half_tasks[i]
+            out = await self.nodes[i].execute_pipeline(
+                "aggregate", {"expected": self._honest_expected(i)}
+            )
+            if prefetch:
+                # no broadcast here — θ½ of round r+1 leaves the node
+                # only in round r+1's body
+                pending_half[i] = asyncio.ensure_future(
+                    self.nodes[i].execute_pipeline("half_step", {"lr": lr})
+                )
+            return out["aggregate"]
+
+        chains = list(half_tasks.values()) + [
+            asyncio.ensure_future(attack_and_cast(i))
+            for i in self.byzantine_indices
+        ]
+        agg_tasks = [
+            asyncio.ensure_future(aggregate_then_prefetch(i))
+            for i in self.honest_indices
+        ]
+        try:
+            await settle_all(chains)
+            aggregated = await settle_all(agg_tasks)
+        except BaseException:
+            # a failed round must not leave half-broadcast frames racing
+            # the caller's teardown — settle everything before raising
+            for t in chains + agg_tasks:
+                t.cancel()
+            await asyncio.gather(*chains, *agg_tasks, return_exceptions=True)
+            raise
+        self.rounds_completed += 1
+        return dict(zip(self.honest_indices, aggregated))
+
     async def run_async(self, rounds: int) -> None:
-        for _ in range(rounds):
-            await self.run_round_async()
+        """Run ``rounds`` gossip rounds. With an
+        :class:`~byzpy_tpu.engine.overlap.OverlapConfig` (``prefetch_depth
+        > 0``) rounds are overlapped: per-node chains replace the phase
+        barriers and each node's next half_step is prefetched behind its
+        aggregate. The final round does not prefetch, so post-``run``
+        worker state matches the serial schedule exactly."""
+        if self._overlap is None or self._overlap.prefetch_depth == 0:
+            for _ in range(rounds):
+                await self.run_round_async()
+            return
+        if not self._started:
+            await self.setup()
+        pending_half: Dict[int, "asyncio.Task"] = {}
+        try:
+            for r in range(rounds):
+                async with self._round_lock:
+                    await self._round_locked_overlap(
+                        pending_half, prefetch=r < rounds - 1
+                    )
+        finally:
+            for task in pending_half.values():
+                task.cancel()
+            if pending_half:
+                await asyncio.gather(
+                    *pending_half.values(), return_exceptions=True
+                )
 
 
 __all__ = ["DecentralizedPeerToPeer", "GOSSIP_TYPE"]

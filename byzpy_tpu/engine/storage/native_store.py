@@ -39,29 +39,55 @@ _CACHE_DIR = os.path.join(
 )
 
 
+def _loadable(path: str) -> bool:
+    """Probe-load a candidate library: a compile can succeed and still
+    produce a .so with unresolved symbols (glibc < 2.34 keeps
+    ``shm_open``/``shm_unlink`` in librt, so a link without ``-lrt``
+    only fails at dlopen time — observed as a cached
+    ``undefined symbol: shm_unlink`` artifact that then crashed every
+    actor child that touched the shm path)."""
+    try:
+        ctypes.CDLL(path)
+        return True
+    except OSError:
+        return False
+
+
 def _build_library() -> Optional[str]:
-    """Compile bshm.c to a shared library (cached)."""
+    """Compile bshm.c to a shared library (cached, probe-loaded)."""
     os.makedirs(_CACHE_DIR, exist_ok=True)
     lib_path = os.path.join(_CACHE_DIR, "libbshm.so")
     if os.path.exists(lib_path) and os.path.getmtime(lib_path) >= os.path.getmtime(_C_SRC):
-        return lib_path
-    for cc in ("cc", "gcc", "clang"):
+        if _loadable(lib_path):
+            return lib_path
+        # stale broken artifact (e.g. linked without -lrt on old glibc):
+        # fall through and rebuild rather than poisoning every process
         try:
-            with tempfile.NamedTemporaryFile(
-                suffix=".so", dir=_CACHE_DIR, delete=False
-            ) as tmp:
-                tmp_path = tmp.name
-            proc = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", "-o", tmp_path, _C_SRC],
-                capture_output=True,
-                timeout=120,
-            )
-            if proc.returncode == 0:
-                os.replace(tmp_path, lib_path)
-                return lib_path
-            os.unlink(tmp_path)
-        except (OSError, subprocess.TimeoutExpired):
-            continue
+            os.unlink(lib_path)
+        except OSError:
+            pass
+    # -lrt second: on glibc >= 2.34 librt is a stub (harmless), on older
+    # glibc it is REQUIRED for shm_open/shm_unlink, and on systems
+    # without librt at all the first variant covers them
+    for cc in ("cc", "gcc", "clang"):
+        for extra in ((), ("-lrt",)):
+            try:
+                with tempfile.NamedTemporaryFile(
+                    suffix=".so", dir=_CACHE_DIR, delete=False
+                ) as tmp:
+                    tmp_path = tmp.name
+                proc = subprocess.run(
+                    [cc, "-O2", "-shared", "-fPIC", "-o", tmp_path, _C_SRC,
+                     *extra],
+                    capture_output=True,
+                    timeout=120,
+                )
+                if proc.returncode == 0 and _loadable(tmp_path):
+                    os.replace(tmp_path, lib_path)
+                    return lib_path
+                os.unlink(tmp_path)
+            except (OSError, subprocess.TimeoutExpired):
+                continue
     return None
 
 
@@ -74,7 +100,13 @@ def _load() -> Optional[ctypes.CDLL]:
         path = _build_library()
         if path is None:
             return None
-        lib = ctypes.CDLL(path)
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            # never let a broken artifact escape as an exception — the
+            # shm fast path degrades to the pipe transport (a child
+            # actor dying here instead would hang its parent's call)
+            return None
         lib.bshm_map.restype = ctypes.c_void_p
         lib.bshm_map.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,

@@ -1540,17 +1540,26 @@ def nnm_selection_mean_stream_pallas(
 MAX_NETWORK_ROWS = 128
 MIN_PALLAS_DIM = 256 * 1024
 # MeaMed's fused kernel amortizes differently from the single-sort
-# kernels: the XLA fallback pays ~7 HBM passes (median sort, deviations,
-# second sort, masked selection) where CwTM/median pay ~2-3, so the
-# fused two-sweep kernel can win well below the generic floor. Tuned on
-# chip via benchmarks/meamed_gate_tune.py.
+# kernels: the XLA fallback pays ~4 passes (sort + window + masked
+# selection) where CwTM/median pay ~2-3, so the fused kernel *may* win
+# below the generic floor — unverified until the on-chip gate tune
+# (benchmarks/meamed_gate_tune.py) lands; held at the generic floor
+# meanwhile.
 MEAMED_MIN_DIM = MIN_PALLAS_DIM
 
 
 def meamed_min_dim() -> int:
     """MeaMed's dispatch floor; ``BYZPY_TPU_MEAMED_MIN_DIM`` overrides
     per call (read here, not at import, so tuning harnesses can flip it
-    after the package is imported)."""
+    before anything traces).
+
+    Caveat — trace-time caching: this gate is evaluated while a
+    ``jax.jit`` traces, and XLA caches the traced program per shape.
+    Flipping the env var after a shape has been traced does NOT retrace
+    that shape — the cached program keeps whichever dispatch decision
+    was active at first trace. Tuning harnesses must set the override
+    before first use of each shape (or clear jax's compilation cache).
+    """
     import os
 
     return int(os.environ.get("BYZPY_TPU_MEAMED_MIN_DIM", MEAMED_MIN_DIM))
@@ -1601,7 +1610,7 @@ def sharding_allows_pallas(x: Array) -> bool:
         return True
 
 
-def use_pallas_for(n: int, d: int, *, min_dim: int = None) -> bool:
+def use_pallas_for(n: int, d: int, *, min_dim: Optional[int] = None) -> bool:
     """True when the Pallas path should serve a coordinate-wise selection
     over an ``(n, d)`` matrix on this backend. ``min_dim`` overrides the
     generic dispatch floor for kernels with a different amortization

@@ -891,6 +891,81 @@ def best_subset_by_score(scores: Array) -> Array:
     return jnp.argmin(scores)
 
 
+# ---------------------------------------------------------------------------
+# Incremental (arrival-order) fold primitives. These back the streaming
+# ``fold``/``fold_finalize`` hooks on the aggregator classes: each update
+# consumes ONE gradient row the moment it arrives, so the work hides in
+# the straggler window of an overlapped round (engine.overlap) instead of
+# running after the gather barrier. The batched ``*_stream`` ops above
+# remain the fused shape for replaying already-buffered rounds.
+# ---------------------------------------------------------------------------
+
+
+def extremes_fold_update(buf: Array, row: Array, *, largest: bool) -> Array:
+    """Fold ``row`` into a per-coordinate running buffer of the ``f``
+    smallest (``largest=False``) or largest values seen so far.
+
+    ``buf``: ``(f, d)``, initialized to ``+inf`` (smallest) / ``-inf``
+    (largest) filler rows that real values displace. One ``(f+1, d)``
+    sort per arrival — O(f·d) work per gradient, so a trimmed mean's
+    sort cost streams over the round instead of spiking at the barrier.
+    Assumes finite inputs (NaNs sort last and would corrupt the
+    buffers); callers keep raw rows and fall back to the exact sorted
+    path when a non-finite value was seen.
+    """
+    if buf.shape[0] == 0:
+        return buf
+    cat = jnp.concatenate([buf, row[None, :]], axis=0)
+    s = jnp.sort(cat, axis=0)
+    return s[1:] if largest else s[:-1]
+
+
+def trimmed_mean_from_extremes(
+    total: Array, low: Array, high: Array, n: int, *, f: int
+) -> Array:
+    """f-trimmed coordinate mean from a running sum and the folded
+    extreme buffers: ``(Σx − Σ f smallest − Σ f largest) / (n − 2f)``.
+
+    Same quantity as :func:`trimmed_mean` on the stacked matrix, but the
+    summation order follows arrival order — parity with the barrier path
+    is to float tolerance, not bit-identical (pinned in
+    ``tests/test_overlap_stream.py``).
+    """
+    if not 0 <= 2 * f < n:
+        raise ValueError(f"trim parameter f must satisfy 0 <= 2f < n (got n={n}, f={f})")
+    kept = total
+    if f > 0:
+        kept = kept - jnp.sum(low, axis=0) - jnp.sum(high, axis=0)
+    return kept / jnp.asarray(n - 2 * f, total.dtype)
+
+
+def krum_scores_from_gram(gram: Array, *, f: int) -> Array:
+    """Krum score per node from a precomputed ``(n, n)`` Gram matrix —
+    the finalize step of the incremental Gram fold, where each arriving
+    gradient contributed its dot products against the rows already in
+    hand. Same math as :func:`krum_scores` (norms off the diagonal,
+    clamped squared distances, sorted-row sum)."""
+    n = gram.shape[0]
+    if not 0 <= f < n - 1:
+        raise ValueError(f"f must satisfy 0 <= f < n-1 (got n={n}, f={f})")
+    norms = jnp.diagonal(gram)
+    d2 = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
+    row_sorted = jnp.sort(d2, axis=1)
+    return jnp.sum(row_sorted[:, 1 : n - f], axis=1)
+
+
+def multi_krum_from_gram(x: Array, gram: Array, *, f: int, q: int) -> Array:
+    """Multi-Krum selection given the stacked matrix AND its Gram (built
+    incrementally by the streaming fold): scores from the Gram, mean of
+    the ``q`` best rows via the masked contraction. Skips the Gram
+    recompute that :func:`multi_krum` would pay."""
+    n = x.shape[0]
+    if not 1 <= q <= n - f:
+        raise ValueError(f"q must satisfy 1 <= q <= n - f (got n={n}, f={f}, q={q})")
+    scores = krum_scores_from_gram(gram, f=f)
+    return ranked_mean(x, scores, q)
+
+
 def aggregate_stream(agg_fn, xs: Array) -> Array:
     """Apply ``agg_fn`` to a stream of ``K`` stacked gradient matrices
     ``xs: (K, n, d)`` inside ONE compiled program (``lax.scan``), returning
@@ -944,4 +1019,8 @@ __all__ = [
     "subset_mean",
     "best_subset_by_score",
     "aggregate_stream",
+    "extremes_fold_update",
+    "trimmed_mean_from_extremes",
+    "krum_scores_from_gram",
+    "multi_krum_from_gram",
 ]

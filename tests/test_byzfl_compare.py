@@ -1,0 +1,193 @@
+"""Coverage for ``benchmarks/byzfl_compare.py`` via a fake ``byzfl``.
+
+The live-comparison harness is an optional-dependency shim (torch-based
+ByzFL is not installed here), so its timing loop, provenance stamping,
+label alignment, per-row error isolation, and clean-skip line are
+exercised with stub ``byzfl``/``torch`` modules injected into
+``sys.modules`` — no network, no torch.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+import types
+
+import numpy as np
+import pytest
+
+BENCH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "byzfl_compare.py",
+)
+RESULTS_MD = os.path.join(os.path.dirname(BENCH), "RESULTS.md")
+
+
+def _load_harness():
+    spec = importlib.util.spec_from_file_location("_byzfl_compare", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_torch():
+    torch = types.ModuleType("torch")
+
+    class Generator:
+        def __init__(self, device="cpu"):
+            self.rng = np.random.default_rng(0)
+
+        def manual_seed(self, seed):
+            self.rng = np.random.default_rng(seed)
+            return self
+
+    def randn(dim, generator=None, dtype=None):
+        rng = generator.rng if generator is not None else np.random.default_rng()
+        return rng.normal(size=dim).astype(np.float32)
+
+    torch.Generator = Generator
+    torch.randn = randn
+    torch.float32 = np.float32
+    return torch
+
+
+class _FakeOp:
+    """Stands in for every ByzFL aggregator/pre-aggregator/attack."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+
+    def __call__(self, grads):
+        return np.mean(np.stack(grads), axis=0)
+
+
+def _fake_byzfl(missing=()):
+    """Module tree matching the harness's import paths; class names in
+    ``missing`` are omitted to exercise per-row error isolation."""
+    byzfl = types.ModuleType("byzfl")
+    aggs_pkg = types.ModuleType("byzfl.aggregators")
+    attacks_pkg = types.ModuleType("byzfl.attacks")
+    leaves = {
+        "byzfl.aggregators.aggregators": [
+            "MultiKrum", "TrMean", "Meamed", "MoNNA", "CAF",
+            "CenteredClipping", "MDA", "SMEA",
+        ],
+        "byzfl.aggregators.preaggregators": [
+            "NNM", "ARC", "Clipping", "Bucketing",
+        ],
+        "byzfl.attacks.attacks": [
+            "ALittleIsEnough", "Gaussian", "Inf",
+            "InnerProductManipulation", "Mimic",
+        ],
+    }
+    mods = {"byzfl": byzfl, "byzfl.aggregators": aggs_pkg,
+            "byzfl.attacks": attacks_pkg}
+    for name, classes in leaves.items():
+        mod = types.ModuleType(name)
+        for cls in classes:
+            if cls not in missing:
+                setattr(mod, cls, _FakeOp)
+        mods[name] = mod
+    byzfl.aggregators = aggs_pkg
+    byzfl.attacks = attacks_pkg
+    aggs_pkg.aggregators = mods["byzfl.aggregators.aggregators"]
+    aggs_pkg.preaggregators = mods["byzfl.aggregators.preaggregators"]
+    attacks_pkg.attacks = mods["byzfl.attacks.attacks"]
+    return mods
+
+
+def test_clean_skip_line_without_byzfl(monkeypatch, tmp_path, capsys):
+    harness = _load_harness()
+    monkeypatch.setattr(harness, "HERE", str(tmp_path))
+    monkeypatch.setitem(sys.modules, "byzfl", None)  # forces ImportError
+    monkeypatch.setattr(sys, "argv", ["byzfl_compare.py"])
+    assert harness.main() == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[0])
+    assert line["status"] == "skipped"
+    assert "byzfl" in line["reason"]
+    assert not (tmp_path / "results").exists()  # nothing written on skip
+
+
+def test_timing_loop_labels_and_provenance(monkeypatch, tmp_path, capsys):
+    harness = _load_harness()
+    monkeypatch.setattr(harness, "HERE", str(tmp_path))
+    for name, mod in _fake_byzfl(missing=("SMEA",)).items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    monkeypatch.setitem(sys.modules, "torch", _fake_torch())
+    monkeypatch.setattr(sys, "argv", ["byzfl_compare.py", "--repeat", "2"])
+    assert harness.main() == 0
+
+    out_path = tmp_path / "results" / "byzfl_local.jsonl"
+    assert out_path.exists()
+    records = [json.loads(ln) for ln in out_path.read_text().splitlines()]
+    by_label = {r["row"]: r for r in records}
+    assert len(records) == len(harness.WORKLOADS)
+
+    for label, module, cls, kwargs, n, dim in harness.WORKLOADS:
+        rec = by_label[label]
+        # provenance stamping: where the number came from and when
+        assert rec["impl"] == f"{module}.{cls}"
+        assert rec["n"] == n and rec["dim"] == dim
+        assert rec["device"] == "cpu"
+        assert "byzfl_compare.py" in rec["provenance"]
+        assert "ts" in rec
+        if cls == "SMEA":
+            assert rec["status"] == "error"  # isolated, not fatal
+            assert "AttributeError" in rec["error"]
+        else:
+            assert rec["status"] == "ok"
+            assert rec["reps"] == 2
+            assert rec["ms"] >= 0.0
+
+    # the stdout stream mirrors the sink, plus the final done line
+    lines = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+    assert lines[-1]["status"] == "done"
+    assert lines[-1]["rows"] == len(harness.WORKLOADS)
+
+
+def test_row_labels_align_with_results_md_grid():
+    """Workload shapes must line up with the RESULTS.md grid rows the
+    ByzFL column annotates (MDA/SMEA intentionally run reduced shapes —
+    ByzFL times out at the grid size; see RESULTS.md)."""
+    harness = _load_harness()
+    results = open(RESULTS_MD).read()
+    reduced = {"mda_18x2048_f6", "smea_12x1024_f3"}
+    for label, _, _, _, n, dim in harness.WORKLOADS:
+        if label in reduced:
+            continue
+        assert f"{n}×{dim:,}" in results, (
+            f"{label}: shape {n}x{dim} has no RESULTS.md grid row"
+        )
+
+
+def test_rows_filter_selects_subset(monkeypatch, tmp_path, capsys):
+    harness = _load_harness()
+    monkeypatch.setattr(harness, "HERE", str(tmp_path))
+    for name, mod in _fake_byzfl().items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    monkeypatch.setitem(sys.modules, "torch", _fake_torch())
+    monkeypatch.setattr(
+        sys, "argv",
+        ["byzfl_compare.py", "--rows", "cwtm_64x65536_f8", "--repeat", "1"],
+    )
+    assert harness.main() == 0
+    records = [
+        json.loads(ln)
+        for ln in (tmp_path / "results" / "byzfl_local.jsonl")
+        .read_text().splitlines()
+    ]
+    assert [r["row"] for r in records] == ["cwtm_64x65536_f8"]
+
+
+def test_time_row_budget_timeout():
+    harness = _load_harness()
+
+    def slow(grads):
+        time.sleep(0.05)
+
+    rec = harness._time_row(slow, [], repeat=3, budget=0.01)
+    assert rec["status"] == "timeout"
+    assert rec["first_call_s"] >= 0.05
+    quick = harness._time_row(lambda g: None, [], repeat=3, budget=5.0)
+    assert quick["status"] == "ok" and quick["reps"] == 3

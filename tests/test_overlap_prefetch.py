@@ -1,0 +1,357 @@
+"""Cross-round prefetch: schedule parity, elastic interaction, P2P.
+
+The overlapped engine chains each node's round-``r`` apply directly into
+its round-``r+1`` compute (PS) / aggregate into next half-step (P2P) —
+per-node program order is the serial schedule's, so training results
+must match bit-for-bit-in-sequence; only cross-node wall-clock
+interleaving changes. Pinned here: result parity, per-node call
+ordering, exact batch accounting under ``run()``, crash isolation with
+elastic policies at prefetch depth 1, and gossip-round parity for the
+overlapped P2P runner.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+from byzpy_tpu.engine.overlap import OverlapConfig
+from byzpy_tpu.engine.parameter_server import (
+    ElasticPolicy,
+    OverlapConfig as PSOverlapConfig,  # re-export check
+    ParameterServer,
+    QuorumLostError,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Node:
+    """Deterministic node that logs its call schedule."""
+
+    def __init__(self, value, d=48):
+        self.value = float(value)
+        self.d = d
+        self.applied = []
+        self.log = []
+
+    async def honest_gradient_for_next_batch(self):
+        self.log.append("compute")
+        await asyncio.sleep(0.001)
+        # gradient depends on applied count, so any schedule deviation
+        # (stale compute before apply) changes the numbers
+        return np.full(
+            self.d, self.value + 0.25 * len(self.applied), np.float32
+        )
+
+    async def apply_server_gradient(self, g):
+        self.log.append("apply")
+        await asyncio.sleep(0.001)
+        self.applied.append(np.asarray(g))
+
+
+class Byz:
+    def __init__(self, d=48):
+        self.d = d
+        self.applied = []
+
+    async def byzantine_gradient_for_next_batch(self, honest):
+        return -3.0 * np.asarray(honest[0])
+
+    async def apply_server_gradient(self, g):
+        self.applied.append(np.asarray(g))
+
+
+def _train(overlap, rounds=4):
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0, 4.0)]
+    byz = [Byz()]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        byzantine_nodes=byz,
+        aggregator=CoordinateWiseTrimmedMean(f=1),
+        overlap=overlap,
+    )
+    run(ps.run(rounds))
+    run(ps.close())
+    return nodes, byz
+
+
+@pytest.mark.parametrize("stream", [False, True])
+def test_prefetch_run_matches_serial_schedule(stream):
+    serial_nodes, serial_byz = _train(None)
+    over_nodes, over_byz = _train(
+        OverlapConfig(stream=stream, prefetch_depth=1)
+    )
+    for a, b in zip(serial_nodes, over_nodes):
+        # identical per-node call sequence => identical batches consumed,
+        # apply strictly before the next compute, no trailing prefetch
+        assert a.log == b.log
+        assert b.log == ["compute", "apply"] * 4
+        assert len(a.applied) == len(b.applied) == 4
+        for x, y in zip(a.applied, b.applied):
+            np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+    for x, y in zip(serial_byz[0].applied, over_byz[0].applied):
+        np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+
+
+def test_round_then_flush_settles_chains():
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=1),
+        overlap=OverlapConfig(prefetch_depth=1),
+    )
+
+    async def scenario():
+        await ps.round()
+        assert ps._pending_honest is not None  # chains in flight
+        await ps.flush()
+        # applies landed; the prefetched gradients stay buffered
+        assert all(len(n.applied) == 1 for n in nodes)
+        assert all(n.log == ["compute", "apply", "compute"] for n in nodes)
+        await ps.round()  # consumes the buffer — no recompute
+        await ps.flush()
+        assert all(
+            n.log == ["compute", "apply", "compute", "apply", "compute"]
+            for n in nodes
+        )
+        await ps.close()
+
+    run(scenario())
+
+
+def test_prefetch_depth_zero_is_serial():
+    nodes = [Node(1.0), Node(2.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        overlap=OverlapConfig(stream=False, prefetch_depth=0),
+    )
+    run(ps.run(2))
+    assert ps._pending_honest is None
+    assert all(n.log == ["compute", "apply"] * 2 for n in nodes)
+
+
+def test_overlap_config_validation():
+    with pytest.raises(ValueError):
+        OverlapConfig(prefetch_depth=-1)
+    assert PSOverlapConfig is OverlapConfig
+
+
+def test_apply_failure_surfaces_on_collection():
+    """Under prefetch a node's apply failure is discovered when its
+    chain is collected — the next round (or flush), one round late."""
+
+    class ApplyFails(Node):
+        async def apply_server_gradient(self, g):
+            raise RuntimeError("disk full")
+
+    nodes = [Node(1.0), Node(2.0), ApplyFails(3.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        overlap=OverlapConfig(prefetch_depth=1),
+    )
+
+    async def scenario():
+        await ps.round()  # dispatches the failing chain, returns fine
+        with pytest.raises(RuntimeError, match="disk full"):
+            await ps.round()
+        await ps.close()
+
+    run(scenario())
+
+
+# -- elastic PS at prefetch depth 1 -----------------------------------------
+
+
+class CrashingNode(Node):
+    def __init__(self, value, fail_from=2, fail_rounds=10**9, **kw):
+        super().__init__(value, **kw)
+        self.fail_from = fail_from
+        self.fail_until = fail_from + fail_rounds
+        self.calls = 0
+
+    async def honest_gradient_for_next_batch(self):
+        self.calls += 1
+        if self.fail_from <= self.calls < self.fail_until:
+            raise ConnectionError("node down")
+        return await super().honest_gradient_for_next_batch()
+
+
+def test_elastic_prefetch_crash_excludes_node_and_rounds_continue():
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0)] + [CrashingNode(50.0)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, readmit_every=0),
+        overlap=OverlapConfig(prefetch_depth=1),
+    )
+    run(ps.run(5))
+    run(ps.close())
+    assert ps.rounds_completed == 5
+    assert "honest:3" in ps.elastic_state.suspects
+    # survivors kept applying every round
+    assert all(len(n.applied) == 5 for n in nodes[:3])
+
+
+def test_elastic_prefetch_recovery_readmits_node():
+    nodes = [Node(v) for v in (1.0, 2.0)] + [
+        CrashingNode(9.0, fail_from=1, fail_rounds=2)
+    ]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, readmit_every=1),
+        overlap=OverlapConfig(prefetch_depth=1),
+    )
+    run(ps.run(6))
+    run(ps.close())
+    assert ps.rounds_completed == 6
+    assert "honest:2" not in ps.elastic_state.suspects
+    events = [kind for _, nid, kind in ps.elastic_state.events
+              if nid == "honest:2"]
+    assert "readmitted" in events
+
+
+def test_elastic_prefetch_quorum_lost_raises():
+    nodes = [Node(1.0)] + [CrashingNode(9.0, fail_from=1) for _ in range(2)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, readmit_every=0),
+        overlap=OverlapConfig(prefetch_depth=1),
+    )
+    with pytest.raises(QuorumLostError):
+        run(ps.run(3))
+    run(ps.close())
+
+
+# -- P2P overlapped gossip ---------------------------------------------------
+
+
+def _p2p(overlap, rounds=4, n=4, byz=1):
+    import jax.numpy as jnp
+
+    from byzpy_tpu.engine.peer_to_peer.nodes import (
+        ByzantineP2PWorker,
+        HonestP2PWorker,
+    )
+    from byzpy_tpu.engine.peer_to_peer.runner import DecentralizedPeerToPeer
+    from byzpy_tpu.engine.peer_to_peer.topology import Topology
+
+    class W(HonestP2PWorker):
+        def __init__(self, v, d=24):
+            self.theta = jnp.full((d,), float(v))
+            self.halves = 0
+
+        def half_step(self, lr):
+            self.halves += 1
+            self.theta = self.theta * (1.0 - lr)
+            return self.theta
+
+        def parameters(self):
+            return self.theta
+
+        def apply_aggregate(self, v):
+            self.theta = jnp.asarray(v)
+
+    class B(ByzantineP2PWorker):
+        def malicious_vector(self, honest):
+            return -5.0 * honest[0] if honest else jnp.zeros(24)
+
+    topo = Topology(n + byz)
+    for a in range(n + byz):
+        for b in range(n + byz):
+            if a != b:
+                topo.add_edge(a, b)
+
+    async def scenario():
+        p2p = DecentralizedPeerToPeer(
+            [W(v + 1) for v in range(n)],
+            [B() for _ in range(byz)],
+            aggregator=CoordinateWiseTrimmedMean(f=1),
+            topology=topo,
+            overlap=overlap,
+            gossip_timeout=10.0,
+        )
+        async with p2p:
+            await p2p.run_async(rounds)
+            workers = [p2p._workers[i] for i in p2p.honest_indices]
+            return (
+                [np.asarray(w.theta) for w in workers],
+                [w.halves for w in workers],
+                p2p.rounds_completed,
+            )
+
+    return run(scenario())
+
+
+def test_p2p_overlapped_run_matches_serial():
+    thetas_s, halves_s, _ = _p2p(None)
+    thetas_o, halves_o, completed = _p2p(
+        OverlapConfig(stream=True, prefetch_depth=1)
+    )
+    assert completed == 4
+    assert halves_s == halves_o  # final round did not prefetch an extra half
+    for a, b in zip(thetas_s, thetas_o):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_p2p_overlap_stream_only_matches_serial():
+    thetas_s, _, _ = _p2p(None)
+    thetas_o, _, _ = _p2p(OverlapConfig(stream=True, prefetch_depth=0))
+    for a, b in zip(thetas_s, thetas_o):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_p2p_overlapped_elastic_removal_mid_training():
+    """A peer excised between overlapped rounds (its prefetched
+    half-step already in flight) must not wedge or corrupt later
+    rounds."""
+    import jax.numpy as jnp
+
+    from byzpy_tpu.engine.peer_to_peer.nodes import HonestP2PWorker
+    from byzpy_tpu.engine.peer_to_peer.runner import DecentralizedPeerToPeer
+    from byzpy_tpu.engine.peer_to_peer.topology import Topology
+
+    class W(HonestP2PWorker):
+        def __init__(self, v, d=16):
+            self.theta = jnp.full((d,), float(v))
+
+        def half_step(self, lr):
+            self.theta = self.theta * (1.0 - lr)
+            return self.theta
+
+        def parameters(self):
+            return self.theta
+
+        def apply_aggregate(self, v):
+            self.theta = jnp.asarray(v)
+
+    n = 4
+    topo = Topology(n)
+    for a in range(n):
+        for b in range(n):
+            if a != b:
+                topo.add_edge(a, b)
+
+    async def scenario():
+        p2p = DecentralizedPeerToPeer(
+            [W(v + 1) for v in range(n)], [],
+            aggregator=CoordinateWiseTrimmedMean(f=1),
+            topology=topo,
+            overlap=OverlapConfig(stream=True, prefetch_depth=1),
+            gossip_timeout=5.0,
+        )
+        async with p2p:
+            await p2p.run_async(2)
+            await p2p.remove_node(3)
+            await p2p.run_async(2)
+            assert p2p.rounds_completed == 4
+            assert sorted(p2p.nodes) == [0, 1, 2]
+
+    run(scenario())

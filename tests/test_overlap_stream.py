@@ -1,0 +1,295 @@
+"""Arrival-order streaming aggregation: permutation parity + gating.
+
+The overlapped round engine folds gradients into the aggregator the
+moment they arrive (``Aggregator.fold``/``fold_finalize``). Semantics
+are pinned here: for every streaming-capable aggregator, the same
+gradient set fed in several random arrival orders must reproduce the
+barrier-path ``aggregate`` result — bit-identical for the slot-buffer
+default (finalize reassembles canonical order and runs the identical
+matrix program), to float tolerance for the genuinely incremental folds
+(running sums / Gram rows accumulate in arrival order; see the fold
+docstrings).
+"""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import (
+    CAF,
+    CenteredClipping,
+    ComparativeGradientElimination,
+    CoordinateWiseMedian,
+    CoordinateWiseTrimmedMean,
+    GeometricMedian,
+    Krum,
+    MeanOfMedians,
+    MoNNA,
+    MultiKrum,
+)
+from byzpy_tpu.aggregators.base import Aggregator
+from byzpy_tpu.engine.overlap import OverlapConfig, gather_arrival_order
+from byzpy_tpu.engine.parameter_server import ParameterServer
+from byzpy_tpu.pre_aggregators import NearestNeighborMixing
+
+N, D = 9, 193
+ORDERS = 3
+
+# (aggregator, bit_identical): slot-buffer folds replay the exact barrier
+# program; incremental folds (documented tolerance) accumulate in
+# arrival order or finalize eagerly where the barrier path is jitted.
+CASES = [
+    (lambda: CoordinateWiseMedian(), True),
+    (lambda: MeanOfMedians(f=2), True),
+    (lambda: MoNNA(f=2), True),
+    (lambda: GeometricMedian(), True),
+    (lambda: CenteredClipping(c_tau=1.0), True),
+    (lambda: CAF(f=2), True),
+    (lambda: Krum(f=2), False),
+    (lambda: CoordinateWiseTrimmedMean(f=2), False),
+    (lambda: MultiKrum(f=2, q=3), False),
+    (lambda: ComparativeGradientElimination(f=2), False),
+]
+
+
+def _grads(seed=0, n=N, d=D):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=d).astype(np.float32) for _ in range(n)]
+
+
+@pytest.mark.parametrize(
+    "make_agg,bit_identical", CASES,
+    ids=[c[0]().name for c in CASES],
+)
+def test_fold_matches_barrier_for_any_arrival_order(make_agg, bit_identical):
+    agg = make_agg()
+    assert agg.supports_streaming
+    grads = _grads()
+    ref = np.asarray(agg.aggregate(list(grads)))
+    for trial in range(ORDERS):
+        order = list(range(N))
+        random.Random(trial).shuffle(order)
+        state = agg.fold_init(N)
+        for i in order:
+            agg.fold(state, i, grads[i])
+        out = np.asarray(agg.fold_finalize(state))
+        if bit_identical:
+            assert np.array_equal(out, ref), (
+                f"{agg.name}: order {order} diverged (max "
+                f"{np.abs(out - ref).max()})"
+            )
+        else:
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fold_handles_pytree_gradients():
+    agg = CoordinateWiseTrimmedMean(f=1)
+    rng = np.random.default_rng(3)
+    grads = [
+        {"w": rng.normal(size=(4, 5)).astype(np.float32),
+         "b": rng.normal(size=7).astype(np.float32)}
+        for _ in range(5)
+    ]
+    ref = agg.aggregate(list(grads))
+    state = agg.fold_init(5)
+    for i in (3, 0, 4, 1, 2):
+        agg.fold(state, i, grads[i])
+    out = agg.fold_finalize(state)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(ref["w"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["b"]), np.asarray(ref["b"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_trimmed_mean_nonfinite_falls_back_to_exact_path():
+    """An adversarial NaN/inf gradient must not corrupt the incremental
+    extreme buffers: finalize detects it and reruns the barrier-identical
+    sorted path (bit-for-bit, including NaN propagation)."""
+    agg = CoordinateWiseTrimmedMean(f=1)
+    grads = _grads(seed=1, n=5)
+    grads[2] = grads[2].copy()
+    grads[2][7] = np.inf
+    grads[3] = grads[3].copy()
+    grads[3][11] = np.nan
+    ref = np.asarray(agg.aggregate(list(grads)))
+    state = agg.fold_init(5)
+    for i in (4, 2, 0, 3, 1):
+        agg.fold(state, i, grads[i])
+    out = np.asarray(agg.fold_finalize(state))
+    np.testing.assert_array_equal(
+        np.nan_to_num(out, nan=1.25), np.nan_to_num(ref, nan=1.25)
+    )
+
+
+def test_fold_slot_reuse_and_bounds_rejected():
+    agg = CoordinateWiseMedian()
+    grads = _grads(n=3)
+    state = agg.fold_init(3)
+    agg.fold(state, 0, grads[0])
+    with pytest.raises(ValueError, match="folded twice"):
+        agg.fold(state, 0, grads[1])
+    with pytest.raises(IndexError):
+        agg.fold(state, 3, grads[1])
+    with pytest.raises(ValueError):
+        agg.fold_init(0)
+
+
+class _Node:
+    def __init__(self, g):
+        self.g = g
+        self.applied = []
+
+    async def honest_gradient_for_next_batch(self):
+        return self.g
+
+    def apply_server_gradient(self, g):
+        self.applied.append(np.asarray(g))
+
+
+class _BarrierOnly(Aggregator):
+    """Aggregator that declines streaming: the PS must keep the barrier."""
+
+    name = "barrier-only"
+    supports_streaming = False
+
+    def __init__(self):
+        self.fold_calls = 0
+
+    def fold(self, state, index, gradient):  # pragma: no cover - must not run
+        self.fold_calls += 1
+        return super().fold(state, index, gradient)
+
+    def _aggregate_matrix(self, x):
+        import jax.numpy as jnp
+
+        return jnp.mean(x, axis=0)
+
+
+def test_ps_streaming_round_matches_barrier_round():
+    grads = _grads(n=6)
+    out = {}
+    for key, overlap in (
+        ("barrier", None),
+        ("stream", OverlapConfig(stream=True, prefetch_depth=0)),
+    ):
+        nodes = [_Node(g) for g in grads]
+        ps = ParameterServer(
+            honest_nodes=nodes,
+            aggregator=CoordinateWiseTrimmedMean(f=1),
+            overlap=overlap,
+        )
+        out[key] = np.asarray(asyncio.run(ps.round()))
+    np.testing.assert_allclose(out["stream"], out["barrier"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ps_respects_supports_streaming_flag():
+    agg = _BarrierOnly()
+    nodes = [_Node(g) for g in _grads(n=4)]
+    ps = ParameterServer(
+        honest_nodes=nodes, aggregator=agg,
+        overlap=OverlapConfig(stream=True, prefetch_depth=0),
+    )
+    asyncio.run(ps.round())
+    assert agg.fold_calls == 0
+    assert ps.last_overlap_stats.mode == "barrier"
+    assert len(ps.last_overlap_stats.ingest_lags_s) == 4
+
+
+def test_ps_pre_aggregator_keeps_barrier_path():
+    nodes = [_Node(g) for g in _grads(n=8)]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=MultiKrum(f=2, q=3),
+        pre_aggregator=NearestNeighborMixing(f=2),
+        overlap=OverlapConfig(stream=True, prefetch_depth=0),
+    )
+    asyncio.run(ps.round())
+    assert ps.last_overlap_stats.mode == "barrier"
+    ref_nodes = [_Node(g) for g in _grads(n=8)]
+    ref_ps = ParameterServer(
+        honest_nodes=ref_nodes,
+        aggregator=MultiKrum(f=2, q=3),
+        pre_aggregator=NearestNeighborMixing(f=2),
+    )
+    asyncio.run(ref_ps.round())
+    np.testing.assert_allclose(
+        nodes[0].applied[0], ref_nodes[0].applied[0], rtol=1e-6
+    )
+
+
+def test_gather_arrival_order_semantics():
+    """Completion order drives ingestion; results return in input order;
+    errors wait for all siblings and surface by input order."""
+
+    async def scenario():
+        seen = []
+
+        async def item(i, delay):
+            await asyncio.sleep(delay)
+            return i
+
+        results = await gather_arrival_order(
+            [item(0, 0.03), item(1, 0.0), item(2, 0.015)],
+            on_item=lambda i, v: seen.append(i),
+        )
+        assert results == [0, 1, 2]
+        assert seen == [1, 2, 0]
+
+        done = []
+
+        async def ok(i, delay):
+            await asyncio.sleep(delay)
+            done.append(i)
+            return i
+
+        async def boom(delay, exc):
+            await asyncio.sleep(delay)
+            raise exc
+
+        with pytest.raises(KeyError):
+            # ValueError lands first in time; KeyError is first in input
+            # order — and the slow sibling must still have settled
+            await gather_arrival_order(
+                [boom(0.02, KeyError("a")), boom(0.0, ValueError("b")),
+                 ok(3, 0.04)]
+            )
+        assert done == [3]
+
+        # an on_item (fold) exception counts as that item's failure and
+        # still waits for every sibling to settle
+        done.clear()
+
+        def folder(i, v):
+            if i == 0:
+                raise ValueError("bad gradient shape")
+
+        with pytest.raises(ValueError, match="bad gradient shape"):
+            await gather_arrival_order(
+                [ok(0, 0.0), ok(1, 0.03)], on_item=folder
+            )
+        assert done == [0, 1]  # the slow sibling ran to completion
+
+        # cancelling the gather cancels the in-flight awaitables
+        started, cancelled = [], []
+
+        async def cancellable(i):
+            started.append(i)
+            try:
+                await asyncio.sleep(30.0)
+            except asyncio.CancelledError:
+                cancelled.append(i)
+                raise
+
+        gather_task = asyncio.ensure_future(
+            gather_arrival_order([cancellable(0), cancellable(1)])
+        )
+        await asyncio.sleep(0.01)
+        gather_task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await gather_task
+        assert started == [0, 1] and sorted(cancelled) == [0, 1]
+
+    asyncio.run(scenario())
