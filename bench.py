@@ -72,9 +72,13 @@ def main() -> None:
                               "post phase-parked output maps)",
             },
             "cpu_measured_this_round": {
-                "robust_learning_mean_vs_trimmed_under_signflip": [0.087, 0.915],
-                "provenance": "benchmarks/ROBUST_LEARNING.md + BREAKDOWN.md "
-                              "(real-data accuracy studies, CPU mesh)",
+                "meamed_64x65536_cpu_speedup": 2.4,
+                "multi_krum_80x65536_cpu_speedup": 1.3,
+                "provenance": "benchmarks/results/hotpath_cpu.jsonl + "
+                              "grid_cpu.jsonl + roofline_cpu.jsonl "
+                              "(int32-key sort + conditional-mask "
+                              "selection, JAX_PLATFORMS=cpu; see "
+                              "benchmarks/RESULTS.md §CPU grid)",
             },
             "second_metric": {
                 "metric": "ps_mnist_trimmed_mean_steps_per_sec",
@@ -126,6 +130,30 @@ def main() -> None:
     # metric (BENCH_r01.json) and BASELINE.md's per-call numbers.
     t_single = timed(jax.jit(partial(robust.multi_krum, f=8, q=12)), xs_1m[0])
 
+    # Achieved-vs-roofline fraction for the headline (the ROADMAP "as
+    # fast as the hardware allows" scorecard; full per-aggregator grid:
+    # `python -m byzpy_tpu.profiling`).
+    roofline = None
+    try:
+        from byzpy_tpu.profiling import detect_hardware, roofline_s
+
+        # calibrate on CPU (same policy as profiler.profile_call): the
+        # static cpu-default spec would score against invented limits
+        spec = detect_hardware(calibrate=jax.default_backend() == "cpu")
+        n, d = 64, 1 << 20
+        floor_s = roofline_s(
+            2.0 * n * n * d,  # the Gram contraction's FLOPs
+            n * d * 4 + d * 4,  # read the round once, write the aggregate
+            dtype="float32", spec=spec,
+        )
+        roofline = {
+            "achieved_fraction": round(floor_s / t_krum_1m, 4),
+            "roofline_ms_per_round": round(floor_s * 1e3, 4),
+            "hardware": spec.name,
+        }
+    except Exception:  # noqa: BLE001 — the headline must not die on this
+        pass
+
     print(json.dumps({
         "metric": "multi_krum_64x1M_stream_grads_per_sec",
         "value": round(value, 2),
@@ -135,6 +163,7 @@ def main() -> None:
         "stream_kernel": stream_kernel,
         "bf16_stream_grads_per_sec": round(64 / t_bf16, 2),
         "single_dispatch_grads_per_sec": round(64 / t_single, 2),
+        "roofline": roofline,
         "second_metric": _ps_steps_metric(),
     }))
 

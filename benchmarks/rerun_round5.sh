@@ -1,7 +1,10 @@
 #!/bin/bash
-# Round-5 on-chip recovery bundle: run EVERYTHING queued behind the
-# tunnel outage, each row in a fresh process (tunnel backpressure — see
+# On-chip recovery bundle: run EVERYTHING queued behind the tunnel
+# outage, each row in a fresh process (tunnel backpressure — see
 # ROUND4_NOTES gotchas), results to benchmarks/results/round5_onchip.jsonl.
+# Extended for ISSUE 2 (roofline + autotune): steps 4-6 produce the
+# on-chip roofline grid, the tuned tile cache, and the refreshed
+# benchmark grid the CPU runs of this round stand in for.
 set -u
 cd "$(dirname "$0")/.."
 OUT=benchmarks/results/round5_onchip.jsonl
@@ -13,9 +16,26 @@ if ! probe; then echo "tunnel down, aborting bundle"; exit 1; fi
 echo "# bundle start $(date -u)" >> "$OUT"
 # 1. round-4 leftovers: 64x1M sort-kernel parity, roofline cells, cw_median refresh
 timeout 3000 python benchmarks/rerun_round4.py >> "$OUT" 2>/tmp/r5_rerun4.err
-# 2. MeaMed gate tune (fresh process)
+# 2. MeaMed gate tune (fresh process): prints the measured crossover —
+#    commit it to pallas_kernels.MEAMED_MIN_DIM (currently the
+#    CPU-derived 64k default)
 timeout 1800 python benchmarks/meamed_gate_tune.py >> "$OUT" 2>/tmp/r5_meamed.err
 # 3. headline bench (fresh process — exactly what the driver will run)
 timeout 1800 python bench.py >> "$OUT" 2>/tmp/r5_bench.err
+# 4. Pallas block-shape autotune at the grid + north-star shapes; winners
+#    persist to the on-disk tile cache every dispatch consults
+#    (tile cache committed for provenance)
+timeout 2400 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
+  python -m byzpy_tpu.profiling --autotune --force \
+  >> "$OUT" 2>/tmp/r5_autotune.err
+# 5. achieved-vs-roofline grid for every ops.robust aggregator at the
+#    BASELINE.md shapes (fresh process so the tuned tiles apply)
+timeout 2400 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
+  python -m byzpy_tpu.profiling --out benchmarks/results/roofline_tpu.jsonl \
+  >> "$OUT" 2>/tmp/r5_roofline.err
+# 6. full measured grid refresh (fresh process, tuned tiles on)
+timeout 3600 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
+  python benchmarks/full_grid.py > benchmarks/results/grid_tpu.jsonl \
+  2>/tmp/r5_grid.err
 echo "# bundle end $(date -u)" >> "$OUT"
-echo "bundle complete: $OUT"
+echo "bundle complete: $OUT (+ roofline_tpu.jsonl, autotune_tpu.json, grid_tpu.jsonl)"

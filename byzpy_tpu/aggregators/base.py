@@ -15,14 +15,26 @@ actor pools (the reference's shm-chunk pattern, minus the shm).
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
+from functools import partial
 from typing import Any, Callable, Mapping, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.flatten_util import ravel_pytree
 
 from ..engine.graph.operator import OpContext, Operator
 from ..utils import placement
 from ..utils.trees import stack_gradients
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _slot_insert(buffer: jnp.ndarray, row: jnp.ndarray, index) -> jnp.ndarray:
+    """Park one flattened gradient in its canonical slot of the ``(n, d)``
+    ingest buffer, IN PLACE: the buffer is donated, so XLA reuses the
+    allocation instead of copying the whole matrix per arrival. This is
+    what makes finalize's "stack" free — the matrix already exists."""
+    return lax.dynamic_update_slice(buffer, row[None, :], (index, 0))
 
 
 def ravel_gradient(gradient: Any) -> tuple:
@@ -38,6 +50,12 @@ def ravel_gradient(gradient: Any) -> tuple:
     return row, unravel
 
 
+#: Marker stored in ``SlotFoldState.rows`` for a slot whose gradient
+#: lives in the donated ingest buffer (the row reference itself is
+#: dropped so fold-state memory stays ~1x the matrix, not 2x).
+_STAGED = object()
+
+
 class SlotFoldState:
     """Default streaming-fold state: an arrival-order ingestion buffer.
 
@@ -46,12 +64,22 @@ class SlotFoldState:
     filled slots *in slot order* and runs the normal matrix aggregate.
     Because the stacked matrix is identical to the barrier path's —
     same per-row flatten, same order — the result is bit-identical for
-    every aggregator, regardless of arrival order. The overlap win is
-    that the per-gradient host work (pytree ravel, dtype cast, host/
-    device placement) happens inside the straggler window.
+    every aggregator, regardless of arrival order.
+
+    Ingestion is donated: every arrival lands in a preallocated
+    ``(n, d)`` device buffer through an in-place dynamic-update-slice
+    (:func:`_slot_insert`) and the per-row reference is dropped
+    (``rows`` keeps a :data:`_STAGED` marker), so the per-gradient host
+    work (pytree ravel, dtype cast, placement) AND the matrix assembly
+    bytes all happen inside the straggler window at ~1x the matrix's
+    memory — a full round's finalize reads the already-built matrix
+    with zero copies where the barrier path pays an n·d stack after
+    the last straggler. A mixed-dtype round (rare) falls back to real
+    row references + a finalize stack, rebuilding the already-staged
+    rows from the buffer.
     """
 
-    __slots__ = ("n", "rows", "unravel", "dim", "filled")
+    __slots__ = ("n", "rows", "unravel", "dim", "filled", "buffer")
 
     def __init__(self, n: int) -> None:
         # the one capacity guard for every fold state (the incremental
@@ -63,6 +91,9 @@ class SlotFoldState:
         self.unravel: Optional[Callable[[jnp.ndarray], Any]] = None
         self.dim: Optional[int] = None
         self.filled = 0
+        #: donated (n, d) ingest buffer; None until the first row, or
+        #: permanently None after a dtype mismatch (stack fallback)
+        self.buffer: Optional[jnp.ndarray] = None
 
     def insert(self, index: int, gradient: Any) -> jnp.ndarray:
         """Flatten ``gradient`` into slot ``index``; returns the row."""
@@ -79,16 +110,50 @@ class SlotFoldState:
                 f"all gradients must flatten to the same length "
                 f"(got {row.shape[0]} != {self.dim})"
             )
-        self.rows[index] = row
+        with placement.on(placement.compute_device(row)):
+            if self.filled == 0:
+                self.buffer = jnp.zeros((self.n, self.dim), row.dtype)
+            if self.buffer is not None and row.dtype == self.buffer.dtype:
+                self.buffer = _slot_insert(self.buffer, row, index)
+                self.rows[index] = _STAGED
+            else:
+                if self.buffer is not None:
+                    # mixed dtypes: rebuild real references for the
+                    # already-staged slots (buffer rows ARE the exact
+                    # values), then stack at finalize
+                    for i, r in enumerate(self.rows):
+                        if r is _STAGED:
+                            self.rows[i] = self.buffer[i]
+                    self.buffer = None
+                self.rows[index] = row
         self.filled += 1
         return row
 
+    def placement_source(self) -> Any:
+        """The value placement decisions should inspect: the ingest
+        buffer when staging is active, else the held rows."""
+        return self.buffer if self.buffer is not None else self.rows
+
     def stacked(self) -> tuple:
-        """``(matrix, unravel)`` over the filled slots, in slot order."""
-        rows = [r for r in self.rows if r is not None]
-        if not rows:
+        """``(matrix, unravel)`` over the filled slots, in slot order.
+        A complete round returns the donated ingest buffer directly
+        (bit-identical to the stack — the buffer holds the exact rows);
+        partial rounds gather the filled slots from it (same values);
+        the mixed-dtype fallback stacks the held rows."""
+        if self.filled == 0:
             raise ValueError("fold_finalize before any gradient was folded")
-        return jnp.stack(rows, axis=0), self.unravel
+        if self.buffer is not None:
+            if self.filled == self.n:
+                return self.buffer, self.unravel
+            idx = jnp.asarray(
+                [i for i, r in enumerate(self.rows) if r is not None],
+                jnp.int32,
+            )
+            return self.buffer[idx], self.unravel
+        return (
+            jnp.stack([r for r in self.rows if r is not None], axis=0),
+            self.unravel,
+        )
 
 
 class Aggregator(Operator, ABC):
@@ -179,7 +244,7 @@ class Aggregator(Operator, ABC):
         ``_aggregate_matrix`` — bit-identical to ``aggregate`` on the
         same gradients in slot order, for any arrival order.
         """
-        with placement.on(placement.compute_device(state.rows)):
+        with placement.on(placement.compute_device(state.placement_source())):
             matrix, unravel = state.stacked()
             self.validate_n(matrix.shape[0])
             return unravel(self._aggregate_matrix(matrix))

@@ -76,7 +76,13 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
         row = state.slots.insert(index, gradient)
         f = self.f
         with placement.on(placement.compute_device(row)):
-            state.total = row if state.total is None else state.total + row
+            if state.total is None:
+                # a COPY, not `row` itself: the donated add below deletes
+                # its first argument, and `row` is shared with the slot
+                # buffer the exact fallback reads
+                state.total = jnp.array(row, copy=True)
+            else:
+                state.total = robust.fold_add_donated(state.total, row)
             bad = ~jnp.all(jnp.isfinite(row))
             state.nonfinite = (
                 bad if state.nonfinite is None else state.nonfinite | bad
@@ -86,10 +92,10 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
                     d = row.shape[0]
                     state.low = jnp.full((f, d), jnp.inf, row.dtype)
                     state.high = jnp.full((f, d), -jnp.inf, row.dtype)
-                state.low = robust.extremes_fold_update(
+                state.low = robust.extremes_fold_update_donated(
                     state.low, row, largest=False
                 )
-                state.high = robust.extremes_fold_update(
+                state.high = robust.extremes_fold_update_donated(
                     state.high, row, largest=True
                 )
 
@@ -100,7 +106,9 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
             # exact sorted path on the kept rows (matches the barrier's
             # NaN-propagation / inf-trimming semantics bit for bit)
             return Aggregator.fold_finalize(self, state.slots)
-        with placement.on(placement.compute_device(state.slots.rows)):
+        with placement.on(
+            placement.compute_device(state.slots.placement_source())
+        ):
             vec = robust.trimmed_mean_from_extremes(
                 state.total, state.low, state.high, n, f=self.f
             )

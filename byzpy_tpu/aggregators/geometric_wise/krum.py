@@ -13,33 +13,44 @@ reference's subtask layout (``krum.py:371-475``) without the shm handles.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 import jax.numpy as jnp
 
 from ...ops import robust
 from ...utils import placement
-from ..base import Aggregator, SlotFoldState
+from ..base import Aggregator, ravel_gradient
 from ..chunked import RowScoredAggregator
 
 
 class _GramFoldState:
     """Incremental Gram state for streaming Multi-Krum: each arriving
-    gradient contributes its dot products against the rows already in
-    hand (O(k·d) work on arrival ``k``), so the O(n²·d) Gram — the
-    dominant cost of Krum scoring — is complete the moment the last
-    straggler lands. Finalize assembles the ``(n, n)`` Gram in canonical
-    slot order (selection tie rules see the same row indices as the
-    barrier path) and runs score + masked-mean selection
-    (``ops.robust.multi_krum_from_gram``)."""
+    gradient lands in a donated ``(n, d)`` staging buffer and
+    contributes its Gram row/column through ONE donated matvec dispatch
+    (``ops.robust.gram_fold_update`` — the old design paid k separate
+    einsum dispatches on arrival k, O(n²) host dispatches per round,
+    plus a full-matrix copy per insert and an O(n)-step Gram assembly
+    at the barrier). The O(n²·d) Gram — the dominant cost of Krum
+    scoring — is complete the moment the last straggler lands, indexed
+    in canonical slot order (selection tie rules see the same row
+    indices as the barrier path). Finalize runs score + selection
+    straight from the staged matrix and Gram — on TPU at large ``d``
+    as ONE fused Pallas pass
+    (``pallas_kernels.selection_mean_from_gram_pallas``)."""
 
-    __slots__ = ("slots", "arrival", "dots")
+    __slots__ = ("n", "buffer", "gram", "present", "unravel", "dim", "filled")
 
     def __init__(self, n: int) -> None:
-        self.slots = SlotFoldState(n)
-        self.arrival: list = []  # slot indices in arrival order
-        self.dots: list = []  # k-th entry: (k+1,) dots vs arrivals 0..k
+        if n <= 0:
+            raise ValueError(f"fold_init needs n >= 1 (got {n})")
+        self.n = n
+        self.buffer: Optional[jnp.ndarray] = None  # (n, d) staged rows
+        self.gram: Optional[jnp.ndarray] = None  # (n, n) accumulator
+        self.present = [False] * n
+        self.unravel = None
+        self.dim: Optional[int] = None
+        self.filled = 0
 
 
 def _krum_score_rows(host: np.ndarray, start: int, end: int, *, f: int) -> jnp.ndarray:
@@ -103,44 +114,66 @@ class MultiKrum(RowScoredAggregator, Aggregator):
         return _GramFoldState(n)
 
     def fold(self, state: Any, index: int, gradient: Any) -> None:
-        row = state.slots.insert(index, gradient)
+        if not 0 <= index < state.n:
+            raise IndexError(f"slot {index} outside [0, {state.n})")
+        if state.present[index]:
+            raise ValueError(f"slot {index} folded twice")
+        row, unravel = ravel_gradient(gradient)
+        if state.dim is None:
+            state.dim = int(row.shape[0])
+            state.unravel = unravel
+        elif int(row.shape[0]) != state.dim:
+            raise ValueError(
+                f"all gradients must flatten to the same length "
+                f"(got {row.shape[0]} != {state.dim})"
+            )
         with placement.on(placement.compute_device(row)):
-            acc = (
-                jnp.float32
-                if row.dtype in (jnp.bfloat16, jnp.float16)
-                else row.dtype
-            )
-            dots = [
-                jnp.einsum(
-                    "d,d->", state.slots.rows[j], row,
-                    preferred_element_type=acc,
+            if state.buffer is None:
+                acc = (
+                    jnp.float32
+                    if row.dtype in (jnp.bfloat16, jnp.float16)
+                    else row.dtype
                 )
-                for j in state.arrival
-            ]
-            dots.append(
-                jnp.einsum("d,d->", row, row, preferred_element_type=acc)
+                state.buffer = jnp.zeros((state.n, state.dim), row.dtype)
+                state.gram = jnp.zeros((state.n, state.n), acc)
+            elif row.dtype != state.buffer.dtype:
+                # mixed dtypes in one round: promote the staged state the
+                # way jnp.stack would promote the barrier matrix (an
+                # exact upcast of everything staged so far; the donated
+                # update below would otherwise silently DOWNCAST this
+                # row to the first arrival's dtype)
+                promo = jnp.promote_types(state.buffer.dtype, row.dtype)
+                acc = (
+                    jnp.float32
+                    if promo in (jnp.bfloat16, jnp.float16)
+                    else promo
+                )
+                state.buffer = state.buffer.astype(promo)
+                state.gram = state.gram.astype(acc)
+            state.buffer, state.gram = robust.gram_fold_update(
+                state.buffer, state.gram, row, index
             )
-            state.dots.append(jnp.stack(dots).astype(acc))
-        state.arrival.append(index)
+        state.present[index] = True
+        state.filled += 1
 
     def fold_finalize(self, state: Any) -> Any:
-        m = len(state.arrival)
+        m = state.filled
         self.validate_n(m)
-        # arrival rank of each canonical (slot-sorted) row
-        rank = {slot: k for k, slot in enumerate(state.arrival)}
-        perm = np.asarray(
-            [rank[s] for s in sorted(state.arrival)], dtype=np.int32
-        )
-        with placement.on(placement.compute_device(state.slots.rows)):
-            matrix, unravel = state.slots.stacked()
-            acc = state.dots[0].dtype if state.dots else matrix.dtype
-            gram = jnp.zeros((m, m), acc)
-            for k, dvec in enumerate(state.dots):
-                gram = gram.at[k, : k + 1].set(dvec)
-            # mirror the lower triangle (diagonal already in place)
-            gram = gram + jnp.tril(gram, -1).T
-            gram = gram[perm][:, perm]
-            return unravel(
+        if state.buffer is None:
+            raise ValueError("fold_finalize before any gradient was folded")
+        with placement.on(placement.compute_device(state.buffer)):
+            if m == state.n:
+                matrix, gram = state.buffer, state.gram
+            else:
+                # elastic partial round: gather the arrived subset (the
+                # Gram's absent rows/columns were never written past
+                # their zero init)
+                idx = jnp.asarray(
+                    np.flatnonzero(np.asarray(state.present)), jnp.int32
+                )
+                matrix = state.buffer[idx]
+                gram = state.gram[idx][:, idx]
+            return state.unravel(
                 robust.multi_krum_from_gram(matrix, gram, f=self.f, q=self.q)
             )
 

@@ -76,7 +76,9 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
     def fold_finalize(self, state: Any) -> Any:
         m = state.slots.filled
         self.validate_n(m)
-        with placement.on(placement.compute_device(state.slots.rows)):
+        with placement.on(
+            placement.compute_device(state.slots.placement_source())
+        ):
             matrix, unravel = state.slots.stacked()
             scores = jnp.stack(
                 [state.norms[s] for s in sorted(state.norms)]

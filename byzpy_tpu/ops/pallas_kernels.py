@@ -52,6 +52,51 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _tuned_tile(family: str, n: int, d: int) -> Optional[int]:
+    """Autotuned tile for ``(family, shape)``, or ``None`` for "use the
+    heuristic". Resolution order: ``BYZPY_TPU_TILE_<FAMILY>`` env
+    override, then the on-disk autotune cache
+    (``byzpy_tpu.profiling.tilecache``; invalid/corrupt entries are
+    ignored there). Every caller runs this in the kernel's Python
+    wrapper — BEFORE the jitted inner function traces — so flipping the
+    env var or re-running a sweep changes the very next dispatch (tile
+    is a static jit argument, a new value retraces)."""
+    import os
+
+    env = os.environ.get(f"BYZPY_TPU_TILE_{family.upper()}")
+    if env:
+        try:
+            tile = int(env)
+        except ValueError:
+            tile = None
+        if tile is not None and tile > 0 and tile % _LANES == 0:
+            return tile
+    try:
+        from ..profiling import tilecache
+
+        return tilecache.lookup(
+            family, platform=jax.default_backend(), n=n, d=d
+        )
+    except Exception:  # noqa: BLE001 — the cache can never break dispatch
+        return None
+
+
+def matmul_input_dtype(x_dtype) -> Optional[str]:
+    """Resolve the ``BYZPY_TPU_MATMUL_DTYPE`` policy for a contraction
+    operand: returns ``"bf16"`` when f32 inputs should be cast to
+    bfloat16 before the MXU dot (f32 accumulation stays — the EQuARX-
+    style low-precision Gram path, halving the dominant HBM read), else
+    ``None`` (exact f32 multiplication, the default). Read per call in
+    the dispatch wrappers, before trace, so the policy participates in
+    the jit key."""
+    import os
+
+    flag = os.environ.get("BYZPY_TPU_MATMUL_DTYPE", "auto")
+    if flag == "bf16" and x_dtype == jnp.float32:
+        return "bf16"
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Column sorting network (small n, huge d)
 # ---------------------------------------------------------------------------
@@ -127,14 +172,20 @@ def _sort_columns_kernel(x_ref, out_ref, *, n_rows: int, is_float: bool):
     out_ref[:] = _keys_to_float(keys, block.dtype) if is_float else keys
 
 
-def _auto_tile(n_pad: int) -> int:
-    """Feature-tile width targeting ~1 MiB f32 blocks: wide tiles amortize
-    per-grid-step overhead for small n (n=8 wants 8192); narrower ones keep
-    VMEM sane as n grows (n=128 measured best at 1024–2048)."""
+def _auto_tile(n_pad: int, d: Optional[int] = None) -> int:
+    """Feature-tile width for ``sort_columns``. The autotune cache / env
+    override (family ``"sort"``; see :func:`_tuned_tile`) wins when a
+    valid entry exists; the heuristic targets ~1 MiB f32 blocks: wide
+    tiles amortize per-grid-step overhead for small n (n=8 wants 8192);
+    narrower ones keep VMEM sane as n grows (n=128 measured best at
+    1024–2048)."""
+    if d is not None:
+        tuned = _tuned_tile("sort", n_pad, d)
+        if tuned is not None:
+            return tuned
     return max(512, min(8192, _round_up(262144 // n_pad, _LANES)))
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
 def sort_columns(
     x: Array, *, tile: Optional[int] = None, interpret: Optional[bool] = None
 ) -> Array:
@@ -149,7 +200,8 @@ def sort_columns(
     are sliced off; ``iinfo.max`` for ints) and ``d`` up to a lane-aligned
     tile. 16-bit floats sort through an exact f32 round-trip: the kernel's
     int32 key path needs 32-bit rows, and every bf16/f16 value is exactly
-    representable in f32.
+    representable in f32. The tile is resolved here, before the jitted
+    inner function traces (env/cache overrides apply per call).
     """
     if interpret is None:
         interpret = not _on_tpu()
@@ -164,7 +216,16 @@ def sort_columns(
     n, d = x.shape
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
-        tile = _auto_tile(n_pad)
+        tile = _auto_tile(n_pad, d)
+    return _sort_columns_call(x, tile=tile, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _sort_columns_call(x: Array, *, tile: int, interpret: bool) -> Array:
+    n, d = x.shape
+    is_float = bool(jnp.issubdtype(x.dtype, jnp.floating))
+    dtype = x.dtype
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     big = jnp.asarray(jnp.nan if is_float else jnp.iinfo(dtype).max, dtype)
     xp = jnp.full((n_pad, d_pad), big, dtype)
@@ -242,13 +303,23 @@ def _gram_kernel(x_ref, out_ref):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
 def gram_pallas(
-    x: Array, *, tile: int = 1024, interpret: Optional[bool] = None
+    x: Array, *, tile: Optional[int] = None, interpret: Optional[bool] = None
 ) -> Array:
-    """``x @ x.T`` accumulated in f32 over lane-aligned feature tiles."""
+    """``x @ x.T`` accumulated in f32 over lane-aligned feature tiles.
+    Tile resolved pre-trace (family ``"gram"``: env override / autotune
+    cache / the 1024 default)."""
     if interpret is None:
         interpret = not _on_tpu()
+    n, d = x.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    if tile is None:
+        tile = _tuned_tile("gram", n_pad, d) or 1024
+    return _gram_pallas_call(x, tile=tile, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _gram_pallas_call(x: Array, *, tile: int, interpret: bool) -> Array:
     n, d = x.shape
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
@@ -319,7 +390,6 @@ def _sorted_reduce_stream_kernel(
     o_ref[0] = out[None, :]
 
 
-@functools.partial(jax.jit, static_argnames=("mode", "f", "tile", "interpret"))
 def sorted_reduce_stream_pallas(
     xs: Array,
     *,
@@ -332,7 +402,8 @@ def sorted_reduce_stream_pallas(
     (``mode='trimmed'``) over ``K`` stacked rounds ``xs: (K, n, d)`` in
     one kernel launch, returning ``(K, d)``. Float dtypes only (16-bit
     floats up-convert per-tile in VMEM — half the HBM traffic of a
-    pre-pass conversion)."""
+    pre-pass conversion). Tile resolved pre-trace (family
+    ``"sorted_reduce"``)."""
     if mode not in {"median", "trimmed"}:
         raise ValueError(f"unknown mode {mode!r}")
     K, n, d = xs.shape
@@ -345,7 +416,20 @@ def sorted_reduce_stream_pallas(
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
         # sort happens on f32 rows in VMEM regardless of input dtype
-        tile = _auto_sort_tile(d, n_pad)
+        tile = _tuned_tile("sorted_reduce", n_pad, d) or _auto_sort_tile(
+            d, n_pad
+        )
+    return _sorted_reduce_stream_call(
+        xs, mode=mode, f=f, tile=tile, interpret=interpret
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "f", "tile", "interpret"))
+def _sorted_reduce_stream_call(
+    xs: Array, *, mode: str, f: int, tile: int, interpret: bool
+) -> Array:
+    K, n, d = xs.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -445,9 +529,6 @@ def _weighted_center_step_kernel(
         o_ref[:] = out.astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("mode", "eps", "c_tau", "tile", "interpret")
-)
 def weighted_center_step_pallas(
     x: Array,
     z: Array,
@@ -461,7 +542,8 @@ def weighted_center_step_pallas(
     """One fused Weiszfeld / centered-clipping iteration: ``x`` ``(n, d)``,
     center ``z`` ``(d,)`` -> new center ``(d,)``. See the kernel docstring;
     ``ops.robust.geometric_median`` / ``centered_clipping`` call this
-    inside their ``lax`` loops when the dispatch gate allows."""
+    inside their ``lax`` loops when the dispatch gate allows. Tile
+    resolved pre-trace."""
     if mode not in {"weiszfeld", "clip"}:
         raise ValueError(f"unknown mode {mode!r}")
     n, d = x.shape
@@ -474,6 +556,20 @@ def weighted_center_step_pallas(
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
         tile = _auto_selection_tile(d, n_pad, jnp.dtype(x.dtype).itemsize)
+    return _weighted_center_step_call(
+        x, z, mode=mode, eps=eps, c_tau=c_tau, tile=tile, interpret=interpret
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mode", "eps", "c_tau", "tile", "interpret")
+)
+def _weighted_center_step_call(
+    x: Array, z: Array, *, mode: str, eps: float, c_tau: float, tile: int,
+    interpret: bool,
+) -> Array:
+    n, d = x.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = x
@@ -590,7 +686,6 @@ def _meamed_stream_kernel(
     o_ref[0] = out[None, :].astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("f", "tile", "interpret"))
 def meamed_stream_pallas(
     xs: Array,
     *,
@@ -604,7 +699,8 @@ def meamed_stream_pallas(
     from HBM exactly ONCE (median, window-minimum cut, and the selected
     mean all compute from one in-VMEM sort — see the kernel docstring);
     ``MEAMED_MAX_DIM`` is retained as a dispatch-gate cap for parity
-    with the other fused kernels' tested envelope."""
+    with the other fused kernels' tested envelope. Tile resolved
+    pre-trace (family ``"meamed"``)."""
     K, n, d = xs.shape
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
@@ -622,7 +718,18 @@ def meamed_stream_pallas(
         # sort-aware budget; the kernel additionally keeps the original
         # block, the decoded sorted floats, and the deviation/mask
         # temporaries live across the sort, so budget 3 extra copies
-        tile = _auto_sort_tile(d, n_pad, copies=13)
+        tile = _tuned_tile("meamed", n_pad, d) or _auto_sort_tile(
+            d, n_pad, copies=13
+        )
+    return _meamed_stream_call(xs, f=f, tile=tile, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("f", "tile", "interpret"))
+def _meamed_stream_call(
+    xs: Array, *, f: int, tile: int, interpret: bool
+) -> Array:
+    K, n, d = xs.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -709,16 +816,22 @@ def _stable_k_select_mask(keys, *, n_pad: int, k: int):
     return _stable_threshold_select(keys, cut, k=k), cut
 
 
-def _accumulate_gram(x_block, gram_ref, c):
+def _accumulate_gram(x_block, gram_ref, c, cast: Optional[str] = None):
     """Phase-0 body shared by the fused kernels: zero the scratch on the
     round's first chunk, then accumulate this feature tile's Gram
     contribution on the MXU (f32 accumulation; each tile of ``x`` is read
     from HBM exactly once — XLA's einsum streams ``x`` twice, as lhs and
-    rhs: 0.91 ms vs the 0.31 ms one-read floor at 64x1M f32 on v5e)."""
+    rhs: 0.91 ms vs the 0.31 ms one-read floor at 64x1M f32 on v5e).
+    ``cast='bf16'`` (the ``BYZPY_TPU_MATMUL_DTYPE`` policy, resolved
+    pre-trace by the wrappers) multiplies f32 tiles at the MXU's native
+    bf16 rate while keeping the f32 accumulator — distances lose ~2^-8
+    relative precision, which only perturbs score near-ties."""
     @pl.when(c == 0)
     def _():
         gram_ref[:] = jnp.zeros_like(gram_ref)
 
+    if cast == "bf16":
+        x_block = x_block.astype(jnp.bfloat16)
     gram_ref[:] += jax.lax.dot_general(
         x_block, x_block,
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -813,7 +926,7 @@ def _auto_sort_tile(
 
 def _selection_mean_stream_kernel(
     x_ref, o_ref, gram_ref, w_ref, *, n_pad: int, n_real: int, f: int, q: int,
-    mode: str, reference_index: int,
+    mode: str, reference_index: int, cast: Optional[str] = None,
 ):
     """Two HBM sweeps per round inside ONE kernel launch, over a grid of
     ``(K, 2, C)`` (round, phase, feature-chunk).
@@ -840,7 +953,7 @@ def _selection_mean_stream_kernel(
 
     @pl.when(p == 0)
     def _():
-        _accumulate_gram(x_ref[0], gram_ref, c)
+        _accumulate_gram(x_ref[0], gram_ref, c, cast)
 
     @pl.when((p == 1) & (c == 0))
     def _():
@@ -857,10 +970,6 @@ def _selection_mean_stream_kernel(
         o_ref[0] = jnp.sum(xt * w, axis=0, keepdims=True).astype(o_ref.dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("f", "q", "mode", "reference_index", "tile", "interpret"),
-)
 def selection_mean_stream_pallas(
     xs: Array,
     *,
@@ -878,7 +987,9 @@ def selection_mean_stream_pallas(
     intermediate copies. This is the training-loop / replay shape of
     ``selection_mean_pallas`` — see that kernel for the per-round
     algorithm and ``ops.robust.aggregate_stream`` for why streaming is
-    the honest throughput shape on a remote-tunneled device."""
+    the honest throughput shape on a remote-tunneled device. Tile and
+    the ``BYZPY_TPU_MATMUL_DTYPE`` Gram-cast policy are resolved here,
+    pre-trace (family ``"selection"``)."""
     if mode not in {"krum", "cge", "monna"}:
         raise ValueError(f"unknown mode {mode!r}")
     K, n, d = xs.shape
@@ -894,7 +1005,27 @@ def selection_mean_stream_pallas(
         raise ValueError(f"unsupported dtype {xs.dtype}")
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
-        tile = _auto_selection_tile(d, n_pad, jnp.dtype(xs.dtype).itemsize)
+        tile = _tuned_tile("selection", n_pad, d) or _auto_selection_tile(
+            d, n_pad, jnp.dtype(xs.dtype).itemsize
+        )
+    return _selection_mean_stream_call(
+        xs, f=f, q=q, mode=mode, reference_index=reference_index, tile=tile,
+        interpret=interpret, cast=matmul_input_dtype(xs.dtype),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "f", "q", "mode", "reference_index", "tile", "interpret", "cast"
+    ),
+)
+def _selection_mean_stream_call(
+    xs: Array, *, f: int, q: int, mode: str, reference_index: int, tile: int,
+    interpret: bool, cast: Optional[str],
+) -> Array:
+    K, n, d = xs.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs  # already aligned: the kernel reads the caller's buffer
@@ -904,7 +1035,7 @@ def selection_mean_stream_pallas(
     out = pl.pallas_call(
         functools.partial(
             _selection_mean_stream_kernel, n_pad=n_pad, n_real=n, f=f, q=q,
-            mode=mode, reference_index=reference_index,
+            mode=mode, reference_index=reference_index, cast=cast,
         ),
         out_shape=jax.ShapeDtypeStruct((K, 1, d_pad), xs.dtype),
         grid=(K, 2, d_pad // tile),
@@ -957,6 +1088,123 @@ def selection_mean_pallas(
         x[None], f=f, q=q, mode=mode, reference_index=reference_index,
         tile=tile, interpret=interpret,
     )[0]
+
+
+def _selection_from_gram_kernel(
+    x_ref, g_ref, o_ref, w_ref, *, n_pad: int, n_real: int, f: int, q: int,
+    mode: str, reference_index: int,
+):
+    """Scores -> ranks -> 1/q weights from a PRECOMPUTED Gram (first
+    step, all on (n, n) VMEM data), then one weighted-mean sweep of
+    ``x``: exactly ONE HBM read of the data plus a (1, d) write — the
+    floor for a finalize whose Gram already exists. The XLA finalize
+    (``ops.robust.multi_krum_from_gram`` -> ``ranked_mean``) pays a
+    masked (n, d) copy plus the contraction read."""
+    c = pl.program_id(0)
+
+    @pl.when(c == 0)
+    def _():
+        scores = _selection_scores(
+            g_ref[:].astype(jnp.float32), mode=mode, n_pad=n_pad,
+            n_real=n_real, f=f, reference_index=reference_index,
+        )
+        w_ref[:] = _selection_weights(scores, n_pad=n_pad, n_real=n_real, q=q)
+
+    w = w_ref[:]
+    xt = jnp.where(w > 0.0, x_ref[:].astype(jnp.float32), 0.0)
+    o_ref[:] = jnp.sum(xt * w, axis=0, keepdims=True).astype(o_ref.dtype)
+
+
+def selection_mean_from_gram_pallas(
+    x: Array,
+    gram: Array,
+    *,
+    f: int,
+    q: int,
+    mode: str = "krum",
+    reference_index: int = 0,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Fused scores→selection→weighted-mean over ``x`` ``(n, d)`` given
+    its PRECOMPUTED ``(n, n)`` Gram matrix — the finalize step of the
+    streaming Multi-Krum fold, where each arriving gradient already
+    contributed its Gram row (``aggregators.geometric_wise.krum``).
+    Equals ``ops.robust.multi_krum_from_gram(x, gram, f=f, q=q)`` for
+    ``mode='krum'`` (selection ties to documented tolerance: scores sum
+    identical values in a different reduction order). One HBM read of
+    ``x`` + a (1, d) write; pairwise distances never materialize in HBM
+    at all. Tile resolved pre-trace (family ``"selection"``)."""
+    if mode not in {"krum", "cge", "monna"}:
+        raise ValueError(f"unknown mode {mode!r}")
+    n, d = x.shape
+    if gram.shape != (n, n):
+        raise ValueError(f"gram must have shape ({n}, {n}), got {gram.shape}")
+    if mode == "krum" and not (0 <= f < n - 1 and 1 <= q <= n - f):
+        raise ValueError(f"invalid (n={n}, f={f}, q={q}) for krum")
+    if not 1 <= q <= n:
+        raise ValueError(f"q must be in [1, n] (got q={q}, n={n})")
+    if not 0 <= reference_index < n:
+        raise ValueError(f"reference_index out of range (got {reference_index})")
+    if x.dtype not in (jnp.bfloat16, jnp.float16, jnp.float32):
+        raise ValueError(f"unsupported dtype {x.dtype}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    if tile is None:
+        tile = _tuned_tile("selection", n_pad, d) or _auto_selection_tile(
+            d, n_pad, jnp.dtype(x.dtype).itemsize
+        )
+    return _selection_from_gram_call(
+        x, gram, f=f, q=q, mode=mode, reference_index=reference_index,
+        tile=tile, interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("f", "q", "mode", "reference_index", "tile", "interpret"),
+)
+def _selection_from_gram_call(
+    x: Array, gram: Array, *, f: int, q: int, mode: str,
+    reference_index: int, tile: int, interpret: bool,
+) -> Array:
+    n, d = x.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
+    d_pad = _round_up(max(d, 1), tile)
+    if (n_pad, d_pad) == (n, d):
+        xp = x
+    else:
+        xp = jnp.zeros((n_pad, d_pad), x.dtype).at[:n, :d].set(x)
+    # zero-pad the Gram: padded rows/cols are neutralized downstream
+    # (_padded_sort_keys for krum distances, the idx >= n_real rank rule
+    # for cge/monna), so they can never be selected
+    gp = jnp.zeros((n_pad, n_pad), jnp.float32).at[:n, :n].set(
+        gram.astype(jnp.float32)
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _selection_from_gram_kernel, n_pad=n_pad, n_real=n, f=f, q=q,
+            mode=mode, reference_index=reference_index,
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, d_pad), x.dtype),
+        grid=(d_pad // tile,),
+        in_specs=[
+            pl.BlockSpec(
+                (n_pad, tile), lambda c: (0, c), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (n_pad, n_pad), lambda c: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile), lambda c: (0, c), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((n_pad, 1), jnp.float32)],
+        interpret=interpret,
+    )(xp, gp)
+    return out[0, :d]
 
 
 # ---------------------------------------------------------------------------
@@ -1041,7 +1289,6 @@ def _nnm_stream_kernel(
         o_ref[0] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("f", "tile", "interpret"))
 def nnm_stream_pallas(
     xs: Array,
     *,
@@ -1051,7 +1298,8 @@ def nnm_stream_pallas(
 ) -> Array:
     """Nearest-Neighbor Mixing over ``K`` stacked rounds ``xs: (K, n, d)``
     in one fused kernel launch; equals ``jax.vmap(lambda x:
-    ops.preagg.nnm(x, f=f))(xs)``. See ``nnm_pallas`` for the K=1 form."""
+    ops.preagg.nnm(x, f=f))(xs)``. See ``nnm_pallas`` for the K=1 form.
+    Tile resolved pre-trace."""
     K, n, d = xs.shape
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
@@ -1065,6 +1313,15 @@ def nnm_stream_pallas(
         # OUTPUT block is as large as the input block, so both count
         # against the scoped-VMEM budget
         tile = _auto_selection_tile(d, n_pad, 2 * jnp.dtype(xs.dtype).itemsize)
+    return _nnm_stream_call(xs, f=f, tile=tile, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("f", "tile", "interpret"))
+def _nnm_stream_call(
+    xs: Array, *, f: int, tile: int, interpret: bool
+) -> Array:
+    K, n, d = xs.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -1294,12 +1551,6 @@ def _clip_selection_stream_kernel(
         )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "tau", "f", "q", "mode", "reference_index", "tile", "interpret"
-    ),
-)
 def clip_selection_mean_stream_pallas(
     xs: Array,
     *,
@@ -1315,7 +1566,8 @@ def clip_selection_mean_stream_pallas(
     ``xs: (K, n, d)`` in ONE fused launch; equals
     ``selection_mean(clip_rows(x, threshold=tau), f=f, q=q)`` per round
     at 2 HBM reads + a (1, d) write. See
-    ``_clip_selection_stream_kernel`` (and its non-finite note)."""
+    ``_clip_selection_stream_kernel`` (and its non-finite note). Tile
+    resolved pre-trace (family ``"selection"``)."""
     if mode not in {"krum", "cge", "monna"}:
         raise ValueError(f"unknown mode {mode!r}")
     K, n, d = xs.shape
@@ -1333,7 +1585,27 @@ def clip_selection_mean_stream_pallas(
         interpret = not _on_tpu()
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
-        tile = _auto_selection_tile(d, n_pad, jnp.dtype(xs.dtype).itemsize)
+        tile = _tuned_tile("selection", n_pad, d) or _auto_selection_tile(
+            d, n_pad, jnp.dtype(xs.dtype).itemsize
+        )
+    return _clip_selection_mean_stream_call(
+        xs, tau=tau, f=f, q=q, mode=mode, reference_index=reference_index,
+        tile=tile, interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tau", "f", "q", "mode", "reference_index", "tile", "interpret"
+    ),
+)
+def _clip_selection_mean_stream_call(
+    xs: Array, *, tau: float, f: int, q: int, mode: str,
+    reference_index: int, tile: int, interpret: bool,
+) -> Array:
+    K, n, d = xs.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -1368,12 +1640,6 @@ def clip_selection_mean_stream_pallas(
     return out[:, 0, :d]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "f_arc", "f", "q", "mode", "reference_index", "tile", "interpret"
-    ),
-)
 def arc_selection_mean_stream_pallas(
     xs: Array,
     *,
@@ -1391,7 +1657,8 @@ def arc_selection_mean_stream_pallas(
     factors are norm-derived like static clipping's — the data-dependent
     threshold (the ``cut_off``-th smallest norm) computes by stable rank
     counting in int32 key space inside VMEM — so the same Gram-collapse
-    applies (see ``_clip_selection_stream_kernel``, ``pre='arc'``)."""
+    applies (see ``_clip_selection_stream_kernel``, ``pre='arc'``). Tile
+    resolved pre-trace (family ``"selection"``)."""
     if mode not in {"krum", "cge", "monna"}:
         raise ValueError(f"unknown mode {mode!r}")
     K, n, d = xs.shape
@@ -1407,12 +1674,32 @@ def arc_selection_mean_stream_pallas(
         raise ValueError(f"unsupported dtype {xs.dtype}")
     if interpret is None:
         interpret = not _on_tpu()
-    from .preagg import arc_cut_off
-
-    cut_off = arc_cut_off(n, f_arc)  # 1-based rank of the threshold norm
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
-        tile = _auto_selection_tile(d, n_pad, jnp.dtype(xs.dtype).itemsize)
+        tile = _tuned_tile("selection", n_pad, d) or _auto_selection_tile(
+            d, n_pad, jnp.dtype(xs.dtype).itemsize
+        )
+    return _arc_selection_mean_stream_call(
+        xs, f_arc=f_arc, f=f, q=q, mode=mode,
+        reference_index=reference_index, tile=tile, interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "f_arc", "f", "q", "mode", "reference_index", "tile", "interpret"
+    ),
+)
+def _arc_selection_mean_stream_call(
+    xs: Array, *, f_arc: int, f: int, q: int, mode: str,
+    reference_index: int, tile: int, interpret: bool,
+) -> Array:
+    from .preagg import arc_cut_off
+
+    K, n, d = xs.shape
+    cut_off = arc_cut_off(n, f_arc)  # 1-based rank of the threshold norm
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -1447,12 +1734,6 @@ def arc_selection_mean_stream_pallas(
     return out[:, 0, :d]
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "f_nnm", "f", "q", "mode", "reference_index", "tile", "interpret"
-    ),
-)
 def nnm_selection_mean_stream_pallas(
     xs: Array,
     *,
@@ -1475,7 +1756,8 @@ def nnm_selection_mean_stream_pallas(
     scores from the full-f32 derived Gram — strictly higher fidelity,
     but a near-tie in krum scores (within ~2^-8 relative for bf16) may
     select a different row than the rounded two-step would. f32 inputs
-    match the composition to float precision."""
+    match the composition to float precision. Tile resolved pre-trace
+    (family ``"selection"``)."""
     if mode not in {"krum", "cge", "monna"}:
         raise ValueError(f"unknown mode {mode!r}")
     K, n, d = xs.shape
@@ -1493,7 +1775,27 @@ def nnm_selection_mean_stream_pallas(
         interpret = not _on_tpu()
     n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     if tile is None:
-        tile = _auto_selection_tile(d, n_pad, jnp.dtype(xs.dtype).itemsize)
+        tile = _tuned_tile("selection", n_pad, d) or _auto_selection_tile(
+            d, n_pad, jnp.dtype(xs.dtype).itemsize
+        )
+    return _nnm_selection_mean_stream_call(
+        xs, f_nnm=f_nnm, f=f, q=q, mode=mode,
+        reference_index=reference_index, tile=tile, interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "f_nnm", "f", "q", "mode", "reference_index", "tile", "interpret"
+    ),
+)
+def _nnm_selection_mean_stream_call(
+    xs: Array, *, f_nnm: int, f: int, q: int, mode: str,
+    reference_index: int, tile: int, interpret: bool,
+) -> Array:
+    K, n, d = xs.shape
+    n_pad = max(_SUBLANES, _round_up(n, _SUBLANES))
     d_pad = _round_up(max(d, 1), tile)
     if (n_pad, d_pad) == (n, d):
         xp = xs
@@ -1540,26 +1842,29 @@ def nnm_selection_mean_stream_pallas(
 MAX_NETWORK_ROWS = 128
 MIN_PALLAS_DIM = 256 * 1024
 # MeaMed's fused kernel amortizes differently from the single-sort
-# kernels: the XLA fallback pays ~4 passes (sort + window + masked
-# selection) where CwTM/median pay ~2-3, so the fused kernel *may* win
-# below the generic floor — unverified until the on-chip gate tune
-# (benchmarks/meamed_gate_tune.py) lands; held at the generic floor
-# meanwhile.
-MEAMED_MIN_DIM = MIN_PALLAS_DIM
+# kernels: its XLA fallback moves a large multiple of the read-once
+# traffic floor (XLA cost analysis measures 24.7x on the CPU backend's
+# chosen program at the 64x65,536 grid row — sort + window + masked
+# selection; benchmarks/meamed_gate_tune.py prints the derivation)
+# where the fused kernel reads the matrix exactly once. The committed
+# floor is 1/4 of the generic MIN_PALLAS_DIM — the conservative
+# bandwidth-model estimate from the kernel docstrings' ~4 TPU passes;
+# the CPU evidence says the true crossover is lower still. The on-chip
+# sweep via the rerun bundle (benchmarks/rerun_round5.sh step 2) is
+# the authoritative refinement when the tunnel returns.
+MEAMED_MIN_DIM = 1 << 16
 
 
 def meamed_min_dim() -> int:
     """MeaMed's dispatch floor; ``BYZPY_TPU_MEAMED_MIN_DIM`` overrides
-    per call (read here, not at import, so tuning harnesses can flip it
-    before anything traces).
-
-    Caveat — trace-time caching: this gate is evaluated while a
-    ``jax.jit`` traces, and XLA caches the traced program per shape.
-    Flipping the env var after a shape has been traced does NOT retrace
-    that shape — the cached program keeps whichever dispatch decision
-    was active at first trace. Tuning harnesses must set the override
-    before first use of each shape (or clear jax's compilation cache).
-    """
+    per call. ``ops.robust.mean_of_medians`` reads this in its Python
+    dispatch wrapper BEFORE the jitted implementation traces, so
+    flipping the env var between calls changes the very next dispatch
+    (no stale-trace pitfall). The one remaining caveat: a caller who
+    wraps ``mean_of_medians`` in their OWN ``jax.jit`` freezes the
+    decision into that outer trace — tuning harnesses should call the
+    public function directly (as ``benchmarks/meamed_gate_tune.py``
+    does)."""
     import os
 
     return int(os.environ.get("BYZPY_TPU_MEAMED_MIN_DIM", MEAMED_MIN_DIM))
@@ -1636,9 +1941,11 @@ __all__ = [
     "meamed_stream_pallas",
     "arc_selection_mean_stream_pallas",
     "clip_selection_mean_stream_pallas",
+    "matmul_input_dtype",
     "nnm_pallas",
     "nnm_stream_pallas",
     "nnm_selection_mean_stream_pallas",
+    "selection_mean_from_gram_pallas",
     "selection_mean_pallas",
     "sorted_reduce_stream_pallas",
     "selection_mean_stream_pallas",

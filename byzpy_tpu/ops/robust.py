@@ -48,10 +48,51 @@ def _feature_matmul_dtype(x: Array):
 
 
 def gram_matrix(x: Array) -> Array:
-    """``(n, n)`` Gram matrix ``x @ x.T`` with f32 accumulation for bf16."""
+    """``(n, n)`` Gram matrix ``x @ x.T`` with f32 accumulation for bf16.
+
+    The ``BYZPY_TPU_MATMUL_DTYPE=bf16`` policy (resolved per call,
+    before trace — see ``pallas_kernels.matmul_input_dtype``) multiplies
+    f32 operands at the MXU's native bf16 rate while keeping the f32
+    accumulator; distances lose ~2^-8 relative precision, which only
+    perturbs score near-ties (parity pinned in
+    ``tests/test_fused_parity.py``)."""
+    from .pallas_kernels import matmul_input_dtype
+
+    if matmul_input_dtype(x.dtype) == "bf16":
+        xb = x.astype(jnp.bfloat16)
+        return jnp.einsum(
+            "id,jd->ij", xb, xb, preferred_element_type=jnp.float32
+        )
     return jnp.einsum(
         "id,jd->ij", x, x, preferred_element_type=_feature_matmul_dtype(x)
     )
+
+
+def sort_rows(x: Array) -> Array:
+    """``jnp.sort(x, axis=0)``, served by a monotone int32-key sort for
+    f32 (and, via an exact f32 round-trip, 16-bit float) matrices.
+
+    ``lax.sort`` on int32 keys is 3.8–5x faster than the float
+    comparator path on XLA:CPU for the grid-row shapes (measured 174 ms
+    vs 662 ms at 64x65,536 — the dominant cost of every coordinate-wise
+    fallback), and the key map (canonicalize NaN, bitcast, flip the
+    magnitude bits of negatives — ``pallas_kernels._float_sort_keys``)
+    reproduces ``jnp.sort``'s value ordering including non-finite
+    values (-inf < finite < +inf < NaN). Divergences are bit-level
+    only, identical to ``sort_columns``'s documented ones: -0.0 keys
+    strictly before +0.0 where the stable ``jnp.sort`` preserves input
+    order, and NaN payload/sign bits canonicalize to the quiet +NaN
+    (pinned in ``tests/test_fused_parity.py``). Other dtypes fall
+    through to ``jnp.sort``."""
+    from .pallas_kernels import _float_sort_keys, _keys_to_float
+
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return sort_rows(x.astype(jnp.float32)).astype(x.dtype)
+    if x.dtype == jnp.float32:
+        return _keys_to_float(
+            lax.sort(_float_sort_keys(x), dimension=0), x.dtype
+        )
+    return jnp.sort(x, axis=0)
 
 
 def pairwise_sq_dists(x: Array) -> Array:
@@ -77,13 +118,30 @@ def pairwise_sq_dists(x: Array) -> Array:
 # ---------------------------------------------------------------------------
 
 
+def _median_from_sorted(s: Array) -> Array:
+    """``jnp.median(x, axis=0)`` from the already-sorted matrix ``s``
+    (float dtypes): midpoint of the middle rows in the input dtype, NaN
+    propagated column-wide (NaNs sort last, so a column contains one iff
+    its bottom sorted row is NaN) — the exact semantics
+    ``pallas_kernels.median_pallas`` pins against the oracle."""
+    n = s.shape[0]
+    lo, hi = (n - 1) // 2, n // 2
+    if lo == hi:
+        med = s[lo]
+    else:
+        med = (s[lo] + s[hi]) * jnp.asarray(0.5, s.dtype)
+    return jnp.where(jnp.isnan(s[n - 1]), jnp.asarray(jnp.nan, s.dtype), med)
+
+
 def coordinate_median(x: Array) -> Array:
     """Coordinate-wise median (ref: ``aggregators/coordinate_wise/median.py``).
     On TPU with small ``n`` and large ``d`` this runs the fused
     sorted-reduce kernel (one HBM read + a (1, d) write; the sorted
     matrix never returns to HBM — ``pallas_kernels
-    .sorted_reduce_stream_pallas``), falling back to the sort-and-slice
-    network for other float widths."""
+    .sorted_reduce_stream_pallas``), falling back to the int32-key sort
+    (:func:`sort_rows` — 3.8x the float sort's throughput on XLA:CPU)
+    for float matrices elsewhere. Dispatch resolves here, before any
+    jit traces."""
     from .pallas_kernels import (
         median_pallas,
         sharding_allows_pallas,
@@ -95,6 +153,8 @@ def coordinate_median(x: Array) -> Array:
         if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16) and sharding_allows_pallas(x):
             return sorted_reduce_stream_pallas(x[None], mode="median")[0]
         return median_pallas(x)
+    if x.ndim == 2 and x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return _median_from_sorted(sort_rows(x))
     return jnp.median(x, axis=0)
 
 
@@ -120,7 +180,6 @@ def coordinate_median_stream(xs: Array) -> Array:
     return aggregate_stream(coordinate_median, xs)
 
 
-@partial(jax.jit, static_argnames=("f",))
 def trimmed_mean_stream(xs: Array, *, f: int) -> Array:
     """f-trimmed coordinate mean over stacked rounds in one fused launch."""
     if _use_stream_kernel(xs):
@@ -130,7 +189,6 @@ def trimmed_mean_stream(xs: Array, *, f: int) -> Array:
     return aggregate_stream(partial(trimmed_mean, f=f), xs)
 
 
-@partial(jax.jit, static_argnames=("f",))
 def mean_of_medians_stream(xs: Array, *, f: int) -> Array:
     """MeaMed over stacked rounds in one fused launch."""
     from .pallas_kernels import MEAMED_MAX_DIM
@@ -142,12 +200,12 @@ def mean_of_medians_stream(xs: Array, *, f: int) -> Array:
     return aggregate_stream(partial(mean_of_medians, f=f), xs)
 
 
-@partial(jax.jit, static_argnames=("f",))
 def trimmed_mean(x: Array, *, f: int) -> Array:
     """Coordinate-wise trimmed mean: sort per coordinate, drop the ``f``
     smallest and ``f`` largest values, average the middle ``n - 2f``
     (Yin et al. 2018; ref: ``aggregators/coordinate_wise/trimmed_mean.py``).
-    """
+    Dispatch (Pallas gate, sort flavor) resolves here, pre-trace; the
+    XLA fallback sorts int32 keys (:func:`sort_rows`)."""
     n = x.shape[0]
     if not 0 <= 2 * f < n:
         raise ValueError(f"trim parameter f must satisfy 0 <= 2f < n (got n={n}, f={f})")
@@ -162,11 +220,16 @@ def trimmed_mean(x: Array, *, f: int) -> Array:
         if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16) and sharding_allows_pallas(x):
             return sorted_reduce_stream_pallas(x[None], mode="trimmed", f=f)[0]
         return trimmed_mean_pallas(x, f=f)
-    s = jnp.sort(x, axis=0)
-    return jnp.mean(s[f : n - f], axis=0)
+    return _trimmed_mean_xla(x, f=f)
 
 
 @partial(jax.jit, static_argnames=("f",))
+def _trimmed_mean_xla(x: Array, *, f: int) -> Array:
+    n = x.shape[0]
+    s = sort_rows(x) if x.ndim == 2 else jnp.sort(x, axis=0)
+    return jnp.mean(s[f : n - f], axis=0)
+
+
 def mean_of_medians(x: Array, *, f: int) -> Array:
     """MeaMed: per coordinate keep the ``n - f`` values closest to the median
     and average them (ref: ``aggregators/coordinate_wise/mean_of_medians.py:28-82``).
@@ -182,11 +245,17 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
     everything strictly below the cut and break ties AT the cut by node
     order via a cumulative count — exactly the stable-argsort tie rule
     (the cut VALUE is identical, so tie semantics are unchanged).
+
+    Dispatch — including the tuned ``MEAMED_MIN_DIM`` floor and its
+    ``BYZPY_TPU_MEAMED_MIN_DIM`` override — resolves HERE, in Python,
+    before the jitted implementation traces: flipping the override
+    between calls changes the very next dispatch. The XLA fallback
+    sorts int32 keys (:func:`sort_rows`, 2.4x the old fallback's
+    throughput on XLA:CPU at the 64x65,536 grid row).
     """
     n = x.shape[0]
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
-    k = n - f
     from .pallas_kernels import (
         MEAMED_MAX_DIM,
         meamed_min_dim,
@@ -205,6 +274,27 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
         # one fused launch: 1 HBM read + a (1, d) write, vs ~4 passes for
         # the sort/window/mask pipeline below
         return meamed_stream_pallas(x[None], f=f)[0]
+    use_network = bool(x.ndim == 2 and use_pallas_for(*x.shape))
+    network_tile = None
+    if use_network:
+        # resolve the sort kernel's tile HERE too — sort_columns runs
+        # inside the jitted impl below, where an env/cache read would
+        # freeze into the trace
+        from .pallas_kernels import _SUBLANES, _auto_tile, _round_up
+
+        n_pad = max(_SUBLANES, _round_up(x.shape[0], _SUBLANES))
+        network_tile = _auto_tile(n_pad, x.shape[1])
+    return _mean_of_medians_xla(
+        x, f=f, use_network=use_network, network_tile=network_tile
+    )
+
+
+@partial(jax.jit, static_argnames=("f", "use_network", "network_tile"))
+def _mean_of_medians_xla(
+    x: Array, *, f: int, use_network: bool, network_tile=None
+) -> Array:
+    n = x.shape[0]
+    k = n - f
     from .pallas_kernels import sort_columns
 
     if not jnp.issubdtype(x.dtype, jnp.floating):
@@ -216,8 +306,10 @@ def mean_of_medians(x: Array, *, f: int) -> Array:
                 jax.ShapeDtypeStruct(x.shape, x.dtype),
             ).dtype
         )
-    if x.ndim == 2 and use_pallas_for(*x.shape):
-        xs = sort_columns(x)
+    if use_network:
+        xs = sort_columns(x, tile=network_tile)
+    elif x.ndim == 2:
+        xs = sort_rows(x)
     else:
         xs = jnp.sort(x, axis=0)
     lo, hi = (n - 1) // 2, n // 2
@@ -289,31 +381,37 @@ def krum_scores(x: Array, *, f: int) -> Array:
     return jnp.sum(row_sorted[:, 1 : n - f], axis=1)
 
 
-def ranked_mean(x: Array, scores: Array, q: int) -> Array:
-    """Mean of the ``q`` lowest-score rows of ``x`` without a row gather.
-
-    Equivalent to ``jnp.mean(x[jnp.argsort(scores)[:q]], axis=0)`` (stable
-    ties broken by row index), but selection happens through a masked
-    matvec: XLA's dynamic row gather on TPU measured ~7x slower than its
-    HBM cost (1.45 ms vs ~0.2 ms for 12 rows of a 64x1M f32 matrix on
-    v5e), while the rank-mask contraction streams ``x`` once at full
-    bandwidth on the MXU.
-    """
-    n = x.shape[0]
+def _nan_last_ranks(scores: Array) -> Array:
+    """Per-row rank of ``scores`` under the stable argsort order every
+    selection path shares: ascending scores, ties broken by row index,
+    NaN scores LAST. The two-level (isnan, score) key matters: plain
+    comparisons would rank a NaN-score row first (all comparisons
+    against NaN are False), letting an adversarial NaN gradient into
+    the selection."""
+    n = scores.shape[0]
     idx = jnp.arange(n)
-    # Two-level key (isnan, score) reproduces argsort's NaN-last ordering:
-    # plain comparisons would rank a NaN-score row first (all comparisons
-    # against NaN are False), letting an adversarial NaN gradient into the
-    # selection.
     isnan = jnp.isnan(scores)
     s = jnp.where(isnan, jnp.zeros_like(scores), scores)
     nan_lt = (~isnan[None, :]) & isnan[:, None]
     nan_eq = isnan[None, :] == isnan[:, None]
     lt = nan_lt | (nan_eq & (s[None, :] < s[:, None]))
     eq = nan_eq & (s[None, :] == s[:, None])
-    rank = jnp.sum(lt | (eq & (idx[None, :] < idx[:, None])), axis=1)
+    return jnp.sum(lt | (eq & (idx[None, :] < idx[:, None])), axis=1)
+
+
+def ranked_mean(x: Array, scores: Array, q: int) -> Array:
+    """Mean of the ``q`` lowest-score rows of ``x`` without a row gather.
+
+    Equivalent to ``jnp.mean(x[jnp.argsort(scores)[:q]], axis=0)`` (stable
+    ties broken by row index, NaN scores last — :func:`_nan_last_ranks`),
+    but selection happens through a masked matvec: XLA's dynamic row
+    gather on TPU measured ~7x slower than its HBM cost (1.45 ms vs
+    ~0.2 ms for 12 rows of a 64x1M f32 matrix on v5e), while the
+    rank-mask contraction streams ``x`` once at full bandwidth on the
+    MXU.
+    """
     acc = _feature_matmul_dtype(x)
-    selected = rank < q
+    selected = _nan_last_ranks(scores) < q
     w = jnp.where(selected, 1.0 / q, 0.0).astype(acc)
     # Zero non-selected rows before the contraction: 0-weight times a NaN/inf
     # gradient is NaN in the matvec, whereas a gather physically excludes the
@@ -341,11 +439,44 @@ def _use_selection_kernel(x: Array) -> bool:
     )
 
 
-@partial(jax.jit, static_argnames=("f", "q"))
+def _selection_mean_xla(
+    x: Array, scores: Array, q: int, any_bad: Array
+) -> Array:
+    """Mean of the ``q`` lowest-score rows on the XLA fallback path, with
+    the same ranking as :func:`ranked_mean` (stable ties by row index,
+    NaN scores last) but the masked-copy pass made CONDITIONAL: the
+    ``jnp.where(selected, x, 0)`` materialization exists only to keep
+    ``0 * inf = NaN`` out of the contraction, yet it costs a full
+    (n, d) write+read — 9 of the 17 ms of the Multi-Krum grid row on
+    XLA:CPU. ``any_bad`` (a scalar the caller derives for free from its
+    score pipeline, e.g. non-finite Gram diagonal — conservative: f32
+    norm overflow of a finite row also routes to the masked path) gates
+    a ``lax.cond``: finite data takes the single-pass ``w @ x``
+    contraction, non-finite data the exact masked path. Results are
+    identical in both branches for finite data (same contraction, the
+    mask is then a no-op)."""
+    selected = _nan_last_ranks(scores) < q
+    acc = _feature_matmul_dtype(x)
+    w = jnp.where(selected, 1.0 / q, 0.0).astype(acc)
+
+    def masked(_):
+        xm = jnp.where(selected[:, None], x, jnp.zeros((), x.dtype))
+        return jnp.einsum("n,nd->d", w, xm, preferred_element_type=acc)
+
+    def fast(_):
+        return jnp.einsum("n,nd->d", w, x, preferred_element_type=acc)
+
+    return lax.cond(any_bad, masked, fast, None).astype(x.dtype)
+
+
 def multi_krum(x: Array, *, f: int, q: int) -> Array:
     """Multi-Krum: mean of the ``q`` lowest-score nodes
-    (ref: ``aggregators/geometric_wise/krum.py:147-242``).
-    """
+    (ref: ``aggregators/geometric_wise/krum.py:147-242``). Dispatch
+    resolves pre-trace; the XLA fallback computes the Gram ONCE (scores
+    via :func:`krum_scores_from_gram`) and selects through the
+    conditional-mask contraction (:func:`_selection_mean_xla`) — 1.3x
+    the old score+masked-mean pipeline on XLA:CPU at the 80x65,536 grid
+    row."""
     n = x.shape[0]
     if not 1 <= q <= n - f:
         raise ValueError(f"q must satisfy 1 <= q <= n - f (got n={n}, f={f}, q={q})")
@@ -353,11 +484,20 @@ def multi_krum(x: Array, *, f: int, q: int) -> Array:
         from .pallas_kernels import selection_mean_pallas
 
         return selection_mean_pallas(x, f=f, q=q, mode="krum")
-    scores = krum_scores(x, f=f)
-    return ranked_mean(x, scores, q)
+    return _multi_krum_xla(x, f=f, q=q)
 
 
 @partial(jax.jit, static_argnames=("f", "q"))
+def _multi_krum_xla(x: Array, *, f: int, q: int) -> Array:
+    gram = gram_matrix(x)
+    scores = krum_scores_from_gram(gram, f=f)
+    # a non-finite row shows up as a non-finite squared norm on the Gram
+    # diagonal (NaN -> NaN, inf -> inf; f32 overflow of a finite row is
+    # flagged too — conservative), so the guard costs nothing extra
+    any_bad = ~jnp.all(jnp.isfinite(jnp.diagonal(gram)))
+    return _selection_mean_xla(x, scores, q, any_bad)
+
+
 def multi_krum_stream(xs: Array, *, f: int, q: int) -> Array:
     """Multi-Krum over a stream of ``K`` stacked rounds ``xs: (K, n, d)``
     in one dispatch (the training-loop / replay shape — see
@@ -399,7 +539,6 @@ def nnm_multi_krum(x: Array, *, f_nnm: int, f: int, q: int) -> Array:
     return multi_krum(nnm(x, f=f_nnm), f=f, q=q)
 
 
-@partial(jax.jit, static_argnames=("f_nnm", "f", "q"))
 def nnm_multi_krum_stream(xs: Array, *, f_nnm: int, f: int, q: int) -> Array:
     """``nnm_multi_krum`` over ``K`` stacked rounds ``(K, n, d)`` in one
     dispatch (the training-loop / replay shape; see ``aggregate_stream``)."""
@@ -434,7 +573,6 @@ def clipped_multi_krum(x: Array, *, tau: float, f: int, q: int) -> Array:
     return multi_krum(clip_rows(x, threshold=tau), f=f, q=q)
 
 
-@partial(jax.jit, static_argnames=("tau", "f", "q"))
 def clipped_multi_krum_stream(
     xs: Array, *, tau: float, f: int, q: int
 ) -> Array:
@@ -474,7 +612,6 @@ def arc_multi_krum(x: Array, *, f_arc: int, f: int, q: int) -> Array:
     return multi_krum(arc_clip(x, f=f_arc), f=f, q=q)
 
 
-@partial(jax.jit, static_argnames=("f_arc", "f", "q"))
 def arc_multi_krum_stream(xs: Array, *, f_arc: int, f: int, q: int) -> Array:
     """``arc_multi_krum`` over ``K`` stacked rounds ``(K, n, d)`` in one
     dispatch (see ``aggregate_stream``)."""
@@ -492,7 +629,6 @@ def arc_multi_krum_stream(xs: Array, *, f_arc: int, f: int, q: int) -> Array:
     return aggregate_stream(partial(arc_multi_krum, f_arc=f_arc, f=f, q=q), xs)
 
 
-@partial(jax.jit, static_argnames=("tol", "max_iter", "eps", "init"))
 def geometric_median(
     x: Array,
     *,
@@ -504,10 +640,30 @@ def geometric_median(
     """Geometric median via Weiszfeld iterations as a ``lax.while_loop``
     (ref: ``aggregators/geometric_wise/geometric_median.py:69-104``; the
     reference's per-iteration subtask fan-out over shm chunks becomes a
-    single compiled loop whose reductions shard over the mesh).
+    single compiled loop whose reductions shard over the mesh). The
+    Pallas gate for the fused iteration kernel resolves here, pre-trace.
     """
     if init not in {"median", "mean"}:
         raise ValueError("init must be 'median' or 'mean'")
+    return _geometric_median_impl(
+        x, tol=tol, max_iter=max_iter, eps=eps, init=init,
+        use_kernel=_use_selection_kernel(x),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("tol", "max_iter", "eps", "init", "use_kernel"),
+)
+def _geometric_median_impl(
+    x: Array,
+    *,
+    tol: float,
+    max_iter: int,
+    eps: float,
+    init: str,
+    use_kernel: bool,
+) -> Array:
     z0 = jnp.median(x, axis=0) if init == "median" else jnp.mean(x, axis=0)
     # The loop carry tracks the previous center instead of a scalar delta:
     # every carry component is then derived from ``x``, which keeps the
@@ -522,8 +678,6 @@ def geometric_median(
         z, zprev, it = state
         delta = jnp.sqrt(jnp.sum((z - zprev) ** 2))
         return ((it == 0) | (delta > tol)) & (it < max_iter)
-
-    use_kernel = _use_selection_kernel(x)
 
     def body(state):
         z, _, it = state
@@ -546,7 +700,6 @@ def geometric_median(
     return z
 
 
-@partial(jax.jit, static_argnames=("c_tau", "M", "eps", "init"))
 def centered_clipping(
     x: Array,
     *,
@@ -557,18 +710,35 @@ def centered_clipping(
 ) -> Array:
     """Centered clipping (Karimireddy et al. 2021):
     ``v <- v + mean_i clip(x_i - v, c_tau)`` for ``M`` iterations
-    (ref: ``aggregators/norm_wise/center_clipping.py:29-120``).
+    (ref: ``aggregators/norm_wise/center_clipping.py:29-120``). The
+    Pallas gate for the fused iteration kernel resolves here, pre-trace.
     """
+    if init not in {"mean", "median", "zero"}:
+        raise ValueError("init must be one of {'mean','median','zero'}")
+    return _centered_clipping_impl(
+        x, c_tau=c_tau, M=M, eps=eps, init=init,
+        use_kernel=_use_selection_kernel(x),
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("c_tau", "M", "eps", "init", "use_kernel")
+)
+def _centered_clipping_impl(
+    x: Array,
+    *,
+    c_tau: float,
+    M: int,
+    eps: float,
+    init: str,
+    use_kernel: bool,
+) -> Array:
     if init == "mean":
         v0 = jnp.mean(x, axis=0)
     elif init == "median":
         v0 = jnp.median(x, axis=0)
-    elif init == "zero":
-        v0 = jnp.zeros((x.shape[1],), x.dtype)
     else:
-        raise ValueError("init must be one of {'mean','median','zero'}")
-
-    use_kernel = _use_selection_kernel(x)
+        v0 = jnp.zeros((x.shape[1],), x.dtype)
 
     def body(_, v):
         if use_kernel:
@@ -585,7 +755,6 @@ def centered_clipping(
     return lax.fori_loop(0, M, body, v0)
 
 
-@partial(jax.jit, static_argnames=("f",))
 def cge_stream(xs: Array, *, f: int) -> Array:
     """CGE over ``K`` stacked rounds in one fused launch (see
     ``multi_krum_stream``)."""
@@ -599,7 +768,6 @@ def cge_stream(xs: Array, *, f: int) -> Array:
     return aggregate_stream(partial(cge, f=f), xs)
 
 
-@partial(jax.jit, static_argnames=("f", "reference_index"))
 def monna_stream(xs: Array, *, f: int, reference_index: int = 0) -> Array:
     """MoNNA over ``K`` stacked rounds in one fused launch."""
     n = xs.shape[-2]
@@ -614,12 +782,13 @@ def monna_stream(xs: Array, *, f: int, reference_index: int = 0) -> Array:
     return aggregate_stream(partial(monna, f=f, reference_index=reference_index), xs)
 
 
-@partial(jax.jit, static_argnames=("f",))
 def cge(x: Array, *, f: int) -> Array:
     """Comparative gradient elimination: drop the ``f`` largest-L2-norm
     vectors, average the rest
     (ref: ``aggregators/norm_wise/comparative_gradient_elimination.py``).
-    """
+    Dispatch resolves pre-trace; the XLA fallback selects through the
+    conditional-mask contraction (the norms themselves are the
+    non-finite guard — see :func:`_selection_mean_xla`)."""
     n = x.shape[0]
     if not 0 <= f < n:
         raise ValueError(f"f must satisfy 0 <= f < n (got n={n}, f={f})")
@@ -627,16 +796,25 @@ def cge(x: Array, *, f: int) -> Array:
         from .pallas_kernels import selection_mean_pallas
 
         return selection_mean_pallas(x, f=0, q=n - f, mode="cge")
+    return _cge_xla(x, f=f)
+
+
+@partial(jax.jit, static_argnames=("f",))
+def _cge_xla(x: Array, *, f: int) -> Array:
+    n = x.shape[0]
     norms = jnp.sum(x * x, axis=1)
-    return ranked_mean(x, norms, n - f)
+    # a row with non-finite data has a non-finite squared norm (0-weight
+    # times such a row would NaN the fast contraction)
+    any_bad = ~jnp.all(jnp.isfinite(norms))
+    return _selection_mean_xla(x, norms, n - f, any_bad)
 
 
-@partial(jax.jit, static_argnames=("f", "reference_index"))
 def monna(x: Array, *, f: int, reference_index: int = 0) -> Array:
     """MoNNA: mean of the ``n - f`` nearest neighbors (by squared distance,
     self included) of a trusted reference node
-    (ref: ``aggregators/geometric_wise/monna.py:36-83``).
-    """
+    (ref: ``aggregators/geometric_wise/monna.py:36-83``). Dispatch
+    resolves pre-trace; the XLA fallback selects through the
+    conditional-mask contraction (:func:`_selection_mean_xla`)."""
     n = x.shape[0]
     if 2 * f >= n:
         raise ValueError(f"Cannot tolerate 2f >= n (got n={n}, f={f})")
@@ -648,9 +826,18 @@ def monna(x: Array, *, f: int, reference_index: int = 0) -> Array:
         return selection_mean_pallas(
             x, f=0, q=n - f, mode="monna", reference_index=reference_index
         )
+    return _monna_xla(x, f=f, reference_index=reference_index)
+
+
+@partial(jax.jit, static_argnames=("f", "reference_index"))
+def _monna_xla(x: Array, *, f: int, reference_index: int) -> Array:
+    n = x.shape[0]
     diff = x - x[reference_index][None, :]
     dists = jnp.sum(diff * diff, axis=1)
-    return ranked_mean(x, dists, n - f)
+    # any non-finite row (or a non-finite reference) yields a non-finite
+    # distance, so the distances themselves are the guard
+    any_bad = ~jnp.all(jnp.isfinite(dists))
+    return _selection_mean_xla(x, dists, n - f, any_bad)
 
 
 @partial(jax.jit, static_argnames=("f", "power_iters"))
@@ -939,6 +1126,52 @@ def trimmed_mean_from_extremes(
     return kept / jnp.asarray(n - 2 * f, total.dtype)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def fold_add_donated(total: Array, row: Array) -> Array:
+    """``total + row`` with the old ``total`` buffer DONATED to XLA, so
+    the running coordinate sum of a streaming fold updates in place
+    instead of allocating a fresh ``(d,)`` buffer per arrival (at 1M-dim
+    f32 that is 4 MB of allocator traffic per gradient, 256 MB per
+    64-node round, all inside the straggler window)."""
+    return total + row
+
+
+@partial(jax.jit, static_argnames=("largest",), donate_argnums=(0,))
+def extremes_fold_update_donated(buf: Array, row: Array, *, largest: bool) -> Array:
+    """:func:`extremes_fold_update` with the running extreme buffer
+    donated — XLA reuses the ``(f, d)`` allocation across arrivals."""
+    return extremes_fold_update(buf, row, largest=largest)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def gram_fold_update(
+    buffer: Array, gram: Array, row: Array, index
+) -> Tuple[Array, Array]:
+    """Fold one arriving gradient into streaming-Gram state, in place.
+
+    ``buffer`` is the ``(n, d)`` staging matrix (zero rows for slots not
+    yet arrived), ``gram`` the ``(n, n)`` f32 accumulator, ``row`` the
+    arriving ``(d,)`` gradient, ``index`` its canonical slot. One donated
+    dispatch per arrival: the row lands in the staging buffer via an
+    in-place dynamic-update-slice (donation kills the full-matrix copy a
+    functional update would pay — 20 MB per arrival at 80x65,536), ONE
+    matvec computes its dot products against every staged row
+    (not-yet-arrived slots are zero rows whose entries later arrivals
+    overwrite), and the Gram's row+column ``index`` are written. This
+    replaces the old per-arrival list of k separate einsum dispatches
+    (O(n^2) host dispatches per round -> O(n)) and the finalize-time
+    O(n) ``.at[].set`` Gram assembly. Accumulation is f32 for 16-bit
+    rows (same policy as the barrier path)."""
+    rowc = row.astype(buffer.dtype)
+    buffer = lax.dynamic_update_slice(buffer, rowc[None, :], (index, 0))
+    g = jnp.einsum(
+        "nd,d->n", buffer, rowc, preferred_element_type=gram.dtype
+    ).astype(gram.dtype)
+    gram = lax.dynamic_update_slice(gram, g[None, :], (index, 0))
+    gram = lax.dynamic_update_slice(gram, g[:, None], (0, index))
+    return buffer, gram
+
+
 def krum_scores_from_gram(gram: Array, *, f: int) -> Array:
     """Krum score per node from a precomputed ``(n, n)`` Gram matrix —
     the finalize step of the incremental Gram fold, where each arriving
@@ -957,13 +1190,30 @@ def krum_scores_from_gram(gram: Array, *, f: int) -> Array:
 def multi_krum_from_gram(x: Array, gram: Array, *, f: int, q: int) -> Array:
     """Multi-Krum selection given the stacked matrix AND its Gram (built
     incrementally by the streaming fold): scores from the Gram, mean of
-    the ``q`` best rows via the masked contraction. Skips the Gram
-    recompute that :func:`multi_krum` would pay."""
+    the ``q`` best rows. Skips the Gram recompute that
+    :func:`multi_krum` would pay. On TPU at large ``d`` this is ONE
+    fused Pallas pass (``pallas_kernels.selection_mean_from_gram_pallas``:
+    scores→selection→weighted-mean with a single HBM read of ``x`` —
+    pairwise distances never materialize in HBM); elsewhere the
+    conditional-mask XLA contraction (non-finite guard free off the
+    Gram diagonal)."""
     n = x.shape[0]
     if not 1 <= q <= n - f:
         raise ValueError(f"q must satisfy 1 <= q <= n - f (got n={n}, f={f}, q={q})")
+    if x.ndim == 2 and _use_selection_kernel(x):
+        from .pallas_kernels import selection_mean_from_gram_pallas
+
+        return selection_mean_from_gram_pallas(x, gram, f=f, q=q, mode="krum")
+    return _multi_krum_from_gram_xla(x, gram, f=f, q=q)
+
+
+@partial(jax.jit, static_argnames=("f", "q"))
+def _multi_krum_from_gram_xla(
+    x: Array, gram: Array, *, f: int, q: int
+) -> Array:
     scores = krum_scores_from_gram(gram, f=f)
-    return ranked_mean(x, scores, q)
+    any_bad = ~jnp.all(jnp.isfinite(jnp.diagonal(gram)))
+    return _selection_mean_xla(x, scores, q, any_bad)
 
 
 def aggregate_stream(agg_fn, xs: Array) -> Array:
@@ -989,6 +1239,7 @@ def aggregate_stream(agg_fn, xs: Array) -> Array:
 __all__ = [
     "gram_matrix",
     "pairwise_sq_dists",
+    "sort_rows",
     "coordinate_median",
     "coordinate_median_stream",
     "trimmed_mean_stream",
@@ -1020,6 +1271,9 @@ __all__ = [
     "best_subset_by_score",
     "aggregate_stream",
     "extremes_fold_update",
+    "extremes_fold_update_donated",
+    "fold_add_donated",
+    "gram_fold_update",
     "trimmed_mean_from_extremes",
     "krum_scores_from_gram",
     "multi_krum_from_gram",

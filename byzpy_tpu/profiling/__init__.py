@@ -1,0 +1,37 @@
+"""Roofline profiling + kernel autotuning for the robust-aggregation hot
+path.
+
+Three pieces (see the ROADMAP north star "as fast as the hardware
+allows"):
+
+* :mod:`.roofline` — per-device hardware specs and the
+  ``max(bytes/BW, flops/peak)`` floor model;
+* :mod:`.profiler` — wraps any ``ops.robust`` entry point, extracts
+  XLA cost analysis, measures wall time, and emits achieved-vs-roofline
+  fractions as JSONL (``python -m byzpy_tpu.profiling``);
+* :mod:`.autotune` + :mod:`.tilecache` — sweeps Pallas block shapes for
+  the hot kernels and persists winners in a shape-keyed on-disk cache
+  consulted (pre-trace) by the dispatch heuristics in
+  ``ops.pallas_kernels``.
+"""
+
+from .autotune import autotune_all, sweep
+from .profiler import (
+    baseline_workloads,
+    profile_call,
+    profile_suite,
+    write_jsonl,
+)
+from .roofline import HardwareSpec, detect_hardware, roofline_s
+
+__all__ = [
+    "HardwareSpec",
+    "autotune_all",
+    "baseline_workloads",
+    "detect_hardware",
+    "profile_call",
+    "profile_suite",
+    "roofline_s",
+    "sweep",
+    "write_jsonl",
+]

@@ -1,0 +1,114 @@
+"""Roofline profiler contract: the floor model, XLA cost extraction,
+record schema, and the JSONL sink."""
+
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from byzpy_tpu.profiling import profiler, roofline
+
+
+def _spec():
+    return roofline.HardwareSpec(
+        "test-chip", 100.0, {"float32": 1000.0, "bfloat16": 2000.0},
+        source="table",
+    )
+
+
+def test_roofline_floor_math():
+    spec = _spec()
+    # pure-memory op: 100 GB at 100 GB/s = 1 s
+    assert roofline.roofline_s(0.0, 100e9, dtype="float32", spec=spec) == (
+        pytest.approx(1.0)
+    )
+    # pure-compute op: 1000 GFLOP at 1000 GFLOP/s = 1 s
+    assert roofline.roofline_s(1000e9, 0.0, dtype="float32", spec=spec) == (
+        pytest.approx(1.0)
+    )
+    # the binding term wins
+    t = roofline.roofline_s(1000e9, 1e9, dtype="float32", spec=spec)
+    assert t == pytest.approx(1.0)
+    assert roofline.bound_kind(1000e9, 1e9, dtype="float32", spec=spec) == (
+        "compute"
+    )
+    assert roofline.bound_kind(1e9, 100e9, dtype="float32", spec=spec) == (
+        "memory"
+    )
+    # dtype selects the peak; unknown dtypes fall back to f32
+    assert spec.peak_for("bfloat16") == 2000.0
+    assert spec.peak_for("float64") == 1000.0
+
+
+def test_traffic_floor_counts_inputs_and_outputs():
+    x = jnp.zeros((4, 8), jnp.float32)
+    y = jnp.zeros((8,), jnp.bfloat16)
+    assert roofline.traffic_floor_bytes((x,), y) == 4 * 8 * 4 + 8 * 2
+
+
+def test_env_overrides(monkeypatch):
+    monkeypatch.setenv("BYZPY_TPU_MEM_GBPS", "123.5")
+    monkeypatch.setenv("BYZPY_TPU_PEAK_GFLOPS_F32", "777")
+    spec = roofline.detect_hardware()
+    assert spec.mem_bw_gbps == 123.5
+    assert spec.peak_gflops["float32"] == 777.0
+    assert spec.source == "env"
+
+
+def test_profile_call_record_schema(tmp_path):
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 256), jnp.float32)
+    rec = profiler.profile_call(
+        lambda a: jnp.median(a, axis=0), x, name="median_smoke",
+        spec=_spec(), warmup=1, repeat=2, extra={"f": 0},
+    )
+    for key in (
+        "name", "shape", "dtype", "measured_ms", "floor_bytes",
+        "roofline_ms", "achieved_fraction", "bound", "hardware",
+        "provenance",
+    ):
+        assert key in rec, key
+    assert rec["shape"] == [8, 256]
+    assert rec["dtype"] == "float32"
+    assert rec["floor_bytes"] == 8 * 256 * 4 + 256 * 4
+    assert rec["measured_ms"] > 0
+    assert 0 < rec["achieved_fraction"]
+    assert rec["f"] == 0
+    assert rec["provenance"]["platform"] == jax.default_backend()
+    # cost analysis on the CPU backend reports flops for a real program
+    assert rec["xla_flops"] is None or rec["xla_flops"] > 0
+
+    out = tmp_path / "roofline.jsonl"
+    profiler.write_jsonl([rec], str(out))
+    profiler.write_jsonl([rec], str(out))  # append semantics
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert len(lines) == 2 and lines[0]["name"] == "median_smoke"
+
+
+def test_xla_cost_handles_unanalyzable_functions():
+    # a function jit can't lower must not crash the profiler
+    cost = profiler.xla_cost(lambda a: (_ for _ in ()).throw(RuntimeError()),
+                             jnp.zeros(3))
+    assert cost == {"flops": None, "bytes_accessed": None}
+
+
+def test_suite_covers_every_robust_aggregator():
+    names = {w[0] for w in profiler.baseline_workloads()}
+    for expected in (
+        "cw_median", "cw_trimmed_mean", "meamed", "multi_krum", "krum",
+        "geometric_median", "centered_clipping", "cge", "monna", "caf",
+        "multi_krum_1M", "cw_median_1M",
+    ):
+        assert expected in names, expected
+
+
+@pytest.mark.slow
+def test_profile_suite_smoke(tmp_path):
+    out = str(tmp_path / "suite.jsonl")
+    recs = profiler.profile_suite(
+        out, scale=0.004, repeat=1, verbose=False,
+        names=["cw_median", "multi_krum"],
+    )
+    assert {r["name"] for r in recs} == {"cw_median", "multi_krum"}
+    assert len(open(out).read().splitlines()) == 2
