@@ -37,5 +37,17 @@ timeout 2400 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
 timeout 3600 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
   python benchmarks/full_grid.py > benchmarks/results/grid_tpu.jsonl \
   2>/tmp/r5_grid.err
+# 7. ISSUE 3 (quantized comm fabric): on-chip wire-bytes + steps/sec
+#    sweep (real ICI — CPU can only certify bytes, not time) and the
+#    per-aggregator int8 robustness grid, tuned quant tiles applied
+#    (fresh processes; the quant family autotunes in step 4)
+timeout 1800 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
+  python benchmarks/quantized_comm_bench.py \
+  --out benchmarks/results/quantized_comm_tpu.jsonl \
+  >> "$OUT" 2>/tmp/r5_quantcomm.err
+timeout 1800 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
+  python benchmarks/quant_robustness_study.py \
+  --out benchmarks/results/quant_robustness_tpu.jsonl \
+  >> "$OUT" 2>/tmp/r5_quantrob.err
 echo "# bundle end $(date -u)" >> "$OUT"
-echo "bundle complete: $OUT (+ roofline_tpu.jsonl, autotune_tpu.json, grid_tpu.jsonl)"
+echo "bundle complete: $OUT (+ roofline_tpu.jsonl, autotune_tpu.json, grid_tpu.jsonl, quantized_comm_tpu.jsonl, quant_robustness_tpu.jsonl)"

@@ -8,14 +8,23 @@ via collectives).
 
 Arrays smaller than ``min_bytes`` travel inline — the pickle round-trip is
 cheaper than two mmap syscalls for small payloads.
+
+``wrap_payload(..., precision="int8"|"bf16")`` composes with the wire
+tier's compressed tensor frames (:mod:`.wire`): large float arrays are
+quantized FIRST, so what lands in shm (and what a downstream pickle
+ships) is the int8/uint16 codes + per-block scales — the
+:class:`~byzpy_tpu.engine.actor.wire.QuantizedWireArray` dataclass
+envelope recurses through the shm swap like any other dataclass.
+``unwrap_payload`` reverses both layers. Default stays lossless.
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from . import wire as _wire
 from ..storage import native_store
 
 _TAG = "__BYZPY_SHARED_TENSOR__"
@@ -41,11 +50,28 @@ def _rebuild_tuple(x: tuple, values: list) -> tuple:
 
 
 def wrap_payload(
-    obj: Any, *, min_bytes: int = DEFAULT_MIN_BYTES
+    obj: Any,
+    *,
+    min_bytes: int = DEFAULT_MIN_BYTES,
+    precision: Optional[str] = None,
 ) -> Tuple[Any, List[native_store.SharedTensorHandle]]:
     """Recursively replace large arrays with shm handles. Returns the
     wrapped payload and the handles registered (caller owns cleanup; on
-    error, everything registered so far is unlinked before the raise)."""
+    error, everything registered so far is unlinked before the raise).
+
+    ``precision`` (``"int8"``/``"bf16"``) quantizes large float arrays
+    into :class:`~byzpy_tpu.engine.actor.wire.QuantizedWireArray` frames
+    before the shm swap — 4x (2x) fewer shm/pickle bytes, lossy;
+    ``unwrap_payload`` dequantizes. Device (jax/duck) arrays are brought
+    to host first so they compress too. ``None`` (default) is lossless;
+    an unrecognized mode raises (an explicit argument must not silently
+    ship full-size payloads)."""
+    if precision is not None:
+        if precision not in ("int8", "bf16"):
+            raise ValueError(
+                f"precision must be None, 'int8', or 'bf16' (got {precision!r})"
+            )
+        obj = _wire.compress_payload(_wire.host_view(obj), precision)
     handles: List[native_store.SharedTensorHandle] = []
 
     def wrap(x: Any) -> Any:
@@ -92,7 +118,8 @@ def unwrap_payload(obj: Any, *, copy: bool = False, close: bool = False) -> Any:
     pass ``copy=True`` when the result must outlive the sender's cleanup.
     ``close=True`` (requires ``copy``) unmaps each segment right after
     copying — the receiving-process pattern, so per-call mappings don't
-    accumulate."""
+    accumulate. Quantized frames produced by ``wrap_payload(...,
+    precision=...)`` are dequantized back to (lossy) float arrays."""
     if close and not copy:
         raise ValueError("close=True requires copy=True (views need the mapping)")
 
@@ -132,7 +159,7 @@ def unwrap_payload(obj: Any, *, copy: bool = False, close: bool = False) -> Any:
             return [unwrap(v) for v in x]
         return x
 
-    return unwrap(obj)
+    return _wire.decompress_payload(unwrap(obj))
 
 
 def cleanup_handles(handles: List[native_store.SharedTensorHandle]) -> None:

@@ -19,20 +19,33 @@ never goes through this wire; it rides XLA collectives (see
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import hmac
 import os
 import struct
 import warnings
-from typing import Any
+from typing import Any, Optional, Tuple
 
 import asyncio
 
 import cloudpickle
+import numpy as np
 
 _HEADER = struct.Struct(">I")
 MAX_FRAME = 1 << 31
 _SIG_LEN = hashlib.sha256().digest_size
+
+#: Env opt-in for compressed tensor frames: "off" (default, lossless
+#: cloudpickle), "bf16", or "int8" (blockwise symmetric, per-block f32
+#: scales carried in the frame). Lossy — remote-TCP PS rounds ship ~4x
+#: fewer payload bytes at int8; see docs/performance.md §quantized comms.
+_WIRE_PRECISION_ENV = "BYZPY_TPU_WIRE_PRECISION"
+_WIRE_BLOCK_ENV = "BYZPY_TPU_WIRE_BLOCK"
+#: Arrays below this element count always travel lossless (the scale
+#: header would rival the payload).
+WIRE_QUANT_MIN_SIZE = 1024
+_WIRE_DEFAULT_BLOCK = 256
 
 
 def _wire_key() -> bytes | None:
@@ -61,9 +74,224 @@ def warn_untrusted_bind(host: str, component: str) -> None:
         )
 
 
+def wire_precision() -> str:
+    """Resolved ``BYZPY_TPU_WIRE_PRECISION`` policy: ``"off"`` (default),
+    ``"bf16"``, or ``"int8"``. Unknown values degrade to ``"off"`` —
+    the wire must never fail on a typo'd env var."""
+    mode = os.environ.get(_WIRE_PRECISION_ENV, "off").lower()
+    return mode if mode in ("bf16", "int8") else "off"
+
+
+def _wire_block() -> int:
+    try:
+        block = int(os.environ.get(_WIRE_BLOCK_ENV, _WIRE_DEFAULT_BLOCK))
+    except ValueError:
+        return _WIRE_DEFAULT_BLOCK
+    return block if block > 0 else _WIRE_DEFAULT_BLOCK
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedWireArray:
+    """One compressed tensor inside a wire frame: ``codes`` (int8 for
+    ``int8`` mode, uint16 bf16 bit patterns for ``bf16``), the per-block
+    f32 ``scales`` header (``None`` for bf16), and enough metadata to
+    reconstruct shape/dtype. Pickles alongside the rest of the payload,
+    so the frame HMAC covers codes AND scales — a tampered scale block
+    fails :func:`decode` before any dequantization runs."""
+
+    mode: str
+    codes: np.ndarray
+    scales: Optional[np.ndarray]
+    block: int
+    shape: Tuple[int, ...]
+    dtype: str
+
+
+def _np_quantize(
+    arr: np.ndarray, block: int
+) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Blockwise symmetric int8 over the flattened array (numpy mirror of
+    ``parallel.quantization.quantize_blockwise``; parity is pinned by
+    ``tests/test_quantized_wire.py``). The third return is False when any
+    block's absmax is non-finite (an inf OR NaN input poisoned it — note
+    a NaN absmax yields a *finite* scale of 1.0, so the caller must test
+    this flag, not the scales) — the wire then ships the array lossless,
+    preserving attack vectors verbatim."""
+    flat = np.ascontiguousarray(arr, dtype=np.float32).ravel()
+    n = flat.size
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    xb = flat.reshape(nb, block)
+    absmax = np.max(np.abs(xb), axis=1)  # propagates inf AND NaN
+    finite = bool(np.isfinite(absmax).all())
+    scales = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    with np.errstate(invalid="ignore", over="ignore"):
+        codes = np.clip(np.rint(xb / scales[:, None]), -127, 127).astype(np.int8)
+    return codes.ravel()[:n], scales, finite
+
+
+def _np_dequantize(
+    codes: np.ndarray, scales: np.ndarray, block: int, shape, dtype
+) -> np.ndarray:
+    n = codes.size
+    nb = scales.size
+    pad = nb * block - n
+    flat = codes.astype(np.float32)
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    out = (flat.reshape(nb, block) * scales[:, None]).ravel()[:n]
+    return out.astype(dtype).reshape(shape)
+
+
+def _np_to_bf16(arr: np.ndarray) -> Tuple[np.ndarray, bool]:
+    """f32 -> bf16 bit patterns (uint16) with round-to-nearest-even.
+    The second return is False when the frame must travel lossless:
+    non-finite INPUTS (checked on the source exponent bits — a negative
+    NaN's rounding add wraps uint32 and would otherwise encode as +0.0,
+    silently sanitizing an adversarial payload) or finite values that
+    overflow to inf in bf16 (checked on the output exponent bits)."""
+    u = np.ascontiguousarray(arr, dtype=np.float32).view(np.uint32)
+    exp_mask = np.uint32(0x7F800000)
+    nonfinite_in = bool(np.any((u & exp_mask) == exp_mask))
+    rounded = u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1))
+    codes = (rounded >> np.uint32(16)).astype(np.uint16)
+    overflow_out = bool(
+        np.any((codes & np.uint16(0x7F80)) == np.uint16(0x7F80))
+    )
+    return codes, not (nonfinite_in or overflow_out)
+
+
+def _np_from_bf16(codes: np.ndarray, shape, dtype) -> np.ndarray:
+    u = codes.astype(np.uint32) << 16
+    return u.view(np.float32).astype(dtype).reshape(shape)
+
+
+def _quantizable(arr: np.ndarray, min_size: int) -> bool:
+    # lossless fallback for everything the blockwise codec can't carry
+    # faithfully enough: non-float dtypes, object payloads, small arrays.
+    # Non-finite payloads also fall back, but that is detected from the
+    # codec's own per-block reductions (a NaN/inf absmax poisons its
+    # scale, an overflowing bf16 cast sets exponent bits) instead of an
+    # extra full-array isfinite pass on the hot encode path.
+    return (
+        isinstance(arr, np.ndarray)
+        and arr.dtype.kind == "f"
+        and arr.dtype.itemsize >= 4
+        and arr.size >= min_size
+        and not arr.dtype.hasobject
+    )
+
+
+def _map_payload_leaves(leaf_fn, obj: Any) -> Any:
+    """Copy-on-write recursion over the wire payload containers
+    (dataclasses, dicts, tuples/namedtuples, lists): ``leaf_fn`` maps a
+    leaf to its replacement or returns it unchanged (identity). Untouched
+    subtrees are returned AS-IS — a frame with nothing to transform pays
+    one traversal and zero rebuilds, and payload dataclasses that cannot
+    be ``dataclasses.replace``'d (e.g. ``init=False`` fields) only fail
+    if a transformed leaf actually lives inside them. Both codec
+    directions (:func:`compress_payload` / :func:`decompress_payload`)
+    walk through here so the container semantics cannot drift; the shm
+    tier's wrap/unwrap and the jax-aware :func:`host_view` keep their own
+    walks (error-cleanup and registered-pytree semantics respectively)."""
+
+    def walk(x: Any) -> Any:
+        out = leaf_fn(x)
+        if out is not x:
+            return out
+        if isinstance(x, QuantizedWireArray):
+            # atomic: never descend into a frame (its scales header is a
+            # float array a compress pass must not re-quantize)
+            return x
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            new = {f.name: walk(getattr(x, f.name))
+                   for f in dataclasses.fields(x)}
+            if all(new[f.name] is getattr(x, f.name)
+                   for f in dataclasses.fields(x)):
+                return x
+            return dataclasses.replace(x, **new)
+        if isinstance(x, dict):
+            new = {k: walk(v) for k, v in x.items()}
+            if all(new[k] is v for k, v in x.items()):
+                return x
+            return new
+        if isinstance(x, (tuple, list)):
+            vals = [walk(v) for v in x]
+            if all(a is b for a, b in zip(vals, x)):
+                return x
+            if isinstance(x, list):
+                return vals
+            if hasattr(x, "_fields"):
+                return type(x)(*vals)
+            return tuple(vals)
+        return x
+
+    return walk(obj)
+
+
+def compress_payload(
+    obj: Any, mode: str, *, block: Optional[int] = None,
+    min_size: int = WIRE_QUANT_MIN_SIZE,
+) -> Any:
+    """Swap large finite float arrays in a payload pytree for
+    :class:`QuantizedWireArray` frames (``mode`` ``"int8"``/``"bf16"``;
+    anything else returns ``obj`` unchanged). Non-float, object-dtype,
+    small, and non-finite arrays pass through lossless (attack vectors
+    arrive verbatim, the reference's semantics). Untouched subtrees are
+    returned as-is."""
+    if mode not in ("int8", "bf16"):
+        return obj
+    block = block or _wire_block()
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, QuantizedWireArray):
+            return x
+        if isinstance(x, np.ndarray) and _quantizable(x, min_size):
+            if mode == "bf16":
+                codes, ok = _np_to_bf16(x)
+                if not ok:
+                    return x
+                return QuantizedWireArray(
+                    "bf16", codes, None, block, x.shape, str(x.dtype)
+                )
+            codes, scales, finite = _np_quantize(x, block)
+            # cheap post-hoc non-finite detection from the codec's own
+            # per-block absmax reduction (no extra full-array pass)
+            if not finite:
+                return x
+            return QuantizedWireArray(
+                "int8", codes, scales, block, x.shape, str(x.dtype)
+            )
+        return x
+
+    return _map_payload_leaves(leaf, obj)
+
+
+def decompress_payload(obj: Any) -> Any:
+    """Inverse of :func:`compress_payload`: every
+    :class:`QuantizedWireArray` becomes a (lossy) numpy array again;
+    everything else — including the whole payload when no compressed
+    frame is present — passes through untouched."""
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, QuantizedWireArray):
+            if x.mode == "bf16":
+                return _np_from_bf16(x.codes, x.shape, x.dtype)
+            return _np_dequantize(x.codes, x.scales, x.block, x.shape, x.dtype)
+        return x
+
+    return _map_payload_leaves(leaf, obj)
+
+
 def encode(obj: Any) -> bytes:
-    """Pickle ``obj`` into a length-prefixed (optionally HMAC-signed) frame body."""
-    body = cloudpickle.dumps(obj)
+    """Pickle ``obj`` into a length-prefixed (optionally HMAC-signed) frame
+    body. With ``BYZPY_TPU_WIRE_PRECISION`` set (``bf16``/``int8``), large
+    finite float arrays ship as compressed frames (per-block scales in the
+    header); the HMAC — unchanged — signs the whole body, compressed
+    payload and scale headers included."""
+    body = cloudpickle.dumps(compress_payload(obj, wire_precision()))
     key = _wire_key()
     if key is not None:
         body = _sign(body, key) + body
@@ -71,7 +299,9 @@ def encode(obj: Any) -> bytes:
 
 
 def decode(body: bytes) -> Any:
-    """Inverse of :func:`encode` (verifies the HMAC when signing is configured)."""
+    """Inverse of :func:`encode` (verifies the HMAC when signing is
+    configured, then expands any compressed tensor frames — so a tampered
+    code or scale byte fails verification before dequantization)."""
     key = _wire_key()
     if key is not None:
         if len(body) < _SIG_LEN:
@@ -82,7 +312,7 @@ def decode(body: bytes) -> Any:
                 "frame HMAC verification failed: wrong BYZPY_TPU_WIRE_KEY "
                 "or tampered/unsigned frame"
             )
-    return cloudpickle.loads(body)
+    return decompress_payload(cloudpickle.loads(body))
 
 
 def host_view(obj: Any) -> Any:
@@ -135,4 +365,16 @@ async def recv_obj(reader: asyncio.StreamReader) -> Any:
     return decode(body)
 
 
-__all__ = ["send_obj", "recv_obj", "encode", "decode", "host_view", "warn_untrusted_bind"]
+__all__ = [
+    "send_obj",
+    "recv_obj",
+    "encode",
+    "decode",
+    "host_view",
+    "warn_untrusted_bind",
+    "wire_precision",
+    "compress_payload",
+    "decompress_payload",
+    "QuantizedWireArray",
+    "WIRE_QUANT_MIN_SIZE",
+]

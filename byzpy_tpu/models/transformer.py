@@ -25,6 +25,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..parallel import collectives
 from .bundle import ModelBundle
 
 Dtype = Any
@@ -36,7 +37,7 @@ def _ring_axis_bound(axis: str) -> bool:
     ring models must then degrade to the exact single-block semantics
     instead of raising an unbound-axis NameError."""
     try:
-        jax.lax.axis_size(axis)
+        collectives.axis_size(axis)
         return True
     except NameError:
         return False

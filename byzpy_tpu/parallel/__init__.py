@@ -1,4 +1,4 @@
-from . import collectives
+from . import collectives, quantization
 from .gossip import (
     GossipStepConfig,
     build_gossip_train_step,
@@ -16,8 +16,21 @@ from .mesh import (
 from .moe import MoEFFN, moe_ffn, top1_dispatch
 from .pipeline import pipeline_forward, stack_stage_params
 from .ps import PSStepConfig, build_ps_train_step, default_optimizer, jit_ps_train_step
+from .quantization import (
+    CommPrecision,
+    QuantizedBlocks,
+    as_comm_precision,
+    dequantize_blockwise,
+    quantize_blockwise,
+)
 
 __all__ = [
+    "CommPrecision",
+    "QuantizedBlocks",
+    "as_comm_precision",
+    "dequantize_blockwise",
+    "quantization",
+    "quantize_blockwise",
     "MoEFFN",
     "moe_ffn",
     "top1_dispatch",

@@ -23,6 +23,12 @@ _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
     "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
     "c128": 16,
+    # fp8 families (quantized fabrics; XLA spells both the IEEE-ish and
+    # the -fn/-fnuz saturating variants)
+    "f8e4m3": 1, "f8e5m2": 1, "f8e4m3fn": 1, "f8e5m2fnuz": 1,
+    "f8e4m3fnuz": 1, "f8e4m3b11fnuz": 1,
+    # s4/u4 pack two values per byte; HLO sizes them at 1 byte minimum
+    "s4": 1, "u4": 1,
 }
 
 _COLLECTIVES = (
@@ -188,6 +194,25 @@ class ScalingPoint:
         return self.compute_s / (self.compute_s + self.comm_s)
 
 
+def compression_factor(
+    precision: str = "off", *, block: int = 256, dtype_bytes: int = 4
+) -> float:
+    """Wire-byte multiplier of a compressed fabric relative to its
+    full-precision baseline: 1.0 for ``"off"``, ``2/dtype_bytes`` for
+    ``"bf16"``, and ``(1 + 4/block)/dtype_bytes`` for ``"int8"``. The
+    law itself lives on
+    :meth:`~byzpy_tpu.parallel.quantization.CommPrecision.wire_bytes_per_value`
+    (single source of truth for the blockwise wire layout); this wrapper
+    only normalizes it to a ratio. Lazy import keeps this module's
+    top-level jax-free, like :func:`collective_traffic`."""
+    from .quantization import CommPrecision, as_comm_precision
+
+    p = as_comm_precision(precision or "off")
+    if p.block != block:
+        p = CommPrecision(mode=p.mode, block=block)
+    return p.wire_bytes_per_value(dtype_bytes) / dtype_bytes
+
+
 def scaling_model(
     *,
     flops_per_chip: float,
@@ -196,17 +221,25 @@ def scaling_model(
     ici_bytes_per_s: float = 4.5e10,  # v5e: 45 GB/s per direction per link
     chips: Sequence[int] = (8, 16, 32, 64, 128),
     mfu: float = 0.4,
+    precision: str = "off",
+    quant_block: int = 256,
 ) -> List[ScalingPoint]:
     """Analytic weak-scaling table: per-chip compute stays constant
     (``flops_per_chip`` at ``mfu`` of peak), per-chip wire bytes follow
     ``wire_bytes_fn(n_chips)`` (use :func:`collective_traffic` at a small
     mesh and the collectives' (g-1)/g laws to extrapolate), and the link
     runs at ``ici_bytes_per_s``. Effiency ≥ target iff comm stays hidden
-    under compute / (1 - target)."""
+    under compute / (1 - target).
+
+    ``precision`` extends the model to the compressed fabrics:
+    ``wire_bytes_fn`` keeps describing the FULL-precision (f32) traffic
+    and the comm term is scaled by :func:`compression_factor` — so one
+    measured byte inventory predicts all three wire modes."""
+    factor = compression_factor(precision, block=quant_block)
     points = []
     for n in chips:
         compute_s = flops_per_chip / (chip_flops * mfu)
-        comm_s = wire_bytes_fn(n) / ici_bytes_per_s
+        comm_s = wire_bytes_fn(n) * factor / ici_bytes_per_s
         points.append(ScalingPoint(n, compute_s, comm_s))
     return points
 
@@ -216,5 +249,6 @@ __all__ = [
     "collectives_in_hlo",
     "collective_traffic",
     "ScalingPoint",
+    "compression_factor",
     "scaling_model",
 ]

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import collectives
+
 Array = jnp.ndarray
 
 
@@ -85,7 +87,7 @@ def moe_ffn(
     """
     t, d = x.shape
     e_local = w_in.shape[0]
-    p = lax.axis_size(axis_name) if axis_name else 1
+    p = collectives.axis_size(axis_name) if axis_name else 1
     n_experts = e_local * p
     capacity = max(1, int(capacity_factor * t / n_experts))
 
@@ -130,7 +132,7 @@ class MoEFFN(nn.Module):
         gate_w = self.param(
             "gate", nn.initializers.lecun_normal(), (d, self.n_experts), self.dtype
         )
-        p = lax.axis_size(self.axis_name) if self.axis_name else 1
+        p = collectives.axis_size(self.axis_name) if self.axis_name else 1
         if self.n_experts % p:
             raise ValueError(
                 f"n_experts={self.n_experts} must divide over axis size {p}"

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import collectives
+
 Array = jnp.ndarray
 
 
@@ -45,7 +47,7 @@ def pipeline_forward(
     Returns ``(n_micro, B_micro, ...)`` final-stage outputs, replicated
     across the axis.
     """
-    p = lax.axis_size(axis_name)
+    p = collectives.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     n_micro = micro_x.shape[0]
     ticks = n_micro + p - 1

@@ -38,6 +38,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.bundle import ModelBundle
 from ..utils.trees import ravel_pytree_fn
 from .mesh import node_axis
+from .quantization import (
+    CommPrecision,
+    QuantizedBlocks,
+    as_comm_precision,
+    dequantize_blockwise,
+    quantize_blockwise,
+)
 
 AggFn = Callable[[jnp.ndarray], jnp.ndarray]          # (n, d) -> (d,)
 PreAggFn = Callable[[jnp.ndarray], jnp.ndarray]       # (n, d) -> (m, d)
@@ -73,6 +80,7 @@ def build_ps_train_step(
     optimizer: Optional[optax.GradientTransformation] = None,
     mesh: Optional[Mesh] = None,
     grad_dtype: Any = None,
+    comm_precision: Any = None,
 ) -> Tuple[Callable, Any]:
     """Build ``(train_step, opt_state0)``.
 
@@ -83,10 +91,21 @@ def build_ps_train_step(
     sharding before aggregation; without a mesh it is the same program on
     one device.
 
+    ``comm_precision`` (``"off"``/``"bf16"``/``"int8"`` or a
+    :class:`~byzpy_tpu.parallel.quantization.CommPrecision`) compresses
+    the gradient-transpose wire traffic — the round's dominant collective
+    at ``d >= 1e5``: the stacked gradient matrix is encoded *before* the
+    node->feature resharding constraint, so the all-to-all XLA inserts
+    moves int8 codes (+ per-block f32 scales) or bf16 instead of f32, and
+    every device decodes after the transpose. Aggregation always runs on
+    the decoded full-precision matrix. The default ``"off"`` produces a
+    program bit-identical to the uncompressed fabric.
+
     Returns ``(params, opt_state, metrics)`` where metrics carries the mean
     honest loss and the aggregated-gradient norm.
     """
     opt = optimizer or default_optimizer(cfg)
+    comm = as_comm_precision(comm_precision)
     opt_state0 = opt.init(bundle.params)
     ravel, unravel = ravel_pytree_fn(bundle.params)
     loss_fn = bundle.loss_fn
@@ -112,6 +131,15 @@ def build_ps_train_step(
         )
         node_spec = NamedSharding(mesh, P(axis, *extra[:1]))
         feat_spec = NamedSharding(mesh, P(None, (axis, *extra)))
+        # rows of the stacked (n, d) gradient matrix live on the node axis
+        # before the transpose; pinning the encoded payload there first
+        # forces the reshard (the wire hop) to move the COMPRESSED tensor
+        # — with only the post-transpose constraint XLA may reshard the
+        # f32 input and encode/decode locally, moving full-precision bytes
+        row_spec = NamedSharding(mesh, P(axis))
+        feat_shards = mesh.shape[axis]
+        for a in extra:
+            feat_shards *= mesh.shape[a]
 
     def per_node_grad(params, x, y):
         loss, g = jax.value_and_grad(loss_fn)(params, x, y)
@@ -122,6 +150,56 @@ def build_ps_train_step(
 
     param_dtype = ravel(bundle.params).dtype
 
+    def build_matrix(grads_n, key):
+        """Honest rows + byzantine rows from the (n, d) per-node gradient
+        stack (pure function of the rows — runs node-sharded in the
+        uncompressed fabric, feature-sharded after a compressed
+        transpose; all attacks are coordinate-wise over the node axis,
+        so both layouts partition cleanly)."""
+        honest = grads_n[:h] if b else grads_n
+        if not b:
+            return honest
+        if attack is not None:
+            byz = attack(honest, key)
+        else:
+            # no attack configured: byzantine nodes echo honest
+            # gradients (cycled, so any b < n works)
+            byz = jnp.tile(honest, ((b + h - 1) // h, 1))[:b]
+        byz = jnp.broadcast_to(byz, (b, honest.shape[1])).astype(honest.dtype)
+        return jnp.concatenate([honest, byz], axis=0)
+
+    def transpose_compressed(grads_n):
+        """Encoded gradient transpose: pin the encoded payload to the node
+        layout, re-pin it to the feature layout (the reshard between the
+        two constraints IS the wire hop — so the all-to-all moves
+        int8/bf16), and decode feature-sharded. The decoded matrix is
+        constrained too, else the partitioner replicates the aggregation
+        input with an (n, d) f32 all-reduce that dwarfs the transpose."""
+        if comm.mode == "bf16":
+            m16 = jax.lax.with_sharding_constraint(
+                grads_n.astype(jnp.bfloat16), row_spec
+            )
+            m16 = jax.lax.with_sharding_constraint(m16, feat_spec)
+            return jax.lax.with_sharding_constraint(
+                m16.astype(grads_n.dtype), feat_spec
+            )
+        q = quantize_blockwise(grads_n, block=comm.block)
+        v = jax.lax.with_sharding_constraint(q.values, row_spec)
+        v = jax.lax.with_sharding_constraint(v, feat_spec)
+        # scales are 4/block of the payload: shard them alongside the
+        # codes when the block grid divides the mesh, else let XLA place
+        # them (tiny either way)
+        s = jax.lax.with_sharding_constraint(q.scales, row_spec)
+        if s.shape[-1] % feat_shards == 0:
+            s = jax.lax.with_sharding_constraint(s, feat_spec)
+        return jax.lax.with_sharding_constraint(
+            dequantize_blockwise(
+                QuantizedBlocks(v, s, q.block, q.orig_dtype),
+                dtype=grads_n.dtype,
+            ),
+            feat_spec,
+        )
+
     def train_step(params, opt_state, xs, ys, key):
         if node_spec is not None:
             xs = jax.lax.with_sharding_constraint(xs, node_spec)
@@ -129,23 +207,23 @@ def build_ps_train_step(
         # Every node's forward/backward runs in parallel across the mesh:
         # vmap over the node axis of node-sharded data with replicated params.
         losses, grads = jax.vmap(per_node_grad, in_axes=(None, 0, 0))(params, xs, ys)
-        honest = grads[:h] if b else grads
-        if b:
-            if attack is not None:
-                byz = attack(honest, key)
-            else:
-                # no attack configured: byzantine nodes echo honest
-                # gradients (cycled, so any b < n works)
-                byz = jnp.tile(honest, ((b + h - 1) // h, 1))[:b]
-            byz = jnp.broadcast_to(byz, (b, honest.shape[1])).astype(honest.dtype)
-            matrix = jnp.concatenate([honest, byz], axis=0)
+        if feat_spec is not None and comm.enabled:
+            # Compressed fabric: every node's RAW gradient row crosses the
+            # wire encoded (exactly what a deployment ships — byzantine
+            # nodes transmit too), and the attack/masking runs on the
+            # decoded, feature-sharded rows: the omniscient adversary sees
+            # the wire view of the honest gradients.
+            matrix = jax.lax.with_sharding_constraint(
+                build_matrix(transpose_compressed(grads), key), feat_spec
+            )
         else:
-            matrix = honest
-        if feat_spec is not None:
-            # Gradient transpose: node-sharded rows -> feature-sharded
-            # columns (XLA lowers this constraint to an all_to_all over ICI),
-            # so the robust aggregation below is chip-local per coordinate.
-            matrix = jax.lax.with_sharding_constraint(matrix, feat_spec)
+            matrix = build_matrix(grads, key)
+            if feat_spec is not None:
+                # Gradient transpose: node-sharded rows -> feature-sharded
+                # columns (XLA lowers this constraint to an all_to_all over
+                # ICI), so the robust aggregation below is chip-local per
+                # coordinate.
+                matrix = jax.lax.with_sharding_constraint(matrix, feat_spec)
         if pre_aggregate is not None:
             matrix = pre_aggregate(matrix)
         agg_flat = aggregate(matrix).astype(param_dtype)

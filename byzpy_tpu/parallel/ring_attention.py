@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from . import collectives
 from .collectives import sharded_fn
 
 Array = jnp.ndarray
@@ -58,7 +59,7 @@ def ring_attention(
     device's global block index is its position on ``axis_name``; K/V
     rotate ``n`` steps so every Q block sees every K/V block.
     """
-    n = lax.axis_size(axis_name)
+    n = collectives.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     lq, d = q.shape
     lk = k.shape[0]

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from . import collectives
 from .collectives import sharded_fn
 from .ring_attention import full_attention
 
@@ -48,7 +49,7 @@ def ulysses_attention(
     heads. Requires ``H % axis_size == 0``. Returns ``(L_local, H, Dh)``
     with the same sequence sharding.
     """
-    p = lax.axis_size(axis_name)
+    p = collectives.axis_size(axis_name)
     lq, h, dh = q.shape
     if h % p != 0:
         raise ValueError(

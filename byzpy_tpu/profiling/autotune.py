@@ -8,6 +8,9 @@ the roofline profiler showed dominating the round loop —
 * ``selection`` — ``pallas_kernels.selection_mean_stream_pallas``
 * ``sorted_reduce`` — ``pallas_kernels.sorted_reduce_stream_pallas``
 * ``meamed`` — ``pallas_kernels.meamed_stream_pallas``
+* ``quant`` — ``parallel.quantization.quantize_blockwise`` (the
+  compressed-fabric encode; candidates stay multiples of the
+  quantization block so scales never straddle a grid step)
 
 — and persists each winner in the shape-keyed on-disk cache
 (:mod:`.tilecache`) that ``_auto_tile`` / ``_auto_selection_tile`` /
@@ -41,6 +44,7 @@ CANDIDATES: Dict[str, Tuple[int, ...]] = {
     "selection": (2048, 4096, 8192, 16384),
     "sorted_reduce": (512, 1024, 2048, 4096),
     "meamed": (256, 512, 1024, 2048),
+    "quant": (1024, 2048, 4096, 8192, 16384),
 }
 
 
@@ -66,6 +70,12 @@ def _kernel_runner(family: str) -> Callable:
         return lambda x, tile: pk.meamed_stream_pallas(
             x[None], f=max(1, x.shape[0] // 8), tile=tile
         )
+    if family == "quant":
+        from ..parallel.quantization import quantize_blockwise
+
+        return lambda x, tile: quantize_blockwise(
+            x, tile=tile, use_pallas=True
+        ).values
     raise ValueError(f"unknown kernel family {family!r}")
 
 

@@ -3,12 +3,14 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from byzpy_tpu.parallel.comms import (
     CollectiveOp,
     collective_traffic,
     collectives_in_hlo,
+    compression_factor,
     scaling_model,
 )
 
@@ -54,6 +56,37 @@ ENTRY %main (p: f32[64]) -> f32[64] {
     assert len(ops) == 1 and not ops[0].in_entry
 
 
+def test_quantized_dtypes_counted_not_dropped():
+    """Satellite of ISSUE 3: s8/u8/s16/u16/f8*/pred buffers must land in
+    wire_bytes_per_device instead of silently vanishing from the traffic
+    model — pinned with a hand-written int8 all-gather (the compressed
+    fabric's dominant payload) plus fp8 and pred cousins."""
+    hlo = """
+HloModule m
+
+ENTRY %main (p: s8[8,256]) -> s8[64,256] {
+  %p = s8[8,256] parameter(0)
+  %ag = s8[64,256]{1,0} all-gather(%p), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %f8 = f8e4m3[8,256]{1,0} all-gather(%p2), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %f8b = f8e5m2[8,256]{1,0} all-gather(%p3), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %msk = pred[8,256]{1,0} all-gather(%p4), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %s16 = s16[8,256]{1,0} all-gather(%p5), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %u16 = u16[8,256]{1,0} all-gather(%p6), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %u8 = u8[8,256]{1,0} all-gather(%p7), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  ROOT %out = s8[64,256]{1,0} copy(%ag)
+}
+"""
+    ops = collectives_in_hlo(hlo, default_group=8)
+    assert len(ops) == 7, ops
+    by_bytes = {op.result_bytes for op in ops}
+    # int8 gather result: 64*256*1 bytes; 1-byte cousins: 8*256; 2-byte: 8*256*2
+    assert 64 * 256 in by_bytes
+    assert 8 * 256 in by_bytes and 8 * 256 * 2 in by_bytes
+    assert all(op.result_bytes > 0 for op in ops), "a dtype fell out of the table"
+    int8_ag = next(op for op in ops if op.result_bytes == 64 * 256)
+    assert int8_ag.wire_bytes_per_device == 64 * 256 * 7 // 8
+
+
 def test_wire_byte_laws():
     assert CollectiveOp("all-gather", 1024, 8).wire_bytes_per_device == 1024 * 7 // 8
     assert CollectiveOp("all-reduce", 1024, 8).wire_bytes_per_device == 2 * 1024 * 7 // 8
@@ -91,6 +124,25 @@ def test_scaling_model_efficiency_saturates():
     # comm is ~constant in N: 128-chip efficiency within 3% of 8-chip
     assert abs(pts[0].efficiency - pts[1].efficiency) < 0.03
     assert 0.0 < pts[0].efficiency < 1.0
+
+
+def test_scaling_model_predicts_compressed_fabrics():
+    """The comm term scales by the compression factor: int8 at block 256
+    moves (1 + 4/256)/4 of the f32 bytes, bf16 exactly half."""
+    kwargs = dict(
+        flops_per_chip=1e9,
+        wire_bytes_fn=lambda g: 8e6 * (g - 1) / g,
+        chips=(8,),
+    )
+    full = scaling_model(**kwargs)[0]
+    i8 = scaling_model(precision="int8", quant_block=256, **kwargs)[0]
+    bf = scaling_model(precision="bf16", **kwargs)[0]
+    assert i8.comm_s == pytest.approx(full.comm_s * (1 + 4 / 256) / 4)
+    assert bf.comm_s == pytest.approx(full.comm_s / 2)
+    assert i8.efficiency > bf.efficiency > full.efficiency
+    assert compression_factor("off") == 1.0
+    with pytest.raises(ValueError):
+        compression_factor("fp4")
 
 
 def test_loop_body_collectives_reported_separately(devices):

@@ -97,11 +97,15 @@ def test_ring_exchange_collects_neighbors():
             got = ring_exchange(blk[0], 2, axis_name="nodes")
             return got[None]
 
-        return jax.shard_map(
+        from byzpy_tpu.parallel.collectives import shard_map
+
+        return shard_map(
             body, mesh=mesh, in_specs=(P("nodes", None),), out_specs=P("nodes", None, None)
         )(x)
 
-    out = np.asarray(run(jax.device_put(x, jax.NamedSharding(mesh, jax.P("nodes", None)))))
+    out = np.asarray(run(jax.device_put(
+        x, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("nodes", None))
+    )))
     # node i receives from i-1 and i-2 (ring senders send clockwise)
     for i in range(N):
         assert out[i, 0, 0] == (i - 1) % N

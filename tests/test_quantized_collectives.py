@@ -1,0 +1,361 @@
+"""Collective tier of the quantized comm fabric on the 8-device CPU mesh:
+parity/error bounds for the quantized collectives, f32-accumulation
+bit-exactness for the once-quantized reduce-scatter, the off=identical
+regression contract for every fabric, and the compressed-wire byte
+reduction measured from compiled HLO."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from byzpy_tpu.models.bundle import ModelBundle
+from byzpy_tpu.parallel import collectives as coll
+from byzpy_tpu.parallel import quantization as qz
+from byzpy_tpu.parallel.mesh import node_mesh, sharding
+
+
+@pytest.fixture
+def mesh(devices):
+    return node_mesh(8)
+
+
+def _node_sharded(mesh, key, shape, dtype=jnp.float32):
+    x = jax.random.normal(key, shape, jnp.float32).astype(dtype)
+    return jax.device_put(x, sharding(mesh, "nodes"))
+
+
+# ---------------------------------------------------------------------------
+# ring_all_reduce_sum: off == bit-identical, quantized == bounded error
+# ---------------------------------------------------------------------------
+
+
+def test_ring_off_is_bit_identical_to_default(mesh):
+    x = _node_sharded(mesh, jax.random.PRNGKey(0), (8, 96))
+
+    def build(precision):
+        return coll.sharded_fn(
+            mesh, "nodes",
+            lambda s: coll.ring_all_reduce_sum(
+                s[0], "nodes", precision=precision
+            )[None],
+            in_spec=P("nodes"), out_spec=P("nodes"),
+        )
+
+    base = np.asarray(build(None)(x))
+    off = np.asarray(build("off")(x))
+    np.testing.assert_array_equal(base, off)
+
+
+@pytest.mark.parametrize("precision,rtol", [("int8", 0.05), ("bf16", 0.02)])
+def test_ring_quantized_tracks_psum(mesh, precision, rtol):
+    x = _node_sharded(mesh, jax.random.PRNGKey(1), (8, 512))
+    ring = coll.sharded_fn(
+        mesh, "nodes",
+        lambda s: coll.ring_all_reduce_sum(s[0], "nodes", precision=precision)[None],
+        in_spec=P("nodes"), out_spec=P("nodes"),
+    )
+    out = np.asarray(ring(x))
+    oracle = np.asarray(x).sum(axis=0)
+    scale = np.abs(oracle).max()
+    for row in out:  # replicated result on every device
+        np.testing.assert_allclose(row, oracle, atol=rtol * scale)
+    # the gather half forwards one encoding: all devices decode identical bits
+    for row in out[1:]:
+        np.testing.assert_array_equal(row, out[0])
+
+
+@pytest.mark.parametrize("dim", [37, 5, 0])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ring_pad_path_edges(mesh, dim, dtype):
+    """Sizes not divisible by n, size < n, and size 0 across f32/bf16 —
+    the zero-pad + reshape path at its edges (satellite of ISSUE 3)."""
+    x = _node_sharded(mesh, jax.random.PRNGKey(dim + 7), (8, dim), dtype)
+    ring = coll.sharded_fn(
+        mesh, "nodes",
+        lambda s: coll.ring_all_reduce_sum(s[0], "nodes")[None],
+        in_spec=P("nodes"), out_spec=P("nodes"),
+    )
+    out = np.asarray(ring(x).astype(jnp.float32))
+    assert out.shape == (8, dim)
+    if dim == 0:
+        return
+    oracle = np.asarray(x.astype(jnp.float32)).sum(axis=0)
+    # bf16 rings accumulate in bf16 and in ring order: allow one bf16 ulp
+    # (2^-8 relative) per of the 7 adds at the partial sums' magnitude
+    atol = 1e-5 if dtype == jnp.float32 else \
+        8 * 2.0 ** -8 * np.abs(np.asarray(x, np.float32)).sum(axis=0).max()
+    for row in out:
+        np.testing.assert_allclose(row, oracle, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# all_gather_q / reduce_scatter_sum_q / all_to_all_q
+# ---------------------------------------------------------------------------
+
+
+def test_all_gather_q_off_identical_and_int8_bounded(mesh):
+    x = _node_sharded(mesh, jax.random.PRNGKey(2), (8, 512))
+
+    def build(precision):
+        return coll.sharded_fn(
+            mesh, "nodes",
+            lambda s: coll.all_gather_q(s, "nodes", precision=precision),
+            in_spec=P("nodes"), out_spec=P(),
+        )
+
+    np.testing.assert_array_equal(np.asarray(build("off")(x)), np.asarray(x))
+    got = np.asarray(build("int8")(x))
+    ref = np.asarray(x)
+    assert np.abs(got - ref).max() <= np.abs(ref).max() / 127 + 1e-6
+
+
+def test_all_gather_q_rejects_misaligned_trailing_axis(mesh):
+    x = _node_sharded(mesh, jax.random.PRNGKey(3), (8, 100))
+    fn = coll.sharded_fn(
+        mesh, "nodes",
+        lambda s: coll.all_gather_q(s[0], "nodes", precision="int8"),
+        in_spec=P("nodes"), out_spec=P(),
+    )
+    with pytest.raises(ValueError, match="trailing axis"):
+        fn(x)
+
+
+def test_reduce_scatter_sum_q_f32_accumulation_bit_exact(mesh):
+    """Each term is quantized exactly once at its source and the receiver
+    sums dequantized f32 — the collective result must be bit-exact
+    against the same dequantize+sum computed locally (acceptance
+    criterion: bit-exact in accumulation dtype)."""
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 512), jnp.float32)
+    xs = jax.device_put(x, sharding(node_mesh(8), "nodes"))
+    rs = coll.sharded_fn(
+        node_mesh(8), "nodes",
+        lambda s: coll.reduce_scatter_sum_q(s[0], "nodes", precision="int8")[None],
+        in_spec=P("nodes"), out_spec=P("nodes"),
+    )
+    out = np.asarray(rs(xs)).reshape(8, 64)
+    # oracle: per-device rows quantized independently, dequantized, then
+    # summed in f32 in device order — the exact program the collective runs
+    deq = jnp.stack([
+        qz.quantize_blockwise(x[dev].reshape(8, 64), block=256).dequantize()
+        for dev in range(8)
+    ])  # (src_dev, chunk_idx, 64)
+    expected = np.asarray(jnp.sum(deq, axis=0))
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_reduce_scatter_sum_q_off_matches_psum_scatter(mesh):
+    x = _node_sharded(mesh, jax.random.PRNGKey(5), (8, 64))
+    rs = coll.sharded_fn(
+        mesh, "nodes",
+        lambda s: coll.reduce_scatter_sum_q(s[0], "nodes", precision="off")[None],
+        in_spec=P("nodes"), out_spec=P("nodes"),
+    )
+    out = np.asarray(rs(x)).reshape(-1)
+    np.testing.assert_allclose(out, np.asarray(x).sum(axis=0), rtol=1e-5)
+
+
+def test_reduce_scatter_sum_q_shape_matches_off_for_ndim2(mesh):
+    """Toggling precision must never change output shapes: the 2-D off
+    path keeps trailing dims ((d0/n, d1)) and so must int8/bf16."""
+    x = _node_sharded(mesh, jax.random.PRNGKey(8), (8, 16, 32))
+
+    def build(precision):
+        return coll.sharded_fn(
+            mesh, "nodes",
+            lambda s: coll.reduce_scatter_sum_q(
+                s[0], "nodes", precision=precision
+            )[None],
+            in_spec=P("nodes"), out_spec=P("nodes"),
+        )
+
+    ref = np.asarray(build("off")(x))
+    for mode in ("int8", "bf16"):
+        got = np.asarray(build(mode)(x))
+        assert got.shape == ref.shape
+        np.testing.assert_allclose(
+            got, ref, atol=np.abs(ref).max() / 60
+        )
+
+
+def test_all_to_all_q_bf16_allows_trailing_axis(mesh):
+    """bf16 is an elementwise cast — no block alignment exists, so
+    trailing-axis exchanges must not raise (int8 still rejects them)."""
+    x = _node_sharded(mesh, jax.random.PRNGKey(9), (8, 64, 8))
+    fn = coll.sharded_fn(
+        mesh, "nodes",
+        lambda s: coll.all_to_all_q(
+            s[0], "nodes", split_axis=1, concat_axis=1, precision="bf16"
+        )[None],
+        in_spec=P("nodes"), out_spec=P("nodes"),
+    )
+    out = np.asarray(fn(x))
+    # out[dev, r, j] = x[j, r, dev] under the tiled split/concat on axis 1
+    ref = np.transpose(np.asarray(x), (2, 1, 0))
+    assert out.shape == (8, 64, 8)
+    np.testing.assert_allclose(out, ref, atol=np.abs(ref).max() * 2 ** -7)
+
+
+def test_ps_fabric_int8_survives_inf_attack(mesh):
+    """The compressed fabric must not convert a survivable inf attack
+    into NaN parameters (the uncompressed robust aggregators already
+    tolerate non-finite byzantine rows)."""
+    from byzpy_tpu.ops import robust
+    from byzpy_tpu.parallel.ps import PSStepConfig, build_ps_train_step
+
+    bundle = _linear_bundle(seed=3)
+    cfg = PSStepConfig(n_nodes=8, n_byzantine=1)
+    xs = jax.random.normal(jax.random.PRNGKey(10), (8, 16, 24))
+    ys = jax.random.normal(jax.random.PRNGKey(11), (8, 16, 3))
+
+    def inf_attack(honest, key):
+        return jnp.full((1, honest.shape[1]), jnp.inf, honest.dtype)
+
+    step, o0 = build_ps_train_step(
+        bundle, lambda m: robust.trimmed_mean(m, f=1), cfg,
+        mesh=mesh, attack=inf_attack, comm_precision="int8",
+    )
+    p1, _, metrics = jax.jit(step)(bundle.params, o0, xs, ys, jax.random.PRNGKey(12))
+    assert np.isfinite(np.asarray(p1["w"])).all()
+    assert np.isfinite(float(metrics["agg_grad_norm"]))
+
+
+def test_all_to_all_q_transposes_with_bounded_error(mesh):
+    x = _node_sharded(mesh, jax.random.PRNGKey(6), (8, 8, 256))
+    fn = coll.sharded_fn(
+        mesh, "nodes",
+        lambda s: coll.all_to_all_q(
+            s[0], "nodes", split_axis=0, concat_axis=0, precision="int8"
+        )[None],
+        in_spec=P("nodes"), out_spec=P("nodes"),
+    )
+    out = np.asarray(fn(x))
+    ref = np.swapaxes(np.asarray(x), 0, 1)
+    assert np.abs(out - ref).max() <= np.abs(ref).max() / 127 + 1e-6
+    with pytest.raises(ValueError, match="trailing axis"):
+        coll.sharded_fn(
+            mesh, "nodes",
+            lambda s: coll.all_to_all_q(
+                s[0], "nodes", split_axis=1, concat_axis=1, precision="int8"
+            )[None],
+            in_spec=P("nodes"), out_spec=P("nodes"),
+        )(x)
+
+
+# ---------------------------------------------------------------------------
+# wire bytes: the compressed fabric must actually shrink the HLO traffic
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_collectives_cut_wire_bytes(mesh):
+    """Compiled-HLO accounting: int8 all_gather moves < 1/2 the bytes of
+    the f32 one (acceptance floor is 1.5x; blockwise int8 delivers ~3.9x)."""
+    from byzpy_tpu.parallel.comms import collective_traffic
+
+    x = _node_sharded(mesh, jax.random.PRNGKey(7), (8, 4096))
+
+    def build(precision):
+        return coll.sharded_fn(
+            mesh, "nodes",
+            lambda s: coll.all_gather_q(s, "nodes", precision=precision),
+            in_spec=P("nodes"), out_spec=P(),
+        )
+
+    full = collective_traffic(build("off"), x)["wire_bytes_per_device"]
+    quant = collective_traffic(build("int8"), x)["wire_bytes_per_device"]
+    assert full > 0 and quant > 0
+    assert full / quant >= 1.5, (full, quant)
+
+
+# ---------------------------------------------------------------------------
+# fabric regression: CommPrecision=off is bit-identical end to end
+# ---------------------------------------------------------------------------
+
+
+def _linear_bundle(seed=0, d_in=24, d_out=3):
+    params = {"w": jax.random.normal(jax.random.PRNGKey(seed), (d_in, d_out)) * 0.1}
+
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    def loss_fn(p, x, y):
+        return jnp.mean((apply_fn(p, x) - y) ** 2)
+
+    return ModelBundle(apply_fn=apply_fn, params=params, loss_fn=loss_fn)
+
+
+def test_ps_fabric_off_bit_identical_and_int8_bounded(mesh):
+    from byzpy_tpu.ops import robust
+    from byzpy_tpu.parallel.ps import PSStepConfig, build_ps_train_step
+
+    bundle = _linear_bundle()
+    cfg = PSStepConfig(n_nodes=8, n_byzantine=1)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 24))
+    ys = jax.random.normal(jax.random.PRNGKey(2), (8, 16, 3))
+    key = jax.random.PRNGKey(3)
+
+    def run(precision):
+        step, o0 = build_ps_train_step(
+            bundle, lambda m: robust.trimmed_mean(m, f=1), cfg,
+            mesh=mesh, comm_precision=precision,
+        )
+        p1, _, _ = jax.jit(step)(bundle.params, o0, xs, ys, key)
+        return np.asarray(p1["w"])
+
+    base, off = run(None), run("off")
+    np.testing.assert_array_equal(base, off)
+    i8 = run("int8")
+    assert not np.array_equal(i8, base) or np.allclose(i8, base)
+    np.testing.assert_allclose(i8, base, atol=5e-3)
+
+
+def test_gossip_fabric_off_bit_identical_and_int8_bounded(mesh):
+    from byzpy_tpu.engine.peer_to_peer.topology import Topology
+    from byzpy_tpu.ops import robust
+    from byzpy_tpu.parallel.gossip import GossipStepConfig, build_gossip_train_step
+
+    bundle = _linear_bundle(seed=1)
+    cfg = GossipStepConfig(n_nodes=8, n_byzantine=1)
+    topo = Topology.ring(8, 2)
+    xs = jax.random.normal(jax.random.PRNGKey(4), (8, 16, 24))
+    ys = jax.random.normal(jax.random.PRNGKey(5), (8, 16, 3))
+    key = jax.random.PRNGKey(6)
+
+    def run(precision):
+        step, init = build_gossip_train_step(
+            bundle, lambda m: robust.trimmed_mean(m, f=1), topo, cfg,
+            mesh=mesh, comm_precision=precision,
+        )
+        theta1, _ = jax.jit(step)(init(), xs, ys, key)
+        return np.asarray(theta1)
+
+    base, off = run(None), run("off")
+    np.testing.assert_array_equal(base, off)
+    np.testing.assert_allclose(run("int8"), base, atol=5e-3)
+
+
+def test_ring_gossip_fabric_off_bit_identical_and_int8_bounded(mesh):
+    from byzpy_tpu.ops import robust
+    from byzpy_tpu.parallel.gossip import (
+        GossipStepConfig,
+        build_ring_gossip_train_step,
+    )
+
+    bundle = _linear_bundle(seed=2)
+    cfg = GossipStepConfig(n_nodes=8, n_byzantine=1)
+    xs = jax.random.normal(jax.random.PRNGKey(7), (8, 16, 24))
+    ys = jax.random.normal(jax.random.PRNGKey(8), (8, 16, 3))
+    key = jax.random.PRNGKey(9)
+
+    def run(precision):
+        step, init = build_ring_gossip_train_step(
+            bundle, lambda m: robust.trimmed_mean(m, f=1), cfg, mesh,
+            k=2, comm_precision=precision,
+        )
+        theta1, _ = jax.jit(step)(init(), xs, ys, key)
+        return np.asarray(theta1)
+
+    base, off = run(None), run("off")
+    np.testing.assert_array_equal(base, off)
+    np.testing.assert_allclose(run("int8"), base, atol=5e-3)

@@ -1,0 +1,258 @@
+"""Actor-wire tier of the quantized comm fabric: compressed tensor
+frames behind ``BYZPY_TPU_WIRE_PRECISION``, HMAC coverage of the scale
+headers, lossless fallbacks (non-float / object / non-finite / small
+payloads), numpy<->jax codec parity, and the shm (ipc) composition."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.engine.actor import ipc, wire
+
+
+@pytest.fixture
+def grads():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=50_000).astype(np.float32)
+
+
+def _body(frame: bytes) -> bytes:
+    return frame[wire._HEADER.size:]
+
+
+# ---------------------------------------------------------------------------
+# env opt-in + frame round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_default_off_is_lossless(monkeypatch, grads):
+    monkeypatch.delenv("BYZPY_TPU_WIRE_PRECISION", raising=False)
+    assert wire.wire_precision() == "off"
+    out = wire.decode(_body(wire.encode({"g": grads})))
+    np.testing.assert_array_equal(out["g"], grads)
+
+
+def test_bogus_env_value_degrades_to_off(monkeypatch, grads):
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "fp4")
+    assert wire.wire_precision() == "off"
+    out = wire.decode(_body(wire.encode({"g": grads})))
+    np.testing.assert_array_equal(out["g"], grads)
+
+
+@pytest.mark.parametrize("mode,min_ratio,max_err", [
+    ("int8", 3.0, 1.0 / 127 + 1e-6),
+    ("bf16", 1.8, 2.0 ** -8),
+])
+def test_quantized_frames_shrink_and_bound_error(monkeypatch, grads, mode,
+                                                 min_ratio, max_err):
+    monkeypatch.delenv("BYZPY_TPU_WIRE_PRECISION", raising=False)
+    full = wire.encode({"g": grads})
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", mode)
+    frame = wire.encode({"g": grads})
+    assert len(full) / len(frame) >= min_ratio
+    out = wire.decode(_body(frame))
+    rel = np.abs(out["g"] - grads).max() / np.abs(grads).max()
+    assert rel <= max_err
+    assert out["g"].dtype == grads.dtype and out["g"].shape == grads.shape
+
+
+def test_lossless_fallback_non_float_object_small_nonfinite(monkeypatch, grads):
+    """Satellite: non-float and object payloads (and small / non-finite
+    float arrays) must round-trip losslessly even with quantization on."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "int8")
+    nonfinite = grads.copy()
+    nonfinite[17] = np.nan
+    payload = {
+        "ints": np.arange(5000, dtype=np.int64),
+        "bools": np.ones(5000, dtype=bool),
+        "obj": np.array([{"k": 1}, [2, 3], None], dtype=object),
+        "small": np.float32([1.5, -2.5]),
+        "nonfinite": nonfinite,
+        "scalar": 7,
+        "text": "x" * 100,
+    }
+    out = wire.decode(_body(wire.encode(payload)))
+    np.testing.assert_array_equal(out["ints"], payload["ints"])
+    np.testing.assert_array_equal(out["bools"], payload["bools"])
+    assert out["obj"][0] == {"k": 1} and out["obj"][1] == [2, 3]
+    np.testing.assert_array_equal(out["small"], payload["small"])
+    np.testing.assert_array_equal(out["nonfinite"], nonfinite)
+    assert out["scalar"] == 7 and out["text"] == payload["text"]
+
+
+@dataclasses.dataclass
+class _Envelope:
+    tag: str
+    payload: object
+
+
+def test_dataclass_and_namedtuple_envelopes_recurse(monkeypatch, grads):
+    import collections
+
+    NT = collections.namedtuple("NT", ["a", "b"])
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "int8")
+    msg = _Envelope(tag="grads", payload=NT(a=grads, b=[_Envelope("inner", grads)]))
+    out = wire.decode(_body(wire.encode(msg)))
+    assert isinstance(out, _Envelope) and isinstance(out.payload, NT)
+    assert np.abs(out.payload.a - grads).max() <= np.abs(grads).max() / 127 + 1e-6
+    assert isinstance(out.payload.b[0], _Envelope)
+
+
+# ---------------------------------------------------------------------------
+# HMAC covers the quantized frame (codes AND scale header)
+# ---------------------------------------------------------------------------
+
+
+def test_hmac_rejects_tampered_quantized_frame(monkeypatch, grads):
+    """Satellite: a tampered scale block must fail decode. The scales
+    pickle near the frame tail (after the codes buffer) — flip bytes
+    across that whole region and require rejection everywhere."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "int8")
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "sekrit")
+    body = _body(wire.encode({"g": grads}))
+    assert wire.decode(body)  # intact frame verifies
+    n = len(body)
+    for off in (wire._SIG_LEN + 5, n // 2, n - n // 8, n - 1):
+        tampered = bytearray(body)
+        tampered[off] ^= 0x01
+        with pytest.raises(ValueError, match="HMAC"):
+            wire.decode(bytes(tampered))
+
+
+def test_without_key_scale_tamper_changes_values_silently(monkeypatch, grads):
+    """Documents the trust model: WITHOUT a wire key nothing veri-
+    fies — integrity of the scale header is exactly the HMAC's job."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "int8")
+    monkeypatch.delenv("BYZPY_TPU_WIRE_KEY", raising=False)
+    q = wire.compress_payload({"g": grads}, "int8")
+    q["g"].scales[0] *= 64.0  # adversarial scale inflation
+    out = wire.decompress_payload(q)
+    assert np.abs(out["g"][:q["g"].block] - grads[:q["g"].block]).max() > 1.0
+
+
+# ---------------------------------------------------------------------------
+# numpy codec parity with the jax kernel tier
+# ---------------------------------------------------------------------------
+
+
+def test_np_codec_matches_jax_quantizer(grads):
+    import jax.numpy as jnp
+
+    from byzpy_tpu.parallel import quantization as qz
+
+    block = 256
+    codes, scales, finite = wire._np_quantize(grads, block)
+    assert finite
+    q = qz.quantize_blockwise(jnp.asarray(grads), block=block)
+    np.testing.assert_array_equal(codes, np.asarray(q.values))
+    np.testing.assert_allclose(scales, np.asarray(q.scales), rtol=1e-7)
+    deq_np = wire._np_dequantize(codes, scales, block, grads.shape, grads.dtype)
+    np.testing.assert_allclose(deq_np, np.asarray(q.dequantize()), rtol=1e-6)
+
+
+def test_bf16_codec_round_trips_exact_bf16_values():
+    import jax.numpy as jnp
+
+    vals = np.float32([1.0, -2.5, 0.15625, 3.0e38, -1.0e-30, 0.0])
+    codes, ok = wire._np_to_bf16(vals)
+    assert ok
+    back = wire._np_from_bf16(codes, vals.shape, np.float32)
+    ref = np.asarray(jnp.asarray(vals).astype(jnp.bfloat16).astype(jnp.float32))
+    np.testing.assert_array_equal(back, ref)
+
+
+def test_bf16_negative_nan_payload_falls_back_lossless(monkeypatch):
+    """Adversarial negative-NaN bit patterns (0xFFFF8000..0xFFFFFFFF)
+    wrap the uint32 rounding add and would encode as +0.0 — the input
+    exponent check must force the lossless fallback so a NaN-poisoning
+    attack vector is never silently sanitized to zeros."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "bf16")
+    payload = np.full(2048, np.uint32(0xFFFFFFFF)).view(np.float32)
+    assert np.isnan(payload).all()
+    out = wire.decode(_body(wire.encode({"g": payload})))
+    np.testing.assert_array_equal(
+        out["g"].view(np.uint32), payload.view(np.uint32)
+    )
+
+
+@dataclasses.dataclass
+class _InitFalseEnvelope:
+    tag: str
+    derived: int = dataclasses.field(init=False, default=0)
+
+
+def test_decode_leaves_untouched_payloads_identical(monkeypatch):
+    """decode() must not rebuild containers that hold no compressed
+    frame: dataclasses that cannot be dataclasses.replace'd (init=False
+    fields) round-trip fine, and an uncompressed decode returns the
+    unpickled object tree as-is (copy-on-write walk)."""
+    monkeypatch.delenv("BYZPY_TPU_WIRE_PRECISION", raising=False)
+    msg = _InitFalseEnvelope(tag="hb")
+    msg.derived = 7
+    out = wire.decode(_body(wire.encode({"m": msg, "seq": [1, (2, 3)]})))
+    assert out["m"].tag == "hb" and out["m"].derived == 7
+    assert out["seq"] == [1, (2, 3)]
+    # copy-on-write: decompress of an untouched tree IS the same object
+    tree = {"a": [1, 2], "b": (np.arange(3),)}
+    assert wire.decompress_payload(tree) is tree
+
+
+# ---------------------------------------------------------------------------
+# shm (ipc) composition
+# ---------------------------------------------------------------------------
+
+
+def test_ipc_wrap_quantizes_then_shms_codes(grads):
+    wrapped, handles = ipc.wrap_payload(
+        {"g": grads, "meta": 1}, min_bytes=1024, precision="int8"
+    )
+    try:
+        assert isinstance(wrapped["g"], wire.QuantizedWireArray)
+        # the int8 codes buffer crossed the min_bytes bar -> shm handle
+        assert isinstance(wrapped["g"].codes, tuple)
+        out = ipc.unwrap_payload(wrapped, copy=True)
+        assert out["meta"] == 1
+        assert np.abs(out["g"] - grads).max() <= np.abs(grads).max() / 127 + 1e-6
+    finally:
+        ipc.cleanup_handles(handles)
+
+
+def test_bf16_overflow_falls_back_lossless(monkeypatch):
+    """Finite f32 values beyond bf16 max would cast to inf — the frame
+    must travel lossless instead of silently minting infinities."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "bf16")
+    # finite in f32 (max 3.4028e38) but above bf16 max (~3.3895e38)
+    big = np.full(5000, 3.4e38, np.float32)
+    assert np.isfinite(big).all()
+    out = wire.decode(_body(wire.encode({"g": big})))
+    np.testing.assert_array_equal(out["g"], big)
+
+
+def test_ipc_rejects_unknown_precision(grads):
+    with pytest.raises(ValueError, match="precision"):
+        ipc.wrap_payload({"g": grads}, precision="int4")
+
+
+def test_ipc_precision_compresses_device_arrays():
+    """jax arrays (duck arrays with __array__) must be hosted and
+    compressed, not silently shipped full-size lossless."""
+    import jax.numpy as jnp
+
+    g = jnp.linspace(-3.0, 3.0, 50_000, dtype=jnp.float32)
+    wrapped, handles = ipc.wrap_payload({"g": g}, min_bytes=1024, precision="int8")
+    try:
+        assert isinstance(wrapped["g"], wire.QuantizedWireArray)
+        out = ipc.unwrap_payload(wrapped, copy=True)
+        assert np.abs(out["g"] - np.asarray(g)).max() <= 3.0 / 127 + 1e-6
+    finally:
+        ipc.cleanup_handles(handles)
+
+
+def test_ipc_default_stays_lossless(grads):
+    wrapped, handles = ipc.wrap_payload({"g": grads}, min_bytes=1024)
+    try:
+        out = ipc.unwrap_payload(wrapped, copy=True)
+        np.testing.assert_array_equal(out["g"], grads)
+    finally:
+        ipc.cleanup_handles(handles)

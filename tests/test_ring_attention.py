@@ -108,7 +108,7 @@ def test_ring_vmapped_over_heads(mesh):
     from functools import partial
 
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from byzpy_tpu.parallel.collectives import shard_map
 
     from byzpy_tpu.parallel.ring_attention import ring_attention
 
@@ -139,7 +139,7 @@ def test_ring_scale_override(mesh):
     qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
     from functools import partial
 
-    from jax import shard_map
+    from byzpy_tpu.parallel.collectives import shard_map
     from jax.sharding import PartitionSpec as P
 
     from byzpy_tpu.parallel.ring_attention import ring_attention
