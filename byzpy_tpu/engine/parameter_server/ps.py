@@ -85,6 +85,21 @@ class ParameterServer:
         assumption (raises :class:`QuorumLostError` below it). Without
         it, any node failure fails the round (the reference's semantics,
         ``byzpy/engine/parameter_server/ps.py:103-144``).
+    update_sharding:
+        Optional :class:`~byzpy_tpu.parallel.ps.ShardedUpdateConfig` (or
+        mode string / bool). With ``mode="on"`` or ``"auto"`` (and more
+        than one local device), the stack→aggregate→unravel hot path
+        places the stacked ``(n, d)`` gradient matrix FEATURE-SHARDED
+        over a 1-D ``feat`` mesh of the local devices before the robust
+        aggregate — the actor-mode analogue of the fused SPMD round's
+        update shard: coordinate-wise aggregators reduce their local
+        column slice, geometric families psum an ``(n, n)`` Gram block,
+        and no chip materializes the whole matrix. Applies to the inline
+        aggregation paths (plain aggregator and fused pipelines) on
+        device-resident payloads; pool-scheduled aggregation and the
+        small-payload host-placement fast path (``utils.placement``) are
+        untouched. Default ``None`` = off — heterogeneous actor
+        deployments may have no local device grid at all.
     overlap:
         Optional :class:`~byzpy_tpu.engine.overlap.OverlapConfig`. Turns
         on the overlapped round engine: arrival-order streaming
@@ -112,6 +127,7 @@ class ParameterServer:
         pool_config: Optional[ActorPoolConfig | Sequence[ActorPoolConfig]] = None,
         elastic: Optional[ElasticPolicy] = None,
         overlap: Optional[OverlapConfig] = None,
+        update_sharding: Any = None,
     ) -> None:
         if not honest_nodes:
             raise ValueError("ParameterServer needs at least one honest node")
@@ -127,6 +143,10 @@ class ParameterServer:
         self.elastic = elastic
         self.elastic_state = ElasticState()
         self.overlap = overlap
+        # feature-sharded aggregation policy (resolved against the local
+        # device count on first use; "off" when unset)
+        self._update_sharding = update_sharding
+        self._feat_sharding_cache = None
         self.last_overlap_stats: Optional[RoundOverlapStats] = None
         # cross-round prefetch buffers: apply→compute chains dispatched
         # at the end of round r, collected at the start of round r+1
@@ -173,20 +193,72 @@ class ParameterServer:
             for node in self.byzantine_nodes
         )
 
+    def _feature_shard_resolved(self) -> bool:
+        """Whether the ``update_sharding`` policy is active on this host's
+        device grid (cheap — checked BEFORE any gradient stacking)."""
+        if self._update_sharding is None:
+            return False
+        import jax
+
+        from ...parallel.ps import as_sharded_update
+
+        return as_sharded_update(self._update_sharding).resolve(
+            len(jax.devices())
+        )
+
+    def _feature_shard(self, matrix: Any) -> Optional[Any]:
+        """The stacked ``(n, d)`` gradient matrix placed feature-sharded
+        over the local ``feat`` mesh, or ``None`` when the
+        ``update_sharding`` policy (or the hardware/shape) doesn't call
+        for it — the actor-mode analogue of the fused round's update
+        shard (``parallel/ps.py``)."""
+        if not self._feature_shard_resolved():
+            return None
+        import jax
+
+        n_dev = len(jax.devices())
+        if getattr(matrix, "ndim", 0) != 2 or matrix.shape[1] < n_dev:
+            return None
+        if self._feat_sharding_cache is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ...parallel.mesh import feature_mesh
+
+            self._feat_sharding_cache = NamedSharding(
+                feature_mesh(n_dev), PartitionSpec(None, "feat")
+            )
+        return jax.device_put(matrix, self._feat_sharding_cache)
+
     async def _aggregate(self, gradients: List[Any]) -> Any:
+        from ...utils import placement
+        from ...utils.trees import stack_gradients
+
         if self.pre_aggregator is not None:
             if self._fused_pipeline is not None:
-                from ...utils import placement
-                from ...utils.trees import stack_gradients
-
-                with placement.on(placement.compute_device(gradients)):
+                dev = placement.compute_device(gradients)
+                with placement.on(dev):
                     matrix, unravel = stack_gradients(gradients)
                     self.pre_aggregator.validate_n(matrix.shape[0])
                     self.aggregator.validate_n(matrix.shape[0])
+                    if dev is None:
+                        # device-resident payload: distribute the fused
+                        # Gram collapse over the local feature grid
+                        sharded = self._feature_shard(matrix)
+                        if sharded is not None:
+                            matrix = sharded
                     return unravel(self._fused_pipeline(matrix))
             gradients = self.pre_aggregator.pre_aggregate(gradients)
         if self._executor is not None:
             return await self._executor.run(gradients)
+        if (
+            self._feature_shard_resolved()
+            and placement.compute_device(gradients) is None
+        ):
+            matrix, unravel = stack_gradients(gradients)
+            sharded = self._feature_shard(matrix)
+            if sharded is not None:
+                self.aggregator.validate_n(matrix.shape[0])
+                return unravel(self.aggregator.matrix_fn()(sharded))
         return self.aggregator.aggregate(gradients)
 
     # -- elastic round pieces -------------------------------------------------

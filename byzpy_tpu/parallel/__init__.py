@@ -15,7 +15,14 @@ from .mesh import (
 )
 from .moe import MoEFFN, moe_ffn, top1_dispatch
 from .pipeline import pipeline_forward, stack_stage_params
-from .ps import PSStepConfig, build_ps_train_step, default_optimizer, jit_ps_train_step
+from .ps import (
+    PSStepConfig,
+    ShardedUpdateConfig,
+    as_sharded_update,
+    build_ps_train_step,
+    default_optimizer,
+    jit_ps_train_step,
+)
 from .quantization import (
     CommPrecision,
     QuantizedBlocks,
@@ -44,6 +51,8 @@ __all__ = [
     "sharding",
     "replicated",
     "PSStepConfig",
+    "ShardedUpdateConfig",
+    "as_sharded_update",
     "build_ps_train_step",
     "jit_ps_train_step",
     "default_optimizer",

@@ -213,6 +213,91 @@ def compression_factor(
     return p.wire_bytes_per_value(dtype_bytes) / dtype_bytes
 
 
+def opt_state_bytes(
+    n_params: int,
+    *,
+    slots: int = 1,
+    dtype_bytes: int = 4,
+    update_sharded: bool = False,
+    n_shards: int = 1,
+) -> int:
+    """Per-chip bytes of the round's carried weight-update state.
+
+    A replicated update keeps ``slots`` full d-sized moment buffers on
+    EVERY chip (SGD+momentum: 1; Adam: 2). The sharded update
+    (``parallel.ps.ShardedUpdateConfig``) carries ``slots + 1`` buffers
+    — every moment plus the chip's authoritative exact flat param shard
+    — each split over the ``n_shards``-way feature grid (ceil: d pads to
+    the grid): a ``slots·n/(slots+1)``× cut (4× at n=8 for momentum,
+    5.3× for Adam; → n× as slots grow)."""
+    if not update_sharded or n_shards <= 1:
+        return slots * n_params * dtype_bytes
+    per_shard = -(-n_params // n_shards)
+    return (slots + 1) * per_shard * dtype_bytes
+
+
+def measured_opt_state_bytes(opt_state: Any) -> int:
+    """Per-chip bytes the carried update state ACTUALLY occupies, from
+    each leaf's shard shape — the measured side of the
+    :func:`opt_state_bytes` law (used by the probe, the sharded-update
+    bench, and its tests; lazy import keeps this module jax-free at the
+    top level)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None or not hasattr(leaf, "shape"):
+            continue
+        n = 1
+        for dim in sharding.shard_shape(leaf.shape):
+            n *= int(dim)
+        total += n * leaf.dtype.itemsize
+    return total
+
+
+def ps_round_wire_bytes(
+    n_params: int,
+    n_chips: int,
+    *,
+    dtype_bytes: int = 4,
+    update_sharded: bool = False,
+    grad_precision: str = "off",
+    param_precision: str = "off",
+    quant_block: int = 256,
+) -> float:
+    """Closed-form per-device wire bytes of the fused PS round's two
+    dominant collectives (validated against compiled HLO by
+    ``benchmarks/sharded_update_bench.py``):
+
+    * the gradient transpose — an all-to-all moving ``d·dt·(n-1)/n``,
+      compressible per ``grad_precision`` (the PR-3 fabric);
+    * the update move — an all-gather of ``d`` values with the same
+      ``(n-1)/n`` law. Replicated update: the f32 *aggregated gradient*
+      is gathered and must stay exact (it feeds every chip's optimizer
+      state), so ``param_precision`` is ignored. Sharded update: only
+      the *refreshed params* are gathered, each chip's exact shard stays
+      in the carried opt state, and the gather compresses per
+      ``param_precision`` without compounding error.
+
+    Robust-aggregation traffic itself (a scalar or an (n, n) Gram psum)
+    is negligible next to these at ``d >= 1e5``."""
+    g = max(n_chips, 1)
+    saturate = (g - 1) / g
+    transpose = (
+        n_params * dtype_bytes
+        * compression_factor(grad_precision, block=quant_block, dtype_bytes=dtype_bytes)
+        * saturate
+    )
+    pfac = (
+        compression_factor(param_precision, block=quant_block, dtype_bytes=dtype_bytes)
+        if update_sharded
+        else 1.0
+    )
+    gather = n_params * dtype_bytes * pfac * saturate
+    return transpose + gather
+
+
 def scaling_model(
     *,
     flops_per_chip: float,
@@ -250,5 +335,8 @@ __all__ = [
     "collective_traffic",
     "ScalingPoint",
     "compression_factor",
+    "measured_opt_state_bytes",
+    "opt_state_bytes",
+    "ps_round_wire_bytes",
     "scaling_model",
 ]

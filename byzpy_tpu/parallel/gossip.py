@@ -34,7 +34,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..engine.peer_to_peer.topology import Topology
 from ..models.bundle import ModelBundle
 from ..utils.trees import ravel_pytree_fn
+from .collectives import all_to_all_q, reshard_q
 from .mesh import node_axis, sharding as mesh_sharding
+from .ps import as_sharded_update
 from .quantization import (
     QuantizedBlocks,
     as_comm_precision,
@@ -66,6 +68,7 @@ def build_gossip_train_step(
     attack: Optional[AttackFn] = None,
     mesh: Optional[Mesh] = None,
     comm_precision: Any = None,
+    update_sharding: Any = None,
 ) -> Tuple[Callable, Callable]:
     """Build ``(train_step, init_stacked_params)``.
 
@@ -86,6 +89,21 @@ def build_gossip_train_step(
     *broadcast* is the attack vector; their own row keeps its half-step
     value (a byzantine node doesn't sabotage itself, it sabotages what it
     sends — matching runner.py:316-368).
+
+    ``update_sharding``
+    (:class:`~byzpy_tpu.parallel.ps.ShardedUpdateConfig`, mode string,
+    bool, or ``None`` = auto) applies the sharded-weight-update transform
+    to the exchange: instead of materializing the whole broadcast matrix
+    on every chip (an implicit ``(n-1)·d``-byte all-gather per device),
+    the matrix transposes node→feature (an all-to-all moving ``~n·d/g``
+    per device, compressed per ``comm_precision``), every neighborhood
+    aggregates shard-locally, and the refreshed rows transpose back
+    feature→node (compressed per ``param_gather_precision`` — each
+    peer's update is computed sharded and gossip moves shards). Under
+    GSPMD constraints the transform is semantics-preserving for ANY
+    aggregator (XLA inserts the cross-shard psum geometric families
+    need); with everything f32 it is bit-identical per coordinate for
+    coordinate-wise families.
     """
     if topology.n_nodes != cfg.n_nodes:
         raise ValueError("topology size must match cfg.n_nodes")
@@ -114,8 +132,24 @@ def build_gossip_train_step(
 
         mesh = get_default_mesh()
     node_sharding = None
+    su = as_sharded_update(update_sharding)
+    gather_p = as_comm_precision(su.param_gather_precision)
+    feat_spec = row_spec = None
+    feat_shards = 1
     if mesh is not None:
-        node_sharding = mesh_sharding(mesh, node_axis(mesh))
+        axis = node_axis(mesh)
+        node_sharding = mesh_sharding(mesh, axis)
+        # the PS round's feature layout (parallel/ps.py): rows stay whole,
+        # columns shard over every mesh axis with extent > 1
+        extra = tuple(
+            a for a in mesh.axis_names if a != axis and mesh.shape[a] > 1
+        )
+        row_spec = NamedSharding(mesh, P(axis))
+        feat_spec = NamedSharding(mesh, P(None, (axis, *extra)))
+        feat_shards = mesh.shape[axis]
+        for a in extra:
+            feat_shards *= mesh.shape[a]
+    su_on = mesh is not None and su.resolve(feat_shards)
 
     def init_stacked_params() -> jnp.ndarray:
         flat = ravel(bundle.params)
@@ -147,12 +181,39 @@ def build_gossip_train_step(
         else:
             broadcast = theta_half
         # 3+4. each node robust-aggregates its in-neighborhood (self included
-        #    via the self index in each group's neighbor rows). `broadcast`
-        #    is logically all-gathered; XLA materializes it from the static
-        #    gathers below, one vmap per in-degree group. With compression
-        #    on, the gathers address the encoded broadcast (int8 codes +
-        #    scales, or bf16) and each neighborhood decodes locally — the
-        #    materialized exchange moves compressed bytes.
+        #    via the self index in each group's neighbor rows).
+        if su_on:
+            # sharded update: transpose the broadcast matrix node->feature
+            # (the exchange — an all-to-all moving ~n·d/g bytes/device,
+            # encoded per comm_precision), aggregate every node's
+            # neighborhood shard-locally (row indexing is free in this
+            # layout: each chip holds ALL rows for its column slice), and
+            # transpose the refreshed rows back feature->node — the params
+            # move, encoded per update_sharding.param_gather_precision.
+            bc = reshard_q(broadcast, row_spec, feat_spec, precision=comm)
+            theta_f = bc
+            for idxs, nbrs in neighbor_groups:
+                rows = jax.vmap(lambda nbr_idx: aggregate(bc[nbr_idx]))(nbrs)
+                theta_f = theta_f.at[idxs].set(rows.astype(theta_f.dtype))
+            theta_f = jax.lax.with_sharding_constraint(theta_f, feat_spec)
+            theta_new = reshard_q(
+                theta_f, feat_spec, row_spec, precision=gather_p
+            )
+            # byzantine nodes keep their own half-step state
+            if b:
+                keep = jnp.arange(n)[:, None] >= h
+                theta_new = jnp.where(keep, theta_half, theta_new)
+            if node_sharding is not None:
+                theta_new = jax.lax.with_sharding_constraint(
+                    theta_new, node_sharding
+                )
+            return theta_new, {"honest_loss": jnp.mean(losses[:h])}
+        #    Replicated exchange: `broadcast` is logically all-gathered;
+        #    XLA materializes it from the static gathers below, one vmap
+        #    per in-degree group. With compression on, the gathers address
+        #    the encoded broadcast (int8 codes + scales, or bf16) and each
+        #    neighborhood decodes locally — the materialized exchange
+        #    moves compressed bytes.
         if comm.mode == "bf16":
             enc = broadcast.astype(jnp.bfloat16)
 
@@ -215,6 +276,7 @@ def build_ring_gossip_train_step(
     k: int = 1,
     attack: Optional[AttackFn] = None,
     comm_precision: Any = None,
+    update_sharding: Any = None,
 ) -> Tuple[Callable, Callable]:
     """Ring-topology gossip as an explicit ``shard_map`` program: parameters
     never leave their chip except as ``ppermute`` neighbor traffic.
@@ -230,6 +292,20 @@ def build_ring_gossip_train_step(
     decode — ~4x fewer ICI bytes at int8. The node's own half-step row
     never crosses the wire and stays exact. ``"off"`` (default) is
     bit-identical to the uncompressed fabric.
+
+    ``update_sharding`` with ``mode="on"`` applies the manual-SPMD shard
+    split: each device owns feature shard ``me`` of EVERY node's outgoing
+    vector (one ``all_to_all``, ``comm_precision``-encoded), aggregates
+    all ``n`` ring neighborhoods over its ``d/n``-wide slice, and a
+    second ``all_to_all`` (``param_gather_precision``-encoded) returns
+    each node its refreshed shards — ``2·d·(n-1)/n`` wire bytes per
+    device instead of ``k·d``, a win for ``k >= 2``. Because this is an
+    explicit per-shard program (not GSPMD), it REQUIRES a coordinate-wise
+    aggregator (per-coordinate decomposable: median, trimmed mean,
+    MeaMed, mean); selection/geometric families would score on partial
+    vectors. ``"auto"`` therefore stays off here — the split is strictly
+    opt-in. Under it the node's own row does cross the wire (encoded like
+    its neighbors').
     """
     axis = node_axis(mesh)
     n = cfg.n_nodes
@@ -272,22 +348,44 @@ def build_ring_gossip_train_step(
             malicious = -half
         outgoing = jnp.where(is_byz, malicious, half)
         comm = as_comm_precision(comm_precision)
-        if comm.mode == "bf16":
-            received = ring_exchange(
-                outgoing.astype(jnp.bfloat16), k, axis_name=axis
-            ).astype(outgoing.dtype)  # (k, d)
-        elif comm.mode == "int8":
-            q = quantize_blockwise(outgoing, block=comm.block)
-            recv_v = ring_exchange(q.values, k, axis_name=axis)
-            recv_s = ring_exchange(q.scales, k, axis_name=axis)
-            received = dequantize_blockwise(
-                QuantizedBlocks(recv_v, recv_s, q.block, q.orig_dtype),
-                dtype=outgoing.dtype,
-            )  # (k, d)
+        su = as_sharded_update(update_sharding)
+        if su.mode == "on":
+            # shard split: device me owns feature slice me of every node
+            d_size = outgoing.shape[0]
+            dpn = -(-d_size // n)
+            chunks = jnp.pad(outgoing, (0, dpn * n - d_size)).reshape(n, dpn)
+            # row j after the exchange = node j's shard `me`
+            cols = all_to_all_q(
+                chunks, axis, split_axis=0, concat_axis=0, precision=comm
+            )
+            # ring neighborhood of node i: [i, i-1, ..., i-k] (the exact
+            # row order the replicated path stacks), sliced to this shard
+            idx = (
+                jnp.arange(n)[:, None] - jnp.arange(k + 1)[None, :]
+            ) % n
+            agg_shards = jax.vmap(aggregate)(cols[idx])  # (n, dpn)
+            # return transpose: row j = shard j of MY aggregate
+            back = all_to_all_q(
+                agg_shards, axis, split_axis=0, concat_axis=0,
+                precision=as_comm_precision(su.param_gather_precision),
+            )
+            agg = back.reshape(-1)[:d_size].astype(half.dtype)
         else:
-            received = ring_exchange(outgoing, k, axis_name=axis)  # (k, d)
-        stacked = jnp.concatenate([half[None, :], received], axis=0)
-        agg = aggregate(stacked)
+            if comm.mode == "bf16":
+                received = ring_exchange(
+                    outgoing.astype(jnp.bfloat16), k, axis_name=axis
+                ).astype(outgoing.dtype)  # (k, d)
+            elif comm.mode == "int8":
+                q = quantize_blockwise(outgoing, block=comm.block)
+                recv_v = ring_exchange(q.values, k, axis_name=axis)
+                recv_s = ring_exchange(q.scales, k, axis_name=axis)
+                received = dequantize_blockwise(
+                    QuantizedBlocks(recv_v, recv_s, q.block, q.orig_dtype),
+                    dtype=outgoing.dtype,
+                )  # (k, d)
+            else:
+                received = ring_exchange(outgoing, k, axis_name=axis)  # (k, d)
+            agg = aggregate(jnp.concatenate([half[None, :], received], axis=0))
         new_row = jnp.where(is_byz, half, agg)
         honest_loss = jax.lax.psum(
             jnp.where(is_byz, 0.0, loss), axis

@@ -17,8 +17,18 @@ round collapses into a single compiled step over a ``Mesh``:
   sharding constraint — XLA inserts the ``all_to_all`` "gradient
   transpose" over ICI — so coordinate-wise aggregators run fully locally
   per chip and geometric ones psum an ``(n, n)`` Gram block;
-* update: the aggregated vector is unraveled and applied with optax;
-  params/opt-state stay replicated.
+* update: the round stays sharded end-to-end. The aggregated flat
+  gradient keeps the feature layout through ``opt.update`` /
+  ``optax.apply_updates`` — optimizer state is initialized and carried
+  feature-sharded over the same grid (per-chip opt-state HBM and update
+  flops both drop ~n×) and ONE params all-gather (optionally bf16/int8
+  via :func:`~byzpy_tpu.parallel.collectives.reshard_q`) replaces the
+  implicit f32 aggregated-gradient all-gather of a replicated update
+  ("Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+  Training", PAPERS.md). :class:`ShardedUpdateConfig` switches the
+  transform (``auto`` default: on whenever the mesh feature grid spans
+  more than one chip; ``off`` reproduces the replicated update
+  bit-for-bit).
 
 No pickling, no shm, no host round-trips — the collectives ARE the
 parameter server.
@@ -28,7 +38,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -37,13 +47,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.bundle import ModelBundle
 from ..utils.trees import ravel_pytree_fn
+from .collectives import reshard_q
 from .mesh import node_axis
 from .quantization import (
     CommPrecision,
-    QuantizedBlocks,
     as_comm_precision,
-    dequantize_blockwise,
-    quantize_blockwise,
 )
 
 AggFn = Callable[[jnp.ndarray], jnp.ndarray]          # (n, d) -> (d,)
@@ -70,6 +78,81 @@ def default_optimizer(cfg: PSStepConfig) -> optax.GradientTransformation:
     return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
 
 
+_SHARDED_UPDATE_MODES = ("off", "on", "auto")
+
+
+@dataclass(frozen=True)
+class ShardedUpdateConfig:
+    """Policy for the feature-sharded weight update.
+
+    ``mode``:
+
+    * ``"off"`` — replicated update: the aggregated gradient is gathered
+      to every chip, every chip holds a full optimizer-state replica and
+      redundantly applies the full d-dim update (the pre-round-8
+      program, kept bit-identical).
+    * ``"on"`` — the flat aggregated gradient, flat params, and the
+      optimizer state all stay feature-sharded through ``opt.update`` /
+      ``apply_updates``; one all-gather of the refreshed flat params
+      replaces the aggregated-gradient gather. Per-chip opt-state HBM
+      and update flops drop by the feature-grid size.
+    * ``"auto"`` (default) — ``"on"`` whenever the mesh's feature grid
+      spans more than one chip, else ``"off"``.
+
+    ``param_gather_precision`` (``None``/``"off"``/``"bf16"``/``"int8"``
+    or a :class:`~byzpy_tpu.parallel.quantization.CommPrecision`)
+    compresses the params all-gather wire payload. The carried state
+    always leads with each chip's authoritative EXACT flat param shard;
+    the (possibly lossy) gathered replica only feeds the next round's
+    forward/backward, so compression error is bounded per round and
+    never compounds into the optimizer state. ``off`` (default) keeps
+    the gather f32 and the sharded round bit-identical (coordinate-wise
+    aggregators; elementwise optimizers) to the replicated one.
+
+    Trajectory contract: with an elementwise optimizer (SGD, momentum,
+    Adam — anything whose update is a per-coordinate function of
+    gradient/state/param) the sharded update is semantics-preserving.
+    Optimizers keyed on the *tree structure* (per-layer scales,
+    parameter-label partitioning) see one flat vector instead and must
+    keep ``mode="off"``.
+    """
+
+    mode: str = "auto"
+    param_gather_precision: Any = None
+
+    def __post_init__(self):
+        if self.mode not in _SHARDED_UPDATE_MODES:
+            raise ValueError(
+                f"mode must be one of {_SHARDED_UPDATE_MODES}, got {self.mode!r}"
+            )
+        as_comm_precision(self.param_gather_precision)  # validate eagerly
+
+    def resolve(self, feat_shards: int) -> bool:
+        """Whether the sharded update is active on a ``feat_shards``-way
+        feature grid."""
+        if self.mode == "on":
+            return True
+        if self.mode == "off":
+            return False
+        return feat_shards > 1
+
+
+def as_sharded_update(
+    value: Union["ShardedUpdateConfig", str, bool, None],
+) -> "ShardedUpdateConfig":
+    """Coerce a user-facing argument (``ShardedUpdateConfig``, a mode
+    string, a bool, or ``None``) into a :class:`ShardedUpdateConfig`."""
+    if value is None:
+        return ShardedUpdateConfig()
+    if isinstance(value, ShardedUpdateConfig):
+        return value
+    if isinstance(value, bool):
+        return ShardedUpdateConfig(mode="on" if value else "off")
+    if isinstance(value, str):
+        return ShardedUpdateConfig(mode=value)
+    raise TypeError(f"cannot interpret {value!r} as a ShardedUpdateConfig")
+
+
 def build_ps_train_step(
     bundle: ModelBundle,
     aggregate: AggFn,
@@ -81,6 +164,7 @@ def build_ps_train_step(
     mesh: Optional[Mesh] = None,
     grad_dtype: Any = None,
     comm_precision: Any = None,
+    sharded_update: Any = None,
 ) -> Tuple[Callable, Any]:
     """Build ``(train_step, opt_state0)``.
 
@@ -101,12 +185,27 @@ def build_ps_train_step(
     the decoded full-precision matrix. The default ``"off"`` produces a
     program bit-identical to the uncompressed fabric.
 
+    ``sharded_update`` (:class:`ShardedUpdateConfig`, a mode string, a
+    bool, or ``None`` = auto) controls the weight update's layout. When
+    active, the flat param vector is padded to the shard grid (and to
+    the quantization block for an int8 params gather), ``opt_state0`` is
+    ``(flat_params, inner_opt_state)`` over the padded FLAT vector,
+    carried feature-sharded — each chip owns the authoritative exact
+    shard of the flat params and of every optimizer moment — and
+    ``train_step`` applies the update per shard, all-gathers only the
+    refreshed flat params (optionally compressed), and unravels once.
+    The returned params pytree stays replicated either way, so callers
+    thread state identically.
+
     Returns ``(params, opt_state, metrics)`` where metrics carries the mean
-    honest loss and the aggregated-gradient norm.
+    honest loss and the aggregated-gradient norm (computed shard-locally
+    as a psum of per-shard partial sums of squares — the aggregated
+    gradient is never gathered just for the norm).
     """
     opt = optimizer or default_optimizer(cfg)
     comm = as_comm_precision(comm_precision)
-    opt_state0 = opt.init(bundle.params)
+    su = as_sharded_update(sharded_update)
+    gather_p = as_comm_precision(su.param_gather_precision)
     ravel, unravel = ravel_pytree_fn(bundle.params)
     loss_fn = bundle.loss_fn
     h, b = cfg.n_honest, cfg.n_byzantine
@@ -148,7 +247,51 @@ def build_ps_train_step(
             flat = flat.astype(grad_dtype)
         return loss, flat
 
-    param_dtype = ravel(bundle.params).dtype
+    flat0 = ravel(bundle.params)
+    param_dtype = flat0.dtype
+    d = flat0.shape[0]
+
+    # -- sharded weight update setup -------------------------------------
+    # The flat layouts reuse the aggregation grid: a (d,) vector sharded
+    # over (axis, *extra) lines up coordinate-for-coordinate with the
+    # feature-sharded (n, d) aggregation matrix, so opt.update consumes
+    # the aggregate with NO reshard at all.
+    su_on = su.resolve(feat_shards if mesh is not None else 1)
+    flat_sharding = repl_sharding = None
+    if mesh is not None:
+        # the flat (d,) layout matching the aggregation matrix's feature
+        # columns — the norm metric reduces over it shard-locally in both
+        # update modes, and the sharded update carries state in it
+        flat_sharding = NamedSharding(mesh, P((axis, *extra)))
+        repl_sharding = NamedSharding(mesh, P())
+    d_pad = d
+    if su_on:
+        # pad to the shard grid so every chip owns an equal slice, and to
+        # the quantization block so an int8 params gather never splits a
+        # block (scales shard alongside the codes)
+        pad_grid = 1
+        if mesh is not None and feat_shards > 1:
+            pad_grid = feat_shards * (
+                gather_p.block if gather_p.mode == "int8" else 1
+            )
+        d_pad = -(-d // pad_grid) * pad_grid
+        flat_padded0 = jnp.pad(flat0, (0, d_pad - d))
+        if flat_sharding is not None:
+            flat_padded0 = jax.device_put(flat_padded0, flat_sharding)
+        # optax init builds state via zeros_like, so every (d_pad,) moment
+        # is BORN sharded like the flat params — nothing replicated to
+        # re-slice later; scalar leaves (e.g. Adam's count) stay tiny.
+        # The carried state leads with each chip's authoritative flat
+        # param shard: re-deriving it from ravel(params) per round would
+        # be free in principle (a local slice of the replicated pytree),
+        # but GSPMD partitions the ravel concat into a d-size all-reduce
+        # however the pytree/flat constraints are pinned — one extra
+        # d_pad/g buffer per chip buys a clean single-gather program AND
+        # makes a lossy params gather safe (the exact shard never passes
+        # through the compressed wire).
+        opt_state0 = (flat_padded0, opt.init(flat_padded0))
+    else:
+        opt_state0 = opt.init(bundle.params)
 
     def build_matrix(grads_n, key):
         """Honest rows + byzantine rows from the (n, d) per-node gradient
@@ -174,30 +317,21 @@ def build_ps_train_step(
         two constraints IS the wire hop — so the all-to-all moves
         int8/bf16), and decode feature-sharded. The decoded matrix is
         constrained too, else the partitioner replicates the aggregation
-        input with an (n, d) f32 all-reduce that dwarfs the transpose."""
-        if comm.mode == "bf16":
-            m16 = jax.lax.with_sharding_constraint(
-                grads_n.astype(jnp.bfloat16), row_spec
-            )
-            m16 = jax.lax.with_sharding_constraint(m16, feat_spec)
-            return jax.lax.with_sharding_constraint(
-                m16.astype(grads_n.dtype), feat_spec
-            )
-        q = quantize_blockwise(grads_n, block=comm.block)
-        v = jax.lax.with_sharding_constraint(q.values, row_spec)
-        v = jax.lax.with_sharding_constraint(v, feat_spec)
-        # scales are 4/block of the payload: shard them alongside the
-        # codes when the block grid divides the mesh, else let XLA place
-        # them (tiny either way)
-        s = jax.lax.with_sharding_constraint(q.scales, row_spec)
-        if s.shape[-1] % feat_shards == 0:
-            s = jax.lax.with_sharding_constraint(s, feat_spec)
-        return jax.lax.with_sharding_constraint(
-            dequantize_blockwise(
-                QuantizedBlocks(v, s, q.block, q.orig_dtype),
-                dtype=grads_n.dtype,
-            ),
-            feat_spec,
+        input with an (n, d) f32 all-reduce that dwarfs the transpose.
+        (One call into :func:`~byzpy_tpu.parallel.collectives.reshard_q`,
+        the fabric-wide compressed-reshard primitive.)"""
+        return reshard_q(grads_n, row_spec, feat_spec, precision=comm)
+
+    def gather_flat_params(new_flat):
+        """The sharded round's ONE parameter collective: all-gather the
+        refreshed flat params from the feature shards back to every chip
+        (optionally bf16/int8 on the wire — the exact shard each chip
+        owns stays in the carried opt state, so gather loss never
+        compounds across rounds)."""
+        if flat_sharding is None:
+            return new_flat
+        return reshard_q(
+            new_flat, flat_sharding, repl_sharding, precision=gather_p
         )
 
     def train_step(params, opt_state, xs, ys, key):
@@ -224,15 +358,59 @@ def build_ps_train_step(
                 # ICI), so the robust aggregation below is chip-local per
                 # coordinate.
                 matrix = jax.lax.with_sharding_constraint(matrix, feat_spec)
+        if su_on and d_pad != d:
+            # zero-pad the feature axis to the shard grid BEFORE the
+            # robust reduce: every shipped aggregator maps all-zero
+            # columns to zero, row norms/Gram blocks are unchanged, and
+            # the padded tail is re-zeroed below regardless
+            matrix = jnp.pad(matrix, ((0, 0), (0, d_pad - d)))
+            if feat_spec is not None:
+                matrix = jax.lax.with_sharding_constraint(matrix, feat_spec)
         if pre_aggregate is not None:
             matrix = pre_aggregate(matrix)
         agg_flat = aggregate(matrix).astype(param_dtype)
-        update = unravel(agg_flat)
-        updates, opt_state = opt.update(update, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if flat_sharding is not None:
+            agg_flat = jax.lax.with_sharding_constraint(agg_flat, flat_sharding)
+        if su_on and d_pad != d:
+            # pin the pad tail to exactly zero so padded params/momenta
+            # never drift (and the norm below matches the unpadded round)
+            agg_flat = jnp.where(jnp.arange(d_pad) < d, agg_flat, 0.0)
+            if flat_sharding is not None:
+                agg_flat = jax.lax.with_sharding_constraint(
+                    agg_flat, flat_sharding
+                )
+        # shard-local norm: per-shard partial sums of squares + a scalar
+        # psum — the aggregated gradient is never gathered for a metric
+        agg_norm = jnp.sqrt(jnp.sum(jnp.square(agg_flat)))
+        if su_on:
+            flat_params, inner = opt_state
+            if flat_sharding is not None:
+                flat_params = jax.lax.with_sharding_constraint(
+                    flat_params, flat_sharding
+                )
+            updates, inner = opt.update(agg_flat, inner, flat_params)
+            new_flat = optax.apply_updates(flat_params, updates)
+            if flat_sharding is not None:
+                new_flat = jax.lax.with_sharding_constraint(
+                    new_flat, flat_sharding
+                )
+                inner = jax.tree_util.tree_map(
+                    lambda leaf: jax.lax.with_sharding_constraint(
+                        leaf, flat_sharding
+                    )
+                    if getattr(leaf, "shape", None) == (d_pad,)
+                    else leaf,
+                    inner,
+                )
+            params = unravel(gather_flat_params(new_flat)[:d])
+            opt_state = (new_flat, inner)
+        else:
+            update = unravel(agg_flat)
+            updates, opt_state = opt.update(update, opt_state, params)
+            params = optax.apply_updates(params, updates)
         metrics = {
             "honest_loss": jnp.mean(losses[:h]),
-            "agg_grad_norm": jnp.linalg.norm(agg_flat),
+            "agg_grad_norm": agg_norm,
         }
         return params, opt_state, metrics
 
@@ -259,6 +437,8 @@ def jit_ps_train_step(
 
 __all__ = [
     "PSStepConfig",
+    "ShardedUpdateConfig",
+    "as_sharded_update",
     "default_optimizer",
     "build_ps_train_step",
     "jit_ps_train_step",

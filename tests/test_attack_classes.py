@@ -128,7 +128,11 @@ def test_attack_pool_fanout_matches_direct(attack, inputs_key):
             return await run_operator(attack, inputs, pool=pool)
 
     pooled = asyncio.run(main())
-    np.testing.assert_array_equal(np.asarray(pooled), np.asarray(direct))
+    # chunked fan-out reorders f32 accumulations (little's per-chunk
+    # mean/std); allow ulp-scale drift, nothing more
+    np.testing.assert_allclose(
+        np.asarray(pooled), np.asarray(direct), rtol=3e-7, atol=1e-7
+    )
 
 
 def test_gaussian_pool_fanout_distribution_and_freshness():

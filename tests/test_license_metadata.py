@@ -1,0 +1,49 @@
+"""Declared license metadata must match the committed LICENSE text.
+
+ADVICE r5 flagged an Apache-2.0/MIT flip across rounds; this pins the
+two sources of truth together so a future edit to either one fails
+loudly instead of shipping contradictory licensing."""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: canonical first-line fingerprints of the license texts we could ship
+_FINGERPRINTS = {
+    "Apache-2.0": "Apache License",
+    "MIT": "MIT License",
+    "BSD-3-Clause": "BSD 3-Clause License",
+}
+
+
+def _declared_license() -> str:
+    with open(os.path.join(REPO, "pyproject.toml")) as fh:
+        text = fh.read()
+    # tomllib only exists on >=3.11 and the floor is 3.10: the license
+    # line is simple enough to pin textually
+    m = re.search(r'^license\s*=\s*\{\s*text\s*=\s*"([^"]+)"', text, re.M)
+    if m is None:
+        m = re.search(r'^license\s*=\s*"([^"]+)"', text, re.M)
+    assert m is not None, "pyproject.toml declares no license"
+    return m.group(1)
+
+
+def test_pyproject_license_matches_license_file():
+    declared = _declared_license()
+    assert declared in _FINGERPRINTS, (
+        f"unrecognized declared license {declared!r} — extend the "
+        f"fingerprint table if this is intentional"
+    )
+    with open(os.path.join(REPO, "LICENSE")) as fh:
+        head = fh.read(2048)
+    assert _FINGERPRINTS[declared] in head, (
+        f"pyproject.toml declares {declared} but LICENSE does not open "
+        f"with {_FINGERPRINTS[declared]!r}"
+    )
+    # and no OTHER known license text is what's actually committed
+    for spdx, fingerprint in _FINGERPRINTS.items():
+        if spdx != declared:
+            assert fingerprint not in head, (
+                f"LICENSE looks like {spdx} but pyproject declares {declared}"
+            )

@@ -1,0 +1,440 @@
+"""Sharded weight update: fixed-seed trajectory parity vs the replicated
+round, carried-state sharding, compressed params gather, gossip/ring
+transforms, actor-mode wiring, and the closed-form byte laws."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from byzpy_tpu.models import ShardedDataset, mnist_mlp, synthetic_classification
+from byzpy_tpu.ops import attack_ops, robust
+from byzpy_tpu.parallel import (
+    GossipStepConfig,
+    PSStepConfig,
+    ShardedUpdateConfig,
+    as_sharded_update,
+    build_gossip_train_step,
+    build_ps_train_step,
+    build_ring_gossip_train_step,
+    jit_ps_train_step,
+    node_mesh,
+)
+
+N_NODES = 8
+N_BYZ = 2
+STEPS = 4
+
+
+@pytest.fixture(scope="module")
+def setup():
+    bundle = mnist_mlp(hidden=16)
+    x, y = synthetic_classification(n_samples=512, seed=7)
+    ds = ShardedDataset(x, y, n_nodes=N_NODES)
+    xs, ys = ds.stacked_shards()
+    return bundle, xs, ys
+
+
+def _attack(honest, key):
+    return attack_ops.empire(honest)
+
+
+def _flat(params):
+    return np.concatenate(
+        [np.ravel(leaf) for leaf in jax.tree_util.tree_leaves(params)]
+    )
+
+
+def _run_ps(bundle, xs, ys, *, sharded_update, mesh, aggregate=None,
+            optimizer=None, comm_precision=None, steps=STEPS):
+    cfg = PSStepConfig(n_nodes=N_NODES, n_byzantine=N_BYZ, learning_rate=0.05)
+    step, opt0 = build_ps_train_step(
+        bundle,
+        aggregate or (lambda m: robust.trimmed_mean(m, f=N_BYZ)),
+        cfg,
+        attack=_attack,
+        mesh=mesh,
+        sharded_update=sharded_update,
+        optimizer=optimizer,
+        comm_precision=comm_precision,
+    )
+    step = jax.jit(step)
+    params, opt = bundle.params, opt0
+    key = jax.random.PRNGKey(0)
+    metrics = None
+    for _ in range(steps):
+        params, opt, metrics = step(params, opt, xs, ys, key)
+    return params, opt, metrics
+
+
+def test_config_coercion_and_resolution():
+    assert as_sharded_update(None).mode == "auto"
+    assert as_sharded_update("on").resolve(1)
+    assert not as_sharded_update("off").resolve(64)
+    assert as_sharded_update(True).mode == "on"
+    assert as_sharded_update(False).mode == "off"
+    assert as_sharded_update("auto").resolve(8)
+    assert not as_sharded_update("auto").resolve(1)
+    with pytest.raises(ValueError):
+        ShardedUpdateConfig(mode="maybe")
+    with pytest.raises(ValueError):
+        ShardedUpdateConfig(param_gather_precision="fp4")
+    with pytest.raises(TypeError):
+        as_sharded_update(3.14)
+
+
+def test_ps_sharded_matches_replicated_trajectory(setup):
+    """The headline parity contract: same mesh, same seed, same
+    aggregator — the sharded update reproduces the replicated round's
+    trajectory to f32 fusion-reorder noise (coordinate-wise aggregator +
+    elementwise optimizer: per-coordinate math is identical)."""
+    bundle, xs, ys = setup
+    mesh = node_mesh(N_NODES)
+    p_off, _, m_off = _run_ps(bundle, xs, ys, sharded_update="off", mesh=mesh)
+    p_on, _, m_on = _run_ps(bundle, xs, ys, sharded_update="on", mesh=mesh)
+    np.testing.assert_allclose(
+        _flat(p_on), _flat(p_off), rtol=1e-6, atol=1e-7
+    )
+    # the shard-local norm (psum of per-shard partials) matches too
+    np.testing.assert_allclose(
+        float(m_on["agg_grad_norm"]), float(m_off["agg_grad_norm"]),
+        rtol=1e-6,
+    )
+
+
+def test_ps_sharded_adam_parity(setup):
+    """Adam exercises multi-slot sharded state + a scalar count leaf."""
+    bundle, xs, ys = setup
+    mesh = node_mesh(N_NODES)
+    p_off, _, _ = _run_ps(
+        bundle, xs, ys, sharded_update="off", mesh=mesh,
+        optimizer=optax.adam(1e-3),
+    )
+    p_on, opt_on, _ = _run_ps(
+        bundle, xs, ys, sharded_update="on", mesh=mesh,
+        optimizer=optax.adam(1e-3),
+    )
+    np.testing.assert_allclose(
+        _flat(p_on), _flat(p_off), rtol=1e-6, atol=1e-7
+    )
+    flat, inner = opt_on
+    # both moments carried (d_pad,) and feature-sharded
+    big = [
+        leaf for leaf in jax.tree_util.tree_leaves(inner)
+        if getattr(leaf, "shape", None) == flat.shape
+    ]
+    assert len(big) == 2, [getattr(leaf, "shape", None) for leaf in big]
+    for leaf in big:
+        assert leaf.sharding.shard_shape(leaf.shape)[0] * N_NODES == leaf.shape[0]
+
+
+def test_ps_sharded_geometric_aggregator(setup):
+    """Gram-based selection under GSPMD: the partitioner psums the
+    (n, n) block, so the sharded update stays semantics-preserving for
+    geometric families too (Gram reduction order may differ)."""
+    bundle, xs, ys = setup
+    mesh = node_mesh(N_NODES)
+    agg = lambda m: robust.multi_krum(m, f=N_BYZ, q=N_NODES - N_BYZ)  # noqa: E731
+    p_off, _, _ = _run_ps(bundle, xs, ys, sharded_update="off", mesh=mesh,
+                          aggregate=agg)
+    p_on, _, _ = _run_ps(bundle, xs, ys, sharded_update="on", mesh=mesh,
+                         aggregate=agg)
+    np.testing.assert_allclose(_flat(p_on), _flat(p_off), rtol=2e-4, atol=2e-5)
+
+
+def test_opt_state_feature_sharded_and_padded(setup):
+    """The carried state is (flat_params, inner) over the padded flat
+    vector, every (d_pad,) leaf sharded d_pad/n per chip; int8 gathers
+    pad to the block grid so scales shard alongside the codes."""
+    bundle, xs, ys = setup
+    d = sum(leaf.size for leaf in jax.tree_util.tree_leaves(bundle.params))
+    mesh = node_mesh(N_NODES)
+    cfg = PSStepConfig(n_nodes=N_NODES, n_byzantine=N_BYZ)
+    _, opt0 = build_ps_train_step(
+        bundle, lambda m: robust.trimmed_mean(m, f=N_BYZ), cfg, mesh=mesh,
+        sharded_update="on",
+    )
+    flat, inner = opt0
+    assert flat.shape[0] == -(-d // N_NODES) * N_NODES
+    assert flat.sharding.shard_shape(flat.shape)[0] == flat.shape[0] // N_NODES
+    _, opt_q = build_ps_train_step(
+        bundle, lambda m: robust.trimmed_mean(m, f=N_BYZ), cfg, mesh=mesh,
+        sharded_update=ShardedUpdateConfig(
+            mode="on", param_gather_precision="int8"
+        ),
+    )
+    flat_q, _ = opt_q
+    grid = N_NODES * 256
+    assert flat_q.shape[0] == -(-d // grid) * grid
+    # the pad tail starts (and stays — pinned per round) exactly zero
+    assert float(jnp.abs(np.asarray(flat_q)[d:]).max()) == 0.0
+
+
+def test_compressed_param_gather_error_bounded_not_compounding(setup):
+    """bf16/int8 params gathers deviate from the f32 trajectory within a
+    per-round quantization bound; because each chip's exact shard stays
+    in the carried state, the deviation does NOT grow with rounds."""
+    bundle, xs, ys = setup
+    mesh = node_mesh(N_NODES)
+    p_f32, _, _ = _run_ps(bundle, xs, ys, sharded_update="on", mesh=mesh)
+    scale = np.abs(_flat(p_f32)).max()
+    for mode, per_value in (("bf16", 1 / 128), ("int8", 1 / 127)):
+        su = ShardedUpdateConfig(mode="on", param_gather_precision=mode)
+        p1, _, _ = _run_ps(bundle, xs, ys, sharded_update=su, mesh=mesh,
+                           steps=1)
+        p4, _, _ = _run_ps(bundle, xs, ys, sharded_update=su, mesh=mesh)
+        dev1 = np.abs(_flat(p1) - _flat(
+            _run_ps(bundle, xs, ys, sharded_update="on", mesh=mesh,
+                    steps=1)[0]
+        )).max()
+        dev4 = np.abs(_flat(p4) - _flat(p_f32)).max()
+        # blockwise symmetric codec: one bound per round (+ gradient
+        # feedback slack), uniform in the round count
+        assert dev1 <= per_value * scale * 2, (mode, dev1, scale)
+        assert dev4 <= per_value * scale * 4, (mode, dev4, scale)
+
+
+def test_sharded_update_no_mesh_mode_on(setup):
+    """mode="on" without a mesh runs the flat update path unsharded —
+    the math is the same, so it must match the replicated step."""
+    bundle, xs, ys = setup
+    p_off, _, _ = _run_ps(bundle, xs, ys, sharded_update="off", mesh=None)
+    p_on, _, _ = _run_ps(bundle, xs, ys, sharded_update="on", mesh=None)
+    np.testing.assert_allclose(_flat(p_on), _flat(p_off), rtol=1e-6, atol=1e-7)
+
+
+def test_sharded_update_donation_smoke(setup):
+    """jit_ps_train_step's donate_argnums covers the sharded carried
+    state (round memory stays ~1x); donated buffers thread fine."""
+    bundle, xs, ys = setup
+    cfg = PSStepConfig(n_nodes=N_NODES, n_byzantine=N_BYZ)
+    step, opt0 = jit_ps_train_step(
+        bundle, lambda m: robust.trimmed_mean(m, f=N_BYZ), cfg,
+        attack=_attack, mesh=node_mesh(N_NODES), sharded_update="on",
+    )
+    # donation consumes the inputs: keep the module fixture's buffers
+    params = jax.tree_util.tree_map(jnp.copy, bundle.params)
+    opt = jax.tree_util.tree_map(jnp.copy, opt0)
+    for i in range(2):
+        params, opt, metrics = step(params, opt, xs, ys, jax.random.PRNGKey(i))
+    assert np.isfinite(float(metrics["agg_grad_norm"]))
+
+
+def test_quantized_transpose_scales_unaligned_parity(setup):
+    """Satellite: the compressed gradient transpose when the block grid
+    does NOT divide the mesh (mnist d=12,730 -> 50 scale blocks, 50 % 8
+    != 0 — the scales skip the feature constraint in `reshard_q`).
+    Parity: int8 decode values are layout-independent, so the unaligned
+    8-way layout must agree with the aligned 2-way one."""
+    bundle, xs, ys = setup
+    d = sum(leaf.size for leaf in jax.tree_util.tree_leaves(bundle.params))
+    nb = -(-d // 256)
+    assert nb % N_NODES != 0, "fixture must exercise the unaligned branch"
+    p8, _, _ = _run_ps(
+        bundle, xs, ys, sharded_update="off", mesh=node_mesh(N_NODES),
+        comm_precision="int8",
+    )
+    mesh2 = node_mesh(2, devices=jax.devices()[:2])
+    assert nb % 2 == 0
+    p2, _, _ = _run_ps(
+        bundle, xs, ys, sharded_update="off", mesh=mesh2,
+        comm_precision="int8",
+    )
+    np.testing.assert_allclose(_flat(p8), _flat(p2), rtol=1e-5, atol=1e-6)
+
+
+def test_quantized_transpose_unaligned_no_f32_reshard(setup):
+    """Satellite, second half: the unaligned-scales branch must not make
+    XLA reshard the full-precision matrix — every all-to-all in the
+    compiled round moves int8 codes (f32 all-to-all traffic, i.e. the
+    scales at most, stays far below one matrix row)."""
+    from byzpy_tpu.parallel.comms import _SHAPE_RE
+
+    bundle, xs, ys = setup
+    d = sum(leaf.size for leaf in jax.tree_util.tree_leaves(bundle.params))
+    cfg = PSStepConfig(n_nodes=N_NODES, n_byzantine=N_BYZ)
+    step, opt0 = build_ps_train_step(
+        bundle, lambda m: robust.trimmed_mean(m, f=N_BYZ), cfg,
+        attack=_attack, mesh=node_mesh(N_NODES), comm_precision="int8",
+    )
+    key = jax.random.PRNGKey(0)
+    txt = (
+        jax.jit(step)
+        .lower(bundle.params, opt0, xs, ys, key)
+        .compile()
+        .as_text()
+    )
+    f32_a2a = 0
+    for line in txt.splitlines():
+        if "all-to-all" not in line or "-done" in line:
+            continue
+        head = line.split("all-to-all")[0]
+        for dtype, dims in _SHAPE_RE.findall(head):
+            if dtype != "f32":
+                continue
+            size = 1
+            for dim in dims.split(","):
+                if dim:
+                    size *= int(dim)
+            f32_a2a += size * 4
+    assert f32_a2a < d * 4, (
+        f"f32 all-to-all moves {f32_a2a} B — the full-precision matrix "
+        f"is being resharded despite int8 comm_precision"
+    )
+
+
+def test_gossip_update_sharding_parity(setup):
+    """Feature-sharded gossip exchange: bit-for-bit (f32) vs the
+    replicated broadcast for both coordinate-wise and Gram-based
+    aggregators, byzantine rows preserved."""
+    from byzpy_tpu.engine.peer_to_peer.topology import Topology
+
+    bundle, xs, ys = setup
+    cfg = GossipStepConfig(n_nodes=N_NODES, n_byzantine=N_BYZ)
+    topo = Topology.ring(N_NODES, 3)
+    mesh = node_mesh(N_NODES)
+    key = jax.random.PRNGKey(1)
+    for agg in (
+        robust.coordinate_median,
+        lambda m: robust.multi_krum(m, f=1, q=2),
+    ):
+        thetas = {}
+        for us in ("off", "on"):
+            step, init = build_gossip_train_step(
+                bundle, agg, topo, cfg, attack=_attack, mesh=mesh,
+                update_sharding=us,
+            )
+            step = jax.jit(step)
+            theta = init()
+            for _ in range(3):
+                theta, _ = step(theta, xs, ys, key)
+            thetas[us] = np.asarray(theta)
+        np.testing.assert_allclose(
+            thetas["on"], thetas["off"], rtol=1e-6, atol=1e-7
+        )
+
+
+def test_ring_gossip_shard_split_parity(setup):
+    """The manual shard split (explicit mode="on", coordinate-wise
+    aggregator) reproduces the replicated ring exchange bit-for-bit and
+    keeps the byzantine self-row convention."""
+    bundle, xs, ys = setup
+    cfg = GossipStepConfig(n_nodes=N_NODES, n_byzantine=N_BYZ)
+    mesh = node_mesh(N_NODES)
+    key = jax.random.PRNGKey(2)
+    thetas = {}
+    for us in ("off", "on"):
+        step, init = build_ring_gossip_train_step(
+            bundle, robust.coordinate_median, cfg, mesh, k=2,
+            update_sharding=us,
+        )
+        step = jax.jit(step)
+        theta = init()
+        for _ in range(3):
+            theta, _ = step(theta, xs, ys, key)
+        thetas[us] = np.asarray(theta)
+    np.testing.assert_allclose(
+        thetas["on"], thetas["off"], rtol=1e-6, atol=1e-7
+    )
+
+
+def test_actor_ps_update_sharding_parity(monkeypatch):
+    """Actor-mode wiring: feature-sharded stack→aggregate→unravel (plain
+    and fused-pipeline paths) matches the unsharded aggregation."""
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean, MultiKrum
+    from byzpy_tpu.engine.parameter_server import ParameterServer
+    from byzpy_tpu.pre_aggregators.nnm import NearestNeighborMixing
+
+    monkeypatch.setenv("BYZPY_TPU_HOST_COMPUTE_BYTES", "0")
+
+    class Node:
+        def __init__(self, grad):
+            self.grad = grad
+
+        def honest_gradient_for_next_batch(self):
+            return [self.grad]
+
+        def apply_server_gradient(self, grad):
+            pass
+
+    rng = np.random.default_rng(0)
+    grads = [
+        jnp.asarray(rng.normal(size=4096).astype(np.float32))
+        for _ in range(N_NODES)
+    ]
+
+    async def run(**kwargs):
+        ps = ParameterServer([Node(g) for g in grads], **kwargs)
+        return await ps.round()
+
+    for kwargs in (
+        {"aggregator": CoordinateWiseTrimmedMean(f=2)},
+        {
+            "aggregator": MultiKrum(f=2, q=4),
+            "pre_aggregator": NearestNeighborMixing(f=2),
+        },
+    ):
+        base = asyncio.run(run(**kwargs))
+        shard = asyncio.run(run(update_sharding="auto", **kwargs))
+        np.testing.assert_allclose(
+            np.asarray(shard[0]), np.asarray(base[0]), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_comm_law_matches_compiled_hlo():
+    """`comms.ps_round_wire_bytes` / `opt_state_bytes` reproduce the
+    compiled round's collective bytes and the carried state's measured
+    shard footprint at an aligned shape."""
+    from byzpy_tpu.models.bundle import ModelBundle
+    from byzpy_tpu.parallel.comms import (
+        collective_traffic,
+        measured_opt_state_bytes,
+        opt_state_bytes,
+        ps_round_wire_bytes,
+    )
+
+    d_model, d_out = 64, 32  # d = 2048: block- and mesh-aligned
+    d = d_model * d_out
+    params = {
+        "w": jax.random.normal(jax.random.PRNGKey(2), (d_model, d_out)) * 0.1
+    }
+    bundle = ModelBundle(
+        apply_fn=lambda p, xb: xb @ p["w"],
+        params=params,
+        loss_fn=lambda p, xb, yb: jnp.mean((xb @ p["w"] - yb) ** 2),
+    )
+    mesh = node_mesh(N_NODES)
+    cfg = PSStepConfig(n_nodes=N_NODES, n_byzantine=1)
+    bx = jnp.zeros((N_NODES, 8, d_model))
+    by = jnp.zeros((N_NODES, 8, d_out))
+    key = jax.random.PRNGKey(0)
+    for su, sharded, pprec in (
+        ("off", False, "off"),
+        ("on", True, "off"),
+        (ShardedUpdateConfig(mode="on", param_gather_precision="int8"),
+         True, "int8"),
+    ):
+        # the no-attack byzantine echo (tile of honest rows) reshards the
+        # matrix a second time; a proper attack keeps the transpose at
+        # the single-matrix law, like the deployment rounds
+        step, opt0 = build_ps_train_step(
+            bundle, lambda m: robust.trimmed_mean(m, f=1), cfg, mesh=mesh,
+            sharded_update=su, attack=_attack,
+        )
+        traffic = collective_traffic(jax.jit(step), params, opt0, bx, by, key)
+        law = ps_round_wire_bytes(
+            d, N_NODES, update_sharded=sharded, param_precision=pprec
+        )
+        moved = sum(
+            v for k, v in traffic["per_opcode_bytes"].items()
+            if k in ("all-to-all", "all-gather")
+        )
+        assert abs(moved - law) <= 0.05 * law + 64, (su, moved, law)
+        state = measured_opt_state_bytes(opt0)
+        law_state = opt_state_bytes(
+            d, slots=1, update_sharded=sharded, n_shards=N_NODES
+        )
+        assert abs(state - law_state) <= 16, (su, state, law_state)
