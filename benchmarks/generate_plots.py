@@ -75,7 +75,7 @@ def main() -> None:
 
     # speedups
     fig, ax = plt.subplots(figsize=(10, 0.42 * len(rows) + 1.5))
-    speedups = [rf / ms for rf, ms in zip(refs, ours)]
+    speedups = [rf / ms for rf, ms in zip(refs, ours, strict=False)]
     colors = ["#2e9e59" if s >= 1 else "#c5483e" for s in speedups]
     ax.barh(list(y), speedups, color=colors, height=0.6)
     ax.axvline(1.0, color="black", linewidth=0.8)

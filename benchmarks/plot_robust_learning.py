@@ -39,7 +39,7 @@ def main() -> int:
     )
     if len(attacks) == 1:
         axes = [axes]
-    for ax, attack in zip(axes, attacks):
+    for ax, attack in zip(axes, attacks, strict=False):
         for agg in aggs:
             row = cells.get((agg, attack))
             if row is None:

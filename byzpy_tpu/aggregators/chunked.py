@@ -232,7 +232,7 @@ class BarrieredIterativeAggregator:
                         kwargs=params,
                         name=f"{self.name}-iter-rows[{s}:{e}]",
                     )
-                    for h, (s, e) in zip(handles, spans)
+                    for h, (s, e) in zip(handles, spans, strict=True)
                 ]
                 partials = await self._run_subtasks(pool, tasks, context)
                 new_center = self._barrier_update(partials, center)

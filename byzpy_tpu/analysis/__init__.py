@@ -1,0 +1,57 @@
+"""byzlint: JAX-aware static analysis for the byzpy_tpu codebase.
+
+Generic linters cannot see the hazards that actually cost this repo
+debugging rounds — stale closure capture of env/config inside jitted
+kernels, use-after-donate on donated fold buffers, unbound collective
+axis names inside ``shard_map``, host-sync stalls in the overlap round
+loops, and blocking calls inside the async actor fabric. byzlint turns
+each of those hard-won conventions into a machine-checked invariant.
+
+Usage::
+
+    python -m byzpy_tpu.analysis byzpy_tpu benchmarks examples
+    byzpy-tpu lint                       # same gate via the CLI
+    python -m byzpy_tpu.analysis --format json --select DONATION paths...
+
+Suppress a deliberate violation with a trailing or preceding comment —
+``# byzlint: ignore[RULE-ID]`` — plus a justification; stale suppressions
+are themselves reported (``UNUSED-IGNORE``). Rule catalog and the real
+incident behind each rule: ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+from .core import (
+    Finding,
+    ModuleInfo,
+    ScanResult,
+    Suppression,
+    UNUSED_IGNORE,
+    render_json,
+    render_text,
+    scan_paths,
+)
+from .rules import ALL_RULES, Rule, ScanContext
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "ModuleInfo",
+    "Rule",
+    "ScanContext",
+    "ScanResult",
+    "Suppression",
+    "UNUSED_IGNORE",
+    "main",
+    "render_json",
+    "render_text",
+    "scan_paths",
+]
+
+
+def main(argv=None) -> int:
+    """Entry point for ``python -m byzpy_tpu.analysis`` / ``byzpy-tpu
+    lint`` (see :func:`byzpy_tpu.analysis.__main__.run`)."""
+    from .__main__ import run
+
+    return run(argv)

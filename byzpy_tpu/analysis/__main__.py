@@ -1,0 +1,95 @@
+"""Command-line front end for byzlint.
+
+``python -m byzpy_tpu.analysis [paths...]`` scans the given files or
+directories (default: ``byzpy_tpu`` ``benchmarks`` ``examples`` relative
+to the current directory, whichever exist) and exits 0 when clean, 1
+when findings survive suppression, 2 on usage errors — the exit-code
+contract the CI gate relies on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .core import render_json, render_text, scan_paths
+from .rules import ALL_RULES
+
+DEFAULT_PATHS = ("byzpy_tpu", "benchmarks", "examples")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Assemble the byzlint argument parser."""
+    parser = argparse.ArgumentParser(
+        prog="python -m byzpy_tpu.analysis",
+        description=(
+            "byzlint: JAX-aware static analysis (trace-safety, donation, "
+            "collective-axis, async-actor hazards)"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: byzpy_tpu benchmarks "
+        "examples, whichever exist under the current directory)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return parser
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    """Parse args, scan, report; returns the process exit code."""
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        try:
+            for rule in ALL_RULES:
+                print(f"{rule.id}\t{rule.summary}")
+            print("UNUSED-IGNORE\tsuppression comment that suppresses nothing")
+        except BrokenPipeError:  # piped into head — fine
+            pass
+        return 0
+    paths = args.paths
+    if not paths:
+        from pathlib import Path
+
+        paths = [p for p in DEFAULT_PATHS if Path(p).exists()]
+        if not paths:
+            print(
+                "byzlint: no paths given and none of "
+                f"{'/'.join(DEFAULT_PATHS)} exist here",
+                file=sys.stderr,
+            )
+            return 2
+    select = args.select.split(",") if args.select else None
+    try:
+        result = scan_paths(paths, select=select)
+    except (FileNotFoundError, ValueError, SyntaxError) as exc:
+        print(f"byzlint: error: {exc}", file=sys.stderr)
+        return 2
+    render = render_json if args.format == "json" else render_text
+    try:
+        print(render(result))
+    except BrokenPipeError:  # e.g. piped into head — not a lint failure
+        pass
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(run())

@@ -1,0 +1,324 @@
+"""byzlint core: file walking, suppressions, rule driving, reporting.
+
+The engine parses each file once, hands the module to every selected
+rule, filters the raw findings through ``# byzlint: ignore[RULE]``
+suppressions, and reports any suppression that suppressed nothing as a
+finding of its own (``UNUSED-IGNORE``) — a stale ignore is how a real
+hazard sneaks back in behind an old waiver.
+
+Suppression syntax (mirrors ``# noqa`` placement rules):
+
+* trailing, on the flagged line::
+
+      x = os.environ.get("FLAG")  # byzlint: ignore[TRACE-DISPATCH]
+
+* own-line, directly above the flagged line::
+
+      # byzlint: ignore[DONATION, HOST-SYNC]
+      out = step(state)
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .astutils import build_import_map
+
+UNUSED_IGNORE = "UNUSED-IGNORE"
+
+_SUPPRESS_RE = re.compile(r"#\s*byzlint:\s*ignore\[([A-Za-z0-9_,\- ]+)\]")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        """``path:line:col: RULE: message`` (the human/CI line format)."""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        """JSON-serializable dict form (stable key order)."""
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# byzlint: ignore[...]`` comment."""
+
+    line: int
+    rules: Set[str]
+    own_line: bool
+    #: inclusive line range the comment covers — the full span of the
+    #: statement it annotates, so a trailing comment on the last line of
+    #: a wrapped call still reaches a finding anchored on its first line
+    span: Tuple[int, int] = (0, 0)
+    used: bool = False
+
+    def covers(self, finding: Finding) -> bool:
+        """Whether this comment's placement+rules reach the finding."""
+        if finding.rule not in self.rules:
+            return False
+        return self.span[0] <= finding.line <= self.span[1]
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file plus the per-module lookup tables rules use."""
+
+    path: Path
+    relpath: str
+    source: str
+    tree: ast.Module
+    imports: Dict[str, str]
+    suppressions: List[Suppression] = field(default_factory=list)
+
+
+@dataclass
+class ScanResult:
+    """Outcome of one engine run over a set of paths."""
+
+    findings: List[Finding]
+    files_scanned: int
+    suppressed: int
+
+    @property
+    def clean(self) -> bool:
+        """True when no finding survived suppression filtering."""
+        return not self.findings
+
+
+def _statement_spans(tree: ast.Module) -> List[Tuple[int, int]]:
+    """(lineno, end_lineno) of every statement, innermost-last ordering
+    not required — lookups pick the narrowest containing span."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt) and node.end_lineno is not None:
+            spans.append((node.lineno, node.end_lineno))
+    return spans
+
+
+def _covering_span(
+    spans: List[Tuple[int, int]], line: int, *, starts_at: bool = False
+) -> Tuple[int, int]:
+    """Narrowest statement span containing ``line`` (or, with
+    ``starts_at``, starting exactly there); falls back to the line itself."""
+    if starts_at:
+        candidates = [s for s in spans if s[0] == line]
+    else:
+        candidates = [s for s in spans if s[0] <= line <= s[1]]
+    if not candidates:
+        return (line, line)
+    return min(candidates, key=lambda s: s[1] - s[0])
+
+
+def parse_suppressions(
+    source: str, tree: Optional[ast.Module] = None
+) -> List[Suppression]:
+    """Extract every ``# byzlint: ignore[...]`` comment from a source
+    string. A trailing comment covers the full line span of the statement
+    it sits on (so wrapped calls still suppress); an own-line comment
+    covers the statement starting on the next line. Tokenized, not
+    grepped — the syntax *quoted inside a docstring* (as in this
+    package's own docs) is not a suppression."""
+    import io
+    import tokenize
+
+    out: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        return out
+    if tree is None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:  # pragma: no cover — callers pre-parse
+            tree = ast.Module(body=[], type_ignores=[])
+    spans = _statement_spans(tree)
+    lines = source.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        text = lines[lineno - 1] if lineno <= len(lines) else ""
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        own_line = text.lstrip().startswith("#")
+        if own_line:
+            span = _covering_span(spans, lineno + 1, starts_at=True)
+        else:
+            span = _covering_span(spans, lineno)
+        out.append(Suppression(lineno, rules, own_line, span))
+    return out
+
+
+def load_module(path: Path, relpath: str) -> ModuleInfo:
+    """Parse one file into a :class:`ModuleInfo` (raises ``SyntaxError``
+    on unparsable source — the ruff gate runs first, so scanned trees are
+    syntactically valid by construction)."""
+    source = path.read_text(encoding="utf-8")
+    tree = ast.parse(source, filename=str(path))
+    return ModuleInfo(
+        path=path,
+        relpath=relpath,
+        source=source,
+        tree=tree,
+        imports=build_import_map(tree),
+        suppressions=parse_suppressions(source, tree),
+    )
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[Path]:
+    """Yield every ``.py`` file under the given files/directories, in
+    sorted order, skipping ``__pycache__`` and hidden directories."""
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                parts = f.relative_to(p).parts
+                if any(
+                    part == "__pycache__" or part.startswith(".")
+                    for part in parts
+                ):
+                    continue
+                yield f
+        else:
+            raise FileNotFoundError(f"no such file or directory: {raw}")
+
+
+def _display_path(p: Path) -> str:
+    try:
+        return str(p.resolve().relative_to(Path.cwd()))
+    except ValueError:
+        return str(p)
+
+
+def scan_paths(
+    paths: Sequence[str],
+    *,
+    select: Optional[Sequence[str]] = None,
+) -> ScanResult:
+    """Run the (optionally ``select``-filtered) rule set over ``paths``.
+
+    Returns the suppression-filtered findings plus counters; see
+    :func:`byzpy_tpu.analysis.main` for the CLI wrapper. Unknown rule ids
+    in ``select`` raise ``ValueError`` so CI typos fail loudly.
+    """
+    from .rules import ALL_RULES, ScanContext
+
+    rules = list(ALL_RULES)
+    check_unused = True
+    if select is not None:
+        wanted = {s.strip() for s in select if s.strip()}
+        known = {r.id for r in rules} | {UNUSED_IGNORE}
+        unknown = wanted - known
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s): {', '.join(sorted(unknown))} "
+                f"(known: {', '.join(sorted(known))})"
+            )
+        rules = [r for r in rules if r.id in wanted]
+        check_unused = UNUSED_IGNORE in wanted
+    selected_ids = {r.id for r in rules}
+
+    modules: List[ModuleInfo] = []
+    for path in iter_python_files(paths):
+        modules.append(load_module(path, _display_path(path)))
+
+    ctx = ScanContext.build(modules)
+
+    findings: List[Finding] = []
+    suppressed = 0
+    for mod in modules:
+        raw: List[Finding] = []
+        for rule in rules:
+            raw.extend(rule.check(mod, ctx))
+        for finding in raw:
+            hit = False
+            for sup in mod.suppressions:
+                if sup.covers(finding):
+                    sup.used = True
+                    hit = True
+            if hit:
+                suppressed += 1
+            else:
+                findings.append(finding)
+        if check_unused:
+            for sup in mod.suppressions:
+                # a suppression naming only non-selected rules is not
+                # provably stale in a filtered run — skip it then
+                if sup.used or (select is not None and not (sup.rules & selected_ids)):
+                    continue
+                findings.append(
+                    Finding(
+                        UNUSED_IGNORE,
+                        mod.relpath,
+                        sup.line,
+                        0,
+                        "suppression matches no finding — remove it (or "
+                        f"re-justify): ignore[{', '.join(sorted(sup.rules))}]",
+                    )
+                )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return ScanResult(findings, len(modules), suppressed)
+
+
+def render_text(result: ScanResult) -> str:
+    """Human-readable report: one line per finding plus a summary."""
+    lines = [f.render() for f in result.findings]
+    status = "clean" if result.clean else f"{len(result.findings)} finding(s)"
+    lines.append(
+        f"byzlint: {status} — {result.files_scanned} file(s) scanned, "
+        f"{result.suppressed} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result: ScanResult) -> str:
+    """Machine-readable report (stable ordering, for CI artifacts)."""
+    return json.dumps(
+        {
+            "findings": [f.to_json() for f in result.findings],
+            "files_scanned": result.files_scanned,
+            "suppressed": result.suppressed,
+            "clean": result.clean,
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+__all__ = [
+    "Finding",
+    "ModuleInfo",
+    "ScanResult",
+    "Suppression",
+    "UNUSED_IGNORE",
+    "iter_python_files",
+    "load_module",
+    "parse_suppressions",
+    "render_json",
+    "render_text",
+    "scan_paths",
+]

@@ -195,6 +195,22 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """``byzpy-tpu lint``: run the byzlint static-analysis gate (exactly
+    equivalent to ``python -m byzpy_tpu.analysis``; see
+    ``docs/static_analysis.md`` for the rule catalog)."""
+    from .analysis import main as lint_main
+
+    argv: List[str] = list(args.paths)
+    if args.format != "text":
+        argv += ["--format", args.format]
+    if args.select:
+        argv += ["--select", args.select]
+    if args.list_rules:
+        argv += ["--list-rules"]
+    return lint_main(argv)
+
+
 def cmd_study(args: argparse.Namespace) -> int:
     """``byzpy-tpu study``: one accuracy-under-attack cell pair on real
     data — the 30-second proof that robust aggregation rescues training a
@@ -242,6 +258,24 @@ def build_parser() -> argparse.ArgumentParser:
     p_bench.add_argument("--dim", type=int, default=65_536)
     p_bench.add_argument("--repeat", type=int, default=10)
     p_bench.set_defaults(fn=cmd_bench)
+
+    p_lint = sub.add_parser(
+        "lint",
+        help="run byzlint, the JAX-aware static-analysis gate "
+        "(trace-safety, donation, collective-axis, async hazards)",
+    )
+    p_lint.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to scan (default: byzpy_tpu benchmarks examples)",
+    )
+    p_lint.add_argument("--format", choices=("text", "json"), default="text")
+    p_lint.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule ids to run",
+    )
+    p_lint.add_argument("--list-rules", action="store_true")
+    p_lint.set_defaults(fn=cmd_lint)
 
     p_study = sub.add_parser(
         "study",

@@ -234,14 +234,21 @@ class ProcessActorBackend:
             # EOF lets the child's blocked conn.recv_bytes thread exit so the
             # child terminates promptly instead of riding out join+kill.
             self._conn.close()
-        if self._proc is not None:
-            self._proc.join(timeout=5)
-            if self._proc.is_alive():
-                self._proc.kill()
-                self._proc.join(timeout=5)
+        # snapshot-and-null BEFORE awaiting: the off-loop join suspends
+        # this coroutine, and a concurrent close() must not re-enter the
+        # join/kill sequence or dereference a nulled _proc
+        proc, self._proc = self._proc, None
         self._conn = None
-        self._proc = None
         self._started = False
+        if proc is not None:
+            # join() blocks up to its timeout: run it off-loop so a slow
+            # child cannot stall every other actor sharing this event loop
+            # (same pattern as node/process_context.py shutdown)
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, proc.join, 5)
+            if proc.is_alive():
+                proc.kill()
+                await loop.run_in_executor(None, proc.join, 5)
 
     def get_endpoint(self) -> Endpoint:
         return Endpoint(self.scheme, "local", self.actor_id)

@@ -219,7 +219,7 @@ def _map_payload_leaves(leaf_fn, obj: Any) -> Any:
             return new
         if isinstance(x, (tuple, list)):
             vals = [walk(v) for v in x]
-            if all(a is b for a, b in zip(vals, x)):
+            if all(a is b for a, b in zip(vals, x, strict=True)):
                 return x
             if isinstance(x, list):
                 return vals

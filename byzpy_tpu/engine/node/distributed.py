@@ -117,7 +117,7 @@ class DistributedByzantineNode(ByzantineNode):
         cls._byz_input_keys = keys
 
         def wrapped(self: "DistributedByzantineNode", *args: Any, **kw: Any):
-            inputs: Dict[str, Any] = dict(zip(cls._byz_input_keys, args))
+            inputs: Dict[str, Any] = dict(zip(cls._byz_input_keys, args, strict=False))
             inputs.update(kw)
             return self._run_attack_pipeline(inputs)
 

@@ -294,7 +294,7 @@ def _record_results(
     shared second half of :func:`elastic_gather` and
     :func:`elastic_settle`."""
     alive: List[Tuple[str, Any]] = []
-    for (nid, _), res in zip(nodes, results):
+    for (nid, _), res in zip(nodes, results, strict=True):
         if isinstance(res, BaseException):
             if isinstance(res, (KeyboardInterrupt, SystemExit)):
                 raise res

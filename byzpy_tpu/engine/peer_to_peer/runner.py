@@ -217,9 +217,9 @@ class DecentralizedPeerToPeer:
             raise ValueError("honest worker count does not fill the topology")
 
         self._workers: Dict[int, Any] = {}
-        for i, w in zip(self.honest_indices, honest_workers):
+        for i, w in zip(self.honest_indices, honest_workers, strict=True):
             self._workers[i] = w
-        for i, w in zip(self.byzantine_indices, byzantine_workers):
+        for i, w in zip(self.byzantine_indices, byzantine_workers, strict=True):
             self._workers[i] = w
         self.aggregator = aggregator
         self._ctx_factory = context_factory or (lambda nid: InProcessContext(nid))
@@ -507,7 +507,7 @@ class DecentralizedPeerToPeer:
             for i in self.honest_indices
         ))
         half_vectors = {
-            i: out["half_step"] for i, out in zip(self.honest_indices, half)
+            i: out["half_step"] for i, out in zip(self.honest_indices, half, strict=True)
         }
 
         # 2. honest broadcasts (ref: runner.py:308-315)
@@ -525,7 +525,7 @@ class DecentralizedPeerToPeer:
                 )
                 for i in self.byzantine_indices
             ))
-            for i, out in zip(self.byzantine_indices, attacks):
+            for i, out in zip(self.byzantine_indices, attacks, strict=True):
                 await self.nodes[i].broadcast_message(GOSSIP_TYPE, out["attack"])
 
         # 4. robust aggregation of own θ½ + received (ref: runner.py:374-388)
@@ -538,7 +538,7 @@ class DecentralizedPeerToPeer:
         self.rounds_completed += 1
         return {
             i: out["aggregate"]
-            for i, out in zip(self.honest_indices, aggregated)
+            for i, out in zip(self.honest_indices, aggregated, strict=True)
         }
 
     async def _round_locked_overlap(
@@ -626,7 +626,7 @@ class DecentralizedPeerToPeer:
             await asyncio.gather(*chains, *agg_tasks, return_exceptions=True)
             raise
         self.rounds_completed += 1
-        return dict(zip(self.honest_indices, aggregated))
+        return dict(zip(self.honest_indices, aggregated, strict=True))
 
     async def run_async(self, rounds: int) -> None:
         """Run ``rounds`` gossip rounds. With an

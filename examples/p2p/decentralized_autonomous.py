@@ -66,7 +66,7 @@ async def main():
         events.append(asyncio.Event())
 
     async with cluster:
-        for node, target, event in zip(nodes, targets, events):
+        for node, target, event in zip(nodes, targets, events, strict=False):
             node.start_autonomous_task(autonomous_loop(float(target), event))
         await asyncio.gather(*(e.wait() for e in events))
 

@@ -35,12 +35,19 @@ async def main():
     ctxs = [MeshRemoteContext(ids[i]) for i in range(N_NODES)]
     nodes = []
     received = {ids[i]: [] for i in range(N_NODES)}
+    all_in = asyncio.Event()
+
+    def check_done() -> None:
+        if all(len(v) >= N_NODES - 1 for v in received.values()):
+            all_in.set()
+
     for i, ctx in enumerate(ctxs):
         node = DecentralizedNode(ids[i], ctx)
         node.bind_topology(topology, ids)
 
         async def keep(message, store=received[ids[i]]):
             store.append(message)
+            check_done()
 
         node.register_handler("gradient", keep)
         await node.start()
@@ -52,10 +59,10 @@ async def main():
                 ctx.add_peer(pid, addr)
 
     # everyone gossips a vector; everyone receives from all peers
+    # (event-driven, not a sleep-poll loop: the handler signals arrival)
     for i, node in enumerate(nodes):
         await node.broadcast_message("gradient", jnp.full((8,), float(i)))
-    while any(len(v) < N_NODES - 1 for v in received.values()):
-        await asyncio.sleep(0.01)
+    await asyncio.wait_for(all_in.wait(), timeout=30.0)
 
     for nid, msgs in received.items():
         senders = sorted(m.sender for m in msgs)

@@ -1,0 +1,34 @@
+"""byzlint fixture: ASYNC-BLOCKING false-positive guards."""
+
+import asyncio
+import time
+
+
+async def cooperative_poll(flag):
+    while not flag.is_set():
+        await asyncio.sleep(0.05)  # awaited asyncio sleep: fine
+
+
+def sync_retry_helper():
+    time.sleep(0.05)  # plain sync function: blocking is allowed
+    return True
+
+
+async def offloaded_join(proc):
+    # the sanctioned pattern: blocking join runs on an executor thread
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, proc.join, 5)
+
+
+async def executor_target_is_exempt(conn):
+    loop = asyncio.get_running_loop()
+
+    def pump():
+        # nested sync def = executor target; its blocking calls are fine
+        return conn.recv(4096)
+
+    return await loop.run_in_executor(None, pump)
+
+
+async def string_join(parts):
+    return ", ".join(parts)  # str.join is not a process join

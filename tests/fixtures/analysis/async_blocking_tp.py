@@ -1,0 +1,23 @@
+"""byzlint fixture: ASYNC-BLOCKING true positives (never imported)."""
+
+import select
+import time
+
+
+async def busy_poll(flag):
+    while not flag.is_set():
+        time.sleep(0.05)  # finding: blocks the shared event loop
+
+
+async def dump_state(state, path):
+    with open(path, "w") as sink:  # finding: blocking file I/O on the loop
+        sink.write(repr(state))
+
+
+async def reap(worker_proc):
+    worker_proc.join(5)  # finding: blocking process join
+
+
+async def wait_readable(sock):
+    select.select([sock], [], [], 1.0)  # finding
+    return sock.recv(4096)  # finding: sync socket read

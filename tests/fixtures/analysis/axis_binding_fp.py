@@ -1,0 +1,37 @@
+"""byzlint fixture: AXIS-BINDING false-positive guards."""
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "nodes"
+
+mesh = Mesh(jax.devices(), ("nodes", "feat"))
+
+
+@partial(shard_map, mesh=mesh, in_specs=(P("nodes"),), out_specs=P())
+def bound_axis(x):
+    return lax.psum(x, "nodes")
+
+
+@partial(shard_map, mesh=mesh, in_specs=(P("nodes"),), out_specs=P())
+def mesh_axis_not_in_specs(x):
+    # legal: "feat" is a mesh axis even though no spec mentions it
+    return lax.pmean(x, "feat")
+
+
+@partial(shard_map, mesh=mesh, in_specs=(P(AXIS),), out_specs=P())
+def const_resolved_axis(x):
+    return lax.psum(x, AXIS)
+
+
+def pmap_bound(xs):
+    return jax.pmap(lambda x: lax.psum(x, "i"), axis_name="i")(xs)
+
+
+def in_spmd_primitive(x, axis_name):
+    # axis arrives as a parameter — not statically checkable, stays silent
+    return lax.psum(x, axis_name)

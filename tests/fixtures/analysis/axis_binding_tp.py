@@ -1,0 +1,25 @@
+"""byzlint fixture: AXIS-BINDING true positives (never imported)."""
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("nodes",))
+
+
+@partial(shard_map, mesh=mesh, in_specs=(P("nodes"),), out_specs=P())
+def wrong_axis(x):
+    return lax.psum(x, "feat")  # finding: mesh binds only "nodes"
+
+
+@partial(shard_map, mesh=mesh, in_specs=(P("nodes"),), out_specs=P("nodes"))
+def wrong_axis_gather(x):
+    g = lax.all_gather(x, "batch", axis=0, tiled=True)  # finding
+    return g
+
+
+def pmap_wrong_axis(xs):
+    return jax.pmap(lambda x: lax.psum(x, "j"), axis_name="i")(xs)  # finding

@@ -1,0 +1,36 @@
+"""byzlint fixture: DONATION false-positive guards — the sanctioned
+rebind-the-result idioms must stay silent."""
+
+from functools import partial
+
+import jax
+
+
+def rebind_result(step_fn, state, batch):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    state = step(state, batch)  # rebound: later reads see the new buffer
+    return state.mean()
+
+
+def loop_with_rebind(step_fn, state, opt_state, batches):
+    step = jax.jit(step_fn, donate_argnums=(0, 1))
+    for batch in batches:
+        state, opt_state = step(state, opt_state, batch)
+    return state, opt_state
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def fold(buf, row):
+    return buf.at[0].add(row)
+
+
+def decorated_rebind(buf, rows):
+    for row in rows:
+        buf = fold(buf, row)
+    return buf
+
+
+def non_donating_call(step_fn, state, batch):
+    step = jax.jit(step_fn)  # no donation: free to keep reading state
+    out = step(state, batch)
+    return out, state.mean()

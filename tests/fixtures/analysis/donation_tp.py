@@ -1,0 +1,36 @@
+"""byzlint fixture: DONATION true positives (never imported)."""
+
+from functools import partial
+
+import jax
+
+
+def read_after_donate(step_fn, state, batch):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    new_state = step(state, batch)
+    return new_state, state.mean()  # finding: state's buffer was donated
+
+
+def loop_without_rebind(step_fn, state, batches):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    losses = []
+    for batch in batches:
+        losses.append(step(state, batch))  # finding: iteration 2 reuses state
+    return losses
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def fold(buf, row):
+    return buf.at[0].add(row)
+
+
+def decorated_read_after_donate(buf, row):
+    out = fold(buf, row)
+    return out + buf  # finding: buf donated to fold above
+
+
+def read_and_rebind(step_fn, state, batch):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    out = step(state, batch)
+    state = state + out  # finding: RHS reads the donated buffer first
+    return state

@@ -1,0 +1,28 @@
+"""byzlint fixture: HOST-SYNC false-positive guards."""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+
+def host_metrics(x):
+    # not traced: .item()/np.asarray are ordinary host code here
+    return float(np.asarray(x).mean()), x.sum().item()
+
+
+@partial(jax.jit, static_argnames=("scale",))
+def static_arg_conversion(x, scale):
+    # scale is static: float() runs on a real python value pre-bake
+    return x * float(scale)
+
+
+def wrapper(x):
+    arr = np.asarray(x)  # pre-trace staging is fine
+
+    @jax.jit
+    def inner(y):
+        return y * 2
+
+    return inner(arr).item()  # host boundary, outside the traced body

@@ -1,0 +1,21 @@
+"""byzlint fixture: HOST-SYNC true positives (never imported)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def item_under_jit(x):
+    return jnp.full((3,), x.mean().item())  # finding: host sync in trace
+
+
+@jax.jit
+def numpy_under_jit(x):
+    return jnp.asarray(np.asarray(x) * 2)  # finding: numpy materialization
+
+
+@jax.jit
+def float_of_param(x):
+    return x / float(x)  # finding: python conversion of traced arg

@@ -1,0 +1,41 @@
+"""byzlint fixture: PYTREE-REG false-positive guards."""
+
+from typing import NamedTuple
+
+import jax
+from jax import lax
+
+
+@jax.tree_util.register_pytree_node_class
+class RegisteredPacket:
+    """QuantizedBlocks-style registered container."""
+
+    def __init__(self, codes, scales):
+        self.codes = codes
+        self.scales = scales
+
+    def tree_flatten(self):
+        return (self.codes, self.scales), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+class TuplePacket(NamedTuple):
+    codes: object
+    scales: object
+
+
+def exchange_registered(codes, scales, perm):
+    pkt = RegisteredPacket(codes, scales)
+    return lax.ppermute(pkt, "ring", perm)
+
+
+def exchange_namedtuple(codes, scales, perm):
+    return lax.ppermute(TuplePacket(codes, scales), "ring", perm)
+
+
+def exchange_array(x, perm):
+    # plain arrays / externally-defined types are out of scope
+    return lax.ppermute(x, "ring", perm)

@@ -1,0 +1,21 @@
+"""byzlint fixture: PYTREE-REG true positives (never imported)."""
+
+from dataclasses import dataclass
+
+from jax import lax
+
+
+@dataclass
+class WirePacket:
+    codes: object
+    scales: object
+
+
+def exchange(codes, scales, perm):
+    pkt = WirePacket(codes, scales)
+    return lax.ppermute(pkt, "ring", perm)  # finding: not a pytree
+
+
+def gather(codes, scales):
+    # constructed inline in the collective call
+    return lax.all_gather(WirePacket(codes, scales), "nodes")  # finding

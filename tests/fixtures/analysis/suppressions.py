@@ -1,0 +1,25 @@
+"""byzlint fixture: suppression syntax + the unused-suppression check."""
+
+import time
+
+
+async def tolerated_block():
+    # deliberate: fixture exercises the trailing-comment suppression form
+    time.sleep(0.01)  # byzlint: ignore[ASYNC-BLOCKING]
+
+
+async def tolerated_block_ownline():
+    # byzlint: ignore[ASYNC-BLOCKING]
+    time.sleep(0.01)
+
+
+async def tolerated_multiline(worker_proc):
+    # trailing comment on the LAST line of a wrapped statement must still
+    # reach the finding anchored on its first line
+    worker_proc.join(
+        5,
+    )  # byzlint: ignore[ASYNC-BLOCKING]
+
+
+def perfectly_fine():
+    return 1  # byzlint: ignore[DONATION] — stale: must raise UNUSED-IGNORE

@@ -1,0 +1,25 @@
+"""byzlint fixture: TRACE-DISPATCH false-positive guards — the PR-2
+wrapper pattern (env/tile dispatch resolved pre-trace) must stay silent.
+"""
+
+import os
+from functools import partial
+
+import jax
+
+
+def dispatch_wrapper(x):
+    # env + tile-cache reads OUTSIDE the traced body: the sanctioned spot
+    tile = int(os.environ.get("BYZPY_TPU_FAKE_TILE", "128"))
+    mode = os.getenv("BYZPY_TPU_FAKE_MODE", "auto")
+
+    @partial(jax.jit, static_argnums=(1, 2))
+    def inner(y, tile, mode):
+        return y * tile if mode == "auto" else y
+
+    return inner(x, tile, mode)
+
+
+def plain_helper():
+    # not traced at all: env reads are ordinary host code here
+    return os.environ.get("HOME")

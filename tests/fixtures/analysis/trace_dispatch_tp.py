@@ -1,0 +1,29 @@
+"""byzlint fixture: TRACE-DISPATCH true positives (never imported)."""
+
+import os
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def env_read_under_jit(x):
+    flag = os.environ.get("BYZPY_TPU_FAKE_FLAG")  # finding: env read in trace
+    return -x if flag else x
+
+
+@partial(jax.jit, static_argnames=("n",))
+def getenv_under_jit(x, n):
+    return x * int(os.getenv("BYZPY_TPU_FAKE_TILE", "128"))  # finding
+
+
+def make_kernel(x):
+    def traced(y):
+        tile = _tuned_tile("sort", 8, y.shape[0])  # finding: dispatch helper
+        return y * tile
+
+    return jax.jit(traced)(x)
+
+
+def _tuned_tile(family, n, d):
+    return 128

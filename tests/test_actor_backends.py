@@ -116,6 +116,51 @@ def test_process_backend_rpc_channels_and_errors():
     asyncio.run(main())
 
 
+def test_process_backend_close_keeps_loop_responsive():
+    # regression (byzlint ASYNC-BLOCKING): close() used to call
+    # self._proc.join(timeout=5) directly on the event loop — a slow
+    # child froze every other actor for the full timeout. The join must
+    # run on an executor thread so the loop keeps ticking.
+    import time
+
+    from byzpy_tpu.engine.actor.backends.process import ProcessActorBackend
+
+    class SlowJoinProc:
+        def join(self, timeout=None):
+            time.sleep(0.5)  # simulated slow child shutdown (sync thread)
+
+        def is_alive(self):
+            return False
+
+        def kill(self):
+            pass
+
+    async def main():
+        backend = ProcessActorBackend()
+        backend._started = True
+        backend._proc = SlowJoinProc()
+
+        gaps = []
+
+        async def ticker():
+            loop = asyncio.get_running_loop()
+            prev = loop.time()
+            while True:
+                await asyncio.sleep(0.01)
+                now = loop.time()
+                gaps.append(now - prev)
+                prev = now
+
+        t = asyncio.ensure_future(ticker())
+        await backend.close()
+        t.cancel()
+        # the 0.5s join ran off-loop: no tick gap anywhere near it
+        assert gaps and max(gaps) < 0.3, f"loop stalled {max(gaps):.3f}s"
+        assert backend._proc is None and not backend._started
+
+    asyncio.run(main())
+
+
 def test_remote_tcp_backend():
     async def main():
         server = RemoteActorServer("127.0.0.1", 0)

@@ -1,0 +1,134 @@
+"""Per-rule golden tests for the byzlint engine.
+
+Every rule has at least one true-positive fixture (must fire) and one
+false-positive guard (must stay silent), run against the checked-in
+fixture files under ``tests/fixtures/analysis/`` — the same corpus a
+rule author reaches for when extending the engine (see
+``docs/static_analysis.md``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from byzpy_tpu.analysis import UNUSED_IGNORE, scan_paths
+from byzpy_tpu.analysis.rules import (
+    ALL_RULES,
+    ASYNC_BLOCKING,
+    AXIS_BINDING,
+    DONATION,
+    HOST_SYNC,
+    PYTREE_REG,
+    TRACE_DISPATCH,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "analysis")
+
+
+def fixture(name: str) -> str:
+    path = os.path.join(FIXTURES, name)
+    assert os.path.exists(path), f"missing fixture {name}"
+    return path
+
+
+def findings_for(name: str, rule: str):
+    result = scan_paths([fixture(name)], select=[rule])
+    return [f for f in result.findings if f.rule == rule]
+
+
+RULE_FIXTURES = {
+    TRACE_DISPATCH: ("trace_dispatch_tp.py", "trace_dispatch_fp.py", 3),
+    DONATION: ("donation_tp.py", "donation_fp.py", 4),
+    AXIS_BINDING: ("axis_binding_tp.py", "axis_binding_fp.py", 3),
+    HOST_SYNC: ("host_sync_tp.py", "host_sync_fp.py", 3),
+    ASYNC_BLOCKING: ("async_blocking_tp.py", "async_blocking_fp.py", 5),
+    PYTREE_REG: ("pytree_reg_tp.py", "pytree_reg_fp.py", 2),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_true_positive_fires(rule):
+    tp, _fp, expected = RULE_FIXTURES[rule]
+    found = findings_for(tp, rule)
+    assert len(found) == expected, (
+        f"{rule} on {tp}: expected {expected} findings, got "
+        f"{[f.render() for f in found]}"
+    )
+    # findings carry usable locations
+    for f in found:
+        assert f.line > 0 and f.message
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_false_positive_guard_silent(rule):
+    _tp, fp, _n = RULE_FIXTURES[rule]
+    found = findings_for(fp, rule)
+    assert found == [], (
+        f"{rule} fired on its false-positive guard {fp}: "
+        f"{[f.render() for f in found]}"
+    )
+
+
+def test_every_shipped_rule_has_fixture_coverage():
+    assert {r.id for r in ALL_RULES} == set(RULE_FIXTURES)
+
+
+def test_suppression_silences_and_unused_is_reported():
+    result = scan_paths([fixture("suppressions.py")])
+    rules = [f.rule for f in result.findings]
+    # all three ASYNC-BLOCKING hits are suppressed (trailing, own-line,
+    # and trailing-on-the-last-line-of-a-wrapped-statement forms)
+    assert ASYNC_BLOCKING not in rules
+    assert result.suppressed == 3
+    # the stale ignore[DONATION] surfaces as UNUSED-IGNORE
+    assert rules == [UNUSED_IGNORE]
+
+
+def test_select_filters_and_rejects_unknown_rules():
+    result = scan_paths([fixture("donation_tp.py")], select=[DONATION])
+    assert {f.rule for f in result.findings} == {DONATION}
+    result = scan_paths([fixture("donation_tp.py")], select=[TRACE_DISPATCH])
+    assert result.findings == []
+    with pytest.raises(ValueError, match="unknown rule"):
+        scan_paths([fixture("donation_tp.py")], select=["NO-SUCH-RULE"])
+
+
+def test_docstring_mention_is_not_a_suppression():
+    # the analysis package's own docs quote the ignore[...] syntax; the
+    # tokenizer-based parser must not read docstrings as suppressions
+    import byzpy_tpu.analysis as pkg
+
+    pkg_dir = os.path.dirname(os.path.abspath(pkg.__file__))
+    result = scan_paths([pkg_dir])
+    assert [f.render() for f in result.findings] == []
+    assert result.suppressed == 0
+
+
+def test_json_and_text_rendering():
+    import json
+
+    from byzpy_tpu.analysis import render_json, render_text
+
+    result = scan_paths([fixture("donation_tp.py")])
+    text = render_text(result)
+    assert "DONATION" in text and text.strip().endswith("0 suppressed")
+    blob = json.loads(render_json(result))
+    assert blob["clean"] is False
+    assert blob["files_scanned"] == 1
+    assert all(
+        set(f) == {"rule", "path", "line", "col", "message"}
+        for f in blob["findings"]
+    )
+
+
+def test_cli_exit_codes(capsys):
+    from byzpy_tpu.analysis import main
+
+    assert main([fixture("donation_fp.py")]) == 0
+    assert main([fixture("donation_tp.py")]) == 1
+    assert main(["--list-rules"]) == 0
+    assert main([os.path.join(FIXTURES, "no_such_file.py")]) == 2
+    capsys.readouterr()  # drain

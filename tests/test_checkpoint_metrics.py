@@ -103,7 +103,7 @@ def test_checkpoint_restore_missing_step_raises(tmp_path):
 
     with CheckpointManager(str(tmp_path / "ck")) as mgr:
         assert mgr.latest_step() is None
-        with pytest.raises(Exception):
+        with pytest.raises(ValueError):
             mgr.restore(41)
 
 

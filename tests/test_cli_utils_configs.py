@@ -50,6 +50,36 @@ def test_cli_list_kinds(capsys):
     assert "Bucketing" in out and "NearestNeighborMixing" in out
 
 
+def test_cli_lint_matches_module_entrypoint(capsys):
+    # `byzpy-tpu lint` must be the exact same gate as
+    # `python -m byzpy_tpu.analysis`: same findings, same exit codes
+    import os
+
+    fixtures = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fixtures", "analysis"
+    )
+    tp = os.path.join(fixtures, "donation_tp.py")
+    fp = os.path.join(fixtures, "donation_fp.py")
+
+    assert main(["lint", fp]) == 0
+    capsys.readouterr()
+    assert main(["lint", tp]) == 1
+    via_cli = capsys.readouterr().out
+
+    from byzpy_tpu.analysis import main as lint_main
+
+    assert lint_main([tp]) == 1
+    via_module = capsys.readouterr().out
+    assert via_cli == via_module
+    assert "DONATION" in via_cli
+
+    assert main(["lint", "--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rule in ("TRACE-DISPATCH", "DONATION", "AXIS-BINDING", "HOST-SYNC",
+                 "ASYNC-BLOCKING", "PYTREE-REG", "UNUSED-IGNORE"):
+        assert rule in listed
+
+
 def test_doctor_report_probes_deps():
     report = doctor_report()
     assert report["flax"]["ok"] and report["optax"]["ok"]

@@ -119,7 +119,7 @@ async def _run_default_fails():
         try:
             await asyncio.wait_for(ps.round(), timeout=10.0)
         except asyncio.TimeoutError:
-            raise AssertionError("round hung instead of failing fast")
+            raise AssertionError("round hung instead of failing fast") from None
         except Exception:
             pass  # expected: the dead remote fails the round
         else:

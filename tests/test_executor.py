@@ -100,5 +100,5 @@ def test_executor_borrowed_pool_not_closed():
 
 
 def test_missing_graph_input_raises():
-    with pytest.raises(Exception):
+    with pytest.raises(KeyError):
         asyncio.run(run_operator(_SumOp(), {"wrong_key": [jnp.ones(2)]}))

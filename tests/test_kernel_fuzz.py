@@ -182,7 +182,7 @@ def test_fuzz_mda_matches_bruteforce(seed):
     # are not broken by enumeration order); accept every tied winner
     winners = [
         x[list(c)].mean(0)
-        for c, dm in zip(combos, diams)
+        for c, dm in zip(combos, diams, strict=True)
         if dm <= best_diam * (1 + 1e-6) + 1e-9
     ]
     assert any(
@@ -222,7 +222,7 @@ def test_fuzz_random_dag_schedulers_agree(seed):
 
         def fn(_coefs=coefs, **kw):
             vals = [kw[k] for k in sorted(kw)]
-            return sum(float(c) * v for c, v in zip(_coefs, vals))
+            return sum(float(c) * v for c, v in zip(_coefs, vals, strict=True))
 
         name = f"n{i}"
         nodes.append(GraphNode(name=name, op=CallableOp(fn), inputs=deps))

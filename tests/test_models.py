@@ -117,7 +117,8 @@ def test_bundle_num_params_and_flatten_roundtrip():
     flat, unravel = stack_gradients([bundle.params])
     back = unravel(flat[0])
     for a, b in zip(
-        jax.tree_util.tree_leaves(bundle.params), jax.tree_util.tree_leaves(back)
+        jax.tree_util.tree_leaves(bundle.params), jax.tree_util.tree_leaves(back),
+        strict=True,
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 

@@ -115,7 +115,7 @@ def test_preagg_stream_class_api_matches_per_round():
         for k in range(3):
             want = pre.pre_aggregate(rounds[k])
             assert len(got[k]) == len(want)
-            for a, b in zip(got[k], want):
+            for a, b in zip(got[k], want, strict=True):
                 np.testing.assert_allclose(
                     np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
                 )

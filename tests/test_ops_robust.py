@@ -363,9 +363,9 @@ def test_parallel_jacobi_schedule_structure():
     for m in (2, 3, 5, 8, 11, 12):
         p_r, q_r, v_r = _parallel_jacobi_schedule(m)
         seen = set()
-        for ps, qs, vs in zip(p_r, q_r, v_r):
+        for ps, qs, vs in zip(p_r, q_r, v_r, strict=True):
             touched = []
-            for p, q, v in zip(ps, qs, vs):
+            for p, q, v in zip(ps, qs, vs, strict=True):
                 if v > 0.5:
                     assert p < q
                     seen.add((int(p), int(q)))

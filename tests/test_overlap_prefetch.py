@@ -85,15 +85,15 @@ def test_prefetch_run_matches_serial_schedule(stream):
     over_nodes, over_byz = _train(
         OverlapConfig(stream=stream, prefetch_depth=1)
     )
-    for a, b in zip(serial_nodes, over_nodes):
+    for a, b in zip(serial_nodes, over_nodes, strict=True):
         # identical per-node call sequence => identical batches consumed,
         # apply strictly before the next compute, no trailing prefetch
         assert a.log == b.log
         assert b.log == ["compute", "apply"] * 4
         assert len(a.applied) == len(b.applied) == 4
-        for x, y in zip(a.applied, b.applied):
+        for x, y in zip(a.applied, b.applied, strict=True):
             np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
-    for x, y in zip(serial_byz[0].applied, over_byz[0].applied):
+    for x, y in zip(serial_byz[0].applied, over_byz[0].applied, strict=True):
         np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
 
 
@@ -297,14 +297,14 @@ def test_p2p_overlapped_run_matches_serial():
     )
     assert completed == 4
     assert halves_s == halves_o  # final round did not prefetch an extra half
-    for a, b in zip(thetas_s, thetas_o):
+    for a, b in zip(thetas_s, thetas_o, strict=True):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
 def test_p2p_overlap_stream_only_matches_serial():
     thetas_s, _, _ = _p2p(None)
     thetas_o, _, _ = _p2p(OverlapConfig(stream=True, prefetch_depth=0))
-    for a, b in zip(thetas_s, thetas_o):
+    for a, b in zip(thetas_s, thetas_o, strict=True):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 

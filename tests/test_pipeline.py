@@ -82,7 +82,8 @@ def test_pipeline_gradients_match_sequential(devices):
     l_seq, g_seq = jax.value_and_grad(seq_loss)(stacked)
     np.testing.assert_allclose(float(l_pipe), float(l_seq), rtol=1e-5)
     for a, b in zip(
-        jax.tree_util.tree_leaves(g_pipe), jax.tree_util.tree_leaves(g_seq)
+        jax.tree_util.tree_leaves(g_pipe), jax.tree_util.tree_leaves(g_seq),
+        strict=True,
     ):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5

@@ -114,5 +114,5 @@ def test_preaggregate_through_placement(monkeypatch):
     want = [np.asarray(v) for v in pre.pre_aggregate(xs)]
     _pretend_accelerator(monkeypatch)
     got = [np.asarray(v) for v in pre.pre_aggregate(xs)]
-    for g, w in zip(got, want):
+    for g, w in zip(got, want, strict=True):
         np.testing.assert_allclose(g, w, rtol=1e-6)

@@ -82,20 +82,20 @@ def test_ring_gradients_match_full(mesh):
         q, k, v = _qkv(jax.random.PRNGKey(7 + causal), 32, 16)
         spec = sharding(mesh, "nodes")
 
-        def ring_loss(q, k, v):
+        def ring_loss(q, k, v, causal=causal):  # bind the loop var (B023)
             out = ring_attention_sharded(
                 mesh, jax.device_put(q, spec), jax.device_put(k, spec),
                 jax.device_put(v, spec), causal=causal,
             )
             return jnp.sum(out * out)
 
-        def full_loss(q, k, v):
+        def full_loss(q, k, v, causal=causal):
             out = full_attention(q, k, v, causal=causal)
             return jnp.sum(out * out)
 
         g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
         g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
-        for gr, gf, name in zip(g_ring, g_full, "qkv"):
+        for gr, gf, name in zip(g_ring, g_full, "qkv", strict=True):
             np.testing.assert_allclose(
                 np.asarray(gr), np.asarray(gf), rtol=2e-4, atol=2e-4,
                 err_msg=f"d/d{name} causal={causal}",

@@ -116,7 +116,8 @@ def test_study_checkpoint_resume_bitexact(digits, tmp_path):
     p_res, _, _ = run(state["params"], state["opt"], state["key"], 20)
 
     for a, b in zip(
-        jax.tree_util.tree_leaves(p_full), jax.tree_util.tree_leaves(p_res)
+        jax.tree_util.tree_leaves(p_full), jax.tree_util.tree_leaves(p_res),
+        strict=True,
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 

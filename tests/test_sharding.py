@@ -88,7 +88,9 @@ def test_selection_kernel_skipped_for_sharded_inputs(mesh, monkeypatch):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
     # unsharded input with the same flag DOES dispatch (guard is the only
     # thing standing between the two paths)
-    with pytest.raises(Exception):
+    # the exact error class varies by jax version/backend (Mosaic raises
+    # different types on CPU-interpret vs TPU), so Exception it is
+    with pytest.raises(Exception):  # noqa: B017
         robust.multi_krum(jax.random.normal(jax.random.PRNGKey(1), (23, 1152)), f=3, q=5)
 
 
