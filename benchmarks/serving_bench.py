@@ -1,0 +1,413 @@
+"""Serving-tier benchmark: ragged-cohort ingestion at 10k-client scale.
+
+Three lanes, each emitting JSON rows (stdout + ``--out`` JSONL):
+
+* ``swarm`` — a simulated client swarm (default 10,000 distinct client
+  identities) streams gradient submissions into one
+  :class:`~byzpy_tpu.serving.ServingFrontend` tenant while the cohort
+  scheduler closes rounds on the window/size trigger and aggregates
+  through the masked bucketed path. Reports sustained accepted
+  submissions/sec, p50/p99 round-close latency, rounds, mean cohort,
+  the rejection breakdown, and the queue's high-water depth (the
+  bounded-backpressure proof: high water never exceeds capacity and
+  ends drained).
+* ``buckets`` — the jit-cache economics: an identical ragged sequence
+  of cohort sizes aggregated (a) through the bucketed masked finalize
+  (one compile per ladder rung) and (b) naively at the exact cohort
+  size (one compile per DISTINCT size, the recompile-per-cohort-size
+  strawman). Wall-clock includes compiles — precisely the cost a
+  serving tier pays on fresh shapes — plus warm per-round time and
+  per-path compile counts. Asserts bit-parity between both paths every
+  round.
+* ``wire`` — ingress accounting: measured frame bytes for the actor
+  wire transport (off/bf16/int8 × unsigned/HMAC) against the
+  ``parallel.comms.serving_ingress_bytes`` law, plus codec round-trip
+  throughput (frames/sec) so the swarm lane's in-process numbers can be
+  projected onto a TCP deployment.
+
+``--smoke`` shrinks everything for CI and asserts the contracts
+(bounded queue, drained shutdown, bucket parity, fewer bucketed than
+naive compiles).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU mesh: the serving tier's host-side machinery is what's under test;
+# a dead accelerator tunnel must not hang the bench (same policy as the
+# other CPU lanes).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+from byzpy_tpu.aggregators import (  # noqa: E402
+    CoordinateWiseTrimmedMean,
+    MultiKrum,
+)
+from byzpy_tpu.engine.actor import wire  # noqa: E402
+from byzpy_tpu.parallel.comms import serving_ingress_bytes  # noqa: E402
+from byzpy_tpu.serving import (  # noqa: E402
+    ServingFrontend,
+    TenantConfig,
+)
+from byzpy_tpu.serving.cohort import CohortAggregator, build_cohort  # noqa: E402
+from byzpy_tpu.serving.credits import CreditPolicy  # noqa: E402
+from byzpy_tpu.serving.buckets import BucketLadder  # noqa: E402
+from byzpy_tpu.serving.queue import Submission  # noqa: E402
+from byzpy_tpu.serving.staleness import StalenessPolicy  # noqa: E402
+
+
+def _emit(row: dict, out_path: str | None) -> None:
+    line = json.dumps(row)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "a") as fh:
+            fh.write(line + "\n")
+
+
+# ---------------------------------------------------------------------------
+# swarm lane
+# ---------------------------------------------------------------------------
+
+
+def _swarm_tenant(args, agg) -> TenantConfig:
+    return TenantConfig(
+        name="swarm",
+        aggregator=agg,
+        dim=args.dim,
+        window_s=args.window_ms / 1e3,
+        cohort_cap=args.cohort_cap,
+        # the aggregator's smallest admissible n (2f+1 for a trimmed
+        # mean): without it a tail cohort below the floor is closed,
+        # fails validate_n in the crash guard, and silently discards
+        # accepted submissions as a failed round
+        min_cohort=2 * args.byzantine + 1,
+        queue_capacity=args.queue_capacity,
+        credit=CreditPolicy(rate_per_s=args.client_rate, burst=args.burst),
+        staleness=StalenessPolicy(kind="exponential", gamma=0.5, cutoff=16),
+    )
+
+
+async def _drive_swarm(fe, args, pool, duration_s: float) -> tuple:
+    """Flood the frontend from ``args.clients`` simulated identities for
+    ``duration_s``; returns ``(offered, accepted, elapsed)``. Offers run
+    far above the credit ceiling on purpose — rejection accounting under
+    flood is part of what the tier must sustain."""
+    rng = np.random.default_rng(0)
+    n_clients = args.clients
+    accepted = 0
+    offered = 0
+    t0 = time.monotonic()
+    deadline = t0 + duration_s
+    burst = 16  # submissions per scheduling slice
+    i = 0
+    while time.monotonic() < deadline:
+        server_round = fe.round_of("swarm")
+        for _ in range(burst):
+            client = f"c{(i * 2654435761) % n_clients:05d}"
+            # clients compute against a recent-but-lagging round
+            lag = int(rng.integers(0, 3))
+            ok, _reason = fe.submit(
+                "swarm", client, server_round - lag, pool[i % len(pool)]
+            )
+            offered += 1
+            accepted += ok
+            i += 1
+        # yield to the scheduler/aggregation tasks
+        await asyncio.sleep(0)
+    elapsed = time.monotonic() - t0
+    await fe.drain("swarm")
+    return offered, accepted, elapsed
+
+
+async def _run_swarm(args) -> dict:
+    agg = CoordinateWiseTrimmedMean(f=args.byzantine)
+    rng = np.random.default_rng(0)
+    # pre-generated gradient pool: the swarm measures the TIER, not
+    # np.random; distinct rows keep aggregation honest
+    pool = [
+        rng.normal(size=args.dim).astype(np.float32) for _ in range(64)
+    ]
+    # warmup pass on a throwaway frontend: the masked jit cache lives on
+    # the AGGREGATOR, so the measured pass starts with every bucket
+    # compiled — steady-state numbers, not compile amortization
+    warm = ServingFrontend([_swarm_tenant(args, agg)])
+    await warm.start()
+    await _drive_swarm(warm, args, pool, min(2.0, args.duration_s))
+    await warm.close()
+
+    fe = ServingFrontend([_swarm_tenant(args, agg)])
+    await fe.start()
+    offered, accepted, elapsed = await _drive_swarm(
+        fe, args, pool, args.duration_s
+    )
+    stats = fe.stats()["swarm"]
+    await fe.close()
+    row = {
+        "lane": "swarm",
+        "clients": args.clients,
+        "dim": args.dim,
+        "aggregator": agg.name,
+        "window_ms": args.window_ms,
+        "cohort_cap": args.cohort_cap,
+        "queue_capacity": args.queue_capacity,
+        "duration_s": round(elapsed, 3),
+        "offered": offered,
+        "accepted": accepted,
+        "accepted_per_sec": round(accepted / elapsed, 1),
+        "offered_per_sec": round(offered / elapsed, 1),
+        "rounds": stats["rounds"],
+        "mean_cohort": round(stats["mean_cohort"], 2),
+        "p50_round_latency_ms": round(stats["p50_round_latency_s"] * 1e3, 3),
+        "p99_round_latency_ms": round(stats["p99_round_latency_s"] * 1e3, 3),
+        "queue_high_water": stats["queue_high_water"],
+        "queue_depth_final": stats["queue_depth"],
+        "outstanding_final": stats["outstanding"],
+        "failed_rounds": stats["failed_rounds"],
+        "rejected": {
+            k: v
+            for k, v in stats["ledger"]["totals"].items()
+            if k != "accepted"
+        },
+        "clients_seen": stats["ledger"]["clients_seen"],
+    }
+    # bounded-queue contract: every accepted submission was aggregated
+    # or is part of the (< min_cohort) inadmissible tail the scheduler
+    # rightly holds — and no round silently dropped a cohort
+    assert stats["queue_high_water"] <= args.queue_capacity, "queue overflow"
+    assert stats["failed_rounds"] == 0, "crash-guarded rounds in swarm"
+    assert stats["outstanding"] < 2 * args.byzantine + 1, "undrained cohort"
+    assert stats["queue_depth"] <= stats["outstanding"], "queue leak"
+    return row
+
+
+# ---------------------------------------------------------------------------
+# bucketed-vs-naive lane
+# ---------------------------------------------------------------------------
+
+
+def _ragged_sizes(rounds: int, cap: int, rng, min_m: int = 5) -> list:
+    """A serving-shaped cohort-size sequence: mostly mid-size cohorts,
+    occasional small stragglers and full windows — many DISTINCT sizes,
+    which is exactly what punishes the recompile-per-size strawman.
+    ``min_m`` floors every draw at the lane aggregators' smallest
+    admissible n (MultiKrum(f=2,q=3) and trimmed-mean f=2 both need
+    n >= 5) — a tenant would enforce the same via ``min_cohort``."""
+    sizes = []
+    for _ in range(rounds):
+        r = rng.random()
+        if r < 0.15:
+            m = int(rng.integers(min_m, max(min_m + 1, cap // 4)))
+        elif r < 0.9:
+            m = int(rng.integers(max(min_m, cap // 3), cap))
+        else:
+            m = cap
+        sizes.append(m)
+    return sizes
+
+
+def _run_buckets(args) -> dict:
+    rng = np.random.default_rng(1)
+    cap = args.cohort_cap
+    d = args.dim
+    agg_m = MultiKrum(f=2, q=3)
+    agg_t = CoordinateWiseTrimmedMean(f=2)
+    sizes = _ragged_sizes(args.bucket_rounds, cap, rng)
+    grads = rng.normal(size=(cap, d)).astype(np.float32)
+    ladder = BucketLadder(cap, min_bucket=8)
+    staleness = StalenessPolicy()
+
+    def cohort_for(m):
+        subs = [
+            Submission(client=f"c{j}", round_submitted=0,
+                       gradient=grads[j], arrived_s=0.0)
+            for j in range(m)
+        ]
+        return build_cohort(subs, 0, ladder, staleness)
+
+    results = {}
+    for name, agg in (("multi-krum", agg_m), ("trimmed-mean", agg_t)):
+        # bucketed masked path
+        executor = CohortAggregator(agg)
+        t0 = time.monotonic()
+        bucketed_out = []
+        per_round_b = []
+        for m in sizes:
+            r0 = time.monotonic()
+            bucketed_out.append(
+                np.asarray(executor.aggregate(cohort_for(m)))
+            )
+            per_round_b.append(time.monotonic() - r0)
+        t_bucketed = time.monotonic() - t0
+        bucketed_compiles = agg._masked_jitted()._cache_size()
+
+        # naive path: exact-size aggregate per cohort (recompile per
+        # DISTINCT size — what a serving tier without bucketing pays)
+        t0 = time.monotonic()
+        naive_out = []
+        per_round_n = []
+        for m in sizes:
+            r0 = time.monotonic()
+            naive_out.append(
+                np.asarray(agg.aggregate([grads[j] for j in range(m)]))
+            )
+            per_round_n.append(time.monotonic() - r0)
+        t_naive = time.monotonic() - t0
+
+        for b, n in zip(bucketed_out, naive_out, strict=True):
+            assert np.array_equal(b, n), f"{name}: bucketed != naive"
+
+        warm = max(1, len(sizes) // 2)
+        results[name] = {
+            "rounds": len(sizes),
+            "distinct_sizes": len(set(sizes)),
+            "buckets_used": len({ladder.bucket_for(m) for m in sizes}),
+            "bucketed_total_s": round(t_bucketed, 3),
+            "naive_total_s": round(t_naive, 3),
+            "total_speedup": round(t_naive / t_bucketed, 2),
+            "bucketed_warm_ms": round(
+                1e3 * float(np.mean(per_round_b[warm:])), 3
+            ),
+            "naive_warm_ms": round(
+                1e3 * float(np.mean(per_round_n[warm:])), 3
+            ),
+            "bucketed_compile_entries": bucketed_compiles,
+            "parity": "bit-identical",
+        }
+    return {
+        "lane": "buckets",
+        "dim": d,
+        "cohort_cap": cap,
+        "ladder": list(ladder.sizes),
+        "results": results,
+    }
+
+
+# ---------------------------------------------------------------------------
+# wire accounting lane
+# ---------------------------------------------------------------------------
+
+
+def _run_wire(args) -> dict:
+    # at least 4096 coords: arrays under wire.WIRE_QUANT_MIN_SIZE travel
+    # lossless by design, which would make the compressed rows vacuous
+    d = max(args.dim, 4096)
+    g = np.random.default_rng(2).normal(size=d).astype(np.float32)
+    frame = {
+        "kind": "submit", "tenant": "swarm", "client": "c01234",
+        "round": 7, "gradient": g,
+    }
+    rows = {}
+    for precision in ("off", "bf16", "int8"):
+        for signed in (False, True):
+            os.environ["BYZPY_TPU_WIRE_PRECISION"] = precision
+            if signed:
+                os.environ["BYZPY_TPU_WIRE_KEY"] = "bench-key"
+            else:
+                os.environ.pop("BYZPY_TPU_WIRE_KEY", None)
+            encoded = wire.encode(frame)
+            measured = len(encoded)
+            law = serving_ingress_bytes(
+                d, precision=precision, signed=signed
+            )
+            # codec round-trip throughput (encode + decode, host-side)
+            n_iter = 50 if not args.smoke else 10
+            t0 = time.monotonic()
+            for _ in range(n_iter):
+                wire.decode(wire.encode(frame)[4:])
+            dt = (time.monotonic() - t0) / n_iter
+            rows[f"{precision}{'+hmac' if signed else ''}"] = {
+                "measured_bytes": measured,
+                "law_bytes": round(law, 1),
+                "law_error": round(abs(measured - law) / measured, 4),
+                "codec_roundtrips_per_sec": round(1.0 / dt, 1),
+            }
+    os.environ.pop("BYZPY_TPU_WIRE_PRECISION", None)
+    os.environ.pop("BYZPY_TPU_WIRE_KEY", None)
+    compressed = rows["int8+hmac"]["measured_bytes"]
+    lossless = rows["off+hmac"]["measured_bytes"]
+    return {
+        "lane": "wire",
+        "dim": d,
+        "frames": rows,
+        "int8_byte_reduction": round(lossless / compressed, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=10_000)
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--duration-s", type=float, default=6.0)
+    ap.add_argument("--window-ms", type=float, default=10.0)
+    ap.add_argument("--cohort-cap", type=int, default=256)
+    ap.add_argument("--queue-capacity", type=int, default=4096)
+    ap.add_argument("--client-rate", type=float, default=50.0)
+    ap.add_argument("--burst", type=float, default=40.0)
+    ap.add_argument("--byzantine", type=int, default=2)
+    ap.add_argument("--bucket-rounds", type=int, default=36)
+    ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small CI run with contract assertions")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.clients = 300
+        args.dim = 512
+        args.duration_s = 2.0
+        args.cohort_cap = 32
+        args.queue_capacity = 256
+        args.bucket_rounds = 10
+
+    meta = {
+        "lane": "meta",
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "smoke": bool(args.smoke),
+    }
+    _emit(meta, args.out)
+
+    swarm = asyncio.run(_run_swarm(args))
+    _emit(swarm, args.out)
+
+    buckets = _run_buckets(args)
+    _emit(buckets, args.out)
+
+    wire_row = _run_wire(args)
+    _emit(wire_row, args.out)
+
+    headline = {
+        "lane": "headline",
+        "metric": "serving_submissions_per_sec",
+        "value": swarm["accepted_per_sec"],
+        "unit": "submissions/sec",
+        "clients": swarm["clients"],
+        "p99_round_latency_ms": swarm["p99_round_latency_ms"],
+        "rounds": swarm["rounds"],
+        "bucketed_vs_naive_speedup": {
+            k: v["total_speedup"] for k, v in buckets["results"].items()
+        },
+    }
+    _emit(headline, args.out)
+
+    if args.smoke:
+        assert swarm["rounds"] > 0, "no rounds closed"
+        assert swarm["accepted"] > 0, "nothing admitted"
+        for res in buckets["results"].values():
+            assert res["bucketed_compile_entries"] <= len(buckets["ladder"])
+            assert res["bucketed_compile_entries"] < res["distinct_sizes"]
+        print("serving smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -249,6 +249,119 @@ class Aggregator(Operator, ABC):
             self.validate_n(matrix.shape[0])
             return unravel(self._aggregate_matrix(matrix))
 
+    # -- masked / ragged finalize (serving-tier bucketed cohorts) ---------
+
+    #: True when the subclass ships a masked matrix program
+    #: (``_aggregate_matrix_masked``): a fold declared for bucket size
+    #: ``n`` can then finalize an actual cohort of ``m <= n`` rows at the
+    #: BUCKET's compiled shape via a validity mask — one jit cache entry
+    #: per bucket instead of one per distinct cohort size. Subclasses
+    #: without one (subset-enumeration aggregators, whose combination
+    #: count is a function of ``m``) fall back to the exact-subset
+    #: ``fold_finalize`` path.
+    supports_masked_finalize: bool = False
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        """Aggregate the VALID rows of the padded ``(n, d)`` matrix to a
+        ``(d,)`` vector — exact size-``m`` semantics at the bucket shape
+        (``m`` traced; see ``ops.robust`` masked section). Only called
+        when :attr:`supports_masked_finalize` is True."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no masked matrix program"
+        )
+
+    def masked_matrix_fn(self) -> Optional[Callable]:
+        """The bare masked ``(matrix, valid) -> vector`` function for
+        embedding in jitted bucketed steps (serving parameter server),
+        or ``None`` when the aggregator has no masked program."""
+        if not self.supports_masked_finalize:
+            return None
+        return self._aggregate_matrix_masked
+
+    def _masked_view(self, state: Any) -> Optional[tuple]:
+        """``(buffer, valid_rows, unravel)`` exposing the fold state's
+        padded ingest buffer for a masked finalize, or ``None`` when the
+        state cannot provide one (mixed-dtype fallback, custom states).
+        ``valid_rows`` is a host-side list/array of booleans per slot."""
+        if isinstance(state, SlotFoldState) and state.buffer is not None:
+            return (
+                state.buffer,
+                [r is not None for r in state.rows],
+                state.unravel,
+            )
+        return None
+
+    def _masked_jitted(self) -> Callable:
+        fn = getattr(self, "_masked_jit_cache", None)
+        if fn is None:
+            fn = jax.jit(self._aggregate_matrix_masked)
+            self._masked_jit_cache = fn
+        return fn
+
+    def aggregate_masked(self, matrix: Any, valid: Any) -> jnp.ndarray:
+        """Exact aggregate of the VALID rows of an already-padded
+        ``(n, d)`` matrix, at the padded shape — the batch door into the
+        same masked program (and per-bucket jit cache) that
+        :meth:`fold_finalize_masked` uses, for callers that assembled
+        the padded cohort in one pass (the serving front end) instead of
+        folding rows as they arrived. Semantics match ``aggregate`` on
+        the valid rows bit-for-bit (f32): finite cohorts run the masked
+        program; non-finite cohorts — and aggregators without a masked
+        program — take the exact compacted-subset path."""
+        import numpy as np
+
+        valid_rows = [bool(v) for v in np.asarray(valid)]
+        m = sum(valid_rows)
+        if m == 0:
+            # validate_n is a no-op for f=0 aggregators (e.g. median),
+            # and the masked programs' (m-1)//2-style gathers would wrap
+            # to a padding row — garbage, not an error — on m=0
+            raise ValueError("aggregate_masked requires at least one valid row")
+        self.validate_n(m)
+        if isinstance(matrix, np.ndarray):
+            finite = bool(np.isfinite(matrix).all())
+        else:
+            finite = bool(jnp.all(jnp.isfinite(matrix)))
+        if self.supports_masked_finalize and finite:
+            return self._masked_jitted()(
+                jnp.asarray(matrix), jnp.asarray(valid_rows, bool)
+            )
+        rows = [matrix[i] for i, v in enumerate(valid_rows) if v]
+        return self.aggregate(rows)
+
+    def fold_finalize_masked(self, state: Any) -> Any:
+        """Finish a round at the BUCKET's compiled shape: aggregate the
+        ``m`` folded gradients of a fold declared for ``n >= m`` slots
+        through the masked matrix program, keeping the ``(n, d)`` jit
+        cache entry warm for every cohort size in the bucket. Exact: the
+        result is bit-identical (f32) to ``aggregate`` on the same ``m``
+        gradients. Falls back to :meth:`fold_finalize` (the exact-subset
+        path, which compiles per distinct ``m``) when the subclass has
+        no masked program, the state exposes no padded buffer, or the
+        cohort contains non-finite values (adversarial NaN/inf rows sort
+        differently against the mask padding — the fallback preserves
+        the barrier path's exact non-finite semantics)."""
+        view = None
+        if self.supports_masked_finalize:
+            view = self._masked_view(state)
+        if view is None:
+            return self.fold_finalize(state)
+        buffer, valid_rows, unravel = view
+        m = sum(bool(v) for v in valid_rows)
+        if m == 0:
+            raise ValueError("fold_finalize before any gradient was folded")
+        self.validate_n(m)
+        with placement.on(placement.compute_device(buffer)):
+            # invalid rows are zero (finite) in every fold buffer, so one
+            # all-reduce answers "is the cohort finite" — the only case
+            # the masked programs do not reproduce bit-for-bit
+            if not bool(jnp.all(jnp.isfinite(buffer))):
+                return self.fold_finalize(state)
+            valid = jnp.asarray(valid_rows, bool)
+            return unravel(self._masked_jitted()(buffer, valid))
+
     def validate_n(self, n: int) -> None:
         """Hook for subclasses to validate hyperparameters against n."""
 

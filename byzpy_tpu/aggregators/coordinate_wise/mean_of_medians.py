@@ -36,8 +36,15 @@ class MeanOfMedians(FeatureChunkedAggregator, Aggregator):
     def _chunk_params(self):
         return {"f": self.f}
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.mean_of_medians(x, f=self.f)
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_mean_of_medians(x, valid, f=self.f)
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.mean_of_medians_stream(xs, f=self.f)

@@ -30,8 +30,15 @@ class CoordinateWiseMedian(FeatureChunkedAggregator, Aggregator):
             raise ValueError("chunk_size must be > 0")
         self.chunk_size = int(chunk_size)
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.coordinate_median(x)
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_coordinate_median(x, valid)
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.coordinate_median_stream(xs)

@@ -61,8 +61,22 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
     def _chunk_params(self):
         return {"f": self.f}
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.trimmed_mean(x, f=self.f)
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_trimmed_mean(x, valid, f=self.f)
+
+    def _masked_view(self, state):
+        # the incremental fold keeps raw rows in a slot buffer precisely
+        # for exact fallbacks; the masked finalize reads the same buffer
+        # (the base class's finite check then routes a NaN/inf round to
+        # the exact sorted path, like the extremes fold does)
+        return Aggregator._masked_view(self, state.slots)
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.trimmed_mean_stream(xs, f=self.f)

@@ -48,9 +48,19 @@ class GeometricMedian(BarrieredIterativeAggregator, Aggregator):
         self.eps = float(eps)
         self.init = init
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.geometric_median(
             x, tol=self.tol, max_iter=self.max_iter, eps=self.eps, init=self.init
+        )
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_geometric_median(
+            x, valid, tol=self.tol, max_iter=self.max_iter,
+            eps=self.eps, init=self.init,
         )
 
     # -- barriered hooks (pool mode) -----------------------------------------

@@ -102,8 +102,24 @@ class MultiKrum(RowScoredAggregator, Aggregator):
     def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
         return robust.ranked_mean(matrix, scores, self.q)
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum(x, f=self.f, q=self.q)
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_multi_krum(x, valid, f=self.f, q=self.q)
+
+    def _masked_view(self, state):
+        # the Gram fold's staging buffer is exactly a padded matrix
+        # (zero rows for absent slots); the masked program recomputes
+        # the Gram from it the way the barrier path would, so parity is
+        # bit-for-bit rather than the incremental fold's tolerance-level
+        if state.buffer is None:
+            return None
+        return state.buffer, list(state.present), state.unravel
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum_stream(xs, f=self.f, q=self.q)

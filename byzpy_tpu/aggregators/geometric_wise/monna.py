@@ -47,8 +47,17 @@ class MoNNA(RowScoredAggregator, Aggregator):
     def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
         return robust.ranked_mean(matrix, scores, matrix.shape[0] - self.f)
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.monna(x, f=self.f, reference_index=self.reference_index)
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_monna(
+            x, valid, f=self.f, reference_index=self.reference_index
+        )
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.monna_stream(xs, f=self.f, reference_index=self.reference_index)

@@ -32,6 +32,10 @@ class CAF(Aggregator):
         if 2 * self.f >= n:
             raise ValueError(f"Cannot tolerate 2f >= n (got n={n}, f={self.f})")
 
+    # no masked matrix program: the filter's spectral reductions are
+    # shape-sensitive at the bit level (a padded power iteration drifts
+    # ~1e-6 from the compacted one), so ragged cohorts take the exact
+    # subset fallback of ``fold_finalize_masked`` instead
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.caf(x, f=self.f, power_iters=self.power_iters, seed=self.seed)
 

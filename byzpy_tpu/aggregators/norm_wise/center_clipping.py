@@ -44,9 +44,18 @@ class CenteredClipping(BarrieredIterativeAggregator, Aggregator):
         self.eps = float(eps)
         self.init = init
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.centered_clipping(
             x, c_tau=self.c_tau, M=self.M, eps=self.eps, init=self.init
+        )
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_centered_clipping(
+            x, valid, c_tau=self.c_tau, M=self.M, eps=self.eps, init=self.init
         )
 
     # -- barriered hooks (pool mode) -----------------------------------------

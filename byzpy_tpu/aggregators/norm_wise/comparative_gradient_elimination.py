@@ -57,8 +57,18 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
     def _select_from_scores(self, scores: jnp.ndarray, matrix: jnp.ndarray) -> jnp.ndarray:
         return robust.ranked_mean(matrix, scores, matrix.shape[0] - self.f)
 
+    supports_masked_finalize = True
+
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.cge(x, f=self.f)
+
+    def _aggregate_matrix_masked(
+        self, x: jnp.ndarray, valid: jnp.ndarray
+    ) -> jnp.ndarray:
+        return robust.masked_cge(x, valid, f=self.f)
+
+    def _masked_view(self, state):
+        return Aggregator._masked_view(self, state.slots)
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.cge_stream(xs, f=self.f)

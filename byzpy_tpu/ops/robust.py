@@ -223,11 +223,31 @@ def trimmed_mean(x: Array, *, f: int) -> Array:
     return _trimmed_mean_xla(x, f=f)
 
 
+def _windowed_row_mean(s: Array, count, *, f: int) -> Array:
+    """Mean of sorted rows ``[f, count - f)`` via a zero-masked einsum
+    row contraction. ``count`` may be a static int or a traced scalar —
+    an einsum contraction accumulates sequentially over the row axis, so
+    appending zero rows (mask padding) preserves every partial sum
+    bit-for-bit, unlike ``jnp.sum``/``jnp.mean`` whose reduction tree
+    re-associates as the row count grows (the masked/ragged parity
+    contract of the serving tier rests on this; pinned by
+    ``tests/test_masked_finalize.py`` up to the bench's bucket cap)."""
+    pos = jnp.arange(s.shape[0])[:, None]
+    window = (pos >= f) & (pos < count - f)
+    kept = jnp.where(window, s, jnp.zeros((), s.dtype))
+    ones = jnp.ones((s.shape[0],), s.dtype)
+    total = jnp.einsum("n,nd->d", ones, kept)
+    denom = count - 2 * f
+    if isinstance(denom, int):
+        return total / denom
+    return total * (jnp.asarray(1.0, total.dtype) / denom.astype(total.dtype))
+
+
 @partial(jax.jit, static_argnames=("f",))
 def _trimmed_mean_xla(x: Array, *, f: int) -> Array:
     n = x.shape[0]
     s = sort_rows(x) if x.ndim == 2 else jnp.sort(x, axis=0)
-    return jnp.mean(s[f : n - f], axis=0)
+    return _windowed_row_mean(s, n, f=f)
 
 
 def mean_of_medians(x: Array, *, f: int) -> Array:
@@ -351,7 +371,11 @@ def _mean_of_medians_xla(
     take_at = at & (jnp.cumsum(at, axis=0) <= quota[None, :])
     mask = below | take_at
     sel = jnp.where(mask, x, jnp.zeros((), x.dtype))
-    out = jnp.sum(sel, axis=0) / jnp.asarray(k, x.dtype)
+    # einsum row contraction, not jnp.sum: sequential accumulation over
+    # the row axis is what makes the masked/ragged mirror
+    # (masked_mean_of_medians) bit-identical at the padded shape
+    ones = jnp.ones((n,), x.dtype)
+    out = jnp.einsum("n,nd->d", ones, sel) / jnp.asarray(k, x.dtype)
     if jnp.issubdtype(x.dtype, jnp.floating):
         # cut is NaN iff fewer than k finite deviations exist (NaNs sort
         # last) — the gather-based selection would have returned NaN there
@@ -455,7 +479,20 @@ def _selection_mean_xla(
     contraction, non-finite data the exact masked path. Results are
     identical in both branches for finite data (same contraction, the
     mask is then a no-op)."""
-    selected = _nan_last_ranks(scores) < q
+    return _selected_rows_mean(x, _nan_last_ranks(scores) < q, q, any_bad)
+
+
+def _selected_rows_mean(
+    x: Array, selected: Array, q, any_bad: Array
+) -> Array:
+    """``mean(x[selected])`` for exactly ``q`` selected rows, as the
+    conditional-mask contraction shared by :func:`_selection_mean_xla`
+    (static ``q``) and :func:`masked_selection_mean` (traced ``q`` —
+    the reciprocal weight divides in f32 exactly like the unpadded
+    path's divide-by-constant rewrite). See ``_selection_mean_xla``'s
+    docstring for the any_bad/lax.cond rationale — keep both callers'
+    bit-parity in mind before touching the masking rule or the
+    accumulation dtype."""
     acc = _feature_matmul_dtype(x)
     w = jnp.where(selected, 1.0 / q, 0.0).astype(acc)
 
@@ -664,7 +701,7 @@ def _geometric_median_impl(
     init: str,
     use_kernel: bool,
 ) -> Array:
-    z0 = jnp.median(x, axis=0) if init == "median" else jnp.mean(x, axis=0)
+    z0 = jnp.median(x, axis=0) if init == "median" else _row_mean_einsum(x)
     # The loop carry tracks the previous center instead of a scalar delta:
     # every carry component is then derived from ``x``, which keeps the
     # varying-manual-axes types consistent when this runs inside a
@@ -692,8 +729,13 @@ def _geometric_median_impl(
         else:
             diff = x - z[None, :]
             dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
-            w = 1.0 / jnp.maximum(dist, eps)
-            z_new = jnp.sum(w[:, None] * x, axis=0) / jnp.sum(w)
+            w = (1.0 / jnp.maximum(dist, eps)).astype(x.dtype)
+            # einsum row contractions (see _windowed_row_mean): the
+            # masked mirror reproduces each step bit-for-bit at the
+            # padded shape
+            num = jnp.einsum("n,nd->d", w, x)
+            den = jnp.einsum("n,n->", w, jnp.ones_like(w))
+            z_new = num / den
         return z_new, z, it + 1
 
     z, _, _ = lax.while_loop(cond, body, (z0, z0, 0))
@@ -734,11 +776,12 @@ def _centered_clipping_impl(
     use_kernel: bool,
 ) -> Array:
     if init == "mean":
-        v0 = jnp.mean(x, axis=0)
+        v0 = _row_mean_einsum(x)
     elif init == "median":
         v0 = jnp.median(x, axis=0)
     else:
         v0 = jnp.zeros((x.shape[1],), x.dtype)
+    n = x.shape[0]
 
     def body(_, v):
         if use_kernel:
@@ -750,7 +793,10 @@ def _centered_clipping_impl(
         diff = x - v[None, :]
         dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
         scale = jnp.minimum(1.0, c_tau / jnp.maximum(dist, eps))
-        return v + jnp.mean(diff * scale[:, None], axis=0)
+        # einsum row contraction (see _windowed_row_mean) so the masked
+        # mirror matches bit-for-bit at the padded shape
+        step = jnp.einsum("n,nd->d", scale.astype(x.dtype), diff)
+        return v + step / n
 
     return lax.fori_loop(0, M, body, v0)
 
@@ -1184,7 +1230,13 @@ def krum_scores_from_gram(gram: Array, *, f: int) -> Array:
     norms = jnp.diagonal(gram)
     d2 = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
     row_sorted = jnp.sort(d2, axis=1)
-    return jnp.sum(row_sorted[:, 1 : n - f], axis=1)
+    # windowed einsum contraction (not a slice + jnp.sum): keeps the
+    # masked/ragged mirror (masked_krum_scores_from_gram) bit-identical
+    # under zero padding — see _windowed_row_mean
+    pos = jnp.arange(n)[None, :]
+    window = (pos >= 1) & (pos < n - f)
+    kept = jnp.where(window, row_sorted, jnp.zeros((), row_sorted.dtype))
+    return jnp.einsum("nk,k->n", kept, jnp.ones((n,), kept.dtype))
 
 
 def multi_krum_from_gram(x: Array, gram: Array, *, f: int, q: int) -> Array:
@@ -1214,6 +1266,344 @@ def _multi_krum_from_gram_xla(
     scores = krum_scores_from_gram(gram, f=f)
     any_bad = ~jnp.all(jnp.isfinite(jnp.diagonal(gram)))
     return _selection_mean_xla(x, scores, q, any_bad)
+
+
+# ---------------------------------------------------------------------------
+# Masked / ragged aggregation. The serving tier (``byzpy_tpu.serving``)
+# closes rounds with whatever cohort arrived in the window, then pads the
+# cohort into one of a few BUCKET shapes so jit caches stay warm: every
+# function here consumes the padded ``(n, d)`` matrix (zero rows for
+# absent slots), an ``(n,)`` boolean validity mask with ``m`` True
+# entries, and computes the EXACT size-``m`` aggregate of the valid rows
+# — bit-for-bit equal (f32, finite inputs) to the corresponding unpadded
+# function on the compacted ``(m, d)`` matrix, for any ``m`` at ONE
+# compiled program per bucket (``m`` is traced, never a shape).
+#
+# The bit-parity recipe (pinned by ``tests/test_masked_finalize.py``):
+#
+# * zero-padded reductions: XLA:CPU/TPU reduce rows in order, and adding
+#   exact zeros preserves every partial sum, so a masked row-sum over
+#   ``n`` rows equals the unpadded sum over ``m``;
+# * division by a traced count must be written ``x * (1.0 / m)``: XLA
+#   rewrites the unpadded ``x / const`` into a reciprocal multiply, so a
+#   literal traced division would round differently;
+# * sorts pad with ``+inf`` (after every finite value, before NaN) and
+#   read dynamic positions with masked positional sums or gathers;
+# * selection ranks count only valid competitors
+#   (:func:`_masked_nan_last_ranks`), reproducing the compacted matrix's
+#   stable tie order exactly.
+#
+# Contract: ``x`` is floating (the fold states cast on ingest), invalid
+# rows are finite (the fold buffers keep them zero), and the VALID rows
+# are finite — a NaN/inf gradient sorts differently against the +inf
+# padding than against real data, so ``Aggregator.fold_finalize_masked``
+# detects non-finite cohorts and falls back to the exact subset path.
+# ``masked_coordinate_median`` alone keeps exact NaN column semantics.
+# ---------------------------------------------------------------------------
+
+
+def _masked_count(valid: Array, dtype=jnp.int32) -> Array:
+    """Number of valid rows ``m`` as a traced scalar."""
+    return jnp.sum(valid.astype(dtype))
+
+
+def _row_mean_einsum(x: Array) -> Array:
+    """``jnp.mean(x, axis=0)`` as an einsum row contraction — the
+    padding-stable reduction every masked mirror shares (see
+    :func:`_windowed_row_mean`)."""
+    ones = jnp.ones((x.shape[0],), x.dtype)
+    return jnp.einsum("n,nd->d", ones, x) / x.shape[0]
+
+
+def _masked_recip(count: Array, dtype) -> Array:
+    """``1 / count`` as the same single-rounded reciprocal XLA's
+    divide-by-constant rewrite produces for the unpadded program."""
+    one = jnp.asarray(1.0, dtype)
+    return one / count.astype(dtype)
+
+
+def masked_mean(x: Array, valid: Array) -> Array:
+    """Mean of the valid rows at the padded shape — bit-for-bit against
+    :func:`_row_mean_einsum` on the compacted matrix."""
+    m = _masked_count(valid)
+    w = valid.astype(x.dtype)
+    s = jnp.einsum("n,nd->d", w, jnp.where(valid[:, None], x, 0.0))
+    return s * _masked_recip(m, s.dtype)
+
+
+def _masked_sorted(x: Array, valid: Array) -> Array:
+    """Sort columns with invalid rows replaced by ``+inf`` (they land
+    after every finite valid value), via the same :func:`sort_rows` the
+    unpadded coordinate-wise fallbacks use — sorted VALUES of the valid
+    prefix are identical to sorting the compacted matrix."""
+    filled = jnp.where(
+        valid[:, None], x, jnp.asarray(jnp.inf, x.dtype)
+    )
+    return sort_rows(filled) if x.ndim == 2 else jnp.sort(filled, axis=0)
+
+
+def _masked_rows_at(s: Array, pos: Array) -> Array:
+    """Row of the sorted matrix at traced position ``pos`` (dynamic
+    per-column gather; ``pos`` broadcasts over columns)."""
+    idx = jnp.broadcast_to(pos, (1, s.shape[1]))
+    return jnp.take_along_axis(s, idx, axis=0)[0]
+
+
+def _masked_mid_rows(s: Array, m: Array) -> Tuple[Array, Array, Array]:
+    """The two middle rows of a sorted matrix at traced count ``m``:
+    ``(s[(m-1)//2], s[m//2], lo == hi)``. Shared by every masked median
+    gather; the MIDPOINT rule stays at each call site on purpose — it
+    must bit-match that site's unpadded mirror, and the mirrors differ
+    (``jnp.median`` computes ``(a+b)*0.5``; ``_mean_of_medians_xla``
+    deliberately uses ``a*0.5 + b*0.5`` against near-max overflow)."""
+    lo, hi = (m - 1) // 2, m // 2
+    return _masked_rows_at(s, lo), _masked_rows_at(s, hi), lo == hi
+
+
+def masked_coordinate_median(x: Array, valid: Array) -> Array:
+    """Coordinate-wise median of the valid rows (exact
+    :func:`coordinate_median` semantics including column-wide NaN
+    propagation), at the padded shape."""
+    m = _masked_count(valid)
+    s = _masked_sorted(x, valid)
+    s_lo, s_hi, single = _masked_mid_rows(s, m)
+    med = jnp.where(
+        single, s_lo, (s_lo + s_hi) * jnp.asarray(0.5, s.dtype)
+    )
+    nan_col = jnp.any(jnp.isnan(x) & valid[:, None], axis=0)
+    return jnp.where(nan_col, jnp.asarray(jnp.nan, s.dtype), med)
+
+
+def masked_trimmed_mean(x: Array, valid: Array, *, f: int) -> Array:
+    """f-trimmed coordinate mean of the valid rows — the masked mirror
+    of :func:`_trimmed_mean_xla`, sharing its windowed einsum reduction
+    with the cohort size traced (callers guarantee ``2f < m``)."""
+    m = _masked_count(valid)
+    s = _masked_sorted(x, valid)
+    return _windowed_row_mean(s, m, f=f)
+
+
+def masked_mean_of_medians(x: Array, valid: Array, *, f: int) -> Array:
+    """MeaMed over the valid rows — the masked mirror of
+    :func:`_mean_of_medians_xla`: the ``k = m - f`` values closest to
+    the median per coordinate still form a contiguous window of the
+    sorted column, and the number of candidate window STARTS is ``f+1``
+    regardless of ``m``, so only the window END moves with the traced
+    cohort size."""
+    n, d = x.shape
+    m = _masked_count(valid)
+    k = m - f
+    s = _masked_sorted(x, valid)
+    s_lo, s_hi, single = _masked_mid_rows(s, m)
+    half = jnp.asarray(0.5, s.dtype)
+    med = jnp.where(single, s_lo, s_lo * half + s_hi * half)
+    nan_col = jnp.any(jnp.isnan(x) & valid[:, None], axis=0)
+    med = jnp.where(nan_col, jnp.asarray(jnp.nan, s.dtype), med)
+    # window starts 0..f (static count); ends s + k - 1 (traced gather)
+    starts = s[: f + 1]
+    end_pos = jnp.arange(f + 1)[:, None] + (k - 1)
+    ends = jnp.take_along_axis(s, jnp.broadcast_to(end_pos, (f + 1, d)), axis=0)
+    radius = jnp.maximum(med[None, :] - starts, ends - med[None, :])
+    dev = jnp.abs(x - med[None, :])
+    finite_dev = jnp.where(jnp.isnan(dev) | ~valid[:, None], 0, 1)
+    cut_nonfinite = jnp.where(
+        jnp.sum(finite_dev, axis=0) >= k,
+        jnp.asarray(jnp.inf, s.dtype),
+        jnp.asarray(jnp.nan, s.dtype),
+    )
+    cut = jnp.where(
+        jnp.isfinite(med), jnp.min(radius, axis=0), cut_nonfinite
+    )
+    below = (dev < cut[None, :]) & valid[:, None]
+    at = (dev == cut[None, :]) & valid[:, None]
+    quota = k - jnp.sum(below, axis=0)
+    take_at = at & (jnp.cumsum(at, axis=0) <= quota[None, :])
+    sel = jnp.where(below | take_at, x, jnp.zeros((), x.dtype))
+    ones = jnp.ones((n,), x.dtype)
+    out = jnp.einsum("n,nd->d", ones, sel) * _masked_recip(k, s.dtype)
+    return jnp.where(jnp.isnan(cut), jnp.asarray(jnp.nan, s.dtype), out)
+
+
+def _masked_nan_last_ranks(scores: Array, valid: Array) -> Array:
+    """Selection rank counting only VALID competitors, under the same
+    (isnan, score, index) key as :func:`_nan_last_ranks` — for valid
+    rows this reproduces the compacted matrix's rank exactly (compaction
+    preserves index order); invalid rows rank ``n`` and are never
+    selected, whatever their score."""
+    n = scores.shape[0]
+    idx = jnp.arange(n)
+    isnan = jnp.isnan(scores)
+    s = jnp.where(isnan, jnp.zeros_like(scores), scores)
+    nan_lt = (~isnan[None, :]) & isnan[:, None]
+    nan_eq = isnan[None, :] == isnan[:, None]
+    lt = nan_lt | (nan_eq & (s[None, :] < s[:, None]))
+    eq = nan_eq & (s[None, :] == s[:, None])
+    before = (lt | (eq & (idx[None, :] < idx[:, None]))) & valid[None, :]
+    return jnp.where(valid, jnp.sum(before, axis=1), n)
+
+
+def masked_selection_mean(
+    x: Array, scores: Array, valid: Array, q: Array, any_bad: Array
+) -> Array:
+    """Mean of the ``q`` lowest-score VALID rows — the masked mirror of
+    :func:`_selection_mean_xla`, sharing its contraction via
+    :func:`_selected_rows_mean` (``q`` traced here)."""
+    return _selected_rows_mean(
+        x, _masked_nan_last_ranks(scores, valid) < q, q, any_bad
+    )
+
+
+def masked_krum_scores_from_gram(
+    gram: Array, valid: Array, *, f: int
+) -> Array:
+    """Krum score per VALID row from the padded Gram matrix (zero
+    rows/columns for absent slots): invalid columns are pushed to
+    ``+inf`` before the row sort, so each valid row's sorted prefix
+    matches the compacted matrix's, and the sum of its ``m - f - 1``
+    nearest squared distances reads through a masked positional window
+    instead of a static slice. Invalid rows score ``+inf``."""
+    n = gram.shape[0]
+    m = _masked_count(valid)
+    norms = jnp.diagonal(gram)
+    d2 = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
+    d2 = jnp.where(valid[None, :], d2, jnp.asarray(jnp.inf, d2.dtype))
+    row_sorted = jnp.sort(d2, axis=1)
+    pos = jnp.arange(n)[None, :]
+    window = (pos >= 1) & (pos < m - f)
+    kept = jnp.where(window, row_sorted, jnp.zeros((), d2.dtype))
+    s = jnp.einsum("nk,k->n", kept, jnp.ones((n,), kept.dtype))
+    return jnp.where(valid, s, jnp.asarray(jnp.inf, d2.dtype))
+
+
+def masked_multi_krum(x: Array, valid: Array, *, f: int, q: int) -> Array:
+    """Multi-Krum over the valid rows at the padded shape — the masked
+    mirror of :func:`_multi_krum_xla` (callers guarantee ``f < m - 1``
+    and ``q <= m - f``)."""
+    gram = gram_matrix(x)
+    scores = masked_krum_scores_from_gram(gram, valid, f=f)
+    diag_ok = jnp.where(valid, jnp.isfinite(jnp.diagonal(gram)), True)
+    any_bad = ~jnp.all(diag_ok)
+    return masked_selection_mean(x, scores, valid, q, any_bad)
+
+
+def masked_cge(x: Array, valid: Array, *, f: int) -> Array:
+    """CGE over the valid rows at the padded shape — the masked mirror
+    of :func:`_cge_xla`; the keep-count ``m - f`` is traced, so one
+    program serves every cohort size in the bucket."""
+    m = _masked_count(valid)
+    norms = jnp.sum(x * x, axis=1)
+    any_bad = ~jnp.all(jnp.where(valid, jnp.isfinite(norms), True))
+    return masked_selection_mean(x, norms, valid, m - f, any_bad)
+
+
+def masked_monna(
+    x: Array, valid: Array, *, f: int, reference_index: int = 0
+) -> Array:
+    """MoNNA over the valid rows at the padded shape: the trusted
+    reference is the ``reference_index``-th VALID row (matching the
+    compacted matrix the unpadded :func:`_monna_xla` sees). Callers
+    guarantee ``reference_index < m`` (``MoNNA.validate_n`` raises
+    host-side; ``m`` is traced here, so the cumsum/argmax gather would
+    otherwise silently fall back to slot 0 — an arbitrary, possibly
+    Byzantine, row as the trusted node)."""
+    m = _masked_count(valid)
+    # slot holding the (reference_index+1)-th valid row
+    ref_slot = jnp.argmax(jnp.cumsum(valid.astype(jnp.int32)) == reference_index + 1)
+    ref = lax.dynamic_index_in_dim(x, ref_slot, axis=0, keepdims=False)
+    diff = x - ref[None, :]
+    dists = jnp.sum(diff * diff, axis=1)
+    any_bad = ~jnp.all(jnp.where(valid, jnp.isfinite(dists), True))
+    return masked_selection_mean(x, dists, valid, m - f, any_bad)
+
+
+def _masked_median_rows(x: Array, valid: Array) -> Array:
+    """``jnp.median(compacted, axis=0)`` at the padded shape (the
+    iterative aggregators' ``init="median"`` center — no NaN column
+    rewrite, mirroring ``jnp.median``)."""
+    m = _masked_count(valid)
+    s = jnp.sort(
+        jnp.where(valid[:, None], x, jnp.asarray(jnp.inf, x.dtype)), axis=0
+    )
+    s_lo, s_hi, single = _masked_mid_rows(s, m)
+    return jnp.where(
+        single, s_lo, (s_lo + s_hi) * jnp.asarray(0.5, s.dtype)
+    )
+
+
+def masked_geometric_median(
+    x: Array,
+    valid: Array,
+    *,
+    tol: float = 1e-6,
+    max_iter: int = 256,
+    eps: float = 1e-12,
+    init: str = "median",
+) -> Array:
+    """Geometric median of the valid rows at the padded shape — the
+    masked mirror of :func:`_geometric_median_impl` (XLA path): every
+    per-row weight is zeroed for invalid slots, so each Weiszfeld step
+    reproduces the compacted iteration bit-for-bit and the while-loop
+    trip count matches."""
+    if init not in {"median", "mean"}:
+        raise ValueError("init must be 'median' or 'mean'")
+    z0 = (
+        _masked_median_rows(x, valid)
+        if init == "median"
+        else masked_mean(x, valid)
+    )
+    vcol = valid[:, None]
+
+    def cond(state):
+        z, zprev, it = state
+        delta = jnp.sqrt(jnp.sum((z - zprev) ** 2))
+        return ((it == 0) | (delta > tol)) & (it < max_iter)
+
+    def body(state):
+        z, _, it = state
+        diff = x - z[None, :]
+        dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
+        w = jnp.where(valid, 1.0 / jnp.maximum(dist, eps), 0.0).astype(x.dtype)
+        num = jnp.einsum("n,nd->d", w, x)
+        den = jnp.einsum("n,n->", w, jnp.ones_like(w))
+        z_new = num / den
+        return z_new, z, it + 1
+
+    z, _, _ = lax.while_loop(cond, body, (z0, z0, 0))
+    return z
+
+
+def masked_centered_clipping(
+    x: Array,
+    valid: Array,
+    *,
+    c_tau: float,
+    M: int = 10,
+    eps: float = 1e-12,
+    init: str = "mean",
+) -> Array:
+    """Centered clipping of the valid rows at the padded shape — the
+    masked mirror of :func:`_centered_clipping_impl` (XLA path)."""
+    if init not in {"mean", "median", "zero"}:
+        raise ValueError("init must be one of {'mean','median','zero'}")
+    m = _masked_count(valid)
+    if init == "mean":
+        v0 = masked_mean(x, valid)
+    elif init == "median":
+        v0 = _masked_median_rows(x, valid)
+    else:
+        v0 = jnp.zeros((x.shape[1],), x.dtype)
+    inv = _masked_recip(m, x.dtype)
+
+    def body(_, v):
+        diff = x - v[None, :]
+        dist = jnp.sqrt(jnp.sum(diff * diff, axis=1))
+        scale = jnp.minimum(1.0, c_tau / jnp.maximum(dist, eps))
+        w = jnp.where(valid, scale, 0.0).astype(x.dtype)
+        # invalid rows: diff = -v (finite), weight exactly 0
+        step = jnp.einsum("n,nd->d", w, diff)
+        return v + step * inv
+
+    return lax.fori_loop(0, M, body, v0)
 
 
 def aggregate_stream(agg_fn, xs: Array) -> Array:
@@ -1277,4 +1667,15 @@ __all__ = [
     "trimmed_mean_from_extremes",
     "krum_scores_from_gram",
     "multi_krum_from_gram",
+    "masked_mean",
+    "masked_coordinate_median",
+    "masked_trimmed_mean",
+    "masked_mean_of_medians",
+    "masked_selection_mean",
+    "masked_krum_scores_from_gram",
+    "masked_multi_krum",
+    "masked_cge",
+    "masked_monna",
+    "masked_geometric_median",
+    "masked_centered_clipping",
 ]

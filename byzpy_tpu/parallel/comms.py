@@ -298,6 +298,47 @@ def ps_round_wire_bytes(
     return transpose + gather
 
 
+#: Measured cloudpickle envelope of one serving submission frame (the
+#: dict keys, tenant/client strings, numpy array header — everything
+#: but the length prefix, HMAC tag, and gradient payload), per wire
+#: precision: compressed frames carry a ``QuantizedWireArray`` header
+#: (mode/block/shape/dtype + the scales array's own pickle framing).
+#: Pinned within tolerance by ``tests/test_serving.py``.
+_SERVING_ENVELOPE_BYTES = {"off": 224, "bf16": 368, "int8": 432}
+
+
+def serving_ingress_bytes(
+    n_params: int,
+    *,
+    precision: str = "off",
+    quant_block: int = 256,
+    signed: bool = False,
+    dtype_bytes: int = 4,
+    envelope_bytes: Optional[int] = None,
+) -> float:
+    """Analytic wire bytes of ONE client gradient submission entering
+    the serving tier (``byzpy_tpu.serving``): the 4-byte length prefix,
+    the 32-byte HMAC tag when ``signed`` (``BYZPY_TPU_WIRE_KEY``), the
+    cloudpickle envelope, and the gradient payload —
+    ``n_params · dtype_bytes`` scaled by :func:`compression_factor` for
+    the ``BYZPY_TPU_WIRE_PRECISION`` fabric the frame rides
+    (``off``/``bf16``/``int8``). Multiply by sustained submissions/sec
+    for the tier's ingress-bandwidth law; the measured side is the
+    frontend's per-tenant ``ingress_bytes`` counter and
+    ``benchmarks/serving_bench.py``'s accounting lane."""
+    mode = (precision or "off").lower()
+    if envelope_bytes is None:
+        envelope_bytes = _SERVING_ENVELOPE_BYTES.get(
+            mode, _SERVING_ENVELOPE_BYTES["off"]
+        )
+    payload = (
+        n_params
+        * dtype_bytes
+        * compression_factor(mode, block=quant_block, dtype_bytes=dtype_bytes)
+    )
+    return 4 + (32 if signed else 0) + envelope_bytes + payload
+
+
 def scaling_model(
     *,
     flops_per_chip: float,
@@ -339,4 +380,5 @@ __all__ = [
     "opt_state_bytes",
     "ps_round_wire_bytes",
     "scaling_model",
+    "serving_ingress_bytes",
 ]

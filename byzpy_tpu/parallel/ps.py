@@ -417,6 +417,97 @@ def build_ps_train_step(
     return train_step, opt_state0
 
 
+def build_serving_ps_step(
+    bundle: ModelBundle,
+    masked_aggregate: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    *,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[Callable, Any]:
+    """Build the serving tier's bucketed update step.
+
+    Unlike :func:`build_ps_train_step` — which computes every node's
+    gradient inside the program — the serving step consumes a COHORT the
+    front end assembled from client submissions
+    (``byzpy_tpu.serving.cohort.Cohort``): ``step(params, opt_state,
+    matrix, valid, weights)`` where ``matrix`` is the ``(bucket, d)``
+    zero-padded gradient stack, ``valid`` the ``(bucket,)`` row mask and
+    ``weights`` the per-row staleness discounts (1.0 = fresh; padding
+    rows carry 0). The masked aggregate (an
+    ``Aggregator.masked_matrix_fn()``) reduces the valid rows EXACTLY as
+    the unpadded aggregate would, with the actual cohort size ``m``
+    traced — so ``jax.jit``'s shape keying compiles ONE program per
+    bucket in the ladder instead of one per distinct cohort size (the
+    jit-cache economics ``benchmarks/serving_bench.py`` measures).
+
+    PRECONDITIONS (the caller's, because ``m`` is traced and a jitted
+    program can neither ``validate_n`` nor fall back): the cohort must
+    be admissible for the aggregator (``m`` at least its smallest valid
+    n — e.g. 2f+1 for a trimmed mean, where a smaller cohort makes the
+    trim window empty and the 1/(m-2f) reciprocal a silent NaN; the
+    serving front end enforces this via ``TenantConfig.min_cohort``)
+    and the valid rows finite (the masked programs' exactness contract
+    is finite-only; the guarded door with the exact non-finite fallback
+    is ``Aggregator.aggregate_masked``, which ``CohortAggregator``
+    uses). This mirrors the rest of the SPMD layer: every in-jit
+    aggregator call trusts its inputs at trace-checked shapes.
+
+    With ``mesh``, the cohort matrix is constrained feature-sharded over
+    every mesh axis before the reduce, the same layout as the fused PS
+    round. Returns ``(step, opt_state0)``; the step is NOT jitted here —
+    wrap with ``jax.jit`` (see :func:`jit_serving_ps_step`) so callers
+    control donation.
+    """
+    opt = optimizer or optax.sgd(learning_rate, momentum=momentum)
+    ravel, unravel = ravel_pytree_fn(bundle.params)
+    param_dtype = ravel(bundle.params).dtype
+    feat_spec = None
+    if mesh is not None:
+        axis = node_axis(mesh)
+        extra = tuple(
+            a for a in mesh.axis_names if a != axis and mesh.shape[a] > 1
+        )
+        feat_spec = NamedSharding(mesh, P(None, (axis, *extra)))
+
+    def step(params, opt_state, matrix, valid, weights):
+        # staleness discount: scale each row before the robust reduce
+        # (a weight of exactly 1.0 leaves the row bit-identical; the
+        # padding rows are zero and stay zero)
+        matrix = matrix * weights[:, None].astype(matrix.dtype)
+        if feat_spec is not None:
+            matrix = jax.lax.with_sharding_constraint(matrix, feat_spec)
+        agg_flat = masked_aggregate(matrix, valid).astype(param_dtype)
+        agg = unravel(agg_flat)
+        updates, new_opt_state = opt.update(agg, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "agg_grad_norm": jnp.sqrt(jnp.sum(jnp.square(agg_flat))),
+            "cohort_m": jnp.sum(valid.astype(jnp.int32)),
+        }
+        return params, new_opt_state, metrics
+
+    return step, opt.init(bundle.params)
+
+
+def jit_serving_ps_step(
+    bundle: ModelBundle,
+    masked_aggregate: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    *,
+    donate: bool = False,
+    **kwargs: Any,
+) -> Tuple[Callable, Any]:
+    """:func:`build_serving_ps_step` + ``jax.jit``. One compiled program
+    per BUCKET shape (jit keys on the padded matrix shape; the cohort
+    size only flows through the validity mask). ``donate=True`` donates
+    params/opt-state for in-place HBM updates — only when the caller
+    never reuses the previous round's references."""
+    step, opt_state0 = build_serving_ps_step(bundle, masked_aggregate, **kwargs)
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums), opt_state0
+
+
 def jit_ps_train_step(
     bundle: ModelBundle,
     aggregate: AggFn,
@@ -441,5 +532,7 @@ __all__ = [
     "as_sharded_update",
     "default_optimizer",
     "build_ps_train_step",
+    "build_serving_ps_step",
     "jit_ps_train_step",
+    "jit_serving_ps_step",
 ]

@@ -1,0 +1,59 @@
+"""Robust-aggregation serving tier: ragged-cohort ingestion at scale.
+
+Both training orchestrators (``engine.parameter_server``, SPMD
+``parallel.ps``) assume a FIXED worker set that all shows up every round.
+This package is the continuous-ingestion front end that lifts that
+assumption: clients stream gradient submissions into a bounded admission
+queue (the HMAC-signed, optionally-quantized actor wire frames of
+``engine.actor.wire`` are the client transport), a cohort scheduler
+closes rounds on a window/size trigger, and the parameter server
+aggregates *ragged, variable-size cohorts* — whoever arrived in the
+window — padded into a small ladder of bucket shapes so jit caches stay
+warm (one compiled program per bucket, not per cohort size; the masked
+finalize is exact, see ``ops.robust``'s masked section).
+
+Pieces:
+
+* :mod:`~byzpy_tpu.serving.credits` — per-client token-bucket rate
+  accounting and rejection stats (a flooding client starves itself, not
+  the queue);
+* :mod:`~byzpy_tpu.serving.queue` — the bounded admission queue
+  (backpressure = reject at the door, never unbounded growth);
+* :mod:`~byzpy_tpu.serving.buckets` — the power-of-two bucket ladder;
+* :mod:`~byzpy_tpu.serving.staleness` — round-lag discount policies
+  (a round-``k`` gradient folds into round ``k + δ`` scaled by
+  ``discount(δ)``; ``δ = 0`` is the exact identity);
+* :mod:`~byzpy_tpu.serving.cohort` — cohort assembly over the
+  aggregators' streaming ``fold_init``/``fold``/``fold_finalize_masked``
+  hooks;
+* :mod:`~byzpy_tpu.serving.frontend` — the multi-tenant asyncio front
+  end: several models share one mesh with independent cohorts, queues,
+  and credit ledgers.
+
+The serving PS step lives in ``parallel.ps.build_serving_ps_step``; the
+ingress-bandwidth law in ``parallel.comms.serving_ingress_bytes``;
+throughput/latency measurement in ``benchmarks/serving_bench.py``.
+"""
+
+from .buckets import BucketLadder
+from .cohort import Cohort, CohortAggregator
+from .credits import CreditLedger, CreditPolicy, TokenBucket
+from .frontend import ServingClient, ServingFrontend, TenantConfig, serve_frame
+from .queue import AdmissionQueue, Submission
+from .staleness import StalenessPolicy
+
+__all__ = [
+    "AdmissionQueue",
+    "BucketLadder",
+    "Cohort",
+    "CohortAggregator",
+    "CreditLedger",
+    "CreditPolicy",
+    "ServingClient",
+    "ServingFrontend",
+    "StalenessPolicy",
+    "Submission",
+    "TenantConfig",
+    "TokenBucket",
+    "serve_frame",
+]

@@ -1,0 +1,118 @@
+"""Cohort assembly: ragged submissions -> padded bucket + masked finalize.
+
+Two consumers share the :class:`Cohort` layout:
+
+* :class:`CohortAggregator` rides the aggregators' streaming
+  ``fold_init(bucket)`` / ``fold(slot, g)`` / ``fold_finalize_masked``
+  hooks — the PR-1 overlapped-round backbone, extended so a fold
+  declared for the BUCKET size finalizes an actual cohort of ``m ≤
+  bucket`` rows through the validity mask at the bucket's compiled
+  shape (exact; see ``aggregators.base.Aggregator.fold_finalize_masked``);
+* ``parallel.ps.build_serving_ps_step`` consumes the padded
+  ``(bucket, d)`` matrix + mask + staleness weights directly inside one
+  jitted update step (jit's shape keying makes the bucket ladder the
+  whole compile-cache story).
+
+Staleness folds in here: a round-``k`` gradient landing in server round
+``k + δ`` is scaled by ``StalenessPolicy.discount(δ)`` before it enters
+the aggregate; ``δ = 0`` rows are bit-identical (weight exactly 1.0).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence, Tuple
+
+import numpy as np
+
+from ..aggregators.base import Aggregator
+from .buckets import BucketLadder
+from .queue import Submission
+from .staleness import StalenessPolicy
+
+
+@dataclass(frozen=True)
+class Cohort:
+    """One closed round's padded cohort.
+
+    ``matrix``: ``(bucket, d)`` float32 rows — valid rows first (slot
+    order = admission order), zero rows after; ``valid``: ``(bucket,)``
+    bool; ``weights``: ``(bucket,)`` float32 staleness discounts (1.0
+    for fresh rows, 0.0 padding); ``clients``: the valid rows' client
+    ids; ``first_arrival_s``: the earliest admission timestamp (round
+    latency is measured from here)."""
+
+    matrix: np.ndarray
+    valid: np.ndarray
+    weights: np.ndarray
+    clients: Tuple[str, ...]
+    first_arrival_s: float
+
+    @property
+    def bucket(self) -> int:
+        """Padded row count (the compiled shape)."""
+        return int(self.matrix.shape[0])
+
+    @property
+    def m(self) -> int:
+        """Actual cohort size (valid rows)."""
+        return int(self.valid.sum())
+
+
+def build_cohort(
+    submissions: Sequence[Submission],
+    server_round: int,
+    ladder: BucketLadder,
+    staleness: StalenessPolicy,
+) -> Cohort:
+    """Pad one round's submissions into the smallest bucket that holds
+    them, stamping per-row staleness discounts against ``server_round``."""
+    m = len(submissions)
+    bucket = ladder.bucket_for(m)
+    d = int(np.asarray(submissions[0].gradient).shape[0])
+    matrix = np.zeros((bucket, d), np.float32)
+    weights = np.zeros((bucket,), np.float32)
+    valid = np.zeros((bucket,), bool)
+    for slot, sub in enumerate(submissions):
+        matrix[slot] = sub.gradient
+        weights[slot] = staleness.discount(server_round - sub.round_submitted)
+        valid[slot] = True
+    return Cohort(
+        matrix=matrix,
+        valid=valid,
+        weights=weights,
+        clients=tuple(s.client for s in submissions),
+        first_arrival_s=min(s.arrived_s for s in submissions),
+    )
+
+
+class CohortAggregator:
+    """Masked-finalize execution of one tenant's robust aggregator.
+
+    ``aggregate(cohort)`` scales any stale rows by their discount (a
+    fresh row's weight is exactly 1.0 and its bits never change), then
+    reduces the padded matrix through
+    :meth:`~byzpy_tpu.aggregators.base.Aggregator.aggregate_masked` —
+    ONE device dispatch per round into the same per-bucket compiled
+    program the streaming ``fold_finalize_masked`` path uses, exact
+    against the unpadded aggregate. Aggregators without a masked
+    program (MDA/SMEA) fall back to the exact-subset path
+    transparently — correct, but compiled per cohort size.
+
+    An overlapped deployment that wants per-arrival ingestion instead
+    (hide the flatten/fold work inside the window) folds submissions
+    into ``fold_init(bucket)`` as they land and closes the round with
+    ``fold_finalize_masked`` — identical results, same jit cache."""
+
+    def __init__(self, aggregator: Aggregator) -> None:
+        self.aggregator = aggregator
+
+    def aggregate(self, cohort: Cohort) -> Any:
+        """Aggregate one cohort to a ``(d,)`` vector."""
+        matrix = cohort.matrix
+        if bool((cohort.weights[: cohort.m] != 1.0).any()):
+            matrix = matrix * cohort.weights[:, None]
+        return self.aggregator.aggregate_masked(matrix, cohort.valid)
+
+
+__all__ = ["Cohort", "CohortAggregator", "build_cohort"]

@@ -1,0 +1,552 @@
+"""Multi-tenant serving front end: wire ingress -> admission -> cohorts.
+
+One :class:`ServingFrontend` hosts several tenants (models) on one
+mesh. Each tenant owns an independent bounded admission queue, credit
+ledger, bucket ladder, staleness policy, and round counter — isolation
+is per-tenant by construction — while a shared device lock serializes
+the actual aggregation dispatches so cohorts from different models
+interleave cleanly on the same chips (the Podracer pattern: thousands
+of cheap producers, one accelerator consumer).
+
+Client transport reuses the actor wire (``engine.actor.wire``)
+verbatim: length-prefixed cloudpickle frames, HMAC-signed when
+``BYZPY_TPU_WIRE_KEY`` is set, gradient payloads blockwise-compressed
+when ``BYZPY_TPU_WIRE_PRECISION`` is ``bf16``/``int8``. A submission
+frame is a dict::
+
+    {"kind": "submit", "tenant": str, "client": str,
+     "round": int, "gradient": np.ndarray (d,)}
+
+answered by ``{"kind": "ack", "accepted": bool, "reason": str,
+"round": int}``; ``{"kind": "stats", "tenant": str}`` returns the
+tenant's accounting snapshot. The analytic per-frame ingress cost is
+``parallel.comms.serving_ingress_bytes``.
+
+The admission path (``submit``) is synchronous and cheap — shape gate,
+staleness gate, token-bucket spend, bounded enqueue — so the asyncio
+loop never blocks on it; aggregation runs through
+``loop.run_in_executor`` to keep ingress responsive during a round's
+device work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..engine.actor import wire
+from .buckets import BucketLadder
+from .cohort import Cohort, CohortAggregator, build_cohort
+from .credits import (
+    ACCEPTED,
+    REJECTED_FULL,
+    REJECTED_RATE,
+    REJECTED_SHAPE,
+    REJECTED_STALE,
+    REJECTED_TENANT,
+    CreditLedger,
+    CreditPolicy,
+    RoundStats,
+)
+from .queue import AdmissionQueue, Submission
+from .staleness import StalenessPolicy
+
+#: Called after every closed round: ``(tenant_name, round_id, cohort,
+#: aggregate)``. Keep it light — it runs on the scheduler task.
+RoundCallback = Callable[[str, int, Cohort, Any], None]
+
+#: A decoded (HMAC-valid) request whose fields are type-nonsense —
+#: distinct from a forged frame (peer dropped) and from every admission
+#: rejection (all of which name a well-formed submission).
+REJECTED_MALFORMED = "rejected_malformed"
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One model's serving parameters.
+
+    ``dim`` is the flattened gradient length the tenant accepts (the
+    shape gate at admission); ``window_s``/``cohort_cap`` the round
+    close triggers; ``queue_capacity`` the admission bound;
+    ``min_bucket`` the bottom of the power-of-two bucket ladder."""
+
+    name: str
+    aggregator: Any
+    dim: int
+    window_s: float = 0.02
+    cohort_cap: int = 256
+    min_cohort: int = 1
+    min_bucket: int = 2
+    queue_capacity: int = 1024
+    credit: CreditPolicy = field(default_factory=CreditPolicy)
+    staleness: StalenessPolicy = field(default_factory=StalenessPolicy)
+
+    def __post_init__(self) -> None:
+        if self.dim <= 0:
+            raise ValueError("dim must be >= 1")
+        if self.window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if self.cohort_cap <= 0:
+            raise ValueError("cohort_cap must be >= 1")
+        if not 1 <= self.min_cohort <= self.cohort_cap:
+            raise ValueError(
+                "min_cohort must satisfy 1 <= min_cohort <= cohort_cap "
+                f"(got {self.min_cohort}/{self.cohort_cap}); the tenant "
+                "raises it to the aggregator's smallest admissible n "
+                "automatically (validate_n probe), so set it only to hold "
+                "rounds open BEYOND that floor"
+            )
+
+
+class _Tenant:
+    """Runtime state behind one :class:`TenantConfig`."""
+
+    __slots__ = (
+        "cfg", "queue", "ledger", "ladder", "executor", "stats",
+        "round_id", "ingress_bytes", "last_aggregate", "min_cohort",
+        "outstanding", "round_done", "failed_rounds",
+    )
+
+    def __init__(self, cfg: TenantConfig) -> None:
+        self.cfg = cfg
+        self.queue = AdmissionQueue(cfg.queue_capacity)
+        self.ledger = CreditLedger(cfg.credit)
+        self.ladder = BucketLadder(cfg.cohort_cap, min_bucket=cfg.min_bucket)
+        self.executor = CohortAggregator(cfg.aggregator)
+        # effective round floor: the operator's min_cohort raised to the
+        # aggregator's smallest admissible n (probed via validate_n), so
+        # the out-of-the-box config can never close a cohort the crash
+        # guard would have to discard — accepted submissions must
+        # aggregate, not vanish as failed rounds
+        floor = cfg.min_cohort
+        probe = getattr(cfg.aggregator, "validate_n", None)
+        if callable(probe):
+            for m in range(1, cfg.cohort_cap + 1):
+                try:
+                    probe(m)
+                except ValueError:
+                    continue
+                floor = max(floor, m)
+                break
+            else:
+                raise ValueError(
+                    f"aggregator {cfg.aggregator!r} admits no cohort size "
+                    f"<= cohort_cap={cfg.cohort_cap}"
+                )
+        self.min_cohort = floor
+        self.stats = RoundStats()
+        self.round_id = 0
+        self.ingress_bytes = 0
+        self.last_aggregate: Any = None
+        #: admitted-but-not-yet-aggregated submissions (drain watches it)
+        self.outstanding = 0
+        self.round_done = asyncio.Event()
+        #: rounds dropped by the crash guard (inadmissible cohort, OOM…)
+        self.failed_rounds = 0
+
+
+class ServingFrontend:
+    """The serving tier's front door (see module docstring)."""
+
+    def __init__(
+        self,
+        tenants: Sequence[TenantConfig],
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        on_round: Optional[RoundCallback] = None,
+    ) -> None:
+        if not tenants:
+            raise ValueError("at least one tenant is required")
+        self._tenants: Dict[str, _Tenant] = {}
+        for cfg in tenants:
+            if cfg.name in self._tenants:
+                raise ValueError(f"duplicate tenant {cfg.name!r}")
+            self._tenants[cfg.name] = _Tenant(cfg)
+        self._clock = clock
+        self._on_round = on_round
+        self._device_lock: Optional[asyncio.Lock] = None
+        self._tasks: list = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._running = False
+        #: frames that failed HMAC verification / deserialization (the
+        #: peer is dropped; no tenant can be trusted off a forged frame)
+        self.bad_frames = 0
+        #: decoded-but-nonsense requests (bad field types from a buggy
+        #: client): answered with ``rejected_malformed``, peer kept
+        self.malformed_requests = 0
+        #: exceptions swallowed from the user's ``on_round`` callback
+        #: (an observer bug must not kill a tenant's scheduler)
+        self.callback_errors = 0
+
+    # -- admission (synchronous, cheap) ----------------------------------
+
+    def submit(
+        self,
+        tenant: str,
+        client: str,
+        round_submitted: int,
+        gradient: Any,
+    ) -> Tuple[bool, str]:
+        """Admit one submission: ``(accepted, reason)``.
+
+        Gates, in order: tenant exists; gradient is a ``(dim,)`` float
+        row (non-finite VALUES pass — adversarial payloads are the
+        aggregators' job, shape abuse is the tier's); within the
+        staleness cutoff; client has rate credit; queue has capacity."""
+        t = self._tenants.get(tenant)
+        if t is None:
+            return False, REJECTED_TENANT
+        now = self._clock()
+        row = np.asarray(gradient)
+        if row.ndim != 1 or row.shape[0] != t.cfg.dim or row.dtype.kind != "f":
+            t.ledger.record(REJECTED_SHAPE, client)
+            return False, REJECTED_SHAPE
+        delta = t.round_id - int(round_submitted)
+        if not t.cfg.staleness.admits(delta):
+            t.ledger.record(REJECTED_STALE, client)
+            return False, REJECTED_STALE
+        if not t.ledger.admit(client, now):
+            t.ledger.record(REJECTED_RATE, client)
+            return False, REJECTED_RATE
+        ok = t.queue.offer(
+            Submission(
+                client=client,
+                round_submitted=int(round_submitted),
+                gradient=row,
+                arrived_s=now,
+            )
+        )
+        if not ok:
+            t.ledger.record(REJECTED_FULL, client)
+            return False, REJECTED_FULL
+        t.outstanding += 1
+        t.ledger.record(ACCEPTED, client)
+        return True, ACCEPTED
+
+    def handle_request(self, request: Any) -> dict:
+        """Serve one decoded wire request (``submit``/``stats``).
+
+        A frame that decodes (HMAC-valid) but carries nonsense fields —
+        a non-numeric round, an unhashable tenant — is a buggy client,
+        not a forged peer: it gets a ``rejected_malformed`` ack and the
+        connection stays up, rather than an exception tearing down the
+        handler with no accounting."""
+        if not isinstance(request, dict):
+            return {"kind": "ack", "accepted": False, "reason": "bad_frame"}
+        kind = request.get("kind")
+        if kind == "submit":
+            tenant = request.get("tenant", "")
+            try:
+                accepted, reason = self.submit(
+                    tenant if isinstance(tenant, str) else "",
+                    str(request.get("client", "")),
+                    int(request.get("round", 0)),
+                    request.get("gradient"),
+                )
+            except Exception:  # noqa: BLE001 — client bug, not ours
+                self.malformed_requests += 1
+                return {
+                    "kind": "ack",
+                    "accepted": False,
+                    "reason": REJECTED_MALFORMED,
+                    "round": -1,
+                }
+            t = (
+                self._tenants.get(tenant)
+                if isinstance(tenant, str)
+                else None
+            )
+            return {
+                "kind": "ack",
+                "accepted": accepted,
+                "reason": reason,
+                "round": t.round_id if t is not None else -1,
+            }
+        if kind == "stats":
+            name = request.get("tenant", "")
+            t = self._tenants.get(name) if isinstance(name, str) else None
+            if t is not None:
+                # snapshot ONLY the requested tenant: a stats poll runs
+                # on the admission loop, and each snapshot sorts the
+                # latency window + top-ks the rejection map
+                return {"kind": "stats", "stats": self._tenant_stats(t)}
+            return {"kind": "ack", "accepted": False, "reason": REJECTED_TENANT}
+        return {"kind": "ack", "accepted": False, "reason": "bad_frame"}
+
+    # -- scheduling ------------------------------------------------------
+
+    async def start(self) -> None:
+        """Launch one cohort-scheduler task per tenant."""
+        if self._running:
+            return
+        self._running = True
+        self._device_lock = asyncio.Lock()
+        self._tasks = [
+            asyncio.create_task(
+                self._tenant_loop(t), name=f"serving-{name}"
+            )
+            for name, t in self._tenants.items()
+        ]
+
+    async def close(self) -> None:
+        """Stop schedulers and the TCP server (idempotent)."""
+        self._running = False
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks = []
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _tenant_loop(self, t: _Tenant) -> None:
+        loop = asyncio.get_running_loop()
+        held: list = []
+        while self._running:
+            more = await t.queue.collect(
+                t.cfg.cohort_cap - len(held), t.cfg.window_s
+            )
+            held.extend(more)
+            if len(held) < t.min_cohort:
+                # under-strength window: hold the round open until the
+                # cohort reaches the tenant's floor (the aggregator's
+                # smallest admissible n) — the window restarts on the
+                # next arrival
+                continue
+            subs, held = held, []
+            cohort = build_cohort(
+                subs, t.round_id, t.ladder, t.cfg.staleness
+            )
+            assert self._device_lock is not None
+            try:
+                async with self._device_lock:
+                    # device work off the event loop: ingress keeps
+                    # admitting while this tenant's round aggregates
+                    vec = await loop.run_in_executor(
+                        None, t.executor.aggregate, cohort
+                    )
+            except Exception:  # noqa: BLE001 — a poisoned cohort must
+                # never kill the scheduler: drop the round, keep serving
+                t.failed_rounds += 1
+                t.outstanding -= cohort.m
+                t.round_done.set()
+                continue
+            t.last_aggregate = vec
+            t.stats.record(
+                self._clock() - cohort.first_arrival_s, cohort.m
+            )
+            t.round_id += 1
+            t.outstanding -= cohort.m
+            t.round_done.set()
+            if self._on_round is not None:
+                try:
+                    self._on_round(t.cfg.name, t.round_id - 1, cohort, vec)
+                except Exception:  # noqa: BLE001 — an observer bug must
+                    # not kill the scheduler any more than a poisoned
+                    # cohort may; counted, never silent
+                    self.callback_errors += 1
+
+    async def drain(self, tenant: str) -> int:
+        """Wait until every ADMISSIBLE submission of ``tenant`` has been
+        aggregated (queued AND in-flight rounds); returns the tenant's
+        round counter (test and shutdown helper).
+
+        Leftovers below ``min_cohort`` are NOT waited for: they cannot
+        form an admissible round until more arrive, so waiting on them
+        would deadlock the caller against a window the scheduler is
+        holding open on purpose — ``stats()``'s ``outstanding`` gauge
+        still reports them (the scheduler may have already popped them
+        off the queue into its held cohort, so ``queue_depth`` alone
+        can read 0 while submissions are pending)."""
+        t = self._tenants[tenant]
+        while t.outstanding >= t.min_cohort:
+            t.round_done.clear()
+            await t.round_done.wait()
+        return t.round_id
+
+    # -- wire transport --------------------------------------------------
+
+    async def serve(self, host: str = "127.0.0.1", port: int = 0) -> tuple:
+        """Start the TCP ingress speaking actor wire frames; returns the
+        bound ``(host, port)``. Call :meth:`start` first (or after —
+        admission only needs the queues)."""
+        wire.warn_untrusted_bind(host, "ServingFrontend")
+        self._server = await asyncio.start_server(
+            self._handle_conn, host=host, port=port
+        )
+        sock = self._server.sockets[0].getsockname()
+        return sock[0], sock[1]
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                try:
+                    header = await reader.readexactly(wire._HEADER.size)
+                except asyncio.IncompleteReadError:
+                    break
+                (length,) = wire._HEADER.unpack(header)
+                if length > wire.MAX_FRAME:
+                    # an oversized prefix is as hostile as a tampered
+                    # frame — count it, never a silent drop
+                    self.bad_frames += 1
+                    break
+                body = await reader.readexactly(length)
+                try:
+                    request = wire.decode(body)
+                except Exception:  # noqa: BLE001 — forged/tampered frame
+                    # a frame that fails HMAC/unpickle names no trustable
+                    # tenant; count it at the frontend and drop the peer
+                    self.bad_frames += 1
+                    break
+                name = (
+                    request.get("tenant")
+                    if isinstance(request, dict)
+                    else None
+                )
+                t = (
+                    self._tenants.get(name)
+                    if isinstance(name, str)
+                    else None
+                )
+                # ingress accounting mirrors the serving_ingress_bytes
+                # law, which prices SUBMISSION frames — stats polls
+                # would skew the measured side
+                if t is not None and request.get("kind") == "submit":
+                    t.ingress_bytes += wire._HEADER.size + length
+                await wire.send_obj(writer, self.handle_request(request))
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001 — peer already gone
+                pass
+
+    # -- introspection ---------------------------------------------------
+
+    def round_of(self, tenant: str) -> int:
+        """Current server round of ``tenant``."""
+        return self._tenants[tenant].round_id
+
+    def last_aggregate(self, tenant: str) -> Any:
+        """Most recent round's aggregated vector (None before round 0)."""
+        return self._tenants[tenant].last_aggregate
+
+    def _tenant_stats(self, t: _Tenant) -> dict:
+        p50, p99 = t.stats.latency_percentiles_s(50, 99)
+        return {
+            "rounds": t.stats.rounds,
+            "round_id": t.round_id,
+            "ledger": t.ledger.snapshot(),
+            "queue_depth": t.queue.depth(),
+            "queue_high_water": t.queue.depth_high_water,
+            "queue_capacity": t.queue.capacity,
+            "rejected_queue_full": t.queue.rejected_full,
+            # the effective round floor (config min_cohort raised to the
+            # aggregator's smallest admissible n)
+            "min_cohort": t.min_cohort,
+            # admitted but not yet aggregated — includes rows the
+            # scheduler already popped into its held cohort, which
+            # queue_depth no longer sees (min_cohort holds them there)
+            "outstanding": t.outstanding,
+            "p50_round_latency_s": p50,
+            "p99_round_latency_s": p99,
+            "mean_cohort": (
+                float(np.mean(t.stats.cohort_sizes))
+                if t.stats.cohort_sizes
+                else 0.0
+            ),
+            "ingress_bytes": t.ingress_bytes,
+            "failed_rounds": t.failed_rounds,
+            # FRONTEND-GLOBAL counters (not per-tenant — a forged frame
+            # names no trustable tenant): nested so a dashboard summing
+            # tenant blocks doesn't double-count them
+            "frontend": {
+                "bad_frames": self.bad_frames,
+                "malformed_requests": self.malformed_requests,
+                "callback_errors": self.callback_errors,
+            },
+        }
+
+    def stats(self) -> dict:
+        """Per-tenant accounting: admission ledger, rounds, cohort and
+        latency telemetry, queue depth high-water, outstanding gauge,
+        ingress bytes."""
+        return {
+            name: self._tenant_stats(t) for name, t in self._tenants.items()
+        }
+
+
+def serve_frame(frontend: ServingFrontend, frame_body: bytes) -> bytes:
+    """In-process wire path: decode one frame body, serve it, encode the
+    reply — the exact codec/HMAC round the TCP ingress runs, minus the
+    socket (the bench's 10k-client swarm exercises the wire cost this
+    way without 10k TCP connections)."""
+    reply = frontend.handle_request(wire.decode(frame_body))
+    return wire.encode(reply)
+
+
+class ServingClient:
+    """Minimal asyncio client for the wire ingress (tests, examples,
+    swarm simulators): one connection, frame-per-call submissions."""
+
+    def __init__(self) -> None:
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self, host: str, port: int) -> None:
+        """Open the connection."""
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+
+    async def submit(
+        self, tenant: str, client: str, round_submitted: int, gradient: Any
+    ) -> dict:
+        """Send one submission frame; returns the decoded ack."""
+        assert self._writer is not None and self._reader is not None
+        await wire.send_obj(
+            self._writer,
+            {
+                "kind": "submit",
+                "tenant": tenant,
+                "client": client,
+                "round": int(round_submitted),
+                "gradient": np.asarray(gradient),
+            },
+        )
+        return await wire.recv_obj(self._reader)
+
+    async def stats(self, tenant: str) -> dict:
+        """Fetch the tenant's stats snapshot."""
+        assert self._writer is not None and self._reader is not None
+        await wire.send_obj(self._writer, {"kind": "stats", "tenant": tenant})
+        return await wire.recv_obj(self._reader)
+
+    async def close(self) -> None:
+        """Close the connection."""
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:  # noqa: BLE001 — server already gone
+                pass
+            self._writer = None
+            self._reader = None
+
+
+__all__ = [
+    "RoundCallback",
+    "ServingClient",
+    "ServingFrontend",
+    "TenantConfig",
+    "serve_frame",
+]

@@ -1,0 +1,100 @@
+"""The bounded admission queue between client ingress and the cohort
+scheduler.
+
+Backpressure contract: capacity is enforced AT THE DOOR — ``offer``
+either enqueues or rejects with ``queue_full``, synchronously, so a
+burst beyond the tier's capacity surfaces as explicit rejections (the
+client retries with backoff) instead of unbounded memory growth or an
+ingress stall that starves other tenants. ``depth_high_water`` proves
+the bound held (asserted by the CI smoke and the serving bench).
+
+The consumer side is the cohort scheduler's window/size trigger:
+``collect`` returns as soon as ``max_items`` submissions are in hand OR
+``window_s`` has elapsed since the round's first arrival — the
+"aggregate whoever arrived in the window" semantics of the ROADMAP
+item.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, List
+
+
+@dataclass(frozen=True)
+class Submission:
+    """One admitted gradient submission.
+
+    ``gradient`` is the host-side flattened row (numpy ``(d,)``, the
+    decoded wire payload); ``round_submitted`` the model round the
+    client computed against; ``arrived_s`` the admission timestamp on
+    the frontend clock (monotonic seconds)."""
+
+    client: str
+    round_submitted: int
+    gradient: Any
+    arrived_s: float
+
+
+class AdmissionQueue:
+    """Bounded asyncio FIFO of :class:`Submission` with explicit-reject
+    overflow and a high-water depth gauge."""
+
+    def __init__(self, capacity: int) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=capacity)
+        self.depth_high_water = 0
+        self.rejected_full = 0
+
+    def depth(self) -> int:
+        """Submissions currently queued."""
+        return self._queue.qsize()
+
+    def offer(self, sub: Submission) -> bool:
+        """Enqueue or reject-at-the-door (False = queue full)."""
+        try:
+            self._queue.put_nowait(sub)
+        except asyncio.QueueFull:
+            self.rejected_full += 1
+            return False
+        depth = self._queue.qsize()
+        if depth > self.depth_high_water:
+            self.depth_high_water = depth
+        return True
+
+    async def collect(
+        self, max_items: int, window_s: float
+    ) -> List[Submission]:
+        """One round's cohort: block for the first submission, then
+        drain until ``max_items`` are in hand or ``window_s`` has
+        elapsed since that first arrival (the window/size trigger)."""
+        first = await self._queue.get()
+        batch = [first]
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + window_s
+        while len(batch) < max_items:
+            # drain whatever is already queued without touching the event
+            # loop — a backlogged queue fills the cohort in one pass
+            # instead of paying a scheduler round-trip per submission
+            try:
+                while len(batch) < max_items:
+                    batch.append(self._queue.get_nowait())
+                break
+            except asyncio.QueueEmpty:
+                pass
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(
+                    await asyncio.wait_for(self._queue.get(), remaining)
+                )
+            except asyncio.TimeoutError:
+                break
+        return batch
+
+
+__all__ = ["AdmissionQueue", "Submission"]

@@ -1,0 +1,73 @@
+"""Stale-gradient handling: fold a round-``k`` submission into round
+``k + δ`` with a configurable discount.
+
+Clients of a continuous-ingestion tier compute against whatever model
+round they last pulled; by the time a submission reaches the scheduler
+the server may be δ rounds ahead. The standard asynchronous-SGD remedy
+(staleness-aware scaling, à la Zhang et al. 2016) multiplies the
+gradient by a decreasing function of δ before it enters the aggregate —
+robust aggregators then see stale contributions shrunk toward zero
+instead of voting at full weight with outdated geometry.
+
+Pinned semantics (``tests/test_masked_finalize.py``):
+
+* ``discount(0) == 1.0`` EXACTLY, and a weight-1.0 row is bit-identical
+  through the fold (IEEE ``1.0 * x == x``) — fresh submissions are
+  untouched;
+* ``cutoff`` turns "too stale" into an admission rejection rather than
+  a zero-weight row wasting a cohort slot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+_KINDS = ("none", "exponential", "polynomial")
+
+
+@dataclass(frozen=True)
+class StalenessPolicy:
+    """Discount policy ``w = discount(δ)`` for a δ-rounds-stale gradient.
+
+    ``kind``:
+
+    * ``"none"`` — every admitted submission folds at full weight;
+    * ``"exponential"`` — ``w = gamma ** δ``;
+    * ``"polynomial"`` — ``w = 1 / (1 + δ) ** alpha``.
+
+    ``cutoff`` (optional): submissions with ``δ > cutoff`` are rejected
+    at admission (reason ``rejected_too_stale``) instead of discounted.
+    """
+
+    kind: str = "none"
+    gamma: float = 0.5
+    alpha: float = 1.0
+    cutoff: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {self.kind!r}")
+        if not 0.0 < self.gamma <= 1.0:
+            raise ValueError("gamma must be in (0, 1]")
+        if self.alpha < 0:
+            raise ValueError("alpha must be >= 0")
+        if self.cutoff is not None and self.cutoff < 0:
+            raise ValueError("cutoff must be >= 0")
+
+    def admits(self, delta: int) -> bool:
+        """False when the submission is beyond the staleness cutoff."""
+        return self.cutoff is None or delta <= self.cutoff
+
+    def discount(self, delta: int) -> float:
+        """Weight for a δ-rounds-stale gradient; ``discount(0) == 1.0``
+        exactly for every policy (δ ≤ 0 — a client somehow ahead of the
+        server — also folds at full weight)."""
+        if delta <= 0 or self.kind == "none":
+            return 1.0
+        if self.kind == "exponential":
+            return float(self.gamma) ** int(delta)
+        return 1.0 / float(1 + delta) ** float(self.alpha)
+
+
+__all__ = ["StalenessPolicy"]

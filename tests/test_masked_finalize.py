@@ -1,0 +1,299 @@
+"""Masked/ragged finalize parity: a cohort of m rows padded into bucket n
+must match the exact size-m aggregate BIT-FOR-BIT (f32).
+
+The serving tier's correctness contract (ISSUE 6): ``fold_finalize_masked``
+/ ``aggregate_masked`` evaluate a fold declared for bucket size ``n`` with
+an actual cohort of ``m <= n`` valid rows through one compiled program per
+bucket (``m`` traced), and the result is indistinguishable from running
+``aggregate`` on the unpadded rows. Aggregators without a masked matrix
+program (CAF, MDA, SMEA) route through the exact-subset fallback — parity
+is trivially bit-level there too, which is exactly the point of the
+fallback.
+
+Staleness-discount semantics are pinned here as well: ``discount(0)`` is
+EXACTLY 1.0 and a weight-1.0 cohort is bit-identical to an undiscounted
+one.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import (
+    CAF,
+    CenteredClipping,
+    ComparativeGradientElimination,
+    CoordinateWiseMedian,
+    CoordinateWiseTrimmedMean,
+    GeometricMedian,
+    Krum,
+    MeanOfMedians,
+    MinimumDiameterAveraging,
+    MoNNA,
+    MultiKrum,
+    SMEA,
+)
+from byzpy_tpu.serving.buckets import BucketLadder
+from byzpy_tpu.serving.cohort import CohortAggregator, build_cohort
+from byzpy_tpu.serving.queue import Submission
+from byzpy_tpu.serving.staleness import StalenessPolicy
+
+N = 8
+D = 193
+
+# (factory, has_masked_program): every aggregator participates — the
+# masked set runs the bucket-shaped program, the rest prove the exact
+# fallback. Hyperparameters chosen so the satellite's m grid
+# {1, n/2, n-1, n} is mostly admissible; inadmissible (agg, m) pairs
+# must raise on BOTH paths.
+CASES = [
+    (lambda: CoordinateWiseMedian(), True),
+    (lambda: CoordinateWiseTrimmedMean(f=0), True),
+    (lambda: CoordinateWiseTrimmedMean(f=1), True),
+    (lambda: MeanOfMedians(f=0), True),
+    (lambda: MeanOfMedians(f=2), True),
+    (lambda: MultiKrum(f=1, q=2), True),
+    (lambda: Krum(f=1), True),
+    (lambda: ComparativeGradientElimination(f=0), True),
+    (lambda: ComparativeGradientElimination(f=1), True),
+    (lambda: MoNNA(f=1), True),
+    (lambda: GeometricMedian(), True),
+    (lambda: CenteredClipping(c_tau=1.0), True),
+    (lambda: CAF(f=1), False),
+    (lambda: MinimumDiameterAveraging(f=1), False),
+    (lambda: SMEA(f=1), False),
+]
+IDS = [
+    "median", "trimmed-f0", "trimmed-f1", "meamed-f0", "meamed-f2",
+    "multikrum", "krum", "cge-f0", "cge-f1", "monna", "geomed", "clip",
+    "caf", "mda", "smea",
+]
+
+
+def _grads(n=N, d=D, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.normal(size=d) * s).astype(np.float32)
+        for s in rng.uniform(0.1, 50.0, n)
+    ]
+
+
+def _admissible(agg, m):
+    try:
+        agg.validate_n(m)
+        return True
+    except ValueError:
+        return False
+
+
+@pytest.mark.parametrize("make_agg,has_masked", CASES, ids=IDS)
+@pytest.mark.parametrize("m", [1, N // 2, N - 1, N])
+def test_masked_fold_matches_unpadded_aggregate_bitwise(
+    make_agg, has_masked, m
+):
+    agg = make_agg()
+    assert agg.supports_masked_finalize == has_masked
+    grads = _grads()
+    if not _admissible(agg, m):
+        state = agg.fold_init(N)
+        for i in range(m):
+            agg.fold(state, i, grads[i])
+        with pytest.raises(ValueError):
+            agg.fold_finalize_masked(state)
+        return
+    ref = np.asarray(agg.aggregate(grads[:m]))
+    state = agg.fold_init(N)
+    for i in range(m):
+        agg.fold(state, i, grads[i])
+    out = np.asarray(agg.fold_finalize_masked(state))
+    np.testing.assert_array_equal(out, ref, err_msg=f"{agg.name} m={m}")
+
+
+@pytest.mark.parametrize("make_agg,has_masked", CASES, ids=IDS)
+def test_masked_fold_arrival_order_and_scattered_slots(make_agg, has_masked):
+    """Masked finalize is arrival-order independent and handles
+    non-prefix slot occupancy (elastic cohorts): the result equals the
+    unpadded aggregate of the occupied slots in CANONICAL slot order."""
+    agg = make_agg()
+    grads = _grads(seed=3)
+    slots = [0, 2, 3, 6, 7]  # scattered occupancy, m=5
+    if not _admissible(agg, len(slots)):
+        pytest.skip("hyperparameters inadmissible at m=5")
+    ref = np.asarray(agg.aggregate([grads[s] for s in slots]))
+    for trial in range(3):
+        order = list(slots)
+        random.Random(trial).shuffle(order)
+        state = agg.fold_init(N)
+        for s in order:
+            agg.fold(state, s, grads[s])
+        out = np.asarray(agg.fold_finalize_masked(state))
+        np.testing.assert_array_equal(out, ref, err_msg=f"{agg.name}")
+
+
+@pytest.mark.parametrize(
+    "make_agg",
+    [
+        lambda: CoordinateWiseTrimmedMean(f=2),
+        lambda: MultiKrum(f=2, q=3),
+        lambda: ComparativeGradientElimination(f=2),
+        lambda: CenteredClipping(c_tau=1.0),
+    ],
+    ids=["trimmed", "multikrum", "cge", "clip"],
+)
+def test_masked_parity_holds_at_large_buckets(make_agg):
+    """The einsum-contraction reductions stay bit-stable under zero
+    padding at bench-scale buckets (where plain jnp.sum re-associates
+    and drifts ~1e-7) — the load-bearing property of the masked
+    recipe."""
+    agg = make_agg()
+    n = 64
+    grads = _grads(n=n, d=257, seed=5)
+    for m in (21, 40, 63, 64):
+        ref = np.asarray(agg.aggregate(grads[:m]))
+        matrix = np.zeros((n, 257), np.float32)
+        matrix[:m] = np.stack(grads[:m])
+        valid = np.zeros(n, bool)
+        valid[:m] = True
+        out = np.asarray(agg.aggregate_masked(matrix, valid))
+        np.testing.assert_array_equal(out, ref, err_msg=f"{agg.name} m={m}")
+
+
+def test_aggregate_masked_matches_fold_finalize_masked():
+    """The batch door and the streaming fold share one program: same
+    bits, same jit cache."""
+    agg = MultiKrum(f=1, q=2)
+    grads = _grads(seed=7)
+    m = 6
+    state = agg.fold_init(N)
+    for i in range(m):
+        agg.fold(state, i, grads[i])
+    via_fold = np.asarray(agg.fold_finalize_masked(state))
+    matrix = np.zeros((N, D), np.float32)
+    matrix[:m] = np.stack(grads[:m])
+    valid = np.zeros(N, bool)
+    valid[:m] = True
+    via_batch = np.asarray(agg.aggregate_masked(matrix, valid))
+    np.testing.assert_array_equal(via_fold, via_batch)
+
+
+def test_masked_jit_cache_one_entry_per_bucket():
+    """The whole point of bucketing: aggregating many distinct cohort
+    sizes compiles once per BUCKET shape, not once per size."""
+    agg = CoordinateWiseTrimmedMean(f=1)
+    rng = np.random.default_rng(11)
+    for bucket in (8, 16):
+        for m in range(4, bucket + 1):
+            matrix = np.zeros((bucket, 64), np.float32)
+            matrix[:m] = rng.normal(size=(m, 64)).astype(np.float32)
+            valid = np.zeros(bucket, bool)
+            valid[:m] = True
+            agg.aggregate_masked(matrix, valid)
+    assert agg._masked_jitted()._cache_size() == 2
+
+
+def test_nonfinite_cohort_falls_back_to_exact_path():
+    """A NaN/inf gradient sorts differently against mask padding, so
+    non-finite cohorts must route to the exact subset path — and still
+    match the unpadded aggregate bit-for-bit (NaN placement included)."""
+    for make_agg in (
+        lambda: CoordinateWiseMedian(),
+        lambda: CoordinateWiseTrimmedMean(f=1),
+        lambda: MultiKrum(f=1, q=2),
+    ):
+        agg = make_agg()
+        grads = _grads(seed=13)
+        grads[1] = grads[1].copy()
+        grads[1][::7] = np.inf
+        grads[2] = grads[2].copy()
+        grads[2][3] = np.nan
+        m = 6
+        ref = np.asarray(agg.aggregate(grads[:m]))
+        state = agg.fold_init(N)
+        for i in range(m):
+            agg.fold(state, i, grads[i])
+        out = np.asarray(agg.fold_finalize_masked(state))
+        np.testing.assert_array_equal(out, ref, err_msg=agg.name)
+
+
+def test_masked_finalize_before_any_fold_raises():
+    agg = CoordinateWiseMedian()
+    state = agg.fold_init(N)
+    with pytest.raises(ValueError):
+        agg.fold_finalize_masked(state)
+
+
+def test_aggregate_masked_all_false_mask_raises():
+    # validate_n is a no-op for f=0 aggregators (median), and the masked
+    # program's (m-1)//2 gather would wrap to a +inf padding row on m=0
+    # — must be an error, never a silently-garbage aggregate
+    agg = CoordinateWiseMedian()
+    with pytest.raises(ValueError):
+        agg.aggregate_masked(np.zeros((4, 3), np.float32), np.zeros(4, bool))
+
+
+# ---------------------------------------------------------------------------
+# staleness-discount semantics
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_discount_zero_delta_is_exact_identity():
+    for kind in ("none", "exponential", "polynomial"):
+        pol = StalenessPolicy(kind=kind, gamma=0.3, alpha=2.0)
+        assert pol.discount(0) == 1.0
+        assert pol.discount(-1) == 1.0  # client ahead of server: fresh
+
+
+def test_staleness_discount_values():
+    exp = StalenessPolicy(kind="exponential", gamma=0.5)
+    assert exp.discount(1) == 0.5 and exp.discount(3) == 0.125
+    poly = StalenessPolicy(kind="polynomial", alpha=1.0)
+    assert poly.discount(1) == 0.5 and poly.discount(3) == 0.25
+    none = StalenessPolicy()
+    assert none.discount(100) == 1.0
+    cut = StalenessPolicy(cutoff=2)
+    assert cut.admits(2) and not cut.admits(3)
+
+
+def _cohort(grads, rounds_submitted, server_round, staleness, cap=8):
+    subs = [
+        Submission(client=f"c{i}", round_submitted=r, gradient=g,
+                   arrived_s=float(i))
+        for i, (g, r) in enumerate(zip(grads, rounds_submitted, strict=True))
+    ]
+    return build_cohort(
+        subs, server_round, BucketLadder(cap), staleness
+    )
+
+
+def test_fresh_cohort_bit_identical_through_staleness_machinery():
+    """δ=0 for every row ⇒ the staleness-aware path produces the same
+    bits as the policy-free aggregate (weights exactly 1.0)."""
+    agg = CoordinateWiseTrimmedMean(f=1)
+    grads = _grads(seed=17)[:5]
+    pol = StalenessPolicy(kind="exponential", gamma=0.25)
+    cohort = _cohort(grads, [4] * 5, 4, pol)
+    assert (cohort.weights[: cohort.m] == 1.0).all()
+    out = np.asarray(CohortAggregator(agg).aggregate(cohort))
+    ref = np.asarray(agg.aggregate(grads))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_stale_rows_are_discounted_before_aggregation():
+    """A round-k gradient folded into round k+δ is scaled by
+    discount(δ) — verified against the hand-scaled unpadded aggregate."""
+    agg = CoordinateWiseTrimmedMean(f=0)
+    grads = _grads(seed=19)[:4]
+    pol = StalenessPolicy(kind="exponential", gamma=0.5)
+    # server at round 6; submissions from rounds 6, 5, 4, 6 -> δ 0,1,2,0
+    cohort = _cohort(grads, [6, 5, 4, 6], 6, pol)
+    np.testing.assert_array_equal(
+        cohort.weights[:4], np.float32([1.0, 0.5, 0.25, 1.0])
+    )
+    out = np.asarray(CohortAggregator(agg).aggregate(cohort))
+    scaled = [
+        grads[0], grads[1] * np.float32(0.5),
+        grads[2] * np.float32(0.25), grads[3],
+    ]
+    ref = np.asarray(agg.aggregate(scaled))
+    np.testing.assert_array_equal(out, ref)

@@ -1,0 +1,685 @@
+"""Serving tier: admission queue, credits, buckets, scheduler, tenants,
+wire transport, ingress law, and the bucketed PS step.
+
+The tier's contracts under test:
+
+* backpressure is reject-at-the-door — the bounded queue never grows
+  past capacity and every rejection is accounted with a reason;
+* a flooding client starves ITSELF (token bucket), never the queue or
+  other clients;
+* rounds close on the window/size trigger and aggregate exactly what
+  arrived (masked parity is pinned in ``test_masked_finalize.py``);
+* tenants are isolated: queues, credits, rounds, and staleness are
+  per-tenant even though one mesh serves all of them;
+* the wire transport is the actor wire verbatim: HMAC-signed frames,
+  tamper ⇒ dropped peer, quantized payload opt-in, and the
+  ``serving_ingress_bytes`` law matches measured frame sizes;
+* the serving PS step compiles once per bucket and equals the unpadded
+  update.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import CoordinateWiseMedian, CoordinateWiseTrimmedMean
+from byzpy_tpu.engine.actor import wire
+from byzpy_tpu.parallel.comms import serving_ingress_bytes
+from byzpy_tpu.serving import (
+    AdmissionQueue,
+    BucketLadder,
+    CreditLedger,
+    CreditPolicy,
+    ServingClient,
+    ServingFrontend,
+    StalenessPolicy,
+    Submission,
+    TenantConfig,
+    TokenBucket,
+    serve_frame,
+)
+
+D = 96
+
+
+def _grad(seed=0, d=D):
+    return np.random.default_rng(seed).normal(size=d).astype(np.float32)
+
+
+def _tenant(name="m0", **kw):
+    defaults = dict(
+        name=name,
+        # median: admissible at any cohort size >= 1, so default tests
+        # never trip the min_cohort floor
+        aggregator=CoordinateWiseMedian(),
+        dim=D,
+        window_s=0.02,
+        cohort_cap=8,
+        queue_capacity=32,
+        credit=CreditPolicy(rate_per_s=0, burst=10),  # rate off by default
+    )
+    defaults.update(kw)
+    return TenantConfig(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# credits
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_burst_then_refill():
+    pol = CreditPolicy(rate_per_s=10.0, burst=3.0)
+    b = TokenBucket(pol, now=0.0)
+    assert all(b.try_consume(0.0) for _ in range(3))
+    assert not b.try_consume(0.0)  # burst exhausted
+    assert b.try_consume(0.1)  # one token refilled after 100 ms
+    assert not b.try_consume(0.1)
+    # refill caps at burst
+    assert sum(b.try_consume(10.0) for _ in range(10)) == 3
+
+
+def test_credit_ledger_flooder_starves_itself_only():
+    ledger = CreditLedger(CreditPolicy(rate_per_s=1.0, burst=2.0))
+    accepted_flood = sum(ledger.admit("flood", 0.0) for _ in range(50))
+    assert accepted_flood == 2  # burst only
+    assert ledger.admit("honest", 0.0)  # untouched by the flood
+    snap_before = ledger.admit("honest", 0.001)
+    assert snap_before  # second token of honest's own burst
+
+
+def test_unlimited_rate_policy_always_admits():
+    ledger = CreditLedger(CreditPolicy(rate_per_s=0, burst=1.0))
+    assert all(ledger.admit("c", float(i)) for i in range(100))
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_powers_of_two():
+    ladder = BucketLadder(256, min_bucket=2)
+    assert ladder.sizes == (2, 4, 8, 16, 32, 64, 128, 256)
+    assert ladder.bucket_for(1) == 2
+    assert ladder.bucket_for(2) == 2
+    assert ladder.bucket_for(3) == 4
+    assert ladder.bucket_for(200) == 256
+    with pytest.raises(ValueError):
+        ladder.bucket_for(257)
+    with pytest.raises(ValueError):
+        ladder.bucket_for(0)
+
+
+def test_bucket_ladder_rounds_cap_up():
+    assert BucketLadder(24, min_bucket=4).sizes == (4, 8, 16, 32)
+    with pytest.raises(ValueError):
+        BucketLadder(4, min_bucket=8)
+
+
+# ---------------------------------------------------------------------------
+# staleness validation
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_policy_validation():
+    with pytest.raises(ValueError):
+        StalenessPolicy(kind="linear")
+    with pytest.raises(ValueError):
+        StalenessPolicy(gamma=0.0)
+    with pytest.raises(ValueError):
+        StalenessPolicy(cutoff=-1)
+
+
+# ---------------------------------------------------------------------------
+# admission queue
+# ---------------------------------------------------------------------------
+
+
+def _sub(i, g=None):
+    return Submission(
+        client=f"c{i}", round_submitted=0,
+        gradient=g if g is not None else _grad(i), arrived_s=float(i),
+    )
+
+
+def test_queue_bounded_reject_at_the_door():
+    async def run():
+        q = AdmissionQueue(4)
+        assert all(q.offer(_sub(i)) for i in range(4))
+        assert not q.offer(_sub(4))  # full -> explicit reject
+        assert q.rejected_full == 1
+        assert q.depth() == 4
+        assert q.depth_high_water == 4
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_queue_collect_size_trigger_drains_backlog_in_one_pass():
+    async def run():
+        q = AdmissionQueue(64)
+        for i in range(20):
+            q.offer(_sub(i))
+        batch = await q.collect(max_items=8, window_s=5.0)
+        assert len(batch) == 8  # size trigger, long before the window
+        assert [s.client for s in batch] == [f"c{i}" for i in range(8)]
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_queue_collect_window_trigger_returns_partial():
+    async def run():
+        q = AdmissionQueue(64)
+        q.offer(_sub(0))
+        q.offer(_sub(1))
+        t0 = asyncio.get_running_loop().time()
+        batch = await q.collect(max_items=8, window_s=0.05)
+        dt = asyncio.get_running_loop().time() - t0
+        assert len(batch) == 2  # whoever arrived in the window
+        assert dt < 1.0
+        return True
+
+    assert asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# frontend admission + rounds + tenancy
+# ---------------------------------------------------------------------------
+
+
+def test_submit_gates_and_reasons():
+    fe = ServingFrontend([
+        _tenant(
+            credit=CreditPolicy(rate_per_s=1.0, burst=2.0),
+            staleness=StalenessPolicy(cutoff=3),
+            queue_capacity=4,
+        )
+    ])
+    ok, reason = fe.submit("nope", "c", 0, _grad())
+    assert (ok, reason) == (False, "rejected_unknown_tenant")
+    ok, reason = fe.submit("m0", "c", 0, np.zeros(3, np.float32))
+    assert (ok, reason) == (False, "rejected_bad_shape")
+    ok, reason = fe.submit("m0", "c", 0, _grad().astype(np.int32))
+    assert (ok, reason) == (False, "rejected_bad_shape")
+    ok, reason = fe.submit("m0", "c", -10, _grad())  # δ = 10 > cutoff 3
+    assert (ok, reason) == (False, "rejected_too_stale")
+    assert fe.submit("m0", "c", 0, _grad())[0]
+    assert fe.submit("m0", "c", 0, _grad())[0]
+    ok, reason = fe.submit("m0", "c", 0, _grad())  # burst of 2 spent
+    assert (ok, reason) == (False, "rejected_rate")
+    # another client still has credit; fill the queue to the bound
+    assert fe.submit("m0", "c2", 0, _grad())[0]
+    assert fe.submit("m0", "c3", 0, _grad())[0]
+    ok, reason = fe.submit("m0", "c4", 0, _grad())
+    assert (ok, reason) == (False, "rejected_queue_full")
+    totals = fe.stats()["m0"]["ledger"]["totals"]
+    assert totals["accepted"] == 4
+    assert totals["rejected_queue_full"] == 1
+
+
+def test_round_loop_aggregates_window_and_matches_direct_aggregate():
+    async def run():
+        agg = CoordinateWiseTrimmedMean(f=1)
+        fe = ServingFrontend(
+            [_tenant(aggregator=agg, window_s=0.01, min_cohort=3)]
+        )
+        await fe.start()
+        grads = [_grad(i) for i in range(5)]
+        for i, g in enumerate(grads):
+            fe.submit("m0", f"c{i}", 0, g)
+        await fe.drain("m0")
+        await fe.close()
+        st = fe.stats()["m0"]
+        assert st["rounds"] == 1
+        assert st["queue_depth"] == 0
+        out = np.asarray(fe.last_aggregate("m0"))
+        ref = np.asarray(agg.aggregate(grads))
+        np.testing.assert_array_equal(out, ref)
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_size_trigger_closes_full_cohorts():
+    async def run():
+        fe = ServingFrontend([_tenant(cohort_cap=4, window_s=5.0)])
+        await fe.start()
+        for i in range(8):
+            fe.submit("m0", f"c{i}", 0, _grad(i))
+        rounds = await fe.drain("m0")
+        await fe.close()
+        st = fe.stats()["m0"]
+        assert rounds == 2  # two full cohorts, size-triggered
+        assert st["mean_cohort"] == 4.0
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_multi_tenant_isolation():
+    """Tenant A's flood (queue overflow + rejections) leaves tenant B's
+    queue, credits, and rounds untouched; both aggregate independently
+    on the shared mesh."""
+
+    async def run():
+        agg_b = CoordinateWiseMedian()
+        fe = ServingFrontend([
+            _tenant("a", queue_capacity=4, window_s=0.01),
+            _tenant("b", aggregator=agg_b, dim=32, window_s=0.01),
+        ])
+        await fe.start()
+        for i in range(50):  # far beyond a's queue bound
+            fe.submit("a", "flood", 0, _grad(i))
+        grads_b = [
+            np.random.default_rng(i).normal(size=32).astype(np.float32)
+            for i in range(3)
+        ]
+        for i, g in enumerate(grads_b):
+            fe.submit("b", f"c{i}", 0, g)
+        await fe.drain("a")
+        await fe.drain("b")
+        await fe.close()
+        sa, sb = fe.stats()["a"], fe.stats()["b"]
+        assert sa["rejected_queue_full"] > 0
+        assert sb["rejected_queue_full"] == 0
+        assert sb["ledger"]["totals"]["accepted"] == 3
+        assert sb["rounds"] >= 1
+        np.testing.assert_array_equal(
+            np.asarray(fe.last_aggregate("b")),
+            np.asarray(agg_b.aggregate(grads_b)),
+        )
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_staleness_delta_measured_against_tenant_round():
+    """Tenancy keeps round counters independent, so the SAME submission
+    round is fresh for one tenant and over-cutoff for another."""
+
+    async def run():
+        fe = ServingFrontend([
+            _tenant("a", staleness=StalenessPolicy(cutoff=0)),
+            _tenant("b", staleness=StalenessPolicy(cutoff=0)),
+        ])
+        await fe.start()
+        # advance tenant a by two rounds
+        for r in range(2):
+            fe.submit("a", "c", r, _grad(r))
+            await fe.drain("a")
+        ok_a, reason_a = fe.submit("a", "c", 0, _grad())  # δ=2 for a
+        ok_b, _ = fe.submit("b", "c", 0, _grad())  # δ=0 for b
+        await fe.close()
+        assert (ok_a, reason_a) == (False, "rejected_too_stale")
+        assert ok_b
+        return True
+
+    assert asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# wire transport
+# ---------------------------------------------------------------------------
+
+
+def test_serve_frame_roundtrip_in_process():
+    fe = ServingFrontend([_tenant()])
+    body = wire.encode({
+        "kind": "submit", "tenant": "m0", "client": "c0",
+        "round": 0, "gradient": _grad(),
+    })[4:]
+    ack = wire.decode(serve_frame(fe, body)[4:])
+    assert ack == {
+        "kind": "ack", "accepted": True, "reason": "accepted", "round": 0
+    }
+
+
+def test_wire_submission_and_stats_over_tcp(monkeypatch):
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "serving-test-key")
+
+    async def run():
+        agg = CoordinateWiseTrimmedMean(f=0)
+        fe = ServingFrontend([_tenant(aggregator=agg, window_s=0.01)])
+        await fe.start()
+        host, port = await fe.serve()
+        client = ServingClient()
+        await client.connect(host, port)
+        grads = [_grad(i) for i in range(3)]
+        for i, g in enumerate(grads):
+            ack = await client.submit("m0", f"c{i}", 0, g)
+            assert ack["accepted"], ack
+        await fe.drain("m0")
+        stats = (await client.stats("m0"))["stats"]
+        await client.close()
+        await fe.close()
+        assert stats["ledger"]["totals"]["accepted"] == 3
+        assert stats["ingress_bytes"] > 3 * D * 4  # payloads crossed the wire
+        np.testing.assert_array_equal(
+            np.asarray(fe.last_aggregate("m0")),
+            np.asarray(agg.aggregate(grads)),
+        )
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_tampered_frame_drops_peer(monkeypatch):
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "serving-test-key")
+
+    async def run():
+        fe = ServingFrontend([_tenant()])
+        await fe.start()
+        host, port = await fe.serve()
+        reader, writer = await asyncio.open_connection(host, port)
+        frame = bytearray(wire.encode({
+            "kind": "submit", "tenant": "m0", "client": "c0",
+            "round": 0, "gradient": _grad(),
+        }))
+        frame[-1] ^= 0xFF  # flip one payload byte under the HMAC
+        writer.write(bytes(frame))
+        await writer.drain()
+        data = await reader.read()  # server drops the connection
+        writer.close()
+        await fe.close()
+        assert data == b""
+        assert fe.bad_frames == 1
+        assert fe.stats()["m0"]["ledger"]["totals"].get("accepted", 0) == 0
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_quantized_wire_submission_admits_lossy_gradient(monkeypatch):
+    """BYZPY_TPU_WIRE_PRECISION=int8 compresses the submission payload;
+    the decoded (lossy) gradient is what enters the cohort — same
+    opt-in contract as the actor wire."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "int8")
+    d = 2048  # above WIRE_QUANT_MIN_SIZE
+    g = np.random.default_rng(0).normal(size=d).astype(np.float32)
+    fe = ServingFrontend([_tenant(dim=d)])
+    body = wire.encode({
+        "kind": "submit", "tenant": "m0", "client": "c0",
+        "round": 0, "gradient": g,
+    })
+    assert len(body) < d * 4 // 2  # payload really compressed
+    ack = wire.decode(serve_frame(fe, body[4:])[4:])
+    assert ack["accepted"]
+
+
+def test_serving_ingress_bytes_law_matches_measured_frames(monkeypatch):
+    d = 4096
+    g = np.random.default_rng(2).normal(size=d).astype(np.float32)
+    frame = {
+        "kind": "submit", "tenant": "m0", "client": "c01234",
+        "round": 3, "gradient": g,
+    }
+    for precision in ("off", "bf16", "int8"):
+        for signed in (False, True):
+            monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", precision)
+            if signed:
+                monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "k")
+            else:
+                monkeypatch.delenv("BYZPY_TPU_WIRE_KEY", raising=False)
+            measured = len(wire.encode(frame))
+            law = serving_ingress_bytes(d, precision=precision, signed=signed)
+            assert abs(measured - law) / measured < 0.02, (
+                precision, signed, measured, law
+            )
+    # signing adds exactly the HMAC tag
+    assert (
+        serving_ingress_bytes(d, signed=True)
+        - serving_ingress_bytes(d, signed=False)
+    ) == 32
+
+
+# ---------------------------------------------------------------------------
+# bucketed serving PS step
+# ---------------------------------------------------------------------------
+
+
+def test_serving_ps_step_updates_and_caches_per_bucket():
+    import jax.numpy as jnp
+    import optax
+    from jax.flatten_util import ravel_pytree
+
+    from byzpy_tpu.models import mnist_mlp
+    from byzpy_tpu.parallel.ps import jit_serving_ps_step
+
+    bundle = mnist_mlp()
+    agg = CoordinateWiseTrimmedMean(f=1)
+    step, opt0 = jit_serving_ps_step(bundle, agg.masked_matrix_fn())
+    flat0, unravel = ravel_pytree(bundle.params)
+    d = flat0.shape[0]
+    rng = np.random.default_rng(0)
+    params, opt = bundle.params, opt0
+    for m, bucket in ((5, 8), (3, 8), (7, 8), (9, 16)):
+        matrix = np.zeros((bucket, d), np.float32)
+        matrix[:m] = rng.normal(size=(m, d)).astype(np.float32)
+        valid = np.zeros(bucket, bool)
+        valid[:m] = True
+        weights = valid.astype(np.float32)
+        params, opt, metrics = step(params, opt, matrix, valid, weights)
+        assert int(metrics["cohort_m"]) == m
+    assert step._cache_size() == 2  # one compile per bucket, not per m
+
+    # parity: a padded cohort steps bit-for-bit with the same jitted
+    # step fed the unpadded (bucket == m, all-valid) matrix
+    m, bucket = 5, 8
+    matrix = np.zeros((bucket, d), np.float32)
+    matrix[:m] = rng.normal(size=(m, d)).astype(np.float32)
+    valid = np.zeros(bucket, bool)
+    valid[:m] = True
+    params2, _, _ = step(
+        bundle.params, opt0, matrix, valid, valid.astype(np.float32)
+    )
+    flat2 = np.asarray(ravel_pytree(params2)[0])
+    valid_m = np.ones(m, bool)
+    params3, _, _ = step(
+        bundle.params, opt0, matrix[:m].copy(), valid_m,
+        valid_m.astype(np.float32),
+    )
+    np.testing.assert_array_equal(flat2, np.asarray(ravel_pytree(params3)[0]))
+
+    # cross-check against the eager optax pipeline: same math, but jit
+    # fuses the momentum multiply-add (FMA) so allow 1 ulp of the
+    # largest parameter
+    agg_ref = np.asarray(agg.aggregate([matrix[i] for i in range(m)]))
+    tx = optax.sgd(0.05, momentum=0.9)
+    updates, _ = tx.update(unravel(jnp.asarray(agg_ref)), opt0, bundle.params)
+    ref_params = optax.apply_updates(bundle.params, updates)
+    ref_flat = np.asarray(ravel_pytree(ref_params)[0])
+    tol = float(np.spacing(np.max(np.abs(ref_flat))))
+    np.testing.assert_allclose(flat2, ref_flat, rtol=0, atol=tol)
+
+
+def test_serving_ps_step_applies_staleness_weights():
+    from jax.flatten_util import ravel_pytree
+
+    from byzpy_tpu.models import mnist_mlp
+    from byzpy_tpu.parallel.ps import jit_serving_ps_step
+
+    bundle = mnist_mlp()
+    agg = CoordinateWiseTrimmedMean(f=0)
+    step, opt0 = jit_serving_ps_step(bundle, agg.masked_matrix_fn())
+    d = ravel_pytree(bundle.params)[0].shape[0]
+    rng = np.random.default_rng(1)
+    matrix = np.zeros((4, d), np.float32)
+    matrix[:3] = rng.normal(size=(3, d)).astype(np.float32)
+    valid = np.array([True, True, True, False])
+    w_fresh = valid.astype(np.float32)
+    w_stale = np.float32([1.0, 0.5, 0.25, 0.0])
+    p_fresh, _, _ = step(bundle.params, opt0, matrix, valid, w_fresh)
+    p_stale, _, _ = step(bundle.params, opt0, matrix, valid, w_stale)
+    a = np.asarray(ravel_pytree(p_fresh)[0])
+    b = np.asarray(ravel_pytree(p_stale)[0])
+    assert not np.array_equal(a, b)  # the discount really changed the step
+
+
+# ---------------------------------------------------------------------------
+# hardening: drain liveness, malformed frames, bounded ledger, bench floors
+# ---------------------------------------------------------------------------
+
+
+def test_drain_returns_when_leftovers_below_min_cohort():
+    # drain() must not deadlock against the scheduler holding the window
+    # open for an under-strength cohort: 2 submissions < min_cohort=3
+    # can never form an admissible round until more arrive
+    async def run():
+        fe = ServingFrontend(
+            [_tenant(aggregator=CoordinateWiseTrimmedMean(f=1),
+                     min_cohort=3, window_s=0.01)]
+        )
+        await fe.start()
+        fe.submit("m0", "c0", 0, _grad(0))
+        fe.submit("m0", "c1", 0, _grad(1))
+        rounds = await asyncio.wait_for(fe.drain("m0"), timeout=2.0)
+        assert rounds == 0
+        # the held-open leftovers stay visible through the outstanding
+        # gauge even after the scheduler popped them off the queue
+        assert fe.stats()["m0"]["outstanding"] == 2
+        # ...and a third arrival closes the held-open round
+        fe.submit("m0", "c2", 0, _grad(2))
+        rounds = await asyncio.wait_for(fe.drain("m0"), timeout=2.0)
+        assert rounds == 1
+        await fe.close()
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_malformed_signed_frame_gets_rejected_ack_not_dropped_conn():
+    # HMAC-valid but type-nonsense fields: the client is buggy, not
+    # forging — it must get a rejected_malformed ack and keep its
+    # connection (contrast test_tampered_frame_drops_peer)
+    fe = ServingFrontend([_tenant()])
+    reply = fe.handle_request(
+        {"kind": "submit", "tenant": "m0", "client": "c0",
+         "round": "seven", "gradient": _grad()}
+    )
+    assert reply == {"kind": "ack", "accepted": False,
+                     "reason": "rejected_malformed", "round": -1}
+    reply = fe.handle_request(
+        {"kind": "submit", "tenant": ["unhashable"], "client": "c0",
+         "round": 0, "gradient": _grad()}
+    )
+    assert not reply["accepted"]
+    assert reply["reason"] == "rejected_unknown_tenant"
+    reply = fe.handle_request({"kind": "stats", "tenant": {}})
+    assert not reply["accepted"]
+    assert fe.malformed_requests == 1
+    assert fe.stats()["m0"]["frontend"]["malformed_requests"] == 1
+
+
+def test_credit_ledger_bounded_under_client_id_churn():
+    # one fresh client id per submission (sybil churn): the ledger must
+    # stay bounded at max_tracked_clients, visibly counting evictions
+    policy = CreditPolicy(rate_per_s=1.0, burst=1.0, max_tracked_clients=16)
+    ledger = CreditLedger(policy)
+    for i in range(100):
+        ledger.admit(f"sybil{i}", now=0.0)
+        ledger.record("rejected_queue_full", f"sybil{i}")
+    snap = ledger.snapshot()
+    assert snap["clients_seen"] == 16
+    assert len(ledger.per_client_rejected) == 16
+    assert snap["evicted"] == 84
+    # LRU: a re-seen client is retained over colder ids
+    ledger.admit("sybil99", now=1.0)
+    for i in range(100, 115):
+        ledger.admit(f"sybil{i}", now=1.0)
+    assert "sybil99" in ledger._buckets
+    with pytest.raises(ValueError):
+        CreditPolicy(max_tracked_clients=0)
+
+
+def test_bench_ragged_sizes_respect_aggregator_floor():
+    # the buckets lane runs MultiKrum(f=2,q=3) / trimmed-mean f=2, both
+    # needing n >= 5: any draw below that crashes the lane by seed luck
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "serving_bench",
+        os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                     "serving_bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for cap in (32, 64, 256):
+        sizes = mod._ragged_sizes(500, cap, np.random.default_rng(1))
+        assert min(sizes) >= 5
+        assert max(sizes) <= cap
+
+
+def test_oversized_frame_counted_and_peer_dropped():
+    # a length prefix beyond MAX_FRAME is as hostile as a tampered
+    # frame: the peer is dropped AND the event is visible in bad_frames
+    async def run():
+        fe = ServingFrontend([_tenant()])
+        await fe.start()
+        host, port = await fe.serve()
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(wire._HEADER.pack(wire.MAX_FRAME + 1))
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        await fe.close()
+        assert data == b""
+        assert fe.bad_frames == 1
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_on_round_callback_error_does_not_kill_scheduler():
+    # an observer bug must not kill the tenant loop: the round still
+    # lands, drain() still returns, later rounds still close
+    calls = []
+
+    def bad_cb(name, round_id, cohort, agg):
+        calls.append(round_id)
+        raise RuntimeError("observer bug")
+
+    async def run():
+        fe = ServingFrontend([_tenant(cohort_cap=4, window_s=5.0)],
+                             on_round=bad_cb)
+        await fe.start()
+        for i in range(8):
+            fe.submit("m0", f"c{i}", 0, _grad(i))
+        rounds = await asyncio.wait_for(fe.drain("m0"), timeout=5.0)
+        await fe.close()
+        assert rounds == 2
+        assert calls == [0, 1]
+        assert fe.callback_errors == 2
+        assert fe.last_aggregate("m0") is not None
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_min_cohort_auto_raised_to_aggregator_floor():
+    # the default min_cohort=1 with an f>0 aggregator would close
+    # inadmissible cohorts that the crash guard then discards — the
+    # tenant probes validate_n and raises the floor to 2f+1 itself
+    async def run():
+        fe = ServingFrontend([
+            _tenant(aggregator=CoordinateWiseTrimmedMean(f=2),
+                    cohort_cap=8, window_s=0.01)
+        ])
+        assert fe.stats()["m0"]["min_cohort"] == 5
+        await fe.start()
+        for i in range(3):  # below the derived floor: held, not failed
+            fe.submit("m0", f"c{i}", 0, _grad(i))
+        rounds = await asyncio.wait_for(fe.drain("m0"), timeout=2.0)
+        assert rounds == 0
+        assert fe.stats()["m0"]["failed_rounds"] == 0
+        for i in range(3, 5):  # reaching the floor closes the round
+            fe.submit("m0", f"c{i}", 0, _grad(i))
+        rounds = await asyncio.wait_for(fe.drain("m0"), timeout=5.0)
+        await fe.close()
+        assert rounds == 1
+        assert fe.stats()["m0"]["failed_rounds"] == 0
+        return True
+
+    assert asyncio.run(run())
