@@ -1,0 +1,507 @@
+"""Chaos grid: the standing (attack × fault × aggregator × precision)
+regression wall.
+
+Four lanes, each emitting JSON rows (stdout + ``--out`` JSONL):
+
+* ``grid`` — every (attack × fault × aggregator) cell runs one
+  declarative :class:`~byzpy_tpu.chaos.Scenario` through the chaos
+  harness (direct masked-aggregate engine), paired with its attack-free
+  twin for the contained/breached verdict. Each row carries the cell's
+  event-trace digest — the replay pin: a future PR that changes any
+  cell's behavior changes its digest, and `--smoke` asserts zero
+  harness-crashed cells. A second pass replays the fault="none" plane
+  at ``precision=int8`` (the PR-3 wire codec) — the grid's precision
+  axis.
+* ``adaptive`` — the head-to-head: each adaptive attacker vs its static
+  counterpart on the aggregators it targets, reporting the influence
+  uplift and exclusion-round gap (the ROADMAP's "adaptive attackers
+  that optimize their next submission" made measurable).
+* ``serving`` — staleness-window abuse against the REAL serving
+  frontend admission path (virtual clock): the attacker stamps at the
+  cutoff and pre-inflates by 1/discount so the tier's staleness
+  discount cancels; outcome per aggregator reported as contained or
+  breached vs the attack-free baseline (threat model: docs/serving.md).
+* ``swarm`` — thousands of simulated clients (default 3,000) through
+  the production admission gates under bursty arrivals, crashes and a
+  partition, with adaptive byzantine clients riding along: sustained
+  submissions/sec, rounds closed, zero failed rounds, full rejection
+  accounting.
+
+``--smoke`` shrinks everything for CI and asserts the contracts
+(zero harness-crashed cells, cell replay determinism, swarm liveness).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU mesh: the chaos fabric is host-side machinery measured on the CPU
+# mesh by design (same policy as serving_bench) — a dead accelerator
+# tunnel must not hang the regression wall.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+from byzpy_tpu.chaos import (  # noqa: E402
+    ArrivalModel,
+    AttackSpec,
+    ChaosHarness,
+    CrashModel,
+    FaultPlan,
+    PartitionEvent,
+    Scenario,
+    StragglerModel,
+)
+
+
+def _emit(row: dict, out_path: str | None) -> None:
+    line = json.dumps(row)
+    print(line, flush=True)
+    if out_path:
+        with open(out_path, "a") as fh:
+            fh.write(line + "\n")
+
+
+# ---------------------------------------------------------------------------
+# grid lane
+# ---------------------------------------------------------------------------
+
+ATTACK_CELLS = [
+    # reference sign convention (attacks/sign_flip.py, attacks/empire.py):
+    # negative scale = inverted direction
+    ("sign_flip", {"scale": -4.0}),
+    ("empire", {"scale": -1.1}),
+    ("little", {"scale": 1.0}),
+    ("outlier", {"scale": 50.0}),
+    ("influence_ascent", {"grow": 1.8, "scale0": 0.1}),
+    ("krum_evasion", {}),
+]
+
+FAULT_CELLS = {
+    "none": FaultPlan(),
+    "stragglers": FaultPlan(
+        stragglers=StragglerModel(
+            kind="bimodal", mu=-4.0, sigma=0.5, tail_prob=0.25, tail_s=0.5
+        )
+    ),
+    "crash_restart": FaultPlan(
+        crash=CrashModel(prob_per_round=0.03, restart_after_rounds=4)
+    ),
+    "partition": FaultPlan(
+        partitions=(PartitionEvent(start_round=6, end_round=14, fraction=0.25),)
+    ),
+}
+
+AGG_CELLS = [
+    ("trimmed_mean", {"f": 3}),
+    ("multi_krum", {"f": 3, "q": 4}),
+    ("cge", {"f": 3}),
+]
+
+#: breached = the attack dragged the final params more than this factor
+#: past the attack-free twin's error (plus an absolute floor so a
+#: near-zero baseline can't declare breaches on noise)
+BREACH_RATIO = 3.0
+BREACH_FLOOR = 0.15
+
+
+def _base_scenario(args, fault_name: str, **kwargs) -> Scenario:
+    return Scenario(
+        seed=args.seed,
+        n_clients=args.clients_grid,
+        dim=args.dim,
+        rounds=args.rounds,
+        faults=FAULT_CELLS[fault_name],
+        **kwargs,
+    )
+
+
+def _verdict(err: float, baseline: float) -> str:
+    return (
+        "breached"
+        if err > max(BREACH_RATIO * baseline, baseline + BREACH_FLOOR)
+        else "contained"
+    )
+
+
+def _run_cell(scenario: Scenario, baseline_err: float) -> dict:
+    """One grid cell, crash-guarded: the wall must report a broken cell,
+    not die on it."""
+    try:
+        report = ChaosHarness(scenario).run()
+        row = report.summary()
+        row["baseline_error"] = round(baseline_err, 6)
+        row["error_ratio"] = round(
+            report.final_error / max(baseline_err, 1e-9), 3
+        )
+        row["verdict"] = _verdict(report.final_error, baseline_err)
+        row["harness_crashed"] = False
+    except Exception as exc:  # noqa: BLE001 — the wall reports, not dies
+        row = {
+            "scenario": scenario.name,
+            "attack": scenario.attack.name,
+            "aggregator": scenario.aggregator,
+            "precision": scenario.precision,
+            "harness_crashed": True,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+    return row
+
+
+def run_grid(args, out) -> list:
+    rows = []
+    for fault_name in args.faults:
+        for agg_name, agg_params in args.aggregators:
+            base = _base_scenario(
+                args,
+                fault_name,
+                name=f"baseline/{fault_name}/{agg_name}",
+                aggregator=agg_name,
+                aggregator_params=agg_params,
+            )
+            baseline = ChaosHarness(base).run()
+            for attack_name, attack_params in args.attacks:
+                cell = base.with_(
+                    name=f"grid/{attack_name}/{fault_name}/{agg_name}",
+                    n_byzantine=args.byzantine,
+                    attack=AttackSpec(name=attack_name, params=attack_params),
+                )
+                row = {"lane": "grid", "fault": fault_name}
+                row.update(_run_cell(cell, baseline.final_error))
+                rows.append(row)
+                _emit(row, out)
+    # precision axis: the fault-free plane again through the int8 wire
+    # codec — robust verdicts must hold on compressed submissions
+    for agg_name, agg_params in args.aggregators:
+        base = _base_scenario(
+            args,
+            "none",
+            name=f"baseline/int8/{agg_name}",
+            aggregator=agg_name,
+            aggregator_params=agg_params,
+            precision="int8",
+        )
+        baseline = ChaosHarness(base).run()
+        for attack_name, attack_params in args.attacks:
+            cell = base.with_(
+                name=f"grid/{attack_name}/none+int8/{agg_name}",
+                n_byzantine=args.byzantine,
+                attack=AttackSpec(name=attack_name, params=attack_params),
+            )
+            row = {"lane": "grid", "fault": "none"}
+            row.update(_run_cell(cell, baseline.final_error))
+            rows.append(row)
+            _emit(row, out)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# adaptive head-to-head lane
+# ---------------------------------------------------------------------------
+
+#: (adaptive, static counterpart, aggregator) triples: the same attack
+#: budget, blind vs observing
+PAIRS = [
+    ("influence_ascent", {"grow": 1.8, "scale0": 0.1},
+     "outlier", {"scale": 50.0}, "multi_krum", {"f": 3, "q": 4}),
+    ("influence_ascent", {"grow": 1.8, "scale0": 0.1},
+     "outlier", {"scale": 50.0}, "cge", {"f": 3}),
+    ("krum_evasion", {}, "outlier", {"scale": 50.0},
+     "multi_krum", {"f": 3, "q": 4}),
+]
+
+
+def run_adaptive(args, out) -> list:
+    rows = []
+    for a_name, a_params, s_name, s_params, agg, agg_params in PAIRS:
+        reports = {}
+        for name, params in ((a_name, a_params), (s_name, s_params)):
+            cell = _base_scenario(
+                args,
+                "none",
+                name=f"adaptive/{name}/{agg}",
+                aggregator=agg,
+                aggregator_params=agg_params,
+                n_byzantine=args.byzantine,
+                attack=AttackSpec(name=name, params=params),
+            )
+            reports[name] = ChaosHarness(cell).run()
+        adaptive, static = reports[a_name], reports[s_name]
+        row = {
+            "lane": "adaptive",
+            "aggregator": agg,
+            "adaptive": a_name,
+            "static": s_name,
+            "adaptive_influence_mean": round(adaptive.influence_mean, 6),
+            "static_influence_mean": round(static.influence_mean, 6),
+            "influence_uplift": round(
+                adaptive.influence_mean / max(static.influence_mean, 1e-9), 2
+            ),
+            "adaptive_last_selected_round": adaptive.last_selected_round,
+            "static_last_selected_round": static.last_selected_round,
+            "adaptive_final_error": round(adaptive.final_error, 6),
+            "static_final_error": round(static.final_error, 6),
+            "adaptive_beats_static": bool(
+                adaptive.influence_mean > static.influence_mean
+                or adaptive.last_selected_round > static.last_selected_round
+            ),
+        }
+        rows.append(row)
+        _emit(row, out)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# serving staleness-abuse lane
+# ---------------------------------------------------------------------------
+
+
+def run_serving(args, out) -> list:
+    rows = []
+    cutoff, gamma = 4, 0.5
+    for agg_name, agg_params in args.aggregators:
+        common = dict(
+            seed=args.seed,
+            n_clients=args.clients_grid,
+            dim=args.dim,
+            rounds=args.rounds,
+            engine="serving",
+            aggregator=agg_name,
+            aggregator_params=agg_params,
+            staleness_kind="exponential",
+            staleness_gamma=gamma,
+            staleness_cutoff=cutoff,
+        )
+        baseline = ChaosHarness(
+            Scenario(name=f"serving-baseline/{agg_name}", **common)
+        ).run()
+        abuse = ChaosHarness(
+            Scenario(
+                name=f"serving-abuse/{agg_name}",
+                n_byzantine=args.byzantine,
+                attack=AttackSpec(
+                    name="staleness_abuse",
+                    params={"kind": "exponential", "gamma": gamma,
+                            "cutoff": cutoff, "scale": 2.0},
+                ),
+                **common,
+            )
+        ).run()
+        row = {
+            "lane": "serving",
+            "aggregator": agg_name,
+            "attack": "staleness_abuse",
+            "staleness": {"kind": "exponential", "gamma": gamma,
+                          "cutoff": cutoff},
+            "inflation": round((1.0 / gamma) ** cutoff, 1),
+            "rounds": abuse.rounds_completed,
+            "verdicts": dict(abuse.verdict_counts),
+            "influence_mean": round(abuse.influence_mean, 6),
+            "baseline_error": round(baseline.final_error, 6),
+            "final_error": round(abuse.final_error, 6),
+            "error_ratio": round(
+                abuse.final_error / max(baseline.final_error, 1e-9), 3
+            ),
+            "outcome": _verdict(abuse.final_error, baseline.final_error),
+            "trace_digest": abuse.trace.digest(),
+        }
+        rows.append(row)
+        _emit(row, out)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# swarm lane
+# ---------------------------------------------------------------------------
+
+
+def run_swarm(args, out) -> dict:
+    scenario = Scenario(
+        name="swarm",
+        seed=args.seed,
+        n_clients=args.clients_swarm,
+        n_byzantine=max(1, args.clients_swarm // 100),
+        dim=args.dim,
+        rounds=args.swarm_rounds,
+        engine="serving",
+        aggregator="trimmed_mean",
+        aggregator_params={"f": max(1, args.clients_swarm // 100)},
+        attack=AttackSpec(
+            name="staleness_abuse",
+            params={"kind": "exponential", "gamma": 0.5, "cutoff": 4},
+        ),
+        arrivals=ArrivalModel(kind="bernoulli", p=0.5),
+        faults=FaultPlan(
+            stragglers=StragglerModel(kind="bimodal", tail_prob=0.1),
+            crash=CrashModel(prob_per_round=0.001, restart_after_rounds=3),
+            partitions=(
+                PartitionEvent(
+                    start_round=args.swarm_rounds // 3,
+                    end_round=2 * args.swarm_rounds // 3,
+                    fraction=0.1,
+                ),
+            ),
+        ),
+        staleness_kind="exponential",
+        staleness_gamma=0.5,
+        staleness_cutoff=4,
+        credit_rate_per_s=200.0,
+        credit_burst=8.0,
+    )
+    t0 = time.monotonic()
+    report = ChaosHarness(scenario).run()
+    elapsed = time.monotonic() - t0
+    submitted = sum(report.verdict_counts.values())
+    # the actor-fabric twin: the same population through the real
+    # actor-mode ParameterServer round loop (asyncio fan-out per node,
+    # adaptive byzantine nodes on the observation channel) — the
+    # Podracer claim that simulated thousands are cheap on BOTH fabrics
+    actor = ChaosHarness(
+        scenario.with_(
+            name="swarm-actor",
+            engine="actor",
+            n_clients=args.clients_actor,
+            n_byzantine=max(1, args.clients_actor // 100),
+            aggregator_params={"f": max(1, args.clients_actor // 100)},
+            rounds=max(3, args.swarm_rounds // 3),
+            attack=AttackSpec(
+                name="influence_ascent", params={"grow": 1.8, "scale0": 0.1}
+            ),
+            faults=FaultPlan(),
+            arrivals=ArrivalModel(),
+        )
+    )
+    ta = time.monotonic()
+    actor_report = actor.run()
+    actor_elapsed = time.monotonic() - ta
+    actor_row = {
+        "lane": "swarm_actor",
+        "clients": args.clients_actor,
+        "rounds": actor_report.rounds_completed,
+        "wall_s": round(actor_elapsed, 3),
+        "gradients_per_sec": round(
+            args.clients_actor
+            * actor_report.rounds_completed
+            / max(actor_elapsed, 1e-9),
+            1,
+        ),
+        # no influence metric here: the actor engine publishes only what
+        # the real PS publishes (the aggregate), and the leave-out
+        # reference needs the cohort matrix the PS never exposes
+        "final_error": round(actor_report.final_error, 6),
+    }
+    _emit(actor_row, out)
+    row = {
+        "lane": "swarm",
+        "clients": scenario.n_clients,
+        "byzantine": scenario.n_byzantine,
+        "rounds": report.rounds_completed,
+        "wall_s": round(elapsed, 3),
+        "submissions": submitted,
+        "submissions_per_sec": round(submitted / max(elapsed, 1e-9), 1),
+        "verdicts": dict(report.verdict_counts),
+        "events": report.trace.counts(),
+        "final_error": round(report.final_error, 6),
+        "influence_mean": round(report.influence_mean, 6),
+        "trace_digest": report.trace.digest(),
+    }
+    _emit(row, out)
+    return row
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--rounds", type=int, default=24)
+    ap.add_argument("--clients-grid", type=int, default=12)
+    ap.add_argument("--byzantine", type=int, default=3)
+    ap.add_argument("--clients-swarm", type=int, default=3000)
+    ap.add_argument("--clients-actor", type=int, default=1000)
+    ap.add_argument("--swarm-rounds", type=int, default=12)
+    ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small CI run with contract assertions")
+    args = ap.parse_args()
+
+    args.attacks = ATTACK_CELLS
+    args.faults = list(FAULT_CELLS)
+    args.aggregators = AGG_CELLS
+    if args.smoke:
+        args.rounds = 10
+        args.dim = 32
+        args.clients_swarm = 400
+        args.clients_actor = 120
+        args.swarm_rounds = 6
+        args.attacks = [ATTACK_CELLS[0], ATTACK_CELLS[4]]
+        args.faults = ["none", "crash_restart"]
+        args.aggregators = AGG_CELLS[:2]
+
+    meta = {
+        "lane": "meta",
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "seed": args.seed,
+        "smoke": bool(args.smoke),
+    }
+    _emit(meta, args.out)
+
+    grid = run_grid(args, args.out)
+    adaptive = run_adaptive(args, args.out)
+    serving = run_serving(args, args.out)
+    swarm = run_swarm(args, args.out)
+
+    crashed = [r for r in grid if r.get("harness_crashed")]
+    headline = {
+        "lane": "headline",
+        "metric": "chaos_grid_cells",
+        "value": len(grid),
+        "crashed_cells": len(crashed),
+        "breached_cells": sum(
+            1 for r in grid if r.get("verdict") == "breached"
+        ),
+        "adaptive_beats_static": sum(
+            1 for r in adaptive if r["adaptive_beats_static"]
+        ),
+        "serving_abuse_outcomes": {
+            r["aggregator"]: r["outcome"] for r in serving
+        },
+        "swarm_submissions_per_sec": swarm["submissions_per_sec"],
+    }
+    _emit(headline, args.out)
+
+    if args.smoke:
+        assert not crashed, f"harness-crashed cells: {crashed}"
+        assert headline["adaptive_beats_static"] >= 1, (
+            "no adaptive attacker beat its static counterpart"
+        )
+        # replay determinism: rerun one cell, digests must match
+        cell = Scenario(
+            name="smoke-replay",
+            seed=args.seed,
+            n_clients=args.clients_grid,
+            n_byzantine=args.byzantine,
+            dim=args.dim,
+            rounds=args.rounds,
+            aggregator="trimmed_mean",
+            aggregator_params={"f": 3},
+            attack=AttackSpec(name="influence_ascent"),
+            faults=FAULT_CELLS["crash_restart"],
+        )
+        d1 = ChaosHarness(cell).run().trace.digest()
+        d2 = ChaosHarness(cell).run().trace.digest()
+        assert d1 == d2, "chaos cell not replayable"
+        assert swarm["rounds"] > 0 and swarm["submissions"] > 0
+        print("chaos smoke OK")
+
+
+if __name__ == "__main__":
+    main()

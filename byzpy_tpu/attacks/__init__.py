@@ -1,3 +1,10 @@
+from .adaptive import (
+    AdaptiveAttack,
+    InfluenceAscentAttack,
+    KrumEvasionAttack,
+    PublicRoundState,
+    StalenessAbuseAttack,
+)
 from .base import Attack
 from .empire import EmpireAttack
 from .gaussian import GaussianAttack
@@ -16,4 +23,9 @@ __all__ = [
     "InfAttack",
     "MimicAttack",
     "LabelFlipAttack",
+    "AdaptiveAttack",
+    "InfluenceAscentAttack",
+    "KrumEvasionAttack",
+    "PublicRoundState",
+    "StalenessAbuseAttack",
 ]

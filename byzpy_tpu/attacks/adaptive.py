@@ -1,0 +1,384 @@
+"""Adaptive Byzantine attacks: stateful adversaries on the public round feed.
+
+The reference attacks (and this repo's, until round 7) are *stateless*
+functions applied blind each round — a sign flip does not know whether the
+aggregator trimmed it away. A realistic adversary participates in the
+protocol: it pulls the broadcast model like every client, sees which of its
+submissions were accepted, and optimizes the next one. This module is that
+adversary, built on the :meth:`~byzpy_tpu.attacks.base.Attack.observe_round`
+observation channel:
+
+* :class:`PublicRoundState` — what a client legitimately learns per round:
+  the broadcast aggregate, the server round counter, per-client
+  acceptance/selection decisions (a Krum-style aggregator's published
+  cohort, or simply "my update was reflected"), and the admission-layer
+  ack verdicts of the serving tier (credit/staleness reason strings).
+* :class:`AdaptiveAttack` — stateful base: records observations, exposes
+  deterministic per-instance randomness (same seed + same observation
+  sequence ⇒ bit-identical submission sequence, the chaos harness's
+  replay contract).
+* :class:`InfluenceAscentAttack` — gradient-ascent on aggregator
+  influence: a multiplicative line search on the attack magnitude that
+  grows while the aggregate keeps moving along the malicious direction
+  and backs off the moment the aggregator clips/trims the push away —
+  converging to the just-inside-tolerance magnitude a static attack can
+  only find by luck.
+* :class:`KrumEvasionAttack` — mimicry of accepted rows: submits the
+  publicly observable consensus (the broadcast aggregate — for Krum
+  families, literally a mean of accepted rows) plus an adaptive bias,
+  shrinking the bias whenever it loses selection, so it stays *inside*
+  the accepted set for many rounds while steadily steering it.
+* :class:`StalenessAbuseAttack` — serving-tier staleness-window abuse:
+  stamps each submission at the oldest admissible round (``δ = cutoff``)
+  and pre-inflates it by ``1 / discount(δ)`` so the tier's staleness
+  discount cancels exactly, while pacing submissions under the published
+  credit policy. The inflated raw row rides the *stale* path through any
+  admission-side magnitude screening that only looks at fresh-equivalent
+  norms — the threat model note in ``docs/serving.md`` — and lands in
+  the cohort at full intended magnitude.
+
+Every attacker here uses ONLY public information (its observations and
+its own parameters) — none requests ``honest_grads``. That is what makes
+them deployable against the serving tier, where honest rows are never
+revealed, and what makes actor-mode vs fused-SPMD parity exact: same
+observation sequence in, same submission sequence out
+(``tests/test_chaos_adaptive.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Mapping, Optional
+
+import numpy as np
+
+from .base import Attack
+
+
+@dataclass(frozen=True)
+class PublicRoundState:
+    """One closed round's public outcome, as an adaptive adversary sees it.
+
+    ``aggregate`` is the broadcast update/model delta every client pulls
+    (host ``(d,)`` array or pytree); ``accepted`` maps client ids to the
+    round's acceptance/selection verdict where the fabric publishes one
+    (empty when it doesn't); ``verdicts`` maps client ids to
+    admission-layer ack reason strings (``accepted``/``rejected_rate``/
+    ``rejected_too_stale``/… — each client at least knows its own acks);
+    ``server_round`` is the server's round counter at broadcast time
+    (what a submission's staleness δ is measured against)."""
+
+    round_id: int
+    aggregate: Any
+    accepted: Mapping[str, bool] = field(default_factory=dict)
+    verdicts: Mapping[str, str] = field(default_factory=dict)
+    server_round: int = 0
+
+
+class AdaptiveAttack(Attack):
+    """Stateful attack base over the :meth:`observe_round` feed.
+
+    Subclasses implement ``_update(state)`` (digest one observation) and
+    ``apply`` (emit the next submission from current state). Determinism
+    contract: all state transitions are pure functions of the
+    constructor arguments and the observation sequence — float32 numpy
+    arithmetic, per-instance ``np.random.Generator`` seeded from
+    ``seed`` — so identical observations replay identical submissions
+    (pinned by ``tests/test_chaos_adaptive.py``)."""
+
+    is_adaptive = True
+    name = "adaptive"
+
+    def __init__(self, dim: int, *, seed: int = 0, client_id: str = "byz") -> None:
+        if dim <= 0:
+            raise ValueError(f"dim must be >= 1 (got {dim})")
+        self.dim = int(dim)
+        self.client_id = str(client_id)
+        self.seed = int(seed)
+        self.rng = np.random.default_rng(seed)
+        self.observations: List[PublicRoundState] = []
+        self.submissions = 0
+
+    # -- observation channel ------------------------------------------------
+
+    def observe_round(self, public_state: PublicRoundState) -> None:
+        """Digest one round's public outcome (appends to ``observations``
+        then delegates to the subclass's ``_update``)."""
+        self.observations.append(public_state)
+        self._update(public_state)
+
+    def _update(self, state: PublicRoundState) -> None:
+        """Subclass hook: fold one observation into attack state."""
+
+    # -- convenience --------------------------------------------------------
+
+    def _aggregate_estimate(self) -> np.ndarray:
+        """The attacker's best public estimate of the honest consensus:
+        the last broadcast aggregate (zeros before any observation)."""
+        if not self.observations:
+            return np.zeros((self.dim,), np.float32)
+        agg = np.asarray(self.observations[-1].aggregate, np.float32)
+        return agg.reshape(-1)[: self.dim]
+
+    def _was_accepted(self, state: PublicRoundState) -> Optional[bool]:
+        """This attacker's acceptance verdict in ``state`` (None when the
+        round published no per-client decision for it)."""
+        if self.client_id in state.accepted:
+            return bool(state.accepted[self.client_id])
+        return None
+
+
+def _unit(direction: Any, dim: int) -> np.ndarray:
+    """Normalized float32 direction vector (default: all-ones)."""
+    if direction is None:
+        vec = np.ones((dim,), np.float32)
+    else:
+        vec = np.asarray(direction, np.float32).reshape(-1)
+        if vec.shape[0] != dim:
+            raise ValueError(f"direction has {vec.shape[0]} coords, expected {dim}")
+    norm = float(np.linalg.norm(vec))
+    if norm == 0.0:
+        raise ValueError("direction must be non-zero")
+    return (vec / np.float32(norm)).astype(np.float32)
+
+
+class InfluenceAscentAttack(AdaptiveAttack):
+    """Gradient-ascent on aggregator influence.
+
+    Goal: drag the broadcast aggregate along ``direction``. Each round
+    the attacker measures its *realized influence* — the component of
+    the broadcast aggregate along the malicious direction — and runs a
+    multiplicative line search on its attack magnitude ``scale``:
+
+    * influence improved (the aggregator passed the push through) →
+      ``scale *= grow``: push harder next round;
+    * influence regressed (trimmed/clipped/excluded — the push
+      backfired or vanished) → ``scale *= shrink``: retreat back inside
+      the aggregator's tolerance.
+
+    The submission is ``estimate + scale · direction`` where
+    ``estimate`` is the last broadcast aggregate — so the row sits near
+    the honest consensus and the whole budget goes into the directional
+    push. Against a trimmed mean this converges from either side onto
+    the largest per-coordinate offset that still survives the trim
+    window (the 'a little is enough' magnitude, *learned online* instead
+    of assumed from known honest variance); a static attack at a fixed
+    large scale is trimmed to zero influence every round
+    (``benchmarks/chaos_bench.py`` 'adaptive' lane measures the gap)."""
+
+    name = "influence-ascent"
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        direction: Any = None,
+        scale0: float = 0.05,
+        grow: float = 1.6,
+        shrink: float = 0.5,
+        max_scale: float = 1e3,
+        seed: int = 0,
+        client_id: str = "byz",
+    ) -> None:
+        super().__init__(dim, seed=seed, client_id=client_id)
+        if not (0.0 < shrink < 1.0 < grow):
+            raise ValueError("need 0 < shrink < 1 < grow")
+        self.direction = _unit(direction, dim)
+        self.scale = np.float32(scale0)
+        self.grow = np.float32(grow)
+        self.shrink = np.float32(shrink)
+        self.max_scale = np.float32(max_scale)
+        self._last_influence: Optional[np.float32] = None
+
+    def _update(self, state: PublicRoundState) -> None:
+        influence = np.float32(
+            np.dot(
+                np.asarray(state.aggregate, np.float32).reshape(-1)[: self.dim],
+                self.direction,
+            )
+        )
+        if self._last_influence is None or influence > self._last_influence:
+            self.scale = min(self.scale * self.grow, self.max_scale)
+        else:
+            self.scale = self.scale * self.shrink
+        self._last_influence = influence
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads=None, base_grad=None) -> np.ndarray:
+        """Next submission: consensus estimate + current push."""
+        self.submissions += 1
+        return (
+            self._aggregate_estimate() + self.scale * self.direction
+        ).astype(np.float32)
+
+
+class KrumEvasionAttack(AdaptiveAttack):
+    """Krum evasion via mimicry of accepted rows.
+
+    Selection aggregators (Krum, Multi-Krum, CGE, MoNNA) publish — via
+    the broadcast itself — a consensus of the *accepted* rows. The
+    evader submits exactly that public consensus plus an adaptive bias
+    ``eps · direction``:
+
+    * while it keeps being selected (its id in the published accepted
+      set, or no exclusion signal) → ``eps *= grow``: steer harder;
+    * the round it loses selection → ``eps *= shrink``: snap back to
+      near-perfect mimicry and re-enter the accepted set.
+
+    A static outlier is excluded by Krum in round 0 and never scores
+    again; the mimic stays inside the selection for many rounds
+    (``exclusion_round`` metric in the chaos grid) while biasing every
+    round's output it participates in."""
+
+    name = "krum-evasion"
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        direction: Any = None,
+        eps0: float = 0.01,
+        grow: float = 1.5,
+        shrink: float = 0.25,
+        max_eps: float = 1e3,
+        seed: int = 0,
+        client_id: str = "byz",
+    ) -> None:
+        super().__init__(dim, seed=seed, client_id=client_id)
+        if not (0.0 < shrink < 1.0 < grow):
+            raise ValueError("need 0 < shrink < 1 < grow")
+        self.direction = _unit(direction, dim)
+        self.eps = np.float32(eps0)
+        self.grow = np.float32(grow)
+        self.shrink = np.float32(shrink)
+        self.max_eps = np.float32(max_eps)
+
+    def _update(self, state: PublicRoundState) -> None:
+        # exclusion = an explicit accepted=False, OR an admission-layer
+        # rejection ack (a serving feed encodes cohort membership only
+        # as presence, so the attacker's own non-accepted ack is the
+        # other public signal that its row did not score)
+        accepted = self._was_accepted(state)
+        verdict = state.verdicts.get(self.client_id)
+        rejected = verdict is not None and verdict != "accepted"
+        if accepted is False or rejected:
+            self.eps = self.eps * self.shrink
+        else:
+            self.eps = min(self.eps * self.grow, self.max_eps)
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads=None, base_grad=None) -> np.ndarray:
+        """Next submission: mimic the published consensus, plus bias."""
+        self.submissions += 1
+        return (
+            self._aggregate_estimate() + self.eps * self.direction
+        ).astype(np.float32)
+
+
+class StalenessAbuseAttack(AdaptiveAttack):
+    """Staleness-window abuse against the serving tier.
+
+    The serving frontend admits a round-``k`` submission up to
+    ``cutoff`` rounds late and folds it discounted by ``discount(δ)``
+    (:class:`~byzpy_tpu.serving.staleness.StalenessPolicy`) — both
+    policy parameters are public (clients must know them to participate).
+    The abuser therefore:
+
+    * stamps every submission at the OLDEST admissible round
+      (``δ = cutoff``), maximizing the window between computing its
+      payload and the geometry the aggregator judges it against;
+    * pre-inflates the payload by ``1 / discount(δ)`` so the tier's
+      discount cancels exactly — the row enters the cohort at full
+      intended magnitude even though it was "discounted";
+    * paces itself under the published credit policy (one submission per
+      admission opportunity — the token bucket never rejects it, so it
+      never burns reputation with ``rejected_rate`` acks), retreating
+      for ``backoff_rounds`` after any rejection verdict.
+
+    ``next_round_stamp(server_round)`` is the round id to put on the
+    wire; ``apply`` returns the pre-inflated gradient row. Outcome
+    against each aggregator (contained or breached) is measured by the
+    ``serving`` lane of ``benchmarks/chaos_bench.py`` and reported in
+    ``benchmarks/RESULTS.md``; the defensive moral — magnitude screens
+    must run post-discount — is documented in ``docs/serving.md``."""
+
+    name = "staleness-abuse"
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        staleness: Any = None,
+        direction: Any = None,
+        scale: float = 1.0,
+        backoff_rounds: int = 1,
+        seed: int = 0,
+        client_id: str = "byz",
+    ) -> None:
+        super().__init__(dim, seed=seed, client_id=client_id)
+        from ..serving.staleness import StalenessPolicy
+
+        self.staleness = (
+            staleness if staleness is not None else StalenessPolicy()
+        )
+        if not isinstance(self.staleness, StalenessPolicy):
+            raise TypeError("staleness must be a StalenessPolicy")
+        self.direction = _unit(direction, dim)
+        self.scale = np.float32(scale)
+        self.backoff_rounds = int(backoff_rounds)
+        self._cooldown = 0
+
+    @property
+    def delta(self) -> int:
+        """The staleness the attack CLAIMS right now: the policy's
+        cutoff, clamped to the last observed server round (a round-2
+        server cannot be handed a round −2 gradient; before the cutoff
+        is reachable the attack claims what it can). 0 when the policy
+        has no cutoff — nothing to abuse, submissions go out fresh."""
+        cutoff = int(self.staleness.cutoff or 0)
+        server = (
+            int(self.observations[-1].server_round)
+            if self.observations
+            else 0
+        )
+        return min(cutoff, server)
+
+    @property
+    def inflation(self) -> np.float32:
+        """``1 / discount(δ)`` for the CLAIMED δ — the pre-inflation
+        that cancels the tier's staleness discount bit-for-bit at fold
+        time (grows with the server round until the cutoff caps it)."""
+        return np.float32(1.0 / self.staleness.discount(self.delta))
+
+    def next_round_stamp(self, server_round: int) -> int:
+        """The round id to stamp on the next submission: the oldest the
+        cutoff admits (clamped at round 0)."""
+        return max(0, int(server_round) - int(self.staleness.cutoff or 0))
+
+    def should_submit(self) -> bool:
+        """Credit pacing: False while backing off after a rejection."""
+        return self._cooldown <= 0
+
+    def _update(self, state: PublicRoundState) -> None:
+        verdict = state.verdicts.get(self.client_id)
+        if verdict is not None and verdict != "accepted":
+            self._cooldown = self.backoff_rounds
+        elif self._cooldown > 0:
+            self._cooldown -= 1
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads=None, base_grad=None) -> np.ndarray:
+        """Next submission: the consensus estimate plus the malicious
+        push, pre-inflated to cancel the staleness discount."""
+        self.submissions += 1
+        payload = self._aggregate_estimate() + self.scale * self.direction
+        return (self.inflation * payload).astype(np.float32)
+
+
+__all__ = [
+    "AdaptiveAttack",
+    "InfluenceAscentAttack",
+    "KrumEvasionAttack",
+    "PublicRoundState",
+    "StalenessAbuseAttack",
+]

@@ -27,7 +27,26 @@ class Attack(Operator, ABC):
     uses_model_batch: bool = False
     uses_honest_grads: bool = False
 
+    #: True for stateful attacks that consume the public round feed
+    #: (:meth:`observe_round`) to optimize their next submission — see
+    #: ``attacks/adaptive.py``. Static attacks stay pure functions.
+    is_adaptive: bool = False
+
     name = "attack"
+
+    def observe_round(self, public_state: Any) -> None:
+        """Receive one closed round's PUBLIC outcome.
+
+        ``public_state`` is a
+        :class:`~byzpy_tpu.attacks.adaptive.PublicRoundState`: the
+        broadcast aggregate every client pulls, the round counter, and
+        whatever acceptance/admission verdicts the fabric publishes
+        (selection decisions, credit/staleness ack reasons). This is the
+        observation channel of the adaptive-adversary API — orchestrators
+        (actor-mode PS, the chaos harness, the serving tier) feed it after
+        every round. The base attack is stateless, so the default is a
+        no-op; adaptive subclasses override it to update their strategy.
+        """
 
     def compute(self, inputs: Mapping[str, Any], *, context: OpContext) -> Any:
         return self.apply_placed(**self._collect_inputs(inputs))

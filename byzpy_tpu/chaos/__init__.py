@@ -1,0 +1,55 @@
+"""Chaos fabric: trace-driven fault injection + adaptive adversaries.
+
+The scenario-diversity subsystem (ROADMAP "pod-scale chaos"): thousands
+of simulated clients run against the round fabric — the direct masked
+aggregation path, a fused-SPMD-style jitted step, the actor-mode
+parameter server, or the PR-6 serving frontend — under configurable
+chaos (arrival/straggler/failure distributions, partition and rejoin
+events, crash/restart mid-round), every run replayable from a single
+seed via a declarative :class:`Scenario` and audited by an
+:class:`EventTrace` whose digest is the determinism contract.
+
+On top of the harness rides the adaptive-adversary API
+(``byzpy_tpu.attacks.adaptive``): attackers observe each round's public
+state through :meth:`~byzpy_tpu.attacks.base.Attack.observe_round` and
+optimize their next submission. ``benchmarks/chaos_bench.py`` runs the
+standing (attack × fault × aggregator × precision) grid over this
+package; its committed ``benchmarks/results/chaos_cpu.jsonl`` is the
+regression wall scaling PRs must hold. See ``docs/chaos.md``.
+"""
+
+from .drills import DRILL_SCENARIOS, run_drill
+from .events import ChaosEvent, EventTrace
+from .harness import ChaosHarness, ChaosReport
+from .influence import attacker_influence, selection_mask
+from .scenario import (
+    ArrivalModel,
+    AttackSpec,
+    CrashModel,
+    FaultPlan,
+    PartitionEvent,
+    Scenario,
+    StragglerModel,
+    build_aggregator,
+    build_attack,
+)
+
+__all__ = [
+    "ArrivalModel",
+    "AttackSpec",
+    "ChaosEvent",
+    "DRILL_SCENARIOS",
+    "run_drill",
+    "ChaosHarness",
+    "ChaosReport",
+    "CrashModel",
+    "EventTrace",
+    "FaultPlan",
+    "PartitionEvent",
+    "Scenario",
+    "StragglerModel",
+    "attacker_influence",
+    "build_aggregator",
+    "build_attack",
+    "selection_mask",
+]

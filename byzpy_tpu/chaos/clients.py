@@ -1,0 +1,135 @@
+"""Simulated clients: the cheap thousands-of-workers population.
+
+Podracer's lesson (arXiv:2104.06272) applied to robust aggregation: the
+interesting scale questions — cohort raggedness, straggler skew, crash
+churn, adaptive drag — do not need real model replicas. One simulated
+client is a quadratic task (a per-client target vector) plus a seeded
+noise stream; a byzantine client swaps the honest gradient for its
+attack's output. The harness owns all *timing* randomness (arrivals,
+delays, crashes) so the population stays embarrassingly cheap and the
+event schedule replays from the scenario seed alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..attacks.base import Attack
+
+
+class SimClient:
+    """One simulated client of the chaos harness.
+
+    Honest behavior: ``gradient(w) = 2 (w - target) + noise`` — the
+    gradient of ``||w - target||²`` with per-client observation noise
+    (seeded ``np.random.Generator``; the noise stream is part of the
+    replay contract). Byzantine behavior (``attack`` set): the attack's
+    ``apply`` output, with honest context provided for static attacks
+    that request it; adaptive attacks run on their public-feed state
+    alone."""
+
+    def __init__(
+        self,
+        cid: str,
+        dim: int,
+        target: np.ndarray,
+        *,
+        seed: int,
+        noise: float = 0.05,
+        attack: Optional[Attack] = None,
+    ) -> None:
+        self.cid = str(cid)
+        self.dim = int(dim)
+        self.target = np.asarray(target, np.float32)
+        self.noise = np.float32(noise)
+        self.rng = np.random.default_rng(seed)
+        self.attack = attack
+        # fault-state flags owned by the harness schedule
+        self.alive = True
+        self.partitioned = False
+        self.down_since_round = -1
+
+    @property
+    def byzantine(self) -> bool:
+        """Whether this client runs an attack."""
+        return self.attack is not None
+
+    def honest_gradient(self, w: np.ndarray) -> np.ndarray:
+        """The quadratic-task gradient at the broadcast params ``w``."""
+        g = 2.0 * (np.asarray(w, np.float32) - self.target)
+        if self.noise > 0:
+            g = g + self.noise * self.rng.standard_normal(
+                self.dim
+            ).astype(np.float32)
+        return g.astype(np.float32)
+
+    def submission(
+        self, w: np.ndarray, honest_rows: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """This round's submission: honest gradient, or the attack's
+        output (static attacks that request honest context receive this
+        round's honest rows — the classic omniscient-attacker model;
+        adaptive attacks see only their observation feed)."""
+        if self.attack is None:
+            return self.honest_gradient(w)
+        kwargs: dict = {}
+        if getattr(self.attack, "uses_honest_grads", False):
+            if honest_rows is None:
+                raise ValueError(
+                    f"{self.attack.name} needs honest rows, none provided"
+                )
+            kwargs["honest_grads"] = [row for row in honest_rows]
+        if getattr(self.attack, "uses_base_grad", False):
+            kwargs["base_grad"] = self.honest_gradient(w)
+        out = np.asarray(self.attack.apply(**kwargs), np.float32)
+        return out.reshape(self.dim)
+
+
+class StaticVectorAttack(Attack):
+    """The grid's static attacks that have NO class in
+    ``byzpy_tpu.attacks`` (sign-flip and empire reuse the real
+    :class:`~byzpy_tpu.attacks.SignFlipAttack` /
+    :class:`~byzpy_tpu.attacks.EmpireAttack` — see the
+    ``chaos.scenario.ATTACKS`` registry):
+
+    * ``little`` — mean + ``scale`` honest standard deviations ('a
+      little is enough' with an assumed-known sigma; the
+      :class:`~byzpy_tpu.attacks.LittleAttack` class parametrizes the
+      shift by ``(f, N)`` instead, which a dim-only registry builder
+      cannot supply);
+    * ``outlier`` — a constant ``scale``-magnitude vector, the crude
+      drill attack (``tests/test_multihost.py``'s 1e3 outlier).
+
+    These are the static counterparts the adaptive lane of
+    ``benchmarks/chaos_bench.py`` compares against."""
+
+    name = "static-vector"
+
+    _MODES = ("little", "outlier")
+
+    def __init__(self, dim: int, *, mode: str, scale: float) -> None:
+        if mode not in self._MODES:
+            raise ValueError(f"mode must be one of {self._MODES}")
+        self.dim = int(dim)
+        self.mode = mode
+        self.scale = np.float32(scale)
+        self.name = mode
+        # needs-flags are per-mode, so they live on the instance
+        self.uses_honest_grads = mode == "little"
+
+    def apply(self, *, model: Any = None, x: Any = None, y: Any = None,
+              honest_grads: Any = None, base_grad: Any = None) -> np.ndarray:
+        """One malicious row from this round's honest context."""
+        if self.mode == "outlier":
+            return np.full((self.dim,), self.scale, np.float32)
+        if not honest_grads:
+            raise ValueError(f"{self.mode} requires honest_grads")
+        honest = np.stack([np.asarray(g, np.float32) for g in honest_grads])
+        mu = honest.mean(axis=0)
+        sigma = honest.std(axis=0)
+        return (mu + self.scale * sigma).astype(np.float32)
+
+
+__all__ = ["SimClient", "StaticVectorAttack"]

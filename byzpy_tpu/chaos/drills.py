@@ -1,0 +1,139 @@
+"""The four hand-written fault drills, promoted to declarative scenarios.
+
+``tests/test_multihost.py`` exercises real OS-process faults (a
+SIGKILLed actor host mid-round, a byzantine subprocess peer, a
+heartbeat excision, the two-process multihost bring-up). Those drills
+stay in place as regression pins — nothing simulates a real SIGKILL —
+but their *fault semantics* now also exist as :class:`Scenario` configs
+the chaos harness executes in milliseconds, which is what lets the same
+shapes run at every point of the chaos grid instead of only at n=3/4
+with one aggregator. ``run_drill`` executes one by name and checks its
+invariant (``tests/test_chaos_drills.py`` runs all four).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .harness import ChaosHarness, ChaosReport
+from .scenario import (
+    AttackSpec,
+    CrashModel,
+    FaultPlan,
+    PartitionEvent,
+    Scenario,
+)
+
+#: The promoted drill configs, keyed by the original test's short name.
+DRILL_SCENARIOS: Dict[str, Scenario] = {
+    # test_two_process_psum_over_distributed_runtime: the multihost
+    # bring-up — every worker's contribution lands in every round's
+    # aggregate. Simulated shape: 2 clients, no faults, mean-family
+    # aggregate; invariant: all rounds close with full cohorts.
+    "two_host_psum": Scenario(
+        name="drill-two-host-psum",
+        seed=11,
+        n_clients=2,
+        dim=8,
+        rounds=3,
+        aggregator="trimmed_mean",
+        aggregator_params={"f": 0},
+        noise=0.0,
+        client_values=(1.0, 2.0),
+    ),
+    # test_elastic_ps_survives_sigkilled_host_process_midround: a worker
+    # dies with its gradient IN FLIGHT and never returns; the rounds
+    # keep closing on the survivors. Simulated shape: the third client
+    # crashes mid-round at round 0 (prob 1 while alive, no restart) and
+    # the trimmed mean converges on the survivors' consensus (1.5).
+    "sigkill_midround": Scenario(
+        name="drill-sigkill-midround",
+        seed=12,
+        n_clients=3,
+        dim=8,
+        rounds=30,
+        aggregator="trimmed_mean",
+        aggregator_params={"f": 0},
+        noise=0.0,
+        client_values=(1.0, 2.0, 9.0),
+        faults=FaultPlan(
+            crash=CrashModel(at_round=0, victim_indices=(2,))
+        ),
+        learning_rate=0.2,
+    ),
+    # test_gossip_with_byzantine_process: a byzantine peer floods a 1e3
+    # outlier; median consensus among the honest peers must hold.
+    # Simulated shape: 3 honest + 1 outlier attacker under a median —
+    # invariant: final params within the honest spread, outlier
+    # influence bounded.
+    "byzantine_process": Scenario(
+        name="drill-byzantine-process",
+        seed=13,
+        n_clients=4,
+        n_byzantine=1,
+        dim=8,
+        rounds=40,
+        aggregator="median",
+        noise=0.0,
+        client_values=(0.0, 1.0, 2.0, 0.0),
+        attack=AttackSpec(name="outlier", params={"scale": 1e3}),
+        learning_rate=0.2,
+    ),
+    # test_heartbeat_policy_excises_sigkilled_process_peer: a peer goes
+    # silent mid-training and is excised; the survivors keep training.
+    # Simulated shape: a partition takes out one client from round 3 on
+    # (the detector's view of a dead peer IS a permanent partition);
+    # invariant: later cohorts are survivor-only and training converges
+    # on the survivors' consensus.
+    "heartbeat_excision": Scenario(
+        name="drill-heartbeat-excision",
+        seed=14,
+        n_clients=4,
+        dim=8,
+        rounds=40,
+        aggregator="median",
+        noise=0.0,
+        client_values=(0.0, 1.0, 2.0, 9.0),
+        faults=FaultPlan(
+            partitions=(
+                PartitionEvent(start_round=3, end_round=40, members=(3,)),
+            )
+        ),
+        learning_rate=0.2,
+    ),
+}
+
+
+def run_drill(name: str) -> Tuple[ChaosReport, bool]:
+    """Execute one promoted drill; returns ``(report, invariant_held)``.
+
+    The invariant mirrors the original subprocess drill's assertion —
+    rounds keep closing and the final parameters sit at the survivors'
+    (or honest) consensus, undragged by the fault/attack."""
+    scenario = DRILL_SCENARIOS[name]
+    report = ChaosHarness(scenario).run()
+    ok = report.rounds_completed > 0
+    w = report.final_params
+    if name == "two_host_psum":
+        ok &= report.rounds_completed == scenario.rounds
+        ok &= len(report.trace.of_kind("arrive")) == 2 * scenario.rounds
+    elif name == "sigkill_midround":
+        # survivors' trimmed-mean consensus: targets 1.0/2.0 -> 1.5
+        ok &= len(report.trace.of_kind("crash")) == 1
+        ok &= bool(np.allclose(w, 1.5, atol=0.05))
+    elif name == "byzantine_process":
+        # median holds within the honest targets' hull despite the 1e3
+        # outlier (mean aggregation would sit near 250)
+        ok &= float(np.max(np.abs(w))) < 3.0
+        ok &= report.influence_max < 10.0
+    else:  # heartbeat_excision
+        # the partitioned peer is out of every cohort after round 3 and
+        # the survivors converge among their own targets
+        ok &= len(report.trace.of_kind("partition")) == 1
+        ok &= bool(np.all(w <= 2.5)) and bool(np.all(w >= -0.5))
+    return report, bool(ok)
+
+
+__all__ = ["DRILL_SCENARIOS", "run_drill"]

@@ -1,0 +1,125 @@
+"""Chaos event trace: the replay/determinism contract of the harness.
+
+Every observable thing that happens in a simulated run — an arrival, a
+straggle past the window, a crash, a restart, a partition, an admission
+verdict, a round close — is appended to one :class:`EventTrace` in
+virtual-time order. The trace's :meth:`~EventTrace.digest` is a SHA-256
+over the canonical rendering of every event, so "same seed ⇒ identical
+run" is testable as a single string equality (and a grid cell's digest,
+committed in ``benchmarks/results/chaos_cpu.jsonl``, pins the cell
+against silent behavioral drift in later PRs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Dict, Iterator, List
+
+#: Canonical event kinds emitted by the harness (other layers may add
+#: their own — the trace is an open vocabulary, the digest covers all).
+KINDS = (
+    "arrive",
+    "straggle",
+    "crash",
+    "restart",
+    "partition",
+    "rejoin",
+    "submit",
+    "reject",
+    "exclude",
+    "round_close",
+)
+
+
+def array_digest(arr) -> str:
+    """8-hex-char fingerprint of an array's exact bits — round_close
+    events carry the aggregate's digest so the trace pins numeric
+    outcomes, not just the schedule."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(arr))
+    return hashlib.sha256(a.tobytes()).hexdigest()[:8]
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One simulated occurrence.
+
+    ``t`` is virtual seconds (the harness clock, not wall time);
+    ``round_id`` the server round it happened in; ``kind`` one of
+    :data:`KINDS` (or a layer-specific extension); ``who`` the client or
+    worker id (empty for round-level events); ``detail`` a short
+    canonical string (rejection reason, cohort size, …)."""
+
+    t: float
+    round_id: int
+    kind: str
+    who: str = ""
+    detail: str = ""
+
+    def canonical(self) -> str:
+        """The digest-stable rendering (time rounded to ns so replays
+        hash identically regardless of float repr churn)."""
+        return f"{self.t:.9f}|{self.round_id}|{self.kind}|{self.who}|{self.detail}"
+
+
+class EventTrace:
+    """Append-only, replayable record of one chaos run."""
+
+    def __init__(self) -> None:
+        self._events: List[ChaosEvent] = []
+
+    def emit(
+        self, t: float, round_id: int, kind: str, who: str = "", detail: str = ""
+    ) -> None:
+        """Append one event."""
+        self._events.append(ChaosEvent(float(t), int(round_id), kind, who, detail))
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def __iter__(self) -> Iterator[ChaosEvent]:
+        return iter(self._events)
+
+    def digest(self) -> str:
+        """SHA-256 over every event's canonical line — the determinism
+        contract: two runs of the same :class:`~byzpy_tpu.chaos.Scenario`
+        (same seed) must produce equal digests."""
+        h = hashlib.sha256()
+        for ev in self._events:
+            h.update(ev.canonical().encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def counts(self) -> Dict[str, int]:
+        """Events per kind (trace summary for reports/bench rows)."""
+        out: Dict[str, int] = {}
+        for ev in self._events:
+            out[ev.kind] = out.get(ev.kind, 0) + 1
+        return out
+
+    def of_kind(self, kind: str) -> List[ChaosEvent]:
+        """All events of one kind, in emission order."""
+        return [ev for ev in self._events if ev.kind == kind]
+
+    def to_jsonl(self, path: str) -> None:
+        """Write the full trace as JSONL (one event per line)."""
+        with open(path, "w") as fh:
+            for ev in self._events:
+                fh.write(
+                    json.dumps(
+                        {
+                            "t": ev.t,
+                            "round": ev.round_id,
+                            "kind": ev.kind,
+                            "who": ev.who,
+                            "detail": ev.detail,
+                        }
+                    )
+                    + "\n"
+                )
+
+
+__all__ = ["KINDS", "ChaosEvent", "EventTrace", "array_digest"]

@@ -1,0 +1,718 @@
+"""The chaos harness: one :class:`Scenario` in, one replayable run out.
+
+Virtual time, real fabric. The harness owns a deterministic virtual
+clock (rounds advance it by the scenario window) and expands the
+scenario's fault plan into per-round chaos — arrivals, straggles past
+the window, mid-round crashes, restarts, partitions/rejoins — while the
+actual *data path* of each round is the repo's production code:
+
+* ``engine="direct"`` — cohorts pad into a
+  :class:`~byzpy_tpu.serving.buckets.BucketLadder` bucket and reduce
+  through :meth:`Aggregator.aggregate_masked`, the serving tier's
+  masked-finalize door (host dispatch per round);
+* ``engine="spmd"`` — the REAL fused serving step
+  (:func:`~byzpy_tpu.parallel.ps.jit_serving_ps_step`): params,
+  optimizer state, cohort matrix + mask + staleness weights through one
+  jitted program per bucket — the single-program analogue of the fused
+  SPMD parameter server;
+* ``engine="actor"`` — the real actor-mode
+  :class:`~byzpy_tpu.engine.parameter_server.ParameterServer` over
+  in-process simulated nodes, byzantine nodes fed through the
+  :meth:`observe_round` observation channel;
+* ``engine="serving"`` — the real :class:`~byzpy_tpu.serving.ServingFrontend`
+  admission path (shape/staleness/credit/queue gates, the production
+  ``submit``) under an injected virtual clock, rounds closed through
+  :meth:`~byzpy_tpu.serving.ServingFrontend.close_round_nowait`.
+
+Adaptive attacks receive a
+:class:`~byzpy_tpu.attacks.adaptive.PublicRoundState` after every round
+(broadcast aggregate, published selection where the aggregator has one,
+each attacker's own admission verdicts) and optimize their next
+submission; the per-round displacement they buy is measured by
+``chaos.influence``. Every observable is appended to an
+:class:`~byzpy_tpu.chaos.events.EventTrace` whose digest is the
+replay/determinism contract (``tests/test_chaos_harness.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .clients import SimClient
+from .events import EventTrace, array_digest
+from .influence import attacker_influence, selection_mask
+from .scenario import Scenario, build_aggregator, build_attack
+
+
+@dataclass
+class ChaosReport:
+    """One chaos run's outcome.
+
+    ``final_error`` is ``||w - mean(honest targets)||₂`` at the end —
+    comparable across attacks within a scenario family (the bench pairs
+    each cell with its attack-free twin for the contained/breached
+    verdict). ``influences`` is the per-closed-round displacement the
+    byzantine rows bought; ``last_selected_round`` the last round a
+    byzantine row survived the aggregator's published selection (-1 =
+    never selected, or no selection published); ``verdict_counts`` the
+    admission-ack tally (serving engine). ``submissions`` holds the
+    byzantine rows actually submitted (parity tests compare them
+    bit-for-bit across engines)."""
+
+    scenario: Scenario
+    rounds_completed: int = 0
+    final_params: Optional[np.ndarray] = None
+    final_error: float = 0.0
+    influences: List[float] = field(default_factory=list)
+    last_selected_round: int = -1
+    verdict_counts: Dict[str, int] = field(default_factory=dict)
+    submissions: List[np.ndarray] = field(default_factory=list)
+    trace: EventTrace = field(default_factory=EventTrace)
+
+    @property
+    def influence_mean(self) -> float:
+        """Mean per-round byzantine displacement (0.0 with no rounds)."""
+        return float(np.mean(self.influences)) if self.influences else 0.0
+
+    @property
+    def influence_max(self) -> float:
+        """Largest single-round byzantine displacement."""
+        return float(np.max(self.influences)) if self.influences else 0.0
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON-ready cell row for the chaos grid."""
+        return {
+            "scenario": self.scenario.name,
+            "engine": self.scenario.engine,
+            "aggregator": self.scenario.aggregator,
+            "attack": self.scenario.attack.name,
+            "precision": self.scenario.precision,
+            "rounds": self.rounds_completed,
+            "final_error": round(self.final_error, 6),
+            "influence_mean": round(self.influence_mean, 6),
+            "influence_max": round(self.influence_max, 6),
+            "last_selected_round": self.last_selected_round,
+            "verdicts": dict(self.verdict_counts),
+            "events": self.trace.counts(),
+            "trace_digest": self.trace.digest(),
+        }
+
+
+class ChaosHarness:
+    """Deterministic executor for one :class:`Scenario` (module docstring)."""
+
+    def __init__(self, scenario: Scenario) -> None:
+        self.s = scenario
+        # independent, order-stable randomness: schedule (faults/timing),
+        # per-client noise, per-attack state
+        seeds = np.random.SeedSequence(scenario.seed).spawn(
+            2 + scenario.n_clients
+        )
+        self._sched_rng = np.random.default_rng(seeds[0])
+        values_rng = np.random.default_rng(seeds[1])
+        if scenario.client_values is not None:
+            values = np.asarray(scenario.client_values, np.float32)
+        else:
+            values = values_rng.normal(1.0, 0.5, scenario.n_clients).astype(
+                np.float32
+            )
+        self.clients: List[SimClient] = []
+        for i in range(scenario.n_clients):
+            byz = i >= scenario.n_honest
+            cid = f"{'byz' if byz else 'c'}{i:04d}"
+            attack = (
+                build_attack(scenario, seed=scenario.seed * 1000 + i, client_id=cid)
+                if byz
+                else None
+            )
+            self.clients.append(
+                SimClient(
+                    cid,
+                    scenario.dim,
+                    np.full((scenario.dim,), values[i], np.float32),
+                    seed=int(
+                        np.random.default_rng(seeds[2 + i]).integers(2**31)
+                    ),
+                    noise=scenario.noise,
+                    attack=attack,
+                )
+            )
+        # partition membership fixed up front: explicit members, or a
+        # deterministic draw from the schedule stream
+        self._partition_members: List[frozenset] = []
+        for part in scenario.faults.partitions:
+            if part.members is not None:
+                self._partition_members.append(
+                    frozenset(int(i) % scenario.n_clients for i in part.members)
+                )
+                continue
+            k = max(1, int(round(part.fraction * scenario.n_clients)))
+            members = self._sched_rng.choice(
+                scenario.n_clients, size=k, replace=False
+            )
+            self._partition_members.append(frozenset(int(i) for i in members))
+        self.honest_target = np.full(
+            (scenario.dim,),
+            float(np.mean(values[: scenario.n_honest])),
+            np.float32,
+        )
+
+    # -- shared chaos schedule (one round) --------------------------------
+
+    def _round_presence(
+        self, r: int, t: float, trace: EventTrace
+    ) -> List[Tuple[SimClient, int]]:
+        """Expand the fault plan for round ``r``: restarts, partitions,
+        crashes, arrival counts, straggler draws. Returns the
+        ``(client, n_submissions)`` list of clients whose submissions
+        make this round's window, emitting every event."""
+        s = self.s
+        # partition boundaries first (they gate everything below)
+        for part, members in zip(
+            s.faults.partitions, self._partition_members, strict=True
+        ):
+            for i in sorted(members):
+                c = self.clients[i]
+                if r == part.start_round and not c.partitioned:
+                    c.partitioned = True
+                    trace.emit(t, r, "partition", c.cid)
+                elif r == part.end_round and c.partitioned:
+                    c.partitioned = False
+                    trace.emit(t, r, "rejoin", c.cid)
+        present: List[Tuple[SimClient, int]] = []
+        crash = s.faults.crash
+        strag = s.faults.stragglers
+        for idx, c in enumerate(self.clients):
+            # restart due?
+            if not c.alive and crash.restart_after_rounds is not None:
+                if r - c.down_since_round >= crash.restart_after_rounds:
+                    c.alive = True
+                    trace.emit(t, r, "restart", c.cid)
+            if not c.alive or c.partitioned:
+                continue
+            # how many submissions does this client offer?
+            if s.arrivals.kind == "every_round":
+                offered = 1
+            elif s.arrivals.kind == "bernoulli":
+                offered = int(self._sched_rng.random() < s.arrivals.p)
+            else:  # poisson
+                offered = int(self._sched_rng.poisson(s.arrivals.p))
+            # mid-round crash: the in-flight submission dies with the
+            # worker (the SIGKILL drill's shape) — targeted
+            # (at_round/victims) or sampled (prob_per_round)
+            targeted = (
+                crash.at_round == r
+                and crash.victim_indices is not None
+                and idx in crash.victim_indices
+            )
+            sampled = crash.prob_per_round > 0 and (
+                self._sched_rng.random() < crash.prob_per_round
+            )
+            if targeted or sampled:
+                c.alive = False
+                c.down_since_round = r
+                trace.emit(t, r, "crash", c.cid, "midround")
+                continue
+            landed = 0
+            for _ in range(offered):
+                if strag.kind == "none":
+                    delay = 0.0
+                elif strag.kind == "lognormal":
+                    delay = float(
+                        np.exp(
+                            strag.mu
+                            + strag.sigma * self._sched_rng.standard_normal()
+                        )
+                    )
+                else:  # bimodal
+                    if self._sched_rng.random() < strag.tail_prob:
+                        delay = strag.tail_s
+                    else:
+                        delay = float(
+                            np.exp(
+                                strag.mu
+                                + strag.sigma
+                                * self._sched_rng.standard_normal()
+                            )
+                        )
+                if delay > s.window_s:
+                    trace.emit(t, r, "straggle", c.cid, f"{delay:.4f}s")
+                    continue
+                landed += 1
+                trace.emit(t + delay, r, "arrive", c.cid)
+            if landed:
+                present.append((c, landed))
+        return present
+
+    # -- submission assembly ----------------------------------------------
+
+    def _round_rows(
+        self,
+        present: List[Tuple[SimClient, int]],
+        w: np.ndarray,
+        report: ChaosReport,
+        *,
+        pace: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray, List[SimClient]]:
+        """Compute every present client's submission (honest first, in
+        client order — the canonical stack order both PS modes use).
+        Returns ``(matrix (m, d), byz_mask (m,), owners)``; multiple
+        arrivals from one client contribute one row per arrival.
+        ``pace=True`` (serving engine) honors an attack's
+        ``should_submit`` credit pacing BEFORE the row is computed, so
+        ``report.submissions`` records only rows that really went out.
+        A byzantine client whose attack needs honest context sits out a
+        round with no honest arrivals (nothing to mimic) instead of
+        killing the run."""
+        honest_rows: List[np.ndarray] = []
+        honest_owners: List[SimClient] = []
+        for c, k in present:
+            if c.byzantine:
+                continue
+            for _ in range(k):
+                honest_rows.append(c.honest_gradient(w))
+                honest_owners.append(c)
+        honest_matrix = (
+            np.stack(honest_rows)
+            if honest_rows
+            else np.zeros((0, self.s.dim), np.float32)
+        )
+        rows = list(honest_rows)
+        owners = list(honest_owners)
+        byz_flags = [False] * len(honest_rows)
+        for c, k in present:
+            if not c.byzantine:
+                continue
+            if not honest_rows and getattr(
+                c.attack, "uses_honest_grads", False
+            ):
+                continue
+            if pace and hasattr(c.attack, "should_submit") and not (
+                c.attack.should_submit()
+            ):
+                continue
+            for _ in range(k):
+                row = c.submission(w, honest_rows=honest_matrix)
+                report.submissions.append(row)
+                rows.append(row)
+                owners.append(c)
+                byz_flags.append(True)
+        matrix = (
+            np.stack(rows) if rows else np.zeros((0, self.s.dim), np.float32)
+        )
+        return matrix, np.asarray(byz_flags, bool), owners
+
+    def _apply_precision(self, matrix: np.ndarray) -> np.ndarray:
+        """Round-trip the cohort through the scenario's wire precision
+        (the PR-3 blockwise codec) — the grid's precision axis."""
+        if self.s.precision == "off" or matrix.size == 0:
+            return matrix
+        import jax.numpy as jnp
+
+        if self.s.precision == "bf16":
+            return np.asarray(
+                jnp.asarray(matrix).astype(jnp.bfloat16).astype(jnp.float32)
+            )
+        from ..parallel.quantization import (
+            dequantize_blockwise,
+            quantize_blockwise,
+        )
+
+        return np.asarray(
+            dequantize_blockwise(quantize_blockwise(jnp.asarray(matrix)))
+        )
+
+    # -- public API --------------------------------------------------------
+
+    def run(self) -> ChaosReport:
+        """Execute the scenario; returns the :class:`ChaosReport`."""
+        if self.s.engine == "actor":
+            return asyncio.run(self._run_actor())
+        if self.s.engine == "serving":
+            return self._run_serving()
+        return self._run_matrix()
+
+    # -- direct / spmd engines ---------------------------------------------
+
+    def _run_matrix(self) -> ChaosReport:
+        """The two matrix engines: pad each round's cohort into a bucket
+        and reduce through the masked program — host door (``direct``)
+        or the jitted serving step (``spmd``)."""
+        from ..serving.buckets import BucketLadder
+
+        s = self.s
+        report = ChaosReport(scenario=s)
+        ladder = BucketLadder(max(2, s.n_clients), min_bucket=2)
+        aggregator = build_aggregator(s)
+        w = np.zeros((s.dim,), np.float32)
+        step = opt_state = None
+        if s.engine == "spmd":
+            step, opt_state = self._build_spmd_step(w)
+        for r in range(s.rounds):
+            t = r * s.window_s
+            present = self._round_presence(r, t, report.trace)
+            matrix, byz_mask, owners = self._round_rows(present, w, report)
+            m = matrix.shape[0]
+            try:
+                aggregator.validate_n(m)
+                admissible = m >= 1
+            except ValueError:
+                admissible = False
+            if not admissible:
+                report.trace.emit(t, r, "round_close", "", f"held m={m}")
+                continue
+            matrix = self._apply_precision(matrix)
+            bucket = ladder.bucket_for(m)
+            padded = np.zeros((bucket, s.dim), np.float32)
+            padded[:m] = matrix
+            valid = np.zeros((bucket,), bool)
+            valid[:m] = True
+            byz = np.zeros((bucket,), bool)
+            byz[:m] = byz_mask
+            # the published aggregate goes through the masked door in
+            # BOTH engines (bit-identical programs — the observation
+            # feed must not depend on which engine closed the round);
+            # the spmd engine's params trajectory then comes from the
+            # real fused step
+            agg = np.asarray(
+                aggregator.aggregate_masked(padded, valid), np.float32
+            )
+            if s.engine == "spmd":
+                w, opt_state = self._spmd_round(
+                    step, w, opt_state, padded, valid
+                )
+            else:
+                w = (w - np.float32(s.learning_rate) * agg).astype(np.float32)
+            report.influences.append(
+                attacker_influence(aggregator, padded, valid, byz)
+            )
+            sel = selection_mask(aggregator, padded, valid)
+            accepted: Dict[str, bool] = {}
+            if sel is not None:
+                for i, owner in enumerate(owners):
+                    # a client with several rows is accepted if any survived
+                    accepted[owner.cid] = accepted.get(owner.cid, False) or bool(
+                        sel[i]
+                    )
+                if bool(sel[valid & byz].any()):
+                    report.last_selected_round = r
+                for i, owner in enumerate(owners):
+                    if byz[i] and not sel[i]:
+                        report.trace.emit(t, r, "exclude", owner.cid)
+            self._publish(report, r, agg, accepted, {})
+            report.trace.emit(
+                t + s.window_s, r, "round_close", "",
+                f"m={m} bucket={bucket} agg={array_digest(agg)}",
+            )
+            report.rounds_completed += 1
+        report.final_params = w
+        report.final_error = float(np.linalg.norm(w - self.honest_target))
+        return report
+
+    def _build_spmd_step(self, w: np.ndarray):
+        """The real fused serving step over the scenario's quadratic
+        task: plain SGD at the scenario's learning rate, so the spmd
+        engine's update arithmetic matches the direct engine's
+        ``w - lr · agg`` exactly."""
+        import optax
+
+        from ..models.bundle import ModelBundle
+        from ..parallel.ps import jit_serving_ps_step
+
+        bundle = ModelBundle(
+            apply_fn=lambda params, x: x,
+            params=np.asarray(w, np.float32),
+            loss_fn=lambda params, x, y: 0.0,
+        )
+        aggregator = build_aggregator(self.s)
+        masked = aggregator.masked_matrix_fn()
+        if masked is None:
+            raise ValueError(
+                f"engine='spmd' needs a masked aggregator program; "
+                f"{self.s.aggregator!r} has none — use engine='direct'"
+            )
+        return jit_serving_ps_step(
+            bundle,
+            masked,
+            optimizer=optax.sgd(self.s.learning_rate),
+        )
+
+    def _spmd_round(self, step, w, opt_state, padded, valid):
+        """One jitted serving-step dispatch (params + opt state in, new
+        params out; the step applies SGD internally)."""
+        import jax.numpy as jnp
+
+        weights = valid.astype(np.float32)
+        new_w, opt_state, _metrics = step(
+            jnp.asarray(w),
+            opt_state,
+            jnp.asarray(padded),
+            jnp.asarray(valid),
+            jnp.asarray(weights),
+        )
+        return np.asarray(new_w, np.float32), opt_state
+
+    def _publish(
+        self,
+        report: ChaosReport,
+        r: int,
+        agg: np.ndarray,
+        accepted: Dict[str, bool],
+        verdicts: Dict[str, str],
+    ) -> None:
+        """Deliver the round's public state to every adaptive attack."""
+        from ..attacks.adaptive import PublicRoundState
+
+        state = PublicRoundState(
+            round_id=r,
+            aggregate=np.asarray(agg, np.float32),
+            accepted=accepted,
+            verdicts=verdicts,
+            server_round=r + 1,
+        )
+        for c in self.clients:
+            if c.attack is not None and getattr(c.attack, "is_adaptive", False):
+                c.attack.observe_round(state)
+
+    # -- actor engine --------------------------------------------------------
+
+    async def _run_actor(self) -> ChaosReport:
+        """The real actor-mode :class:`ParameterServer` over in-process
+        sim nodes. Fault injection is limited to what the PS fabric
+        observes (full-round crash = the node's slot missing), since a
+        real deployment's SIGKILL drills live in
+        ``tests/test_multihost.py``; the chaos value here is the
+        adaptive observation channel riding the production round loop.
+        A scenario that ASKS for fault/arrival/precision injection is
+        rejected rather than silently run fault-free — its trace would
+        otherwise pin a run its config never describes."""
+        from ..engine.parameter_server import ParameterServer
+        from .scenario import ArrivalModel, FaultPlan
+
+        s = self.s
+        if (
+            s.faults != FaultPlan()
+            or s.arrivals != ArrivalModel()
+            or s.precision != "off"
+        ):
+            raise ValueError(
+                "engine='actor' drives the real ParameterServer round "
+                "loop, where the harness cannot inject faults, arrival "
+                "models, or wire precision — use engine='direct'/'spmd'/"
+                "'serving' for fault plans, or clear them for actor runs"
+            )
+        report = ChaosReport(scenario=s)
+        harness = self
+
+        class _HonestSimNode:
+            def __init__(self, client: SimClient) -> None:
+                self.client = client
+
+            def honest_gradient_for_next_batch(self):
+                return self.client.honest_gradient(harness._actor_w)
+
+            def apply_server_gradient(self, g):  # update handled centrally
+                pass
+
+        class _ByzSimNode:
+            def __init__(self, client: SimClient) -> None:
+                self.client = client
+
+            def byzantine_gradient_for_next_batch(self, honest_grads):
+                row = self.client.submission(
+                    harness._actor_w,
+                    honest_rows=np.stack(
+                        [np.asarray(g, np.float32) for g in honest_grads]
+                    )
+                    if honest_grads
+                    else np.zeros((0, s.dim), np.float32),
+                )
+                report.submissions.append(row)
+                return row
+
+            def apply_server_gradient(self, g):
+                pass
+
+            def observe_round(self, state):
+                if getattr(self.client.attack, "is_adaptive", False):
+                    self.client.attack.observe_round(state)
+
+        self._actor_w = np.zeros((s.dim,), np.float32)
+        ps = ParameterServer(
+            honest_nodes=[
+                _HonestSimNode(c) for c in self.clients if not c.byzantine
+            ],
+            byzantine_nodes=[
+                _ByzSimNode(c) for c in self.clients if c.byzantine
+            ],
+            aggregator=build_aggregator(s),
+        )
+        for r in range(s.rounds):
+            t = r * s.window_s
+            agg = np.asarray(await ps.round(), np.float32)
+            self._actor_w = (
+                self._actor_w - np.float32(s.learning_rate) * agg
+            ).astype(np.float32)
+            for c in self.clients:
+                report.trace.emit(t, r, "arrive", c.cid)
+            report.trace.emit(
+                t + s.window_s, r, "round_close", "",
+                f"agg={array_digest(agg)}",
+            )
+            report.rounds_completed += 1
+        report.final_params = self._actor_w
+        report.final_error = float(
+            np.linalg.norm(self._actor_w - self.honest_target)
+        )
+        return report
+
+    # -- serving engine ------------------------------------------------------
+
+    def _run_serving(self) -> ChaosReport:
+        """The real serving admission path under a virtual clock: every
+        submission goes through ``ServingFrontend.submit`` (shape,
+        staleness-cutoff, credit and queue gates — production code),
+        rounds close through ``close_round_nowait``, and each client
+        observes the public feed plus its OWN ack verdicts."""
+        from ..serving import ServingFrontend, TenantConfig
+        from ..serving.credits import CreditPolicy
+        from ..serving.staleness import StalenessPolicy
+
+        s = self.s
+        report = ChaosReport(scenario=s)
+        aggregator = build_aggregator(s)
+        self._vclock = 0.0
+        fe = ServingFrontend(
+            [
+                TenantConfig(
+                    name="chaos",
+                    aggregator=aggregator,
+                    dim=s.dim,
+                    window_s=s.window_s,
+                    cohort_cap=max(2, s.n_clients),
+                    queue_capacity=max(4, 4 * s.n_clients),
+                    credit=CreditPolicy(
+                        rate_per_s=s.credit_rate_per_s, burst=s.credit_burst
+                    ),
+                    staleness=StalenessPolicy(
+                        kind=s.staleness_kind,
+                        gamma=s.staleness_gamma,
+                        cutoff=s.staleness_cutoff,
+                    ),
+                )
+            ],
+            clock=lambda: self._vclock,
+        )
+        w = np.zeros((s.dim,), np.float32)
+        failed_seen = 0
+        for r in range(s.rounds):
+            t = r * s.window_s
+            self._vclock = t
+            present = self._round_presence(r, t, report.trace)
+            matrix, _byz_mask, owners = self._round_rows(
+                present, w, report, pace=True
+            )
+            matrix = self._apply_precision(matrix)
+            server_round = fe.round_of("chaos")
+            round_acks: Dict[str, str] = {}
+            for i, owner in enumerate(owners):
+                stamp = server_round
+                attack = owner.attack
+                if attack is not None and hasattr(attack, "next_round_stamp"):
+                    stamp = attack.next_round_stamp(server_round)
+                ok, reason = fe.submit("chaos", owner.cid, stamp, matrix[i])
+                # a client with several arrivals keeps its ACCEPTED ack:
+                # the submission that folded defines the round's outcome
+                # for the adversary (a partial rate-rejection must not
+                # mask that its row entered the aggregate)
+                if round_acks.get(owner.cid) != "accepted":
+                    round_acks[owner.cid] = reason
+                report.verdict_counts[reason] = (
+                    report.verdict_counts.get(reason, 0) + 1
+                )
+                kind = "submit" if ok else "reject"
+                report.trace.emit(t, r, kind, owner.cid, reason)
+            closed = fe.close_round_nowait("chaos")
+            if closed is None:
+                # distinguish a window legitimately held open from a
+                # crash-guarded FAILED round (submissions consumed and
+                # dropped) — a replay trace must not narrate dropped
+                # rows as still pending
+                failed_now = fe.stats()["chaos"]["failed_rounds"]
+                detail = "failed" if failed_now > failed_seen else "held"
+                failed_seen = failed_now
+                report.trace.emit(t + s.window_s, r, "round_close", "", detail)
+                continue
+            round_id, cohort, agg_vec = closed
+            agg = np.asarray(agg_vec, np.float32)
+            w = (w - np.float32(s.learning_rate) * agg).astype(np.float32)
+            byz_ids = {c.cid for c in self.clients if c.byzantine}
+            cohort_byz = np.asarray(
+                [cid in byz_ids for cid in cohort.clients], bool
+            )
+            pad = np.zeros((cohort.bucket - len(cohort.clients),), bool)
+            discounted = cohort.matrix * cohort.weights[:, None]
+            report.influences.append(
+                attacker_influence(
+                    aggregator,
+                    discounted,
+                    cohort.valid,
+                    np.concatenate([cohort_byz, pad]),
+                )
+            )
+            state = fe.public_state("chaos")
+            from ..attacks.adaptive import PublicRoundState
+
+            for c in self.clients:
+                if c.attack is not None and getattr(
+                    c.attack, "is_adaptive", False
+                ):
+                    # each client observes the shared public feed plus
+                    # its OWN admission acks — never another client's.
+                    # A client that submitted but is absent from the
+                    # published cohort KNOWS it was left out: surface
+                    # that as an explicit accepted=False — but only
+                    # when every accepted row actually folded this
+                    # round (an overflow past cohort_cap leaves
+                    # admitted rows queued for the NEXT round, and a
+                    # still-pending row is not an exclusion)
+                    accepted_acks = sum(
+                        1 for v in round_acks.values() if v == "accepted"
+                    )
+                    unambiguous = accepted_acks <= cohort.m
+                    own = (
+                        {c.cid: round_acks[c.cid]}
+                        if c.cid in round_acks
+                        else {}
+                    )
+                    accepted = dict(state.accepted)
+                    if (
+                        unambiguous
+                        and c.cid in round_acks
+                        and c.cid not in accepted
+                    ):
+                        accepted[c.cid] = False
+                    c.attack.observe_round(
+                        PublicRoundState(
+                            round_id=state.round_id,
+                            aggregate=np.asarray(agg, np.float32),
+                            accepted=accepted,
+                            verdicts=own,
+                            server_round=state.server_round,
+                        )
+                    )
+            report.trace.emit(
+                t + s.window_s, r, "round_close", "",
+                f"m={cohort.m} round={round_id} agg={array_digest(agg)}",
+            )
+            report.rounds_completed += 1
+        report.final_params = w
+        report.final_error = float(np.linalg.norm(w - self.honest_target))
+        return report
+
+
+__all__ = ["ChaosHarness", "ChaosReport"]

@@ -1,0 +1,99 @@
+"""Adversarial-influence metrics for the chaos grid.
+
+Two observables turn "did the attack work" into numbers:
+
+* :func:`attacker_influence` — the leave-the-attackers-out norm: how far
+  the round's aggregate moved because the byzantine rows were present
+  (``|| agg(all rows) - agg(honest rows) ||₂``). Zero when the
+  aggregator fully excluded/trimmed the attack; the adaptive lane's
+  headline is this metric's uplift over the static counterpart.
+* :func:`selection_mask` — for selection aggregators (Krum families,
+  CGE, MoNNA), which rows the aggregator actually kept, computed
+  host-side from the same score programs ``ops.robust`` uses. Feeds the
+  ``exclusion_round`` metric (how long a mimic stays selected) and the
+  public ``accepted`` verdicts adaptive attackers observe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def attacker_influence(
+    aggregator, matrix: np.ndarray, valid: np.ndarray, byz: np.ndarray
+) -> float:
+    """``||agg(valid rows) - agg(valid honest rows)||₂`` — the realized
+    displacement the byzantine rows bought this round.
+
+    ``matrix`` is the padded ``(n, d)`` cohort, ``valid`` the row mask,
+    ``byz`` the byzantine-row mask. Returns 0.0 when no byzantine row is
+    present, or when removing them leaves an inadmissible cohort (the
+    honest-only reference is undefined — e.g. all-byzantine)."""
+    valid = np.asarray(valid, bool)
+    byz = np.asarray(byz, bool)
+    if not bool((valid & byz).any()):
+        return 0.0
+    honest_valid = valid & ~byz
+    if not bool(honest_valid.any()):
+        return 0.0
+    try:
+        with_byz = np.asarray(aggregator.aggregate_masked(matrix, valid))
+        without = np.asarray(aggregator.aggregate_masked(matrix, honest_valid))
+    except ValueError:
+        return 0.0
+    return float(np.linalg.norm(with_byz - without))
+
+
+def selection_mask(
+    aggregator, matrix: np.ndarray, valid: np.ndarray
+) -> Optional[np.ndarray]:
+    """Which VALID rows the aggregator's selection kept, or ``None`` for
+    non-selection aggregators (means/medians use every row).
+
+    Computed host-side from the published score functions
+    (``ops.robust.krum_scores`` for the Krum families; per-row norm
+    ranking for CGE), over the compacted valid rows, then scattered back
+    to padded positions — the tie rules match the aggregation programs
+    (stable lowest-``q``/lowest-``(n-f)`` pick)."""
+    import jax.numpy as jnp
+
+    from ..aggregators import (
+        ComparativeGradientElimination,
+        MoNNA,
+        MultiKrum,
+    )
+    from ..ops import robust
+
+    valid = np.asarray(valid, bool)
+    idx = np.flatnonzero(valid)
+    m = int(idx.size)
+    if m == 0:
+        return None
+    try:
+        # an m the aggregator would reject has no defined selection —
+        # without this, the m <= f slices below go negative and
+        # fabricate a non-empty "selected" set
+        aggregator.validate_n(m)
+    except ValueError:
+        return None
+    rows = jnp.asarray(np.asarray(matrix, np.float32)[idx])
+    if isinstance(aggregator, MultiKrum):  # Krum subclasses MultiKrum (q=1)
+        scores = np.asarray(robust.krum_scores(rows, f=int(aggregator.f)))
+        keep = np.argsort(scores, kind="stable")[: int(aggregator.q)]
+    elif isinstance(aggregator, ComparativeGradientElimination):
+        norms = np.asarray(jnp.linalg.norm(rows, axis=1))
+        keep = np.argsort(norms, kind="stable")[: m - int(aggregator.f)]
+    elif isinstance(aggregator, MoNNA):
+        ref = rows[int(getattr(aggregator, "reference_index", 0)) % m]
+        d2 = np.asarray(jnp.sum((rows - ref[None, :]) ** 2, axis=1))
+        keep = np.argsort(d2, kind="stable")[: m - int(aggregator.f)]
+    else:
+        return None
+    mask = np.zeros(valid.shape, bool)
+    mask[idx[np.asarray(keep)]] = True
+    return mask
+
+
+__all__ = ["attacker_influence", "selection_mask"]

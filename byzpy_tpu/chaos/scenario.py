@@ -1,0 +1,449 @@
+"""Declarative chaos scenarios: one config, one seed, one replayable run.
+
+A :class:`Scenario` is a frozen, JSON-round-trippable description of a
+simulated deployment: how many clients, which robust aggregator, which
+attack the byzantine fraction runs, and the fault plan (straggler
+distribution, crash/restart model, partition events). The harness
+(``chaos/harness.py``) expands it into a deterministic event schedule
+from the single ``seed`` — the same config replays the same run
+bit-for-bit, which is what lets the chaos grid act as a regression wall
+(``benchmarks/chaos_bench.py``) and lets a failing cell be rerun in
+isolation from its committed config.
+
+Attack and aggregator references are registry *names* (plus a params
+mapping), not instances, so configs stay serializable; the four
+hand-written fault drills of ``tests/test_multihost.py`` are promoted to
+these configs in ``chaos/drills.py``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field, replace
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+_ARRIVAL_KINDS = ("every_round", "bernoulli", "poisson")
+_STRAGGLER_KINDS = ("none", "lognormal", "bimodal")
+_ENGINES = ("direct", "spmd", "actor", "serving")
+_PRECISIONS = ("off", "bf16", "int8")
+
+
+@dataclass(frozen=True)
+class ArrivalModel:
+    """When clients offer submissions.
+
+    ``every_round`` — each live client submits once per round (the PS
+    fabric's fixed-worker-set assumption); ``bernoulli`` — each live
+    client submits with probability ``p`` per round (serving-style
+    sparse participation); ``poisson`` — each live client offers
+    ``Poisson(p)`` submissions per round (flooding clients exist)."""
+
+    kind: str = "every_round"
+    p: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.kind not in _ARRIVAL_KINDS:
+            raise ValueError(f"arrival kind must be one of {_ARRIVAL_KINDS}")
+        if self.p < 0:
+            raise ValueError("p must be >= 0")
+
+
+@dataclass(frozen=True)
+class StragglerModel:
+    """Per-submission delay distribution (virtual seconds).
+
+    ``none`` — everything lands instantly; ``lognormal`` — delays are
+    ``exp(N(mu, sigma))``; ``bimodal`` — fast ``exp(N(mu, sigma))``
+    bulk with probability ``1 - tail_prob``, else a ``tail_s``-second
+    straggler (the skewed two-population shape the overlap bench uses).
+    A submission whose delay exceeds the round window misses the cohort
+    (event ``straggle``)."""
+
+    kind: str = "none"
+    mu: float = -4.0
+    sigma: float = 0.5
+    tail_prob: float = 0.1
+    tail_s: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.kind not in _STRAGGLER_KINDS:
+            raise ValueError(f"straggler kind must be one of {_STRAGGLER_KINDS}")
+        if not 0.0 <= self.tail_prob <= 1.0:
+            raise ValueError("tail_prob must be in [0, 1]")
+
+
+@dataclass(frozen=True)
+class CrashModel:
+    """Worker crash/restart process.
+
+    Each live client crashes with ``prob_per_round`` per round (drawn
+    from the scenario seed's schedule stream); a targeted drill instead
+    pins ``at_round`` + ``victim_indices`` (those clients crash
+    deterministically at that round). A crash is mid-round: the round's
+    in-flight submission is lost with the worker. A crashed client
+    restarts after ``restart_after_rounds`` rounds (event ``restart``),
+    or stays dead forever when ``None`` — the SIGKILL drill shape."""
+
+    prob_per_round: float = 0.0
+    restart_after_rounds: Optional[int] = None
+    at_round: Optional[int] = None
+    victim_indices: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.prob_per_round <= 1.0:
+            raise ValueError("prob_per_round must be in [0, 1]")
+        if self.restart_after_rounds is not None and self.restart_after_rounds < 1:
+            raise ValueError("restart_after_rounds must be >= 1")
+        if (self.at_round is None) != (self.victim_indices is None):
+            raise ValueError(
+                "at_round and victim_indices must be set together"
+            )
+
+
+@dataclass(frozen=True)
+class PartitionEvent:
+    """A network partition: some clients are unreachable for rounds
+    ``[start_round, end_round)``, then rejoin. Membership is either an
+    explicit ``members`` index tuple (targeted drills) or ``fraction``
+    of the population, deterministically drawn from the scenario seed."""
+
+    start_round: int
+    end_round: int
+    fraction: float = 0.25
+    members: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self) -> None:
+        if not 0 <= self.start_round < self.end_round:
+            raise ValueError("need 0 <= start_round < end_round")
+        if not 0.0 < self.fraction <= 1.0:
+            raise ValueError("fraction must be in (0, 1]")
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """The scenario's fault injection: stragglers + crashes + partitions."""
+
+    stragglers: StragglerModel = field(default_factory=StragglerModel)
+    crash: CrashModel = field(default_factory=CrashModel)
+    partitions: Tuple[PartitionEvent, ...] = ()
+
+
+@dataclass(frozen=True)
+class AttackSpec:
+    """Registry reference to the byzantine clients' attack: a
+    :data:`ATTACKS` name plus constructor params (``"none"`` = no
+    byzantine behavior even if ``n_byzantine > 0`` — crash-only
+    faults)."""
+
+    name: str = "none"
+    params: Mapping[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One replayable chaos run (see module docstring).
+
+    The simulated learning task is a quadratic: client ``i`` holds a
+    target scalar (``client_values[i]``, or drawn from ``N(1, 0.25)``)
+    and submits ``2 (w - target_i) + noise`` against the broadcast
+    parameter vector ``w`` — rich enough that robust aggregation,
+    staleness and adaptive drag all have measurable consequences, cheap
+    enough to run thousands of clients on a CPU mesh. ``engine`` picks
+    the fabric under test: ``direct`` (host masked-aggregate door),
+    ``spmd`` (jitted masked step, the fused-PS analogue), ``actor``
+    (the real actor-mode :class:`ParameterServer`), or ``serving`` (the
+    real :class:`ServingFrontend` admission path under a virtual
+    clock). ``precision`` round-trips every submission through the
+    blockwise wire codec first (the PR-3 fabric)."""
+
+    name: str
+    seed: int = 0
+    n_clients: int = 16
+    n_byzantine: int = 0
+    dim: int = 64
+    rounds: int = 20
+    aggregator: str = "trimmed_mean"
+    aggregator_params: Mapping[str, Any] = field(default_factory=dict)
+    attack: AttackSpec = field(default_factory=AttackSpec)
+    faults: FaultPlan = field(default_factory=FaultPlan)
+    arrivals: ArrivalModel = field(default_factory=ArrivalModel)
+    engine: str = "direct"
+    precision: str = "off"
+    window_s: float = 0.1
+    learning_rate: float = 0.1
+    noise: float = 0.05
+    client_values: Optional[Tuple[float, ...]] = None
+    # serving-engine knobs (ignored elsewhere)
+    staleness_kind: str = "none"
+    staleness_gamma: float = 0.5
+    staleness_cutoff: Optional[int] = None
+    credit_rate_per_s: float = 0.0
+    credit_burst: float = 20.0
+
+    def __post_init__(self) -> None:
+        if self.n_clients < 1:
+            raise ValueError("n_clients must be >= 1")
+        if not 0 <= self.n_byzantine < self.n_clients:
+            raise ValueError("need 0 <= n_byzantine < n_clients")
+        if self.rounds < 1 or self.dim < 1:
+            raise ValueError("rounds and dim must be >= 1")
+        if self.engine not in _ENGINES:
+            raise ValueError(f"engine must be one of {_ENGINES}")
+        if self.precision not in _PRECISIONS:
+            raise ValueError(f"precision must be one of {_PRECISIONS}")
+        if self.aggregator not in AGGREGATORS:
+            raise ValueError(
+                f"unknown aggregator {self.aggregator!r} "
+                f"(have {sorted(AGGREGATORS)})"
+            )
+        if self.attack.name not in ATTACKS:
+            raise ValueError(
+                f"unknown attack {self.attack.name!r} (have {sorted(ATTACKS)})"
+            )
+        if self.client_values is not None and len(self.client_values) != self.n_clients:
+            raise ValueError("client_values must have n_clients entries")
+
+    @property
+    def n_honest(self) -> int:
+        """Honest client count."""
+        return self.n_clients - self.n_byzantine
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-ready dict (inverse of :meth:`from_dict`)."""
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: Mapping[str, Any]) -> "Scenario":
+        """Rebuild a scenario from :meth:`to_dict` output (accepts plain
+        JSON: nested dicts/lists become the frozen config types)."""
+        d = dict(data)
+        if isinstance(d.get("attack"), Mapping):
+            d["attack"] = AttackSpec(**d["attack"])
+        if isinstance(d.get("arrivals"), Mapping):
+            d["arrivals"] = ArrivalModel(**d["arrivals"])
+        if isinstance(d.get("faults"), Mapping):
+            f = dict(d["faults"])
+            if isinstance(f.get("stragglers"), Mapping):
+                f["stragglers"] = StragglerModel(**f["stragglers"])
+            if isinstance(f.get("crash"), Mapping):
+                c = dict(f["crash"])
+                if c.get("victim_indices") is not None:
+                    c["victim_indices"] = tuple(
+                        int(i) for i in c["victim_indices"]
+                    )
+                f["crash"] = CrashModel(**c)
+            parts = []
+            for p in f.get("partitions", ()):
+                if isinstance(p, Mapping):
+                    p = dict(p)
+                    if p.get("members") is not None:
+                        p["members"] = tuple(int(i) for i in p["members"])
+                    p = PartitionEvent(**p)
+                parts.append(p)
+            f["partitions"] = tuple(parts)
+            d["faults"] = FaultPlan(**f)
+        if d.get("client_values") is not None:
+            d["client_values"] = tuple(float(v) for v in d["client_values"])
+        return cls(**d)
+
+    def to_json(self) -> str:
+        """Canonical JSON rendering (sorted keys)."""
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def with_(self, **kwargs: Any) -> "Scenario":
+        """A copy with fields replaced (``dataclasses.replace``) —
+        grid sweeps derive cells from one base config this way."""
+        return replace(self, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# registries (names keep Scenario serializable; instances built per run)
+# ---------------------------------------------------------------------------
+
+
+def _trimmed(p: Mapping[str, Any]):
+    from ..aggregators import CoordinateWiseTrimmedMean
+
+    return CoordinateWiseTrimmedMean(f=int(p.get("f", 1)))
+
+
+def _median(p: Mapping[str, Any]):
+    from ..aggregators import CoordinateWiseMedian
+
+    return CoordinateWiseMedian()
+
+
+def _multi_krum(p: Mapping[str, Any]):
+    from ..aggregators import MultiKrum
+
+    return MultiKrum(f=int(p.get("f", 1)), q=int(p.get("q", 3)))
+
+
+def _cge(p: Mapping[str, Any]):
+    from ..aggregators import ComparativeGradientElimination
+
+    return ComparativeGradientElimination(f=int(p.get("f", 1)))
+
+
+def _geomed(p: Mapping[str, Any]):
+    from ..aggregators import GeometricMedian
+
+    return GeometricMedian()
+
+
+def _mean_of_medians(p: Mapping[str, Any]):
+    from ..aggregators import MeanOfMedians
+
+    return MeanOfMedians(f=int(p.get("f", 1)))
+
+
+def _mda(p: Mapping[str, Any]):
+    from ..aggregators import MinimumDiameterAveraging
+
+    return MinimumDiameterAveraging(f=int(p.get("f", 1)))
+
+
+#: Aggregator registry: scenario name -> builder(params) -> Aggregator.
+AGGREGATORS = {
+    "trimmed_mean": _trimmed,
+    "median": _median,
+    "multi_krum": _multi_krum,
+    "cge": _cge,
+    "geometric_median": _geomed,
+    "mean_of_medians": _mean_of_medians,
+    "mda": _mda,
+}
+
+
+def build_aggregator(scenario: Scenario):
+    """Instantiate the scenario's aggregator from the registry."""
+    return AGGREGATORS[scenario.aggregator](scenario.aggregator_params)
+
+
+def _a_none(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    return None
+
+
+def _a_sign_flip(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    # the REAL attack class, reference sign convention (scale < 0 flips)
+    from ..attacks import SignFlipAttack
+
+    return SignFlipAttack(scale=float(p.get("scale", -4.0)))
+
+
+def _a_empire(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    from ..attacks import EmpireAttack
+
+    return EmpireAttack(scale=float(p.get("scale", -1.1)))
+
+
+def _a_little(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    from .clients import StaticVectorAttack
+
+    return StaticVectorAttack(
+        dim, mode="little", scale=float(p.get("scale", 1.0))
+    )
+
+
+def _a_outlier(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    from .clients import StaticVectorAttack
+
+    return StaticVectorAttack(
+        dim, mode="outlier", scale=float(p.get("scale", 1e3))
+    )
+
+
+def _a_influence(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    from ..attacks.adaptive import InfluenceAscentAttack
+
+    return InfluenceAscentAttack(
+        dim,
+        scale0=float(p.get("scale0", 0.05)),
+        grow=float(p.get("grow", 1.6)),
+        shrink=float(p.get("shrink", 0.5)),
+        seed=seed,
+        client_id=client_id,
+    )
+
+
+def _a_krum_evasion(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    from ..attacks.adaptive import KrumEvasionAttack
+
+    return KrumEvasionAttack(
+        dim,
+        eps0=float(p.get("eps0", 0.01)),
+        grow=float(p.get("grow", 1.5)),
+        shrink=float(p.get("shrink", 0.25)),
+        seed=seed,
+        client_id=client_id,
+    )
+
+
+def _a_staleness(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
+    from ..attacks.adaptive import StalenessAbuseAttack
+    from ..serving.staleness import StalenessPolicy
+
+    cutoff = p.get("cutoff", 4)
+    return StalenessAbuseAttack(
+        dim,
+        staleness=StalenessPolicy(
+            kind=str(p.get("kind", "exponential")),
+            gamma=float(p.get("gamma", 0.5)),
+            cutoff=None if cutoff is None else int(cutoff),
+        ),
+        scale=float(p.get("scale", 1.0)),
+        seed=seed,
+        client_id=client_id,
+    )
+
+
+#: Attack registry: spec name -> builder(dim, params, seed, client_id).
+ATTACKS = {
+    "none": _a_none,
+    "sign_flip": _a_sign_flip,
+    "empire": _a_empire,
+    "little": _a_little,
+    "outlier": _a_outlier,
+    "influence_ascent": _a_influence,
+    "krum_evasion": _a_krum_evasion,
+    "staleness_abuse": _a_staleness,
+}
+
+
+def build_attack(scenario: Scenario, *, seed: int, client_id: str):
+    """Instantiate ONE byzantine client's attack from the registry
+    (``None`` for spec ``"none"``). Adaptive attacks get a per-client
+    seed so replicas don't emit identical noise.
+
+    ``staleness_abuse`` defaults its assumed policy to the SCENARIO's
+    own ``staleness_*`` fields (params still override): the attack's
+    whole premise is cancelling the tier's published discount, so the
+    two configs must agree unless a cell deliberately mis-informs the
+    attacker. With the scenario default (``kind='none'``) the attack
+    correctly degenerates to fresh, uninflated submissions — nothing
+    to abuse."""
+    params = scenario.attack.params
+    if scenario.attack.name == "staleness_abuse":
+        merged = dict(params)
+        merged.setdefault("kind", scenario.staleness_kind)
+        merged.setdefault("gamma", scenario.staleness_gamma)
+        merged.setdefault("cutoff", scenario.staleness_cutoff)
+        params = merged
+    return ATTACKS[scenario.attack.name](
+        scenario.dim, params, seed, client_id
+    )
+
+
+__all__ = [
+    "AGGREGATORS",
+    "ATTACKS",
+    "ArrivalModel",
+    "AttackSpec",
+    "CrashModel",
+    "FaultPlan",
+    "PartitionEvent",
+    "Scenario",
+    "StragglerModel",
+    "build_aggregator",
+    "build_attack",
+]

@@ -261,6 +261,43 @@ class ParameterServer:
                 return unravel(self.aggregator.matrix_fn()(sharded))
         return self.aggregator.aggregate(gradients)
 
+    # -- adaptive-adversary observation channel -------------------------------
+
+    def _adaptive_observers(self) -> List[Any]:
+        """Byzantine nodes subscribed to the public round feed: LOCAL
+        node objects whose class defines ``observe_round`` (the
+        :meth:`~byzpy_tpu.attacks.base.Attack.observe_round` channel).
+        Actor handles are excluded on purpose — a
+        :class:`~byzpy_tpu.engine.node.actors.NodeActor` fabricates any
+        attribute as an RPC, so a ``getattr`` probe would "find" the
+        method on every remote node and fail the round calling it."""
+        return [
+            node
+            for node in self.byzantine_nodes
+            if callable(getattr(type(node), "observe_round", None))
+        ]
+
+    def _publish_public_state(self, aggregated: Any) -> None:
+        """Feed the closed round's PUBLIC outcome to adaptive byzantine
+        nodes — exactly what any client of the fabric observes (the
+        broadcast aggregate and the round counter; the actor-mode PS
+        publishes no per-client selection), so an adaptive attack's
+        state transition is identical here and in the fused-SPMD/chaos
+        engines given the same aggregates (the parity contract of
+        ``tests/test_chaos_adaptive.py``)."""
+        observers = self._adaptive_observers()
+        if not observers:
+            return
+        from ...attacks.adaptive import PublicRoundState
+
+        state = PublicRoundState(
+            round_id=self.rounds_completed,
+            aggregate=aggregated,
+            server_round=self.rounds_completed + 1,
+        )
+        for node in observers:
+            node.observe_round(state)
+
     # -- elastic round pieces -------------------------------------------------
 
     def _rotation(self, role: str, nodes: Sequence[Any], external: set):
@@ -346,6 +383,7 @@ class ParameterServer:
             policy=policy, state=state, round_no=rnd,
         )
         aggregated = await self._aggregate(honest + [g for _, g in byz_pairs])
+        self._publish_public_state(aggregated)
         # fan-out is best-effort: a node that cannot take the update is
         # suspected like any other failure, but the round's result stands.
         # Internal AND external suspects are excluded — delivering the
@@ -465,6 +503,7 @@ class ParameterServer:
                 t_consume - t for t in arrivals.values()
             )
             aggregated = await self._aggregate(honest + byz)
+        self._publish_public_state(aggregated)
         if self._prefetch_depth() > 0:
             self._pending_honest = [
                 asyncio.ensure_future(
@@ -521,6 +560,7 @@ class ParameterServer:
         honest = await self._stream_honest()
         byz = await self._stream_byzantine(honest)
         aggregated = await self._aggregate(honest + byz)
+        self._publish_public_state(aggregated)
         await _gather_all(
             _invoke(node, "apply_server_gradient", aggregated)
             for node in self.honest_nodes + self.byzantine_nodes

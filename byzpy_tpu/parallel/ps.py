@@ -491,6 +491,39 @@ def build_serving_ps_step(
     return step, opt.init(bundle.params)
 
 
+def adaptive_attack_rows(
+    attack: Any, n_byz: int, *, honest: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """Host-side bridge from the stateful adaptive-attack API to the
+    fused SPMD round.
+
+    A static :data:`AttackFn` is traced INTO ``build_ps_train_step``'s
+    program; an adaptive attack (``attacks.adaptive``) cannot be — its
+    ``observe_round`` mutates Python state between rounds, which has no
+    trace-time meaning (exactly the hazard class byzlint's
+    TRACE-DISPATCH rule exists for). The fused-fabric pattern is
+    therefore: compute the byzantine rows OUTSIDE the step with this
+    helper, then pass them in as data (a ``(n_byz, d)`` array argument
+    replacing the traced attack), and feed the step's broadcast output
+    back through ``attack.observe_round``. The chaos harness's ``spmd``
+    engine and ``tests/test_chaos_adaptive.py`` use this to pin
+    actor-mode vs fused-SPMD attacker parity.
+
+    ``honest`` (optional ``(h, d)`` matrix) is forwarded to attacks that
+    declare ``uses_honest_grads``; public-feed-only adaptive attacks
+    ignore it.
+    """
+    if n_byz < 1:
+        raise ValueError(f"n_byz must be >= 1 (got {n_byz})")
+    kwargs: dict = {}
+    if getattr(attack, "uses_honest_grads", False):
+        if honest is None:
+            raise ValueError(f"{attack.name} needs the honest matrix")
+        kwargs["honest_grads"] = list(honest)
+    row = jnp.asarray(attack.apply(**kwargs))
+    return jnp.tile(row[None, :], (n_byz, 1))
+
+
 def jit_serving_ps_step(
     bundle: ModelBundle,
     masked_aggregate: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
@@ -529,6 +562,7 @@ def jit_ps_train_step(
 __all__ = [
     "PSStepConfig",
     "ShardedUpdateConfig",
+    "adaptive_attack_rows",
     "as_sharded_update",
     "default_optimizer",
     "build_ps_train_step",

@@ -109,6 +109,7 @@ class _Tenant:
         "cfg", "queue", "ledger", "ladder", "executor", "stats",
         "round_id", "ingress_bytes", "last_aggregate", "min_cohort",
         "outstanding", "round_done", "failed_rounds",
+        "last_cohort_clients", "held",
     )
 
     def __init__(self, cfg: TenantConfig) -> None:
@@ -147,6 +148,13 @@ class _Tenant:
         self.round_done = asyncio.Event()
         #: rounds dropped by the crash guard (inadmissible cohort, OOM…)
         self.failed_rounds = 0
+        #: the most recent closed round's cohort membership — the public
+        #: acceptance record adaptive clients may observe
+        self.last_cohort_clients: Tuple[str, ...] = ()
+        #: under-strength submissions held open by the SYNCHRONOUS round
+        #: closer (:meth:`ServingFrontend.close_round_nowait`); the async
+        #: scheduler keeps its own held list
+        self.held: list = []
 
 
 class ServingFrontend:
@@ -308,9 +316,45 @@ class ServingFrontend:
             await self._server.wait_closed()
             self._server = None
 
+    def _fail_round(self, t: _Tenant, cohort: Cohort) -> None:
+        """Round-drop bookkeeping shared by both round closers: a
+        poisoned cohort counts a ``failed_round`` and releases its
+        outstanding rows — never silent, never fatal."""
+        t.failed_rounds += 1
+        t.outstanding -= cohort.m
+        t.round_done.set()
+
+    def _finish_round(self, t: _Tenant, cohort: Cohort, vec: Any) -> int:
+        """Round-close bookkeeping shared by the async scheduler and
+        :meth:`close_round_nowait` (ONE copy, so the async and
+        virtual-time paths cannot drift): publish the aggregate and
+        cohort membership, record telemetry, advance the round counter,
+        release outstanding rows, fire the (crash-guarded) observer.
+        Returns the closed round id."""
+        t.last_aggregate = vec
+        t.last_cohort_clients = cohort.clients
+        t.stats.record(self._clock() - cohort.first_arrival_s, cohort.m)
+        closed = t.round_id
+        t.round_id += 1
+        t.outstanding -= cohort.m
+        t.round_done.set()
+        if self._on_round is not None:
+            try:
+                self._on_round(t.cfg.name, closed, cohort, vec)
+            except Exception:  # noqa: BLE001 — an observer bug must
+                # not kill the scheduler any more than a poisoned
+                # cohort may; counted, never silent
+                self.callback_errors += 1
+        return closed
+
     async def _tenant_loop(self, t: _Tenant) -> None:
         loop = asyncio.get_running_loop()
-        held: list = []
+        # adopt anything a prior synchronous round closer parked in
+        # t.held (sequential sync -> async handover): those rows were
+        # admitted and count in `outstanding`, so abandoning them would
+        # lose submissions and deadlock drain()
+        held: list = list(t.held)
+        t.held.clear()
         while self._running:
             more = await t.queue.collect(
                 t.cfg.cohort_cap - len(held), t.cfg.window_s
@@ -336,24 +380,9 @@ class ServingFrontend:
                     )
             except Exception:  # noqa: BLE001 — a poisoned cohort must
                 # never kill the scheduler: drop the round, keep serving
-                t.failed_rounds += 1
-                t.outstanding -= cohort.m
-                t.round_done.set()
+                self._fail_round(t, cohort)
                 continue
-            t.last_aggregate = vec
-            t.stats.record(
-                self._clock() - cohort.first_arrival_s, cohort.m
-            )
-            t.round_id += 1
-            t.outstanding -= cohort.m
-            t.round_done.set()
-            if self._on_round is not None:
-                try:
-                    self._on_round(t.cfg.name, t.round_id - 1, cohort, vec)
-                except Exception:  # noqa: BLE001 — an observer bug must
-                    # not kill the scheduler any more than a poisoned
-                    # cohort may; counted, never silent
-                    self.callback_errors += 1
+            self._finish_round(t, cohort, vec)
 
     async def drain(self, tenant: str) -> int:
         """Wait until every ADMISSIBLE submission of ``tenant`` has been
@@ -372,6 +401,69 @@ class ServingFrontend:
             t.round_done.clear()
             await t.round_done.wait()
         return t.round_id
+
+    # -- virtual-time round closing (chaos harness) ----------------------
+
+    def close_round_nowait(self, tenant: str) -> Optional[Tuple[int, Any, Any]]:
+        """Synchronously close one round of ``tenant`` from whatever is
+        queued — the virtual-clock twin of the async scheduler, used by
+        the chaos harness (``byzpy_tpu.chaos``) to replay the REAL
+        admission + cohort + masked-aggregate path deterministically.
+
+        Drains the admission queue into the tenant's held list; when the
+        held cohort reaches the ``min_cohort`` floor, builds the padded
+        cohort, aggregates it (crash-guarded exactly like the scheduler:
+        a poisoned cohort counts a ``failed_round`` and is dropped), and
+        advances the round counter. Returns ``(closed_round_id, cohort,
+        aggregate)``, or ``None`` while the window stays open (or the
+        round failed). One round closer per deployment: mixing with the
+        async scheduler would split submissions across two held lists
+        and double-drive the round counter, so a running scheduler is a
+        checked error."""
+        if self._tasks:
+            raise RuntimeError(
+                "close_round_nowait cannot run next to the async cohort "
+                "scheduler (start() was called) — use one round closer"
+            )
+        t = self._tenants[tenant]
+        t.held.extend(t.queue.drain_nowait(t.cfg.cohort_cap - len(t.held)))
+        if len(t.held) < t.min_cohort:
+            return None
+        subs, t.held = t.held, []
+        cohort = build_cohort(subs, t.round_id, t.ladder, t.cfg.staleness)
+        try:
+            vec = t.executor.aggregate(cohort)
+        except Exception:  # noqa: BLE001 — same contract as the scheduler
+            self._fail_round(t, cohort)
+            return None
+        return self._finish_round(t, cohort, vec), cohort, vec
+
+    def public_state(self, tenant: str) -> Any:
+        """The tenant's public per-round feed, as any client —
+        including an adaptive adversary — legitimately sees it: the
+        broadcast aggregate, the round counter, and the last closed
+        round's cohort membership (acceptance record). Per-client
+        admission verdicts are NOT included: each client only ever
+        learns its own ack reasons (returns a
+        :class:`~byzpy_tpu.attacks.adaptive.PublicRoundState` with
+        empty ``verdicts``; callers merge their own acks). Raises
+        ``ValueError`` before the first round has closed — there is no
+        broadcast yet for anyone to observe."""
+        from ..attacks.adaptive import PublicRoundState
+
+        t = self._tenants[tenant]
+        if t.last_aggregate is None:
+            raise ValueError(
+                f"tenant {tenant!r} has not closed a round yet — "
+                "there is no public state to observe"
+            )
+        return PublicRoundState(
+            round_id=t.round_id - 1,
+            aggregate=t.last_aggregate,
+            accepted={cid: True for cid in t.last_cohort_clients},
+            verdicts={},
+            server_round=t.round_id,
+        )
 
     # -- wire transport --------------------------------------------------
 

@@ -65,6 +65,19 @@ class AdmissionQueue:
             self.depth_high_water = depth
         return True
 
+    def drain_nowait(self, max_items: int) -> List[Submission]:
+        """Synchronously pop up to ``max_items`` queued submissions
+        (possibly none) without touching the event loop — the chaos
+        harness's virtual-time round closer, which replays the admission
+        path deterministically and cannot block on a real clock."""
+        batch: List[Submission] = []
+        while len(batch) < max_items:
+            try:
+                batch.append(self._queue.get_nowait())
+            except asyncio.QueueEmpty:
+                break
+        return batch
+
     async def collect(
         self, max_items: int, window_s: float
     ) -> List[Submission]:

@@ -1,0 +1,261 @@
+"""Adaptive-adversary API: state transitions, replay purity, and
+actor-mode vs fused-SPMD parity.
+
+The satellite contract: an adaptive attack is a pure function of its
+constructor arguments and observation sequence — SAME public
+observations in, SAME adversarial submissions out, no matter which
+fabric (actor-mode PS, fused-SPMD serving step, direct masked door)
+produced the observations."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.attacks import (
+    InfluenceAscentAttack,
+    KrumEvasionAttack,
+    PublicRoundState,
+    StalenessAbuseAttack,
+)
+from byzpy_tpu.chaos import AttackSpec, ChaosHarness, Scenario
+from byzpy_tpu.serving.staleness import StalenessPolicy
+
+DIM = 16
+
+
+def _state(r, agg, accepted=None, verdicts=None):
+    return PublicRoundState(
+        round_id=r,
+        aggregate=np.asarray(agg, np.float32),
+        accepted=accepted or {},
+        verdicts=verdicts or {},
+        server_round=r + 1,
+    )
+
+
+class TestInfluenceAscent:
+    def test_scale_grows_while_influence_rises(self):
+        atk = InfluenceAscentAttack(DIM, scale0=0.1, grow=2.0, shrink=0.5)
+        s0 = float(atk.scale)
+        atk.observe_round(_state(0, np.ones(DIM)))       # first obs: grow
+        atk.observe_round(_state(1, 2.0 * np.ones(DIM)))  # improved: grow
+        assert float(atk.scale) == pytest.approx(s0 * 4.0)
+
+    def test_scale_backs_off_when_influence_drops(self):
+        atk = InfluenceAscentAttack(DIM, scale0=0.1, grow=2.0, shrink=0.5)
+        atk.observe_round(_state(0, np.ones(DIM)))
+        atk.observe_round(_state(1, np.zeros(DIM)))  # regressed: shrink
+        assert float(atk.scale) == pytest.approx(0.1 * 2.0 * 0.5)
+
+    def test_submission_tracks_public_estimate(self):
+        atk = InfluenceAscentAttack(DIM, scale0=0.5)
+        first = atk.apply()
+        np.testing.assert_allclose(first, 0.5 / np.sqrt(DIM), rtol=1e-5)
+        atk.observe_round(_state(0, 3.0 * np.ones(DIM)))
+        second = atk.apply()
+        assert float(second.mean()) > 3.0  # estimate + push
+
+
+class TestKrumEvasion:
+    def test_bias_shrinks_on_exclusion_grows_on_selection(self):
+        atk = KrumEvasionAttack(
+            DIM, eps0=0.1, grow=2.0, shrink=0.25, client_id="byz"
+        )
+        atk.observe_round(_state(0, np.zeros(DIM), accepted={"byz": True}))
+        assert float(atk.eps) == pytest.approx(0.2)
+        atk.observe_round(_state(1, np.zeros(DIM), accepted={"byz": False}))
+        assert float(atk.eps) == pytest.approx(0.05)
+
+    def test_mimics_published_consensus(self):
+        atk = KrumEvasionAttack(DIM, eps0=1e-4)
+        agg = np.arange(DIM, dtype=np.float32)
+        atk.observe_round(_state(0, agg))
+        np.testing.assert_allclose(atk.apply(), agg, atol=1e-3)
+
+
+class TestStalenessAbuse:
+    def test_stamps_cutoff_and_cancels_discount(self):
+        pol = StalenessPolicy(kind="exponential", gamma=0.5, cutoff=4)
+        atk = StalenessAbuseAttack(DIM, staleness=pol, scale=1.0)
+        # before the cutoff is reachable, the claimed δ tracks the
+        # server round (a round-2 server can't take a round −2 gradient)
+        assert atk.delta == 0 and float(atk.inflation) == 1.0
+        atk.observe_round(
+            PublicRoundState(
+                round_id=1, aggregate=np.zeros(DIM), server_round=2
+            )
+        )
+        assert atk.delta == 2 and float(atk.inflation) == pytest.approx(4.0)
+        atk.observe_round(
+            PublicRoundState(
+                round_id=9, aggregate=np.zeros(DIM), server_round=10
+            )
+        )
+        assert atk.delta == 4  # capped at the cutoff
+        assert atk.next_round_stamp(10) == 6
+        assert atk.next_round_stamp(2) == 0  # clamped at round 0
+        assert float(atk.inflation) == pytest.approx(16.0)
+        # inflation * discount(claimed δ) == 1: the fold-time cancellation
+        assert float(atk.inflation) * pol.discount(4) == pytest.approx(1.0)
+
+    def test_no_cutoff_means_fresh_submissions(self):
+        atk = StalenessAbuseAttack(DIM, staleness=StalenessPolicy())
+        assert atk.delta == 0 and float(atk.inflation) == 1.0
+
+    def test_backs_off_after_rejection_verdict(self):
+        atk = StalenessAbuseAttack(
+            DIM,
+            staleness=StalenessPolicy(kind="exponential", cutoff=2),
+            backoff_rounds=2,
+            client_id="byz",
+        )
+        assert atk.should_submit()
+        atk.observe_round(
+            _state(0, np.zeros(DIM), verdicts={"byz": "rejected_rate"})
+        )
+        assert not atk.should_submit()
+        atk.observe_round(
+            _state(1, np.zeros(DIM), verdicts={"byz": "accepted"})
+        )
+        atk.observe_round(
+            _state(2, np.zeros(DIM), verdicts={"byz": "accepted"})
+        )
+        assert atk.should_submit()
+
+
+class TestReplayPurity:
+    """Same observation sequence ⇒ same submission sequence, bit for bit."""
+
+    @pytest.mark.parametrize(
+        "make",
+        [
+            lambda: InfluenceAscentAttack(DIM, seed=7, client_id="b"),
+            lambda: KrumEvasionAttack(DIM, seed=7, client_id="b"),
+            lambda: StalenessAbuseAttack(
+                DIM,
+                staleness=StalenessPolicy(kind="exponential", cutoff=3),
+                seed=7,
+                client_id="b",
+            ),
+        ],
+    )
+    def test_replay_reproduces_submissions(self, make):
+        rng = np.random.default_rng(0)
+        observations = [
+            _state(
+                r,
+                rng.normal(size=DIM).astype(np.float32),
+                accepted={"b": bool(r % 2)},
+                verdicts={"b": "accepted" if r % 3 else "rejected_rate"},
+            )
+            for r in range(8)
+        ]
+        live, replay = make(), make()
+        live_subs = []
+        for obs in observations:
+            live_subs.append(live.apply())
+            live.observe_round(obs)
+        for obs, expected in zip(observations, live_subs, strict=True):
+            assert np.array_equal(replay.apply(), expected)
+            replay.observe_round(obs)
+
+
+class TestCrossFabricParity:
+    """Actor-mode PS, fused-SPMD serving step, and the direct masked
+    door produce the SAME observation feed on the same scenario, hence
+    the SAME adversarial submissions — the PR's parity satellite."""
+
+    def _scenario(self, engine):
+        return Scenario(
+            name=f"parity-{engine}",
+            seed=17,
+            n_clients=8,
+            n_byzantine=2,
+            dim=DIM,
+            rounds=6,
+            aggregator="trimmed_mean",
+            aggregator_params={"f": 2},
+            attack=AttackSpec(name="influence_ascent"),
+            noise=0.0,
+            engine=engine,
+        )
+
+    def test_actor_vs_spmd_submissions_identical(self):
+        ra = ChaosHarness(self._scenario("actor")).run()
+        rs = ChaosHarness(self._scenario("spmd")).run()
+        assert len(ra.submissions) == len(rs.submissions) > 0
+        for a, b in zip(ra.submissions, rs.submissions, strict=True):
+            assert np.array_equal(a, b)
+
+    def test_actor_vs_direct_submissions_identical(self):
+        ra = ChaosHarness(self._scenario("actor")).run()
+        rd = ChaosHarness(self._scenario("direct")).run()
+        for a, b in zip(ra.submissions, rd.submissions, strict=True):
+            assert np.array_equal(a, b)
+
+
+class TestObservationChannel:
+    def test_parameter_server_publishes_to_adaptive_nodes(self):
+        """The actor-mode PS feeds observe_round on local byzantine
+        nodes after every round — the production observation channel."""
+        from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+        from byzpy_tpu.engine.parameter_server import ParameterServer
+
+        attack = InfluenceAscentAttack(4, client_id="byz")
+
+        class Honest:
+            def honest_gradient_for_next_batch(self):
+                return np.ones(4, np.float32)
+
+            def apply_server_gradient(self, g):
+                pass
+
+        class Byz:
+            def byzantine_gradient_for_next_batch(self, honest):
+                return attack.apply()
+
+            def apply_server_gradient(self, g):
+                pass
+
+            def observe_round(self, state):
+                attack.observe_round(state)
+
+        ps = ParameterServer(
+            honest_nodes=[Honest(), Honest(), Honest()],
+            byzantine_nodes=[Byz()],
+            aggregator=CoordinateWiseTrimmedMean(f=1),
+        )
+
+        async def drive():
+            for _ in range(3):
+                await ps.round()
+
+        asyncio.run(drive())
+        assert len(attack.observations) == 3
+        assert [o.round_id for o in attack.observations] == [0, 1, 2]
+
+    def test_static_attack_observe_round_is_noop(self):
+        from byzpy_tpu.attacks import SignFlipAttack
+
+        atk = SignFlipAttack()
+        atk.observe_round(_state(0, np.zeros(4)))  # must not raise
+
+
+class TestAdaptiveAttackRowsBridge:
+    def test_tiles_rows_for_fused_step(self):
+        from byzpy_tpu.parallel.ps import adaptive_attack_rows
+
+        atk = InfluenceAscentAttack(DIM, scale0=0.25)
+        rows = np.asarray(adaptive_attack_rows(atk, 3))
+        assert rows.shape == (3, DIM)
+        assert np.array_equal(rows[0], rows[2])
+
+    def test_rejects_bad_counts_and_missing_context(self):
+        from byzpy_tpu.attacks import EmpireAttack
+        from byzpy_tpu.parallel.ps import adaptive_attack_rows
+
+        with pytest.raises(ValueError):
+            adaptive_attack_rows(InfluenceAscentAttack(DIM), 0)
+        with pytest.raises(ValueError, match="honest"):
+            adaptive_attack_rows(EmpireAttack(scale=-1.1), 2)

@@ -1,0 +1,52 @@
+"""The promoted fault drills: declarative scenarios reproduce the
+``tests/test_multihost.py`` invariants in-process.
+
+The subprocess originals stay as regression pins (nothing simulates a
+real SIGKILL); these runs prove the *fault semantics* are captured in
+replayable configs the chaos grid can sweep."""
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.chaos import DRILL_SCENARIOS, run_drill
+from byzpy_tpu.chaos.scenario import Scenario
+
+
+def test_all_four_drills_present():
+    assert set(DRILL_SCENARIOS) == {
+        "two_host_psum",
+        "sigkill_midround",
+        "byzantine_process",
+        "heartbeat_excision",
+    }
+
+
+@pytest.mark.parametrize("name", sorted(DRILL_SCENARIOS))
+def test_drill_invariant_holds(name):
+    report, ok = run_drill(name)
+    assert ok, report.summary()
+
+
+def test_drills_are_replayable_configs():
+    for name, scenario in DRILL_SCENARIOS.items():
+        rebuilt = Scenario.from_dict(scenario.to_dict())
+        assert rebuilt == scenario, name
+
+
+def test_sigkill_drill_matches_original_consensus():
+    """The original drill pins the survivors' trimmed mean at 1.5
+    (targets 1.0/2.0 once the 9.0 host is dead) — the simulated twin
+    converges to the same consensus."""
+    report, ok = run_drill("sigkill_midround")
+    assert ok
+    np.testing.assert_allclose(report.final_params, 1.5, atol=0.05)
+
+
+def test_heartbeat_drill_excludes_victim_from_cohorts():
+    report, ok = run_drill("heartbeat_excision")
+    assert ok
+    victim = "c0003"
+    assert any(e.who == victim for e in report.trace.of_kind("partition"))
+    for e in report.trace.of_kind("arrive"):
+        if e.who == victim:
+            assert e.round_id < 3

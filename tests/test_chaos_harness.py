@@ -1,0 +1,241 @@
+"""Chaos harness: determinism, fault injection, and engine contracts.
+
+The headline contract is REPLAY: a scenario is one seed, and one seed
+is one run — same event trace (digest equality), same adversarial
+submissions, same verdicts. Everything the chaos grid pins in
+``benchmarks/results/chaos_cpu.jsonl`` rests on this."""
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.chaos import (
+    ArrivalModel,
+    AttackSpec,
+    ChaosHarness,
+    CrashModel,
+    FaultPlan,
+    PartitionEvent,
+    Scenario,
+    StragglerModel,
+)
+
+
+def _scenario(**kwargs) -> Scenario:
+    base = dict(
+        name="t",
+        seed=123,
+        n_clients=8,
+        n_byzantine=2,
+        dim=16,
+        rounds=6,
+        aggregator="trimmed_mean",
+        aggregator_params={"f": 2},
+        attack=AttackSpec(name="influence_ascent"),
+    )
+    base.update(kwargs)
+    return Scenario(**base)
+
+
+def _run(s: Scenario):
+    return ChaosHarness(s).run()
+
+
+class TestDeterminism:
+    def test_same_seed_same_trace_and_submissions(self):
+        s = _scenario(
+            faults=FaultPlan(
+                stragglers=StragglerModel(kind="bimodal", tail_prob=0.3),
+                crash=CrashModel(prob_per_round=0.1, restart_after_rounds=2),
+                partitions=(PartitionEvent(start_round=2, end_round=4),),
+            ),
+            arrivals=ArrivalModel(kind="bernoulli", p=0.9),
+        )
+        r1, r2 = _run(s), _run(s)
+        assert r1.trace.digest() == r2.trace.digest()
+        assert len(r1.submissions) == len(r2.submissions) > 0
+        for a, b in zip(r1.submissions, r2.submissions, strict=True):
+            assert np.array_equal(a, b)
+        assert r1.summary() == r2.summary()
+
+    def test_different_seed_different_trace(self):
+        s = _scenario(noise=0.1)
+        assert (
+            _run(s).trace.digest()
+            != _run(s.with_(seed=124)).trace.digest()
+        )
+
+    def test_serving_engine_deterministic(self):
+        s = _scenario(
+            engine="serving",
+            attack=AttackSpec(name="staleness_abuse", params={"cutoff": 3}),
+            staleness_kind="exponential",
+            staleness_cutoff=3,
+        )
+        r1, r2 = _run(s), _run(s)
+        assert r1.trace.digest() == r2.trace.digest()
+        assert r1.verdict_counts == r2.verdict_counts
+
+
+class TestFaultInjection:
+    def test_targeted_crash_removes_client(self):
+        s = _scenario(
+            n_byzantine=0,
+            attack=AttackSpec(name="none"),
+            faults=FaultPlan(
+                crash=CrashModel(at_round=1, victim_indices=(0,))
+            ),
+            noise=0.0,
+        )
+        r = _run(s)
+        crashes = r.trace.of_kind("crash")
+        assert [e.who for e in crashes] == ["c0000"]
+        # after the crash the victim never arrives again
+        late_arrivals = [
+            e for e in r.trace.of_kind("arrive")
+            if e.round_id > 1 and e.who == "c0000"
+        ]
+        assert late_arrivals == []
+
+    def test_crash_restart_cycle(self):
+        s = _scenario(
+            n_byzantine=0,
+            attack=AttackSpec(name="none"),
+            rounds=10,
+            faults=FaultPlan(
+                crash=CrashModel(
+                    at_round=1, victim_indices=(2,), restart_after_rounds=3
+                )
+            ),
+        )
+        r = _run(s)
+        restarts = r.trace.of_kind("restart")
+        assert [e.who for e in restarts] == ["c0002"]
+        assert restarts[0].round_id == 4
+        assert any(
+            e.who == "c0002" and e.round_id >= 4
+            for e in r.trace.of_kind("arrive")
+        )
+
+    def test_partition_and_rejoin(self):
+        s = _scenario(
+            n_byzantine=0,
+            attack=AttackSpec(name="none"),
+            rounds=8,
+            faults=FaultPlan(
+                partitions=(
+                    PartitionEvent(start_round=2, end_round=5, members=(1, 3)),
+                )
+            ),
+        )
+        r = _run(s)
+        assert {e.who for e in r.trace.of_kind("partition")} == {
+            "c0001", "c0003"
+        }
+        assert {e.who for e in r.trace.of_kind("rejoin")} == {
+            "c0001", "c0003"
+        }
+        for e in r.trace.of_kind("arrive"):
+            if e.who in ("c0001", "c0003"):
+                assert not 2 <= e.round_id < 5
+
+    def test_honestless_round_survives_context_hungry_attack(self):
+        """A round whose honest set is emptied by crashes must not kill
+        the run when the attack needs honest context — the byzantine
+        client sits the round out (nothing to mimic) and the run
+        continues on the restarts."""
+        s = _scenario(
+            n_clients=3,
+            n_byzantine=1,
+            aggregator_params={"f": 0},
+            attack=AttackSpec(name="empire", params={"scale": -1.1}),
+            rounds=6,
+            faults=FaultPlan(
+                crash=CrashModel(
+                    at_round=1, victim_indices=(0, 1),
+                    restart_after_rounds=2,
+                )
+            ),
+        )
+        r = _run(s)  # must not raise
+        assert len(r.trace.of_kind("crash")) == 2
+        assert len(r.trace.of_kind("restart")) == 2
+        assert r.rounds_completed > 0
+
+    def test_stragglers_miss_the_window(self):
+        s = _scenario(
+            n_byzantine=0,
+            attack=AttackSpec(name="none"),
+            faults=FaultPlan(
+                stragglers=StragglerModel(
+                    kind="bimodal", tail_prob=0.5, tail_s=1.0
+                )
+            ),
+            window_s=0.1,
+            rounds=10,
+        )
+        r = _run(s)
+        straggles = r.trace.of_kind("straggle")
+        assert straggles, "bimodal tail should miss the 0.1 s window"
+        assert len(straggles) + len(r.trace.of_kind("arrive")) == 8 * 10
+
+
+class TestEngines:
+    def test_direct_vs_spmd_bit_parity(self):
+        """The fused serving step closes rounds bit-identically to the
+        host masked door on the same cohorts (PR-6 contract riding the
+        chaos schedule)."""
+        s = _scenario(noise=0.0)
+        rd = _run(s.with_(engine="direct"))
+        rs = _run(s.with_(engine="spmd"))
+        assert rd.rounds_completed == rs.rounds_completed
+        for a, b in zip(rd.submissions, rs.submissions, strict=True):
+            assert np.array_equal(a, b)
+        np.testing.assert_allclose(
+            rd.final_params, rs.final_params, atol=1e-6
+        )
+
+    def test_spmd_rejects_unmasked_aggregator(self):
+        # MDA is subset-enumeration: no masked program, so the fused
+        # serving step cannot host it — direct falls back, spmd refuses
+        s = _scenario(engine="spmd", aggregator="mda",
+                      aggregator_params={"f": 1})
+        with pytest.raises(ValueError, match="masked"):
+            _run(s)
+
+    def test_precision_int8_bounded_drift(self):
+        s = _scenario(noise=0.0)
+        off = _run(s)
+        q = _run(s.with_(precision="int8"))
+        assert off.rounds_completed == q.rounds_completed
+        # int8 wire error is tiny relative to the honest signal
+        np.testing.assert_allclose(
+            off.final_params, q.final_params, atol=0.05
+        )
+        assert off.trace.digest() != "" and q.trace.digest() != ""
+
+    def test_serving_engine_uses_real_admission(self):
+        """Credit exhaustion surfaces as real rejected_rate acks from
+        the production ledger, and rejected rows never aggregate."""
+        s = _scenario(
+            engine="serving",
+            n_byzantine=0,
+            attack=AttackSpec(name="none"),
+            arrivals=ArrivalModel(kind="poisson", p=3.0),
+            credit_rate_per_s=1.0,
+            credit_burst=1.0,
+            rounds=6,
+        )
+        r = _run(s)
+        assert r.verdict_counts.get("rejected_rate", 0) > 0
+        assert r.rounds_completed > 0
+
+    def test_influence_zero_without_attack(self):
+        r = _run(_scenario(n_byzantine=0, attack=AttackSpec(name="none")))
+        assert r.influences == [0.0] * r.rounds_completed
+
+    def test_summary_row_is_json_ready(self):
+        import json
+
+        row = _run(_scenario()).summary()
+        assert json.loads(json.dumps(row)) == row
+        assert row["trace_digest"]

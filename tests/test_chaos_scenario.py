@@ -1,0 +1,123 @@
+"""Scenario schema: validation, registries, and JSON round-tripping.
+
+The chaos grid's regression-wall property depends on configs being
+exactly reconstructable from their committed JSON — a cell that cannot
+be rerun from its row is not a regression pin."""
+
+import json
+
+import pytest
+
+from byzpy_tpu.chaos import (
+    ArrivalModel,
+    AttackSpec,
+    CrashModel,
+    FaultPlan,
+    PartitionEvent,
+    Scenario,
+    StragglerModel,
+    build_aggregator,
+    build_attack,
+)
+from byzpy_tpu.chaos.scenario import AGGREGATORS, ATTACKS
+
+
+def _rich_scenario() -> Scenario:
+    return Scenario(
+        name="rich",
+        seed=42,
+        n_clients=10,
+        n_byzantine=2,
+        dim=32,
+        rounds=7,
+        aggregator="multi_krum",
+        aggregator_params={"f": 2, "q": 3},
+        attack=AttackSpec(name="krum_evasion", params={"eps0": 0.02}),
+        faults=FaultPlan(
+            stragglers=StragglerModel(kind="bimodal", tail_prob=0.3),
+            crash=CrashModel(prob_per_round=0.05, restart_after_rounds=3),
+            partitions=(
+                PartitionEvent(start_round=2, end_round=5, fraction=0.2),
+                PartitionEvent(start_round=5, end_round=6, members=(1, 3)),
+            ),
+        ),
+        arrivals=ArrivalModel(kind="bernoulli", p=0.8),
+        engine="direct",
+        precision="int8",
+        client_values=tuple(float(i) for i in range(10)),
+        staleness_kind="exponential",
+        staleness_cutoff=4,
+    )
+
+
+def test_roundtrip_through_json():
+    s = _rich_scenario()
+    rebuilt = Scenario.from_dict(json.loads(s.to_json()))
+    assert rebuilt == s
+    assert rebuilt.to_json() == s.to_json()
+
+
+def test_with_derives_cells():
+    s = _rich_scenario()
+    cell = s.with_(aggregator="cge", aggregator_params={"f": 1}, name="cell")
+    assert cell.aggregator == "cge" and cell.name == "cell"
+    assert cell.faults == s.faults  # everything else carried over
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"n_clients": 0},
+        {"n_byzantine": 5, "n_clients": 5},
+        {"rounds": 0},
+        {"engine": "warp"},
+        {"precision": "fp4"},
+        {"aggregator": "no_such_aggregator"},
+        {"attack": AttackSpec(name="no_such_attack")},
+        {"client_values": (1.0, 2.0)},  # wrong length
+    ],
+)
+def test_invalid_configs_rejected(kwargs):
+    base = dict(name="bad", n_clients=5)
+    base.update(kwargs)
+    with pytest.raises(ValueError):
+        Scenario(**base)
+
+
+def test_fault_model_validation():
+    with pytest.raises(ValueError):
+        StragglerModel(kind="cauchy")
+    with pytest.raises(ValueError):
+        CrashModel(prob_per_round=1.5)
+    with pytest.raises(ValueError):
+        CrashModel(at_round=3)  # victims missing
+    with pytest.raises(ValueError):
+        PartitionEvent(start_round=5, end_round=5)
+    with pytest.raises(ValueError):
+        ArrivalModel(kind="burst")
+
+
+def test_registries_build_every_entry():
+    for name in AGGREGATORS:
+        s = Scenario(name="t", aggregator=name)
+        agg = build_aggregator(s)
+        assert hasattr(agg, "aggregate"), name
+    for name in ATTACKS:
+        s = Scenario(name="t", n_clients=4, n_byzantine=1,
+                     attack=AttackSpec(name=name))
+        attack = build_attack(s, seed=1, client_id="byz0001")
+        if name == "none":
+            assert attack is None
+        else:
+            assert hasattr(attack, "apply"), name
+
+
+def test_adaptive_attacks_flagged():
+    for name in ("influence_ascent", "krum_evasion", "staleness_abuse"):
+        s = Scenario(name="t", n_clients=4, n_byzantine=1,
+                     attack=AttackSpec(name=name))
+        attack = build_attack(s, seed=1, client_id="b")
+        assert attack.is_adaptive
+    s = Scenario(name="t", n_clients=4, n_byzantine=1,
+                 attack=AttackSpec(name="sign_flip"))
+    assert not build_attack(s, seed=1, client_id="b").is_adaptive
