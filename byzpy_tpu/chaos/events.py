@@ -17,6 +17,9 @@ import json
 from dataclasses import dataclass
 from typing import Dict, Iterator, List
 
+from ..observability import runtime as _obs_runtime
+from ..observability import tracing as _obs_tracing
+
 #: Canonical event kinds emitted by the harness (other layers may add
 #: their own — the trace is an open vocabulary, the digest covers all).
 KINDS = (
@@ -74,8 +77,22 @@ class EventTrace:
     def emit(
         self, t: float, round_id: int, kind: str, who: str = "", detail: str = ""
     ) -> None:
-        """Append one event."""
+        """Append one event. With telemetry enabled the event is also
+        mirrored onto the process tracer's ``chaos`` track (an instant
+        event carrying the virtual time), so a chaos cell replays as a
+        timeline correlated with the host spans of whatever fabric the
+        cell drove — the digest is computed from the trace's own events
+        only and is bit-identical with telemetry on or off."""
         self._events.append(ChaosEvent(float(t), int(round_id), kind, who, detail))
+        if _obs_runtime.STATE.enabled:
+            _obs_tracing.instant(
+                f"chaos.{kind}",
+                track="chaos",
+                vt=float(t),
+                round=int(round_id),
+                who=who,
+                detail=detail,
+            )
 
     def __len__(self) -> int:
         return len(self._events)
@@ -103,6 +120,60 @@ class EventTrace:
     def of_kind(self, kind: str) -> List[ChaosEvent]:
         """All events of one kind, in emission order."""
         return [ev for ev in self._events if ev.kind == kind]
+
+    def to_chrome_trace(self, path: str) -> int:
+        """Write the trace as chrome-trace JSON on the VIRTUAL clock
+        (``ts`` = virtual seconds → µs): a chaos cell replays as a
+        Perfetto timeline — arrivals/crashes/rejections as instants,
+        each round a complete span — summarizable by
+        ``python -m byzpy_tpu.observability``. Returns the event count."""
+        import os
+
+        pid = os.getpid()
+        events: List[dict] = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 1,
+                "args": {"name": "chaos (virtual time)"},
+            }
+        ]
+        round_start: Dict[int, float] = {}
+        for ev in self._events:
+            round_start.setdefault(ev.round_id, ev.t)
+            if ev.kind == "round_close":
+                t0 = round_start[ev.round_id]
+                events.append(
+                    {
+                        "name": "chaos.round",
+                        "ph": "X",
+                        "pid": pid,
+                        "tid": 1,
+                        "ts": t0 * 1e6,
+                        "dur": max(0.0, ev.t - t0) * 1e6,
+                        "args": {"round": ev.round_id, "detail": ev.detail},
+                    }
+                )
+                continue
+            events.append(
+                {
+                    "name": f"chaos.{ev.kind}",
+                    "ph": "i",
+                    "s": "t",
+                    "pid": pid,
+                    "tid": 1,
+                    "ts": ev.t * 1e6,
+                    "args": {
+                        "round": ev.round_id,
+                        "who": ev.who,
+                        "detail": ev.detail,
+                    },
+                }
+            )
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+        return len(events)
 
     def to_jsonl(self, path: str) -> None:
         """Write the full trace as JSONL (one event per line)."""

@@ -446,14 +446,19 @@ class ChaosHarness:
         params out; the step applies SGD internally)."""
         import jax.numpy as jnp
 
+        from ..observability import tracing as obs_tracing
+
         weights = valid.astype(np.float32)
-        new_w, opt_state, _metrics = step(
-            jnp.asarray(w),
-            opt_state,
-            jnp.asarray(padded),
-            jnp.asarray(valid),
-            jnp.asarray(weights),
-        )
+        with obs_tracing.device_span(
+            "spmd.device_step", track="chaos", bucket=int(padded.shape[0])
+        ):
+            new_w, opt_state, _metrics = step(
+                jnp.asarray(w),
+                opt_state,
+                jnp.asarray(padded),
+                jnp.asarray(valid),
+                jnp.asarray(weights),
+            )
         return np.asarray(new_w, np.float32), opt_state
 
     def _publish(

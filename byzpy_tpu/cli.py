@@ -158,7 +158,7 @@ def bench_report(*, n: int = 16, d: int = 65_536, repeat: int = 10) -> Dict[str,
     import jax.numpy as jnp
 
     from .ops import robust
-    from .utils.metrics import timed_call_s
+    from .observability.compat import timed_call_s
 
     try:
         devices = _devices_with_timeout(jax)

@@ -32,6 +32,9 @@ import asyncio
 import cloudpickle
 import numpy as np
 
+from ...observability import metrics as _obs_metrics
+from ...observability import runtime as _obs_runtime
+
 _HEADER = struct.Struct(">I")
 MAX_FRAME = 1 << 31
 _SIG_LEN = hashlib.sha256().digest_size
@@ -285,6 +288,38 @@ def decompress_payload(obj: Any) -> Any:
     return _map_payload_leaves(leaf, obj)
 
 
+#: (frames, bytes) counter pairs per direction, resolved ONCE on the
+#: first telemetry-enabled frame — encode/decode are per-frame hot
+#: paths and must not pay a registry get-or-create lookup per call.
+_FRAME_COUNTER_CACHE: dict = {}
+
+
+def _frame_counters(direction: str, nbytes: int) -> None:
+    """Publish one wire frame into the process registry (telemetry-
+    enabled path only; callers hold the flag check). Per-direction
+    frame/byte counters are the measured side of the ingress/wire laws
+    EQuARX-style comms tuning needs in flight."""
+    pair = _FRAME_COUNTER_CACHE.get(direction)
+    if pair is None:
+        reg = _obs_metrics.registry()
+        labels = {"direction": direction}
+        pair = _FRAME_COUNTER_CACHE[direction] = (
+            reg.counter(
+                "byzpy_wire_frames_total",
+                help="actor-wire frames encoded (tx) / decoded (rx)",
+                labels=labels,
+            ),
+            reg.counter(
+                "byzpy_wire_bytes_total",
+                help="actor-wire frame bytes incl. length prefix and HMAC tag",
+                labels=labels,
+            ),
+        )
+    frames, nbytes_counter = pair
+    frames.inc()
+    nbytes_counter.inc(nbytes)
+
+
 def encode(obj: Any) -> bytes:
     """Pickle ``obj`` into a length-prefixed (optionally HMAC-signed) frame
     body. With ``BYZPY_TPU_WIRE_PRECISION`` set (``bf16``/``int8``), large
@@ -295,6 +330,8 @@ def encode(obj: Any) -> bytes:
     key = _wire_key()
     if key is not None:
         body = _sign(body, key) + body
+    if _obs_runtime.STATE.enabled:
+        _frame_counters("tx", _HEADER.size + len(body))
     return _HEADER.pack(len(body)) + body
 
 
@@ -302,6 +339,8 @@ def decode(body: bytes) -> Any:
     """Inverse of :func:`encode` (verifies the HMAC when signing is
     configured, then expands any compressed tensor frames — so a tampered
     code or scale byte fails verification before dequantization)."""
+    if _obs_runtime.STATE.enabled:
+        _frame_counters("rx", _HEADER.size + len(body))
     key = _wire_key()
     if key is not None:
         if len(body) < _SIG_LEN:

@@ -34,6 +34,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, List, Optional, Sequence
 
+from ..observability import metrics as obs_metrics
+from ..observability import runtime as obs_runtime
+
 
 @dataclass(frozen=True)
 class OverlapConfig:
@@ -74,19 +77,38 @@ class RoundOverlapStats:
     completion when streaming; aggregate start on the barrier path) —
     the straggler tax each early gradient pays. ``mode`` records which
     ingestion path served the round.
+
+    This is a thin per-round VIEW over the telemetry layer's shared
+    machinery: :meth:`observe_lag` keeps the exact per-round sample
+    list (so bench output is unchanged) and, with telemetry enabled,
+    also feeds the process-wide ``byzpy_overlap_ingest_lag_seconds``
+    histogram; :meth:`lag_percentile` delegates to the one nearest-rank
+    rule in :func:`byzpy_tpu.observability.metrics.percentile_of_sorted`.
     """
 
     mode: str = "barrier"
     ingest_lags_s: List[float] = field(default_factory=list)
     round_seconds: float = 0.0
 
+    def observe_lag(self, lag_s: float) -> None:
+        """Record one gradient's ingestion lag (and publish it to the
+        shared telemetry histogram when telemetry is on)."""
+        self.ingest_lags_s.append(lag_s)
+        if obs_runtime.STATE.enabled:
+            _ingest_lag_histogram().observe(lag_s)
+
     def lag_percentile(self, pct: float) -> float:
         """Ingestion-lag percentile (nearest-rank) in seconds."""
-        if not self.ingest_lags_s:
-            return 0.0
-        lags = sorted(self.ingest_lags_s)
-        rank = max(0, min(len(lags) - 1, int(round(pct / 100.0 * (len(lags) - 1)))))
-        return lags[rank]
+        return obs_metrics.percentile_of_sorted(sorted(self.ingest_lags_s), pct)
+
+
+def _ingest_lag_histogram() -> "obs_metrics.Histogram":
+    """The process-wide ingestion-lag histogram (get-or-create — cheap,
+    but only touched on the telemetry-enabled path)."""
+    return obs_metrics.registry().histogram(
+        "byzpy_overlap_ingest_lag_seconds",
+        help="arrival-to-consumption lag of each gradient (overlap engine)",
+    )
 
 
 async def gather_arrival_order(

@@ -21,6 +21,9 @@ import inspect
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence
 
 from ...aggregators.base import Aggregator
+from ...observability import metrics as obs_metrics
+from ...observability import runtime as obs_runtime
+from ...observability import tracing as obs_tracing
 from ...pre_aggregators.base import PreAggregator
 from ..graph.executor import OperatorExecutor
 from ..graph.pool import ActorPool, ActorPoolConfig
@@ -56,6 +59,21 @@ async def _gather_all(coros) -> List[Any]:
     retrieved (see :func:`~byzpy_tpu.engine.overlap.settle_all`, the one
     implementation of this contract)."""
     return await settle_all(list(coros))
+
+
+def _publish_round_metrics(mode: str, seconds: float) -> None:
+    """Publish one closed actor-PS round into the process registry
+    (telemetry-enabled path only — callers hold the flag check)."""
+    reg = obs_metrics.registry()
+    reg.counter(
+        "byzpy_ps_rounds_total",
+        help="actor-mode ParameterServer rounds completed",
+        labels={"mode": mode},
+    ).inc()
+    reg.histogram(
+        "byzpy_ps_round_seconds",
+        help="actor-mode ParameterServer wall seconds per round",
+    ).observe(seconds)
 
 
 class ParameterServer:
@@ -246,10 +264,14 @@ class ParameterServer:
                         sharded = self._feature_shard(matrix)
                         if sharded is not None:
                             matrix = sharded
-                    return unravel(self._fused_pipeline(matrix))
+                    with obs_tracing.device_span(
+                        "ps.aggregate", track="ps", mode="fused_pipeline"
+                    ):
+                        return unravel(self._fused_pipeline(matrix))
             gradients = self.pre_aggregator.pre_aggregate(gradients)
         if self._executor is not None:
-            return await self._executor.run(gradients)
+            with obs_tracing.span("ps.aggregate", track="ps", mode="pool"):
+                return await self._executor.run(gradients)
         if (
             self._feature_shard_resolved()
             and placement.compute_device(gradients) is None
@@ -258,8 +280,12 @@ class ParameterServer:
             sharded = self._feature_shard(matrix)
             if sharded is not None:
                 self.aggregator.validate_n(matrix.shape[0])
-                return unravel(self.aggregator.matrix_fn()(sharded))
-        return self.aggregator.aggregate(gradients)
+                with obs_tracing.device_span(
+                    "ps.aggregate", track="ps", mode="feature_sharded"
+                ):
+                    return unravel(self.aggregator.matrix_fn()(sharded))
+        with obs_tracing.device_span("ps.aggregate", track="ps"):
+            return self.aggregator.aggregate(gradients)
 
     # -- adaptive-adversary observation channel -------------------------------
 
@@ -330,6 +356,19 @@ class ParameterServer:
         )
 
     async def _elastic_round(self) -> Any:
+        """Telemetry bracket around :meth:`_elastic_round_inner` (round
+        span + round metrics; a quorum-lost round records its error on
+        the span via the context manager's exception path)."""
+        t0 = now()
+        with obs_tracing.span(
+            "ps.round", track="ps", round=self.rounds_completed, mode="elastic"
+        ):
+            aggregated = await self._elastic_round_inner()
+            if obs_runtime.STATE.enabled:
+                _publish_round_metrics("elastic", now() - t0)
+            return aggregated
+
+    async def _elastic_round_inner(self) -> Any:
         policy, state = self.elastic, self.elastic_state
         rnd = self.rounds_completed
         external = (
@@ -399,27 +438,28 @@ class ParameterServer:
             (nid, n) for nid, n in all_pairs
             if nid not in state.suspects and nid not in external
         ]
-        if self._prefetch_depth() > 0:
-            honest_ids = {
-                node_id("honest", i) for i in range(len(self.honest_nodes))
-            }
-            live_honest = [(nid, n) for nid, n in live if nid in honest_ids]
-            live_byz = [(nid, n) for nid, n in live if nid not in honest_ids]
-            self._pending_elastic = {
-                nid: asyncio.ensure_future(
-                    self._elastic_chain_apply_compute(n, aggregated)
+        with obs_tracing.span("ps.broadcast", track="ps"):
+            if self._prefetch_depth() > 0:
+                honest_ids = {
+                    node_id("honest", i) for i in range(len(self.honest_nodes))
+                }
+                live_honest = [(nid, n) for nid, n in live if nid in honest_ids]
+                live_byz = [(nid, n) for nid, n in live if nid not in honest_ids]
+                self._pending_elastic = {
+                    nid: asyncio.ensure_future(
+                        self._elastic_chain_apply_compute(n, aggregated)
+                    )
+                    for nid, n in live_honest
+                }
+                await elastic_gather(
+                    live_byz, "apply_server_gradient", (aggregated,),
+                    policy=policy, state=state, round_no=rnd,
                 )
-                for nid, n in live_honest
-            }
-            await elastic_gather(
-                live_byz, "apply_server_gradient", (aggregated,),
-                policy=policy, state=state, round_no=rnd,
-            )
-        else:
-            await elastic_gather(
-                live, "apply_server_gradient", (aggregated,),
-                policy=policy, state=state, round_no=rnd,
-            )
+            else:
+                await elastic_gather(
+                    live, "apply_server_gradient", (aggregated,),
+                    policy=policy, state=state, round_no=rnd,
+                )
         self.rounds_completed += 1
         return aggregated
 
@@ -457,74 +497,84 @@ class ParameterServer:
         fan-out."""
         stream = self._stream_enabled()
         stats = RoundOverlapStats(mode="stream" if stream else "barrier")
-        t0 = now()
-        n_h = len(self.honest_nodes)
-        fold_state = (
-            self.aggregator.fold_init(n_h + len(self.byzantine_nodes))
-            if stream
-            else None
-        )
-        arrivals: Dict[int, float] = {}
+        with obs_tracing.span(
+            "ps.round", track="ps", round=self.rounds_completed, mode=stats.mode
+        ):
+            t0 = now()
+            n_h = len(self.honest_nodes)
+            fold_state = (
+                self.aggregator.fold_init(n_h + len(self.byzantine_nodes))
+                if stream
+                else None
+            )
+            arrivals: Dict[int, float] = {}
 
-        def ingest(offset: int):
-            def cb(i: int, grad: Any) -> None:
-                slot = offset + i
-                arrivals[slot] = now()
-                if fold_state is not None:
-                    self.aggregator.fold(fold_state, slot, grad)
-                    stats.ingest_lags_s.append(now() - arrivals[slot])
-            return cb
+            def ingest(offset: int):
+                def cb(i: int, grad: Any) -> None:
+                    slot = offset + i
+                    arrivals[slot] = now()
+                    if fold_state is not None:
+                        with obs_tracing.span("ps.fold", track="ps", slot=slot):
+                            self.aggregator.fold(fold_state, slot, grad)
+                        stats.observe_lag(now() - arrivals[slot])
+                return cb
 
-        pending = self._pending_honest
-        self._pending_honest = None
-        honest_aws = (
-            pending
-            if pending is not None
-            else [
-                _invoke(node, "honest_gradient_for_next_batch")
-                for node in self.honest_nodes
-            ]
-        )
-        honest = await gather_arrival_order(honest_aws, on_item=ingest(0))
-        byz: List[Any] = []
-        if self.byzantine_nodes:
-            byz = await gather_arrival_order(
-                [
-                    _invoke(node, "byzantine_gradient_for_next_batch", honest)
-                    for node in self.byzantine_nodes
-                ],
-                on_item=ingest(n_h),
+            pending = self._pending_honest
+            self._pending_honest = None
+            honest_aws = (
+                pending
+                if pending is not None
+                else [
+                    _invoke(node, "honest_gradient_for_next_batch")
+                    for node in self.honest_nodes
+                ]
             )
-        if stream:
-            aggregated = self.aggregator.fold_finalize(fold_state)
-        else:
-            t_consume = now()
-            stats.ingest_lags_s.extend(
-                t_consume - t for t in arrivals.values()
-            )
-            aggregated = await self._aggregate(honest + byz)
-        self._publish_public_state(aggregated)
-        if self._prefetch_depth() > 0:
-            self._pending_honest = [
-                asyncio.ensure_future(
-                    self._chain_apply_compute(node, aggregated)
-                )
-                for node in self.honest_nodes
-            ]
-            if self.byzantine_nodes:
-                await _gather_all(
-                    _invoke(node, "apply_server_gradient", aggregated)
-                    for node in self.byzantine_nodes
-                )
-        else:
-            await _gather_all(
-                _invoke(node, "apply_server_gradient", aggregated)
-                for node in self.honest_nodes + self.byzantine_nodes
-            )
-        stats.round_seconds = now() - t0
-        self.last_overlap_stats = stats
-        self.rounds_completed += 1
-        return aggregated
+            with obs_tracing.span("ps.gather", track="ps"):
+                honest = await gather_arrival_order(honest_aws, on_item=ingest(0))
+                byz: List[Any] = []
+                if self.byzantine_nodes:
+                    byz = await gather_arrival_order(
+                        [
+                            _invoke(
+                                node, "byzantine_gradient_for_next_batch", honest
+                            )
+                            for node in self.byzantine_nodes
+                        ],
+                        on_item=ingest(n_h),
+                    )
+            if stream:
+                with obs_tracing.device_span("ps.fold_finalize", track="ps"):
+                    aggregated = self.aggregator.fold_finalize(fold_state)
+            else:
+                t_consume = now()
+                for t in arrivals.values():
+                    stats.observe_lag(t_consume - t)
+                aggregated = await self._aggregate(honest + byz)
+            self._publish_public_state(aggregated)
+            with obs_tracing.span("ps.broadcast", track="ps"):
+                if self._prefetch_depth() > 0:
+                    self._pending_honest = [
+                        asyncio.ensure_future(
+                            self._chain_apply_compute(node, aggregated)
+                        )
+                        for node in self.honest_nodes
+                    ]
+                    if self.byzantine_nodes:
+                        await _gather_all(
+                            _invoke(node, "apply_server_gradient", aggregated)
+                            for node in self.byzantine_nodes
+                        )
+                else:
+                    await _gather_all(
+                        _invoke(node, "apply_server_gradient", aggregated)
+                        for node in self.honest_nodes + self.byzantine_nodes
+                    )
+            stats.round_seconds = now() - t0
+            self.last_overlap_stats = stats
+            self.rounds_completed += 1
+            if obs_runtime.STATE.enabled:
+                _publish_round_metrics(stats.mode, stats.round_seconds)
+            return aggregated
 
     async def flush(self) -> None:
         """Settle outstanding prefetched apply→compute chains.
@@ -557,16 +607,24 @@ class ParameterServer:
             return await self._elastic_round()
         if self.overlap is not None:
             return await self._plain_round()
-        honest = await self._stream_honest()
-        byz = await self._stream_byzantine(honest)
-        aggregated = await self._aggregate(honest + byz)
-        self._publish_public_state(aggregated)
-        await _gather_all(
-            _invoke(node, "apply_server_gradient", aggregated)
-            for node in self.honest_nodes + self.byzantine_nodes
-        )
-        self.rounds_completed += 1
-        return aggregated
+        t0 = now()
+        with obs_tracing.span(
+            "ps.round", track="ps", round=self.rounds_completed, mode="serial"
+        ):
+            with obs_tracing.span("ps.gather", track="ps"):
+                honest = await self._stream_honest()
+                byz = await self._stream_byzantine(honest)
+            aggregated = await self._aggregate(honest + byz)
+            self._publish_public_state(aggregated)
+            with obs_tracing.span("ps.broadcast", track="ps"):
+                await _gather_all(
+                    _invoke(node, "apply_server_gradient", aggregated)
+                    for node in self.honest_nodes + self.byzantine_nodes
+                )
+            self.rounds_completed += 1
+            if obs_runtime.STATE.enabled:
+                _publish_round_metrics("serial", now() - t0)
+            return aggregated
 
     async def run(
         self,

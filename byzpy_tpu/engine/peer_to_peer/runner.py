@@ -44,12 +44,25 @@ from ..node.decentralized import DecentralizedNode
 
 if TYPE_CHECKING:  # pragma: no cover — avoids node.cluster -> topology cycle
     from ..node.cluster import DecentralizedCluster
+from ...observability import metrics as obs_metrics
+from ...observability import runtime as obs_runtime
+from ...observability import tracing as obs_tracing
 from ..overlap import OverlapConfig, settle_all
 from .elastic import HeartbeatPolicy
 from .nodes import ByzantineP2PWorker, HonestP2PWorker
 from .topology import Topology
 
 GOSSIP_TYPE = "gradient"  # message type name matches the reference handler
+
+
+def _publish_p2p_round(mode: str) -> None:
+    """Publish one closed gossip round into the process registry
+    (telemetry-enabled path only — callers hold the flag check)."""
+    obs_metrics.registry().counter(
+        "byzpy_p2p_rounds_total",
+        help="DecentralizedPeerToPeer gossip rounds completed",
+        labels={"mode": mode},
+    ).inc()
 
 
 def _configure_honest(
@@ -499,6 +512,15 @@ class DecentralizedPeerToPeer:
             return await self._round_locked()
 
     async def _round_locked(self) -> Dict[int, Any]:
+        with obs_tracing.span(
+            "p2p.round", track="p2p", round=self.rounds_completed, mode="barrier"
+        ):
+            out = await self._round_locked_inner()
+        if obs_runtime.STATE.enabled:
+            _publish_p2p_round("barrier")
+        return out
+
+    async def _round_locked_inner(self) -> Dict[int, Any]:
         lr = self.learning_rate
 
         # 1. half steps (concurrently; ref: runner.py:295-298)
@@ -529,12 +551,13 @@ class DecentralizedPeerToPeer:
                 await self.nodes[i].broadcast_message(GOSSIP_TYPE, out["attack"])
 
         # 4. robust aggregation of own θ½ + received (ref: runner.py:374-388)
-        aggregated = await asyncio.gather(*(
-            self.nodes[i].execute_pipeline(
-                "aggregate", {"expected": self._honest_expected(i)}
-            )
-            for i in self.honest_indices
-        ))
+        with obs_tracing.span("p2p.aggregate", track="p2p"):
+            aggregated = await asyncio.gather(*(
+                self.nodes[i].execute_pipeline(
+                    "aggregate", {"expected": self._honest_expected(i)}
+                )
+                for i in self.honest_indices
+            ))
         self.rounds_completed += 1
         return {
             i: out["aggregate"]
@@ -559,6 +582,22 @@ class DecentralizedPeerToPeer:
         round's body (after every aggregate here settled), so frames
         can never leak across round boundaries.
         """
+        with obs_tracing.span(
+            "p2p.round", track="p2p", round=self.rounds_completed, mode="overlap"
+        ):
+            out = await self._overlap_round_body(pending_half, prefetch=prefetch)
+        if obs_runtime.STATE.enabled:
+            _publish_p2p_round("overlap")
+        return out
+
+    async def _overlap_round_body(
+        self,
+        pending_half: Dict[int, "asyncio.Task"],
+        *,
+        prefetch: bool,
+    ) -> Dict[int, Any]:
+        """The overlapped round proper (telemetry bracket in
+        :meth:`_round_locked_overlap`)."""
         lr = self.learning_rate
 
         # drop prefetched half-steps for peers excised since last round

@@ -1,0 +1,81 @@
+"""Unified runtime telemetry: tracing, metrics, flight recording.
+
+Three pillars (ROADMAP: the observability layer the SURVEY flags as a
+required addition):
+
+* **Tracing** (:mod:`~byzpy_tpu.observability.tracing`) — lightweight
+  spans (``span("serving.fold", round=k, tenant=...)``) instrumenting
+  the full round lifecycle across every fabric: ingress frame decode →
+  admission/credit gate → cohort close → bucket pad → fold/finalize →
+  device step (``device_span`` brackets dispatches with
+  ``jax.profiler.TraceAnnotation`` so host spans correlate with XLA
+  device traces) → param broadcast. Exports Perfetto/chrome-trace JSON.
+* **Metrics** (:mod:`~byzpy_tpu.observability.metrics`) — a typed
+  registry (counters, gauges, fixed-bucket histograms) the serving
+  frontend, both orchestrators, the overlap engine, the actor wire and
+  the chaos harness publish into; JSONL exporter + a Prometheus text
+  endpoint on the serving frontend's TCP ingress.
+* **Flight recorder** (:mod:`~byzpy_tpu.observability.recorder`) — a
+  bounded ring of recent spans that dumps the last N rounds (plus a
+  metrics snapshot) on unhandled failure.
+
+Telemetry is OFF by default and the disabled path is one flag check
+with no allocation (:mod:`~byzpy_tpu.observability.runtime`); enable
+with ``BYZPY_TPU_TELEMETRY=1`` or :func:`enable`. Summarize a recorded
+run with ``python -m byzpy_tpu.observability <trace.json>``
+(per-stage latency breakdown, top-k slow rounds, wire-law residuals).
+
+This package imports neither jax nor any engine/serving module at
+import time — hot paths import IT, so it must stay dependency-light.
+"""
+
+from .runtime import STATE, TelemetryState, disable, enable, enabled
+
+__all__ = [
+    "STATE",
+    "TelemetryState",
+    "FlightRecorder",
+    "MetricsLogger",
+    "MetricsRegistry",
+    "StepTimer",
+    "Tracer",
+    "device_span",
+    "disable",
+    "enable",
+    "enabled",
+    "instant",
+    "registry",
+    "span",
+    "tracer",
+]
+
+_LAZY = {
+    "span": ("tracing", "span"),
+    "device_span": ("tracing", "device_span"),
+    "instant": ("tracing", "instant"),
+    "tracer": ("tracing", "tracer"),
+    "Tracer": ("tracing", "Tracer"),
+    "registry": ("metrics", "registry"),
+    "MetricsRegistry": ("metrics", "MetricsRegistry"),
+    "FlightRecorder": ("recorder", "FlightRecorder"),
+    "MetricsLogger": ("compat", "MetricsLogger"),
+    "StepTimer": ("compat", "StepTimer"),
+}
+
+
+def __getattr__(name: str):
+    # lazy: compat imports jax; keep `import byzpy_tpu.observability`
+    # (and the hot paths that only need runtime.STATE) jax-free
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod_name}", __name__), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

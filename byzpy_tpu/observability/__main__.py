@@ -1,0 +1,239 @@
+"""Summarize a recorded telemetry run.
+
+``python -m byzpy_tpu.observability TRACE [--metrics METRICS.jsonl]``
+reads a chrome-trace JSON export (``Tracer.export_chrome_trace``, a
+chaos ``EventTrace.to_chrome_trace``, or a flight-recorder dump) and
+prints:
+
+* the **per-stage latency breakdown** — count / total / mean / p50 /
+  p99 per span name, sorted by total time, the "where inside the round
+  does the time live" answer;
+* the **top-k slow rounds** — the longest round-lifecycle spans with
+  their tenant/round attributes;
+* with ``--metrics``, the **wire-bytes law residuals** — measured
+  serving ingress bytes per submit frame against the analytic
+  ``parallel.comms.serving_ingress_bytes`` law for the recorded tenant
+  dim and wire precision.
+
+``--json`` emits the same summary as one JSON object for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from .metrics import iter_jsonl, percentile_of_sorted
+from .recorder import ROUND_SPAN_NAMES
+
+
+def load_events(path: str) -> List[dict]:
+    """Events from a chrome-trace export, a bare event list, or a
+    flight-recorder dump."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    if isinstance(obj, list):
+        return obj
+    if isinstance(obj, dict):
+        if "traceEvents" in obj:
+            return list(obj["traceEvents"])
+        if obj.get("kind") == "byzpy_tpu.flight_recorder":
+            return list(obj.get("events", []))
+    raise ValueError(f"{path}: not a chrome trace or flight-recorder dump")
+
+
+def stage_breakdown(events: List[dict]) -> List[dict]:
+    """Per-span-name latency stats over the complete ('X') events."""
+    by_name: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") == "X" and "dur" in ev:
+            by_name.setdefault(ev["name"], []).append(float(ev["dur"]))
+    total_all = sum(sum(v) for v in by_name.values()) or 1.0
+    out = []
+    for name, durs in by_name.items():
+        durs.sort()
+        total = sum(durs)
+        out.append(
+            {
+                "stage": name,
+                "count": len(durs),
+                "total_ms": total / 1e3,
+                "mean_ms": total / len(durs) / 1e3,
+                "p50_ms": percentile_of_sorted(durs, 50) / 1e3,
+                "p99_ms": percentile_of_sorted(durs, 99) / 1e3,
+                "share": total / total_all,
+            }
+        )
+    out.sort(key=lambda r: -r["total_ms"])
+    return out
+
+
+def _is_round_span(ev: dict) -> bool:
+    return ev.get("ph") == "X" and (
+        ev.get("name") in ROUND_SPAN_NAMES or "round" in ev.get("args", {})
+    )
+
+
+def slow_rounds(events: List[dict], top: int) -> List[dict]:
+    """The ``top`` longest round-lifecycle spans."""
+    rounds = [ev for ev in events if _is_round_span(ev)]
+    rounds.sort(key=lambda ev: -float(ev.get("dur", 0.0)))
+    out = []
+    for ev in rounds[:top]:
+        args = ev.get("args", {})
+        out.append(
+            {
+                "span": ev["name"],
+                "round": args.get("round"),
+                "tenant": args.get("tenant"),
+                "dur_ms": float(ev.get("dur", 0.0)) / 1e3,
+                "ts_ms": float(ev.get("ts", 0.0)) / 1e3,
+                "args": {
+                    k: v for k, v in args.items() if k not in ("round", "tenant")
+                },
+            }
+        )
+    return out
+
+
+def wire_residuals(metrics_path: str) -> List[dict]:
+    """Measured-vs-law ingress bytes per tenant, from a metrics JSONL.
+
+    Needs the serving frontend's ``byzpy_serving_ingress_bytes_total`` +
+    ``byzpy_serving_submit_frames_total`` counters, the
+    ``byzpy_serving_tenant_dim`` gauge, and the ``byzpy_wire_info``
+    marker the frontend publishes at scrape/export time. Tenants whose
+    counters are missing are skipped (partial recordings are normal)."""
+    last: Dict[tuple, dict] = {}
+    for rec in iter_jsonl(metrics_path):
+        last[(rec["name"], tuple(sorted(rec.get("labels", {}).items())))] = rec
+
+    precision, signed = "off", False
+    for (name, labels), _rec in last.items():
+        if name == "byzpy_wire_info":
+            d = dict(labels)
+            precision = d.get("precision", "off")
+            signed = d.get("signed", "0") in ("1", "true")
+
+    from ..parallel.comms import serving_ingress_bytes
+
+    tenants: Dict[str, dict] = {}
+    for (name, labels), rec in last.items():
+        tenant = dict(labels).get("tenant")
+        if tenant is None:
+            continue
+        t = tenants.setdefault(tenant, {})
+        if name == "byzpy_serving_ingress_bytes_total":
+            t["bytes"] = rec["value"]
+        elif name == "byzpy_serving_submit_frames_total":
+            t["frames"] = rec["value"]
+        elif name == "byzpy_serving_tenant_dim":
+            t["dim"] = int(rec["value"])
+    out = []
+    for tenant, t in sorted(tenants.items()):
+        if not t.get("frames") or "bytes" not in t or "dim" not in t:
+            continue
+        measured = t["bytes"] / t["frames"]
+        law = serving_ingress_bytes(t["dim"], precision=precision, signed=signed)
+        out.append(
+            {
+                "tenant": tenant,
+                "frames": int(t["frames"]),
+                "dim": t["dim"],
+                "precision": precision,
+                "signed": signed,
+                "measured_bytes_per_frame": round(measured, 1),
+                "law_bytes_per_frame": round(law, 1),
+                "residual": round((measured - law) / measured, 4) if measured else 0.0,
+            }
+        )
+    return out
+
+
+def _print_table(rows: List[dict], columns: List[tuple]) -> None:
+    widths = [
+        max(len(title), *(len(fmt(r)) for r in rows)) if rows else len(title)
+        for title, fmt in columns
+    ]
+    print("  ".join(t.ljust(w) for (t, _), w in zip(columns, widths, strict=True)))
+    for r in rows:
+        print(
+            "  ".join(
+                fmt(r).ljust(w) for (_, fmt), w in zip(columns, widths, strict=True)
+            )
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry point; returns the process exit code."""
+    ap = argparse.ArgumentParser(
+        prog="python -m byzpy_tpu.observability", description=__doc__
+    )
+    ap.add_argument("trace", help="chrome-trace JSON or flight-recorder dump")
+    ap.add_argument("--metrics", help="metrics JSONL (registry.to_jsonl output)")
+    ap.add_argument("--top", type=int, default=5, help="slow rounds to show")
+    ap.add_argument("--json", action="store_true", help="emit one JSON object")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.trace)
+    summary: Dict[str, Any] = {
+        "trace": args.trace,
+        "events": len(events),
+        "stages": stage_breakdown(events),
+        "slow_rounds": slow_rounds(events, args.top),
+    }
+    if args.metrics:
+        summary["wire_residuals"] = wire_residuals(args.metrics)
+
+    if args.json:
+        print(json.dumps(summary, indent=2))
+        return 0
+
+    print(f"{args.trace}: {summary['events']} events")
+    print("\n== per-stage latency breakdown ==")
+    _print_table(
+        summary["stages"],
+        [
+            ("stage", lambda r: r["stage"]),
+            ("count", lambda r: str(r["count"])),
+            ("total_ms", lambda r: f"{r['total_ms']:.3f}"),
+            ("mean_ms", lambda r: f"{r['mean_ms']:.3f}"),
+            ("p50_ms", lambda r: f"{r['p50_ms']:.3f}"),
+            ("p99_ms", lambda r: f"{r['p99_ms']:.3f}"),
+            ("share", lambda r: f"{100 * r['share']:.1f}%"),
+        ],
+    )
+    if summary["slow_rounds"]:
+        print(f"\n== top {args.top} slow rounds ==")
+        _print_table(
+            summary["slow_rounds"],
+            [
+                ("span", lambda r: r["span"]),
+                ("tenant", lambda r: str(r["tenant"])),
+                ("round", lambda r: str(r["round"])),
+                ("dur_ms", lambda r: f"{r['dur_ms']:.3f}"),
+                ("at_ms", lambda r: f"{r['ts_ms']:.3f}"),
+            ],
+        )
+    if "wire_residuals" in summary:
+        print("\n== wire bytes vs comms law ==")
+        if summary["wire_residuals"]:
+            _print_table(
+                summary["wire_residuals"],
+                [
+                    ("tenant", lambda r: r["tenant"]),
+                    ("frames", lambda r: str(r["frames"])),
+                    ("measured B/frame", lambda r: f"{r['measured_bytes_per_frame']:.1f}"),
+                    ("law B/frame", lambda r: f"{r['law_bytes_per_frame']:.1f}"),
+                    ("residual", lambda r: f"{100 * r['residual']:.2f}%"),
+                ],
+            )
+        else:
+            print("(no serving ingress counters in the metrics file)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

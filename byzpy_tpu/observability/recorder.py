@@ -1,0 +1,130 @@
+"""Flight recorder: dump the last N rounds of telemetry on failure.
+
+The :class:`~byzpy_tpu.observability.tracing.Tracer`'s bounded ring is
+the always-on black box; the :class:`FlightRecorder` is the view that
+turns its tail into a crash artifact — the trailing ``last_rounds``
+round lifecycles' spans (cut at round-boundary spans, i.e. events whose
+``args`` carry a ``round``) plus a metrics-registry snapshot.
+
+``install()`` chains ``sys.excepthook`` (and ``threading.excepthook``)
+so an unhandled exception writes the dump BEFORE the traceback
+propagates — the "what were the last rounds doing" artifact a crashed
+serving process leaves behind. Explicit ``dump()`` serves health
+endpoints and tests. (In-memory state cannot outlive a SIGKILL; the
+contract is dump-on-failure, not dump-after-oblivion.)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+#: Span names that mark a round boundary even without a ``round`` arg.
+ROUND_SPAN_NAMES = ("serving.round", "ps.round", "p2p.round")
+
+
+class FlightRecorder:
+    """Crash-dump view over the tracer ring + metrics registry."""
+
+    def __init__(
+        self,
+        tracer: Optional["_tracing.Tracer"] = None,
+        registry: Optional["_metrics.MetricsRegistry"] = None,
+        last_rounds: int = 32,
+    ) -> None:
+        if last_rounds < 1:
+            raise ValueError("last_rounds must be >= 1")
+        self.tracer = tracer or _tracing.tracer()
+        self.registry = registry or _metrics.registry()
+        self.last_rounds = last_rounds
+        self._installed: List[Any] = []
+
+    # -- dumping ----------------------------------------------------------
+
+    def _tail_events(self) -> List[dict]:
+        events = self.tracer.events()
+        # cut the tail at the Nth-from-last ROUND span so the dump is
+        # "the last N round lifecycles", not "the last N events". Only
+        # the round-lifecycle span names count as boundaries — stage
+        # spans and chaos instants also carry a `round` arg, and
+        # counting them would shrink the window to a fraction of the
+        # rounds the operator sized the recorder for. The cutoff is the
+        # boundary span's START, so the stages inside it come along.
+        boundaries = [
+            ev["ts"]
+            for ev in events
+            if ev.get("ph") == "X" and ev["name"] in ROUND_SPAN_NAMES
+        ]
+        if not boundaries:
+            return events
+        cutoff = boundaries[max(0, len(boundaries) - self.last_rounds)]
+        return [ev for ev in events if ev["ts"] >= cutoff]
+
+    def record(self, reason: str = "manual") -> Dict[str, Any]:
+        """Assemble the dump object (no file IO): tail spans, metrics
+        snapshot, drop counter, and the failure reason."""
+        return {
+            "kind": "byzpy_tpu.flight_recorder",
+            "time_unix_s": time.time(),
+            "reason": reason,
+            "last_rounds": self.last_rounds,
+            "dropped_events": self.tracer.dropped,
+            "events": self._tail_events(),
+            "metrics": self.registry.snapshot(),
+        }
+
+    def dump(self, path: str, reason: str = "manual") -> Dict[str, Any]:
+        """Write :meth:`record` as JSON to ``path``; returns the dump.
+        (Host-side file IO — keep it off event loops.)"""
+        rec = self.record(reason)
+        with open(path, "w") as fh:
+            json.dump(rec, fh)
+        return rec
+
+    # -- crash hooks ------------------------------------------------------
+
+    def install(self, path: str) -> None:
+        """Chain the process exception hooks so an unhandled exception
+        writes the flight dump to ``path`` before the crash propagates.
+        Idempotent per recorder; :meth:`uninstall` restores the previous
+        hooks."""
+        if self._installed:
+            return
+        prev_sys = sys.excepthook
+        prev_thread = threading.excepthook
+
+        def _sys_hook(exc_type, exc, tb):
+            self._try_dump(path, f"excepthook:{exc_type.__name__}")
+            prev_sys(exc_type, exc, tb)
+
+        def _thread_hook(args):
+            name = getattr(args.exc_type, "__name__", "Exception")
+            self._try_dump(path, f"thread_excepthook:{name}")
+            prev_thread(args)
+
+        sys.excepthook = _sys_hook
+        threading.excepthook = _thread_hook
+        self._installed = [prev_sys, prev_thread]
+
+    def uninstall(self) -> None:
+        """Restore the hooks :meth:`install` replaced."""
+        if self._installed:
+            sys.excepthook, threading.excepthook = self._installed
+            self._installed = []
+
+    def _try_dump(self, path: str, reason: str) -> None:
+        try:
+            self.dump(path, reason)
+        except Exception:  # noqa: BLE001 — the crash path must never
+            # raise over the original failure; a lost dump is the
+            # lesser incident
+            pass
+
+
+__all__ = ["FlightRecorder", "ROUND_SPAN_NAMES"]

@@ -1,0 +1,60 @@
+"""Process-wide telemetry switch.
+
+Every instrumented hot path in the framework — serving admission, the
+actor wire codec, the round loops — guards its telemetry work behind
+``STATE.enabled``, a single attribute read on a module singleton. The
+disabled path therefore costs one flag check and allocates nothing
+(``tracing.span`` returns a shared no-op singleton; metric instruments
+are created once at construction time, never per call).
+
+Telemetry is off by default. Enable it with ``BYZPY_TPU_TELEMETRY=1``
+in the environment (read once at import) or programmatically::
+
+    from byzpy_tpu import observability
+    observability.enable()
+"""
+
+from __future__ import annotations
+
+import os
+
+_TRUTHY = ("1", "on", "true", "yes")
+
+
+def _env_enabled() -> bool:
+    """Initial switch position from ``BYZPY_TPU_TELEMETRY``."""
+    return os.environ.get("BYZPY_TPU_TELEMETRY", "").strip().lower() in _TRUTHY
+
+
+class TelemetryState:
+    """Mutable process-wide telemetry switch (module singleton
+    :data:`STATE`); hot paths read ``STATE.enabled`` directly."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self) -> None:
+        self.enabled = _env_enabled()
+
+
+#: The process-wide switch. Hot paths read ``STATE.enabled`` (one
+#: attribute load); everything else should go through :func:`enabled`.
+STATE = TelemetryState()
+
+
+def enabled() -> bool:
+    """Whether telemetry (tracing + metrics publishing) is on."""
+    return STATE.enabled
+
+
+def enable() -> None:
+    """Turn telemetry on for this process."""
+    STATE.enabled = True
+
+
+def disable() -> None:
+    """Turn telemetry off (instrumented code reverts to the
+    single-flag-check no-op path)."""
+    STATE.enabled = False
+
+
+__all__ = ["STATE", "TelemetryState", "disable", "enable", "enabled"]

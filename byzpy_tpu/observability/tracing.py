@@ -1,0 +1,297 @@
+"""Round-lifecycle tracing: lightweight spans + Perfetto/chrome export.
+
+``span("serving.fold", round=k, tenant="m0")`` brackets one stage of a
+round; closed spans land in the process :class:`Tracer`'s bounded ring
+(also the flight recorder's raw material, see
+:mod:`byzpy_tpu.observability.recorder`) and export as chrome-trace
+JSON (``Tracer.export_chrome_trace``) that Perfetto / ``chrome://
+tracing`` open directly.
+
+Cost contract: with telemetry disabled (:mod:`runtime`), :func:`span`
+is ONE flag check returning a shared no-op singleton — no allocation,
+no clock read. Enabled, a span is two ``perf_counter_ns`` reads and one
+deque append.
+
+Timelines ("tracks"): by default a span lands on the calling OS
+thread's track. Async code that interleaves several logical timelines
+on one loop thread (one serving tenant per scheduler task, the PS round
+loop) passes ``track="tenant:m0"`` so overlapping spans render on their
+own named rows instead of mis-nesting on the loop thread. Device
+correlation: :func:`device_span` additionally enters a
+``jax.profiler.TraceAnnotation`` of the same name, so when a
+``jax.profiler`` capture is active the host span shows up on the XLA
+device timeline and the two traces correlate by name.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import runtime
+
+#: Synthetic tid space for named tracks (real OS thread ids stay well
+#: clear of this range on Linux/macOS).
+_TRACK_TID_BASE = 1_000_000
+
+
+class _NullSpan:
+    """The disabled path's span: a shared, stateless no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **attrs: Any) -> "_NullSpan":
+        """No-op attribute update."""
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One live span (context manager). Attributes set via ``set()`` (or
+    the ``span(...)`` kwargs) become chrome-trace ``args``."""
+
+    __slots__ = ("name", "track", "attrs", "_tracer", "_t0_ns")
+
+    def __init__(
+        self, tracer: "Tracer", name: str, track: Optional[str], attrs: Dict[str, Any]
+    ) -> None:
+        self.name = name
+        self.track = track
+        self.attrs = attrs
+        self._tracer = tracer
+        self._t0_ns = 0
+
+    def set(self, **attrs: Any) -> "Span":
+        """Attach/update span attributes; returns self for chaining."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._t0_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        t1 = time.perf_counter_ns()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._record(self.name, self.track, self._t0_ns, t1, self.attrs)
+        return False
+
+
+class _DeviceSpan:
+    """A :class:`Span` that also enters a ``jax.profiler.TraceAnnotation``
+    of the same name, so host stages correlate with XLA device traces
+    when a profiler capture is running. jax is imported inside
+    ``__enter__`` (enabled path only) so telemetry never forces a
+    backend init."""
+
+    __slots__ = ("_span", "_ann")
+
+    def __init__(self, span: Span) -> None:
+        self._span = span
+        self._ann = None
+
+    def set(self, **attrs: Any) -> "_DeviceSpan":
+        """Attach/update attributes on the underlying span."""
+        self._span.set(**attrs)
+        return self
+
+    def __enter__(self) -> "_DeviceSpan":
+        self._span.__enter__()
+        try:
+            from jax.profiler import TraceAnnotation
+
+            self._ann = TraceAnnotation(self._span.name)
+            self._ann.__enter__()
+        except Exception:  # noqa: BLE001 — no jax / no profiler: host-only span
+            self._ann = None
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        return self._span.__exit__(exc_type, exc, tb)
+
+
+class Tracer:
+    """Bounded in-memory trace: the last ``capacity`` closed spans and
+    instant events, ready to export as chrome-trace JSON. The ring IS
+    the flight recorder's buffer — it survives any failure the process
+    itself survives, and :class:`~byzpy_tpu.observability.recorder.
+    FlightRecorder` dumps its tail on crash."""
+
+    def __init__(self, capacity: int = 65536) -> None:
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._tracks: Dict[str, int] = {}
+        self._epoch_ns = time.perf_counter_ns()
+        self._epoch_unix_s = time.time()
+        self.dropped = 0
+
+    # -- recording --------------------------------------------------------
+
+    def _tid(self, track: Optional[str]) -> int:
+        if track is None:
+            return threading.get_ident() & 0xFFFF
+        tid = self._tracks.get(track)
+        if tid is None:
+            with self._lock:
+                tid = self._tracks.setdefault(
+                    track, _TRACK_TID_BASE + len(self._tracks)
+                )
+        return tid
+
+    def _record(
+        self,
+        name: str,
+        track: Optional[str],
+        t0_ns: int,
+        t1_ns: int,
+        attrs: Dict[str, Any],
+    ) -> None:
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": (t0_ns - self._epoch_ns) / 1e3,
+            "dur": (t1_ns - t0_ns) / 1e3,
+            "tid": self._tid(track),
+        }
+        if attrs:
+            ev["args"] = attrs
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def span(self, name: str, track: Optional[str] = None, **attrs: Any) -> Span:
+        """Open a span on this tracer (unconditionally — the flag-checked
+        front door is the module-level :func:`span`)."""
+        return Span(self, name, track, attrs)
+
+    def instant(self, name: str, track: Optional[str] = None, **attrs: Any) -> None:
+        """Record an instant (zero-duration) event."""
+        ev: Dict[str, Any] = {
+            "name": name,
+            "ph": "i",
+            "s": "t",
+            "ts": (time.perf_counter_ns() - self._epoch_ns) / 1e3,
+            "tid": self._tid(track),
+        }
+        if attrs:
+            ev["args"] = attrs
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(ev)
+
+    # -- introspection / export ------------------------------------------
+
+    def events(self) -> List[dict]:
+        """Snapshot of the retained events (oldest first)."""
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        """Drop retained events (tests / between recorded runs)."""
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def chrome_trace(self) -> dict:
+        """The chrome-trace JSON object (``traceEvents`` + metadata):
+        open in Perfetto (ui.perfetto.dev) or ``chrome://tracing``."""
+        pid = os.getpid()
+        retained = self.events()
+        used_tids = {ev["tid"] for ev in retained}
+        with self._lock:
+            # snapshot: _tid mutates this dict from other threads, and
+            # the crash-dump path may export mid-flight
+            tracks = dict(self._tracks)
+        events: List[dict] = []
+        for track, tid in sorted(tracks.items(), key=lambda kv: kv[1]):
+            if tid not in used_tids:
+                continue  # only name tracks the retained events reference
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": track},
+                }
+            )
+        for ev in retained:
+            events.append({"pid": pid, **ev})
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "byzpy_tpu.observability",
+                "epoch_unix_s": self._epoch_unix_s,
+                "dropped_events": self.dropped,
+            },
+        }
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write :meth:`chrome_trace` to ``path``; returns the event
+        count. (Host-side file IO — keep it off event loops.)"""
+        trace = self.chrome_trace()
+        with open(path, "w") as fh:
+            json.dump(trace, fh)
+        return len(trace["traceEvents"])
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    """The process-wide tracer all instrumented fabrics record into."""
+    return _TRACER
+
+
+def span(name: str, track: Optional[str] = None, **attrs: Any):
+    """Open a span on the process tracer — or, with telemetry disabled,
+    return the shared no-op singleton after a single flag check."""
+    if not runtime.STATE.enabled:
+        return NULL_SPAN
+    return Span(_TRACER, name, track, attrs)
+
+
+def device_span(name: str, track: Optional[str] = None, **attrs: Any):
+    """A :func:`span` that also brackets the region with
+    ``jax.profiler.TraceAnnotation`` so host and XLA device timelines
+    correlate (use around device dispatches: folds, jitted steps)."""
+    if not runtime.STATE.enabled:
+        return NULL_SPAN
+    return _DeviceSpan(Span(_TRACER, name, track, attrs))
+
+
+def instant(name: str, track: Optional[str] = None, **attrs: Any) -> None:
+    """Record an instant event on the process tracer (flag-checked)."""
+    if runtime.STATE.enabled:
+        _TRACER.instant(name, track, **attrs)
+
+
+__all__ = [
+    "NULL_SPAN",
+    "Span",
+    "Tracer",
+    "device_span",
+    "instant",
+    "span",
+    "tracer",
+]

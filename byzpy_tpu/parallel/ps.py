@@ -472,16 +472,23 @@ def build_serving_ps_step(
         feat_spec = NamedSharding(mesh, P(None, (axis, *extra)))
 
     def step(params, opt_state, matrix, valid, weights):
-        # staleness discount: scale each row before the robust reduce
-        # (a weight of exactly 1.0 leaves the row bit-identical; the
-        # padding rows are zero and stay zero)
-        matrix = matrix * weights[:, None].astype(matrix.dtype)
+        # named_scope = the in-jit analogue of the host tracing spans:
+        # the stage names land in HLO op metadata, so an XLA device
+        # profile shows the same serving.* stage taxonomy as the host
+        # timeline (docs/observability.md)
+        with jax.named_scope("serving.staleness_scale"):
+            # staleness discount: scale each row before the robust
+            # reduce (a weight of exactly 1.0 leaves the row
+            # bit-identical; the padding rows are zero and stay zero)
+            matrix = matrix * weights[:, None].astype(matrix.dtype)
         if feat_spec is not None:
             matrix = jax.lax.with_sharding_constraint(matrix, feat_spec)
-        agg_flat = masked_aggregate(matrix, valid).astype(param_dtype)
+        with jax.named_scope("serving.masked_aggregate"):
+            agg_flat = masked_aggregate(matrix, valid).astype(param_dtype)
         agg = unravel(agg_flat)
-        updates, new_opt_state = opt.update(agg, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        with jax.named_scope("serving.opt_update"):
+            updates, new_opt_state = opt.update(agg, opt_state, params)
+            params = optax.apply_updates(params, updates)
         metrics = {
             "agg_grad_norm": jnp.sqrt(jnp.sum(jnp.square(agg_flat))),
             "cohort_m": jnp.sum(valid.astype(jnp.int32)),

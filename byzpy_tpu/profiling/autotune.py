@@ -97,7 +97,7 @@ def sweep(
     import jax.numpy as jnp
 
     from ..ops.pallas_kernels import _SUBLANES, _round_up
-    from ..utils.metrics import timed_call_s
+    from ..observability.compat import timed_call_s
 
     platform = jax.default_backend()
     # cache keys carry the SUBLANE-PADDED row count — that is what the

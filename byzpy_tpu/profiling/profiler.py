@@ -99,7 +99,7 @@ def profile_call(
     the record (hyper-parameters, workload tags)."""
     import jax
 
-    from ..utils.metrics import timed_call_s
+    from ..observability.compat import timed_call_s
 
     spec = spec or detect_hardware(calibrate=jax.default_backend() == "cpu")
     jfn = jax.jit(fn)

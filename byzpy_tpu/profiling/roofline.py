@@ -97,7 +97,7 @@ def calibrate_cpu() -> HardwareSpec:
     import jax
     import jax.numpy as jnp
 
-    from ..utils.metrics import timed_call_s
+    from ..observability.compat import timed_call_s
 
     m = 1 << 26  # 64M f32 = 256 MB
     x = jnp.zeros((m,), jnp.float32)

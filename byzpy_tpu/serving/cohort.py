@@ -26,6 +26,7 @@ from typing import Any, Sequence, Tuple
 import numpy as np
 
 from ..aggregators.base import Aggregator
+from ..observability import tracing as obs_tracing
 from .buckets import BucketLadder
 from .queue import Submission
 from .staleness import StalenessPolicy
@@ -64,26 +65,35 @@ def build_cohort(
     server_round: int,
     ladder: BucketLadder,
     staleness: StalenessPolicy,
+    *,
+    tenant: str = "",
 ) -> Cohort:
     """Pad one round's submissions into the smallest bucket that holds
-    them, stamping per-row staleness discounts against ``server_round``."""
+    them, stamping per-row staleness discounts against ``server_round``.
+    ``tenant`` (optional) attributes the telemetry span to the owning
+    tenant's trace row."""
     m = len(submissions)
     bucket = ladder.bucket_for(m)
-    d = int(np.asarray(submissions[0].gradient).shape[0])
-    matrix = np.zeros((bucket, d), np.float32)
-    weights = np.zeros((bucket,), np.float32)
-    valid = np.zeros((bucket,), bool)
-    for slot, sub in enumerate(submissions):
-        matrix[slot] = sub.gradient
-        weights[slot] = staleness.discount(server_round - sub.round_submitted)
-        valid[slot] = True
-    return Cohort(
-        matrix=matrix,
-        valid=valid,
-        weights=weights,
-        clients=tuple(s.client for s in submissions),
-        first_arrival_s=min(s.arrived_s for s in submissions),
-    )
+    with obs_tracing.span(
+        "serving.bucket_pad",
+        track=f"tenant:{tenant}" if tenant else None,
+        round=server_round, m=m, bucket=bucket, tenant=tenant,
+    ):
+        d = int(np.asarray(submissions[0].gradient).shape[0])
+        matrix = np.zeros((bucket, d), np.float32)
+        weights = np.zeros((bucket,), np.float32)
+        valid = np.zeros((bucket,), bool)
+        for slot, sub in enumerate(submissions):
+            matrix[slot] = sub.gradient
+            weights[slot] = staleness.discount(server_round - sub.round_submitted)
+            valid[slot] = True
+        return Cohort(
+            matrix=matrix,
+            valid=valid,
+            weights=weights,
+            clients=tuple(s.client for s in submissions),
+            first_arrival_s=min(s.arrived_s for s in submissions),
+        )
 
 
 class CohortAggregator:
@@ -104,15 +114,30 @@ class CohortAggregator:
     into ``fold_init(bucket)`` as they land and closes the round with
     ``fold_finalize_masked`` — identical results, same jit cache."""
 
-    def __init__(self, aggregator: Aggregator) -> None:
+    def __init__(self, aggregator: Aggregator, *, tenant: str = "") -> None:
         self.aggregator = aggregator
+        #: owning tenant (telemetry attribution); the fold runs on
+        #: anonymous executor threads, so without this the expensive
+        #: stages would land on unnamed thread rows in the trace
+        self.tenant = tenant
+        self._track = f"tenant:{tenant}" if tenant else None
 
     def aggregate(self, cohort: Cohort) -> Any:
         """Aggregate one cohort to a ``(d,)`` vector."""
-        matrix = cohort.matrix
-        if bool((cohort.weights[: cohort.m] != 1.0).any()):
-            matrix = matrix * cohort.weights[:, None]
-        return self.aggregator.aggregate_masked(matrix, cohort.valid)
+        with obs_tracing.span(
+            "serving.fold", track=self._track,
+            m=cohort.m, bucket=cohort.bucket, tenant=self.tenant,
+        ):
+            matrix = cohort.matrix
+            if bool((cohort.weights[: cohort.m] != 1.0).any()):
+                matrix = matrix * cohort.weights[:, None]
+            # the device dispatch proper: TraceAnnotation-bracketed so a
+            # jax.profiler capture shows this fold on the XLA timeline
+            with obs_tracing.device_span(
+                "serving.device_step", track=self._track,
+                m=cohort.m, bucket=cohort.bucket, tenant=self.tenant,
+            ):
+                return self.aggregator.aggregate_masked(matrix, cohort.valid)
 
 
 __all__ = ["Cohort", "CohortAggregator", "build_cohort"]

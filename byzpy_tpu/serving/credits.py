@@ -20,6 +20,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict
 
+from ..observability.metrics import percentile_of_sorted
+
 
 @dataclass(frozen=True)
 class CreditPolicy:
@@ -168,14 +170,11 @@ class RoundStats:
     def latency_percentiles_s(self, *pcts: float) -> tuple:
         """Several latency percentiles from ONE sort of the retained
         window — a stats poll asking for p50 and p99 should not pay two
-        full sorts of a 4096-entry window on the admission loop."""
-        if not self.latencies_s:
-            return tuple(0.0 for _ in pcts)
+        full sorts of a 4096-entry window on the admission loop. The
+        rank rule is the telemetry layer's shared
+        :func:`~byzpy_tpu.observability.metrics.percentile_of_sorted`."""
         data = sorted(self.latencies_s)
-        top = len(data) - 1
-        return tuple(
-            data[min(top, int(round((p / 100.0) * top)))] for p in pcts
-        )
+        return tuple(percentile_of_sorted(data, p) for p in pcts)
 
 
 __all__ = [

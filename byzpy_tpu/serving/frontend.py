@@ -32,6 +32,7 @@ device work.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
@@ -39,6 +40,9 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from ..engine.actor import wire
+from ..observability import metrics as obs_metrics
+from ..observability import runtime as obs_runtime
+from ..observability import tracing as obs_tracing
 from .buckets import BucketLadder
 from .cohort import Cohort, CohortAggregator, build_cohort
 from .credits import (
@@ -63,6 +67,24 @@ RoundCallback = Callable[[str, int, Cohort, Any], None]
 #: distinct from a forged frame (peer dropped) and from every admission
 #: rejection (all of which name a well-formed submission).
 REJECTED_MALFORMED = "rejected_malformed"
+
+#: First 4 bytes of an HTTP GET — the ingress sniffs them where the
+#: wire length prefix would sit and serves a Prometheus scrape instead.
+_HTTP_GET_PREFIX = b"GET "
+_HTTP_MAX_REQUEST = 8192
+
+
+def _publish_wire_info() -> None:
+    """Refresh the ``byzpy_wire_info`` marker gauge (wire precision +
+    HMAC signing in effect) so exported metrics carry the parameters
+    the ingress-bytes law needs; reflects the env at the last scrape."""
+    precision = wire.wire_precision() or "off"
+    signed = "1" if os.environ.get("BYZPY_TPU_WIRE_KEY") else "0"
+    obs_metrics.registry().gauge(
+        "byzpy_wire_info",
+        help="wire precision/signing marker (value is always 1)",
+        labels={"precision": precision, "signed": signed},
+    ).set(1)
 
 
 @dataclass(frozen=True)
@@ -102,6 +124,89 @@ class TenantConfig:
             )
 
 
+class _TenantTelemetry:
+    """One tenant's registry instruments, created ONCE at tenant
+    construction so the per-submission path never pays a get-or-create
+    lookup — hot paths touch these only behind the telemetry flag
+    (``observability.runtime.STATE.enabled``). The instruments mirror
+    the tenant's pre-existing stats dict (``ServingFrontend.stats()``
+    stays the back-compat view); a Prometheus scrape of the TCP ingress
+    renders them in exposition format."""
+
+    __slots__ = (
+        "labels", "outcomes", "rounds", "failed", "ingress_bytes",
+        "submit_frames", "queue_depth", "outstanding", "latency_s",
+        "cohort_m",
+    )
+
+    def __init__(self, name: str, dim: int) -> None:
+        reg = obs_metrics.registry()
+        self.labels = {"tenant": name}
+        self.outcomes: Dict[str, obs_metrics.Counter] = {}
+        for reason in (
+            ACCEPTED, REJECTED_RATE, REJECTED_FULL, REJECTED_STALE,
+            REJECTED_SHAPE, REJECTED_MALFORMED,
+        ):
+            self.outcomes[reason] = reg.counter(
+                "byzpy_serving_submissions_total",
+                help="serving admissions by outcome",
+                labels={"tenant": name, "outcome": reason},
+            )
+        self.rounds = reg.counter(
+            "byzpy_serving_rounds_total",
+            help="closed serving rounds", labels=self.labels,
+        )
+        self.failed = reg.counter(
+            "byzpy_serving_failed_rounds_total",
+            help="crash-guarded (dropped) serving rounds", labels=self.labels,
+        )
+        self.ingress_bytes = reg.counter(
+            "byzpy_serving_ingress_bytes_total",
+            help="wire bytes of submit frames (length prefix included)",
+            labels=self.labels,
+        )
+        self.submit_frames = reg.counter(
+            "byzpy_serving_submit_frames_total",
+            help="submit frames received on the TCP ingress",
+            labels=self.labels,
+        )
+        self.queue_depth = reg.gauge(
+            "byzpy_serving_queue_depth",
+            help="admission queue depth", labels=self.labels,
+        )
+        self.outstanding = reg.gauge(
+            "byzpy_serving_outstanding",
+            help="admitted-but-not-aggregated submissions", labels=self.labels,
+        )
+        self.latency_s = reg.histogram(
+            "byzpy_serving_round_latency_seconds",
+            help="first-arrival-to-close latency of closed rounds",
+            labels=self.labels,
+        )
+        self.cohort_m = reg.histogram(
+            "byzpy_serving_cohort_size",
+            help="closed-round cohort sizes", labels=self.labels,
+            buckets=obs_metrics.SIZE_BUCKETS,
+        )
+        reg.gauge(
+            "byzpy_serving_tenant_dim",
+            help="tenant gradient dimension (for the ingress-bytes law)",
+            labels=self.labels,
+        ).set(dim)
+
+    def outcome(self, reason: str) -> None:
+        """Count one admission outcome (unknown reasons get their
+        counter on first sight)."""
+        c = self.outcomes.get(reason)
+        if c is None:
+            c = self.outcomes[reason] = obs_metrics.registry().counter(
+                "byzpy_serving_submissions_total",
+                help="serving admissions by outcome",
+                labels={**self.labels, "outcome": reason},
+            )
+        c.inc()
+
+
 class _Tenant:
     """Runtime state behind one :class:`TenantConfig`."""
 
@@ -109,7 +214,7 @@ class _Tenant:
         "cfg", "queue", "ledger", "ladder", "executor", "stats",
         "round_id", "ingress_bytes", "last_aggregate", "min_cohort",
         "outstanding", "round_done", "failed_rounds",
-        "last_cohort_clients", "held",
+        "last_cohort_clients", "held", "telemetry",
     )
 
     def __init__(self, cfg: TenantConfig) -> None:
@@ -117,7 +222,7 @@ class _Tenant:
         self.queue = AdmissionQueue(cfg.queue_capacity)
         self.ledger = CreditLedger(cfg.credit)
         self.ladder = BucketLadder(cfg.cohort_cap, min_bucket=cfg.min_bucket)
-        self.executor = CohortAggregator(cfg.aggregator)
+        self.executor = CohortAggregator(cfg.aggregator, tenant=cfg.name)
         # effective round floor: the operator's min_cohort raised to the
         # aggregator's smallest admissible n (probed via validate_n), so
         # the out-of-the-box config can never close a cohort the crash
@@ -155,6 +260,7 @@ class _Tenant:
         #: closer (:meth:`ServingFrontend.close_round_nowait`); the async
         #: scheduler keeps its own held list
         self.held: list = []
+        self.telemetry = _TenantTelemetry(cfg.name, cfg.dim)
 
 
 class ServingFrontend:
@@ -189,6 +295,26 @@ class ServingFrontend:
         #: exceptions swallowed from the user's ``on_round`` callback
         #: (an observer bug must not kill a tenant's scheduler)
         self.callback_errors = 0
+        # frontend-global registry mirrors of the three counters above
+        # (+ unknown-tenant rejections, which name no tenant) — created
+        # once; incremented only behind the telemetry flag
+        reg = obs_metrics.registry()
+        self._m_bad_frames = reg.counter(
+            "byzpy_serving_bad_frames_total",
+            help="frames dropped at the ingress (HMAC/decode/oversize)",
+        )
+        self._m_malformed = reg.counter(
+            "byzpy_serving_malformed_requests_total",
+            help="decoded frames with nonsense fields (peer kept)",
+        )
+        self._m_callback_errors = reg.counter(
+            "byzpy_serving_callback_errors_total",
+            help="exceptions swallowed from on_round observers",
+        )
+        self._m_unknown_tenant = reg.counter(
+            "byzpy_serving_unknown_tenant_total",
+            help="submissions naming no configured tenant",
+        )
 
     # -- admission (synchronous, cheap) ----------------------------------
 
@@ -207,18 +333,27 @@ class ServingFrontend:
         staleness cutoff; client has rate credit; queue has capacity."""
         t = self._tenants.get(tenant)
         if t is None:
+            if obs_runtime.STATE.enabled:
+                self._m_unknown_tenant.inc()
             return False, REJECTED_TENANT
+        telemetry = obs_runtime.STATE.enabled
         now = self._clock()
         row = np.asarray(gradient)
         if row.ndim != 1 or row.shape[0] != t.cfg.dim or row.dtype.kind != "f":
             t.ledger.record(REJECTED_SHAPE, client)
+            if telemetry:
+                t.telemetry.outcome(REJECTED_SHAPE)
             return False, REJECTED_SHAPE
         delta = t.round_id - int(round_submitted)
         if not t.cfg.staleness.admits(delta):
             t.ledger.record(REJECTED_STALE, client)
+            if telemetry:
+                t.telemetry.outcome(REJECTED_STALE)
             return False, REJECTED_STALE
         if not t.ledger.admit(client, now):
             t.ledger.record(REJECTED_RATE, client)
+            if telemetry:
+                t.telemetry.outcome(REJECTED_RATE)
             return False, REJECTED_RATE
         ok = t.queue.offer(
             Submission(
@@ -230,9 +365,15 @@ class ServingFrontend:
         )
         if not ok:
             t.ledger.record(REJECTED_FULL, client)
+            if telemetry:
+                t.telemetry.outcome(REJECTED_FULL)
             return False, REJECTED_FULL
         t.outstanding += 1
         t.ledger.record(ACCEPTED, client)
+        if telemetry:
+            t.telemetry.outcome(ACCEPTED)
+            t.telemetry.queue_depth.set(t.queue.depth())
+            t.telemetry.outstanding.set(t.outstanding)
         return True, ACCEPTED
 
     def handle_request(self, request: Any) -> dict:
@@ -249,14 +390,20 @@ class ServingFrontend:
         if kind == "submit":
             tenant = request.get("tenant", "")
             try:
-                accepted, reason = self.submit(
-                    tenant if isinstance(tenant, str) else "",
-                    str(request.get("client", "")),
-                    int(request.get("round", 0)),
-                    request.get("gradient"),
-                )
+                with obs_tracing.span(
+                    "serving.admission",
+                    tenant=tenant if isinstance(tenant, str) else "?",
+                ):
+                    accepted, reason = self.submit(
+                        tenant if isinstance(tenant, str) else "",
+                        str(request.get("client", "")),
+                        int(request.get("round", 0)),
+                        request.get("gradient"),
+                    )
             except Exception:  # noqa: BLE001 — client bug, not ours
                 self.malformed_requests += 1
+                if obs_runtime.STATE.enabled:
+                    self._m_malformed.inc()
                 return {
                     "kind": "ack",
                     "accepted": False,
@@ -323,6 +470,9 @@ class ServingFrontend:
         t.failed_rounds += 1
         t.outstanding -= cohort.m
         t.round_done.set()
+        if obs_runtime.STATE.enabled:
+            t.telemetry.failed.inc()
+            t.telemetry.outstanding.set(t.outstanding)
 
     def _finish_round(self, t: _Tenant, cohort: Cohort, vec: Any) -> int:
         """Round-close bookkeeping shared by the async scheduler and
@@ -333,18 +483,33 @@ class ServingFrontend:
         Returns the closed round id."""
         t.last_aggregate = vec
         t.last_cohort_clients = cohort.clients
-        t.stats.record(self._clock() - cohort.first_arrival_s, cohort.m)
+        latency_s = self._clock() - cohort.first_arrival_s
+        t.stats.record(latency_s, cohort.m)
         closed = t.round_id
         t.round_id += 1
         t.outstanding -= cohort.m
         t.round_done.set()
-        if self._on_round is not None:
-            try:
-                self._on_round(t.cfg.name, closed, cohort, vec)
-            except Exception:  # noqa: BLE001 — an observer bug must
-                # not kill the scheduler any more than a poisoned
-                # cohort may; counted, never silent
-                self.callback_errors += 1
+        if obs_runtime.STATE.enabled:
+            t.telemetry.rounds.inc()
+            t.telemetry.latency_s.observe(latency_s)
+            t.telemetry.cohort_m.observe(cohort.m)
+            t.telemetry.queue_depth.set(t.queue.depth())
+            t.telemetry.outstanding.set(t.outstanding)
+        with obs_tracing.span(
+            "serving.broadcast",
+            track=f"tenant:{t.cfg.name}",
+            tenant=t.cfg.name,
+            round=closed,
+        ):
+            if self._on_round is not None:
+                try:
+                    self._on_round(t.cfg.name, closed, cohort, vec)
+                except Exception:  # noqa: BLE001 — an observer bug must
+                    # not kill the scheduler any more than a poisoned
+                    # cohort may; counted, never silent
+                    self.callback_errors += 1
+                    if obs_runtime.STATE.enabled:
+                        self._m_callback_errors.inc()
         return closed
 
     async def _tenant_loop(self, t: _Tenant) -> None:
@@ -367,22 +532,33 @@ class ServingFrontend:
                 # next arrival
                 continue
             subs, held = held, []
-            cohort = build_cohort(
-                subs, t.round_id, t.ladder, t.cfg.staleness
-            )
-            assert self._device_lock is not None
-            try:
-                async with self._device_lock:
-                    # device work off the event loop: ingress keeps
-                    # admitting while this tenant's round aggregates
-                    vec = await loop.run_in_executor(
-                        None, t.executor.aggregate, cohort
+            track = f"tenant:{t.cfg.name}"
+            with obs_tracing.span(
+                "serving.round", track=track, tenant=t.cfg.name,
+                round=t.round_id, m=len(subs),
+            ) as round_span:
+                with obs_tracing.span(
+                    "serving.cohort_close", track=track,
+                    round=t.round_id, m=len(subs),
+                ):
+                    cohort = build_cohort(
+                        subs, t.round_id, t.ladder, t.cfg.staleness,
+                        tenant=t.cfg.name,
                     )
-            except Exception:  # noqa: BLE001 — a poisoned cohort must
-                # never kill the scheduler: drop the round, keep serving
-                self._fail_round(t, cohort)
-                continue
-            self._finish_round(t, cohort, vec)
+                round_span.set(bucket=cohort.bucket)
+                assert self._device_lock is not None
+                try:
+                    async with self._device_lock:
+                        # device work off the event loop: ingress keeps
+                        # admitting while this tenant's round aggregates
+                        vec = await loop.run_in_executor(
+                            None, t.executor.aggregate, cohort
+                        )
+                except Exception:  # noqa: BLE001 — a poisoned cohort must
+                    # never kill the scheduler: drop the round, keep serving
+                    self._fail_round(t, cohort)
+                    continue
+                self._finish_round(t, cohort, vec)
 
     async def drain(self, tenant: str) -> int:
         """Wait until every ADMISSIBLE submission of ``tenant`` has been
@@ -430,13 +606,25 @@ class ServingFrontend:
         if len(t.held) < t.min_cohort:
             return None
         subs, t.held = t.held, []
-        cohort = build_cohort(subs, t.round_id, t.ladder, t.cfg.staleness)
-        try:
-            vec = t.executor.aggregate(cohort)
-        except Exception:  # noqa: BLE001 — same contract as the scheduler
-            self._fail_round(t, cohort)
-            return None
-        return self._finish_round(t, cohort, vec), cohort, vec
+        track = f"tenant:{t.cfg.name}"
+        with obs_tracing.span(
+            "serving.round", track=track, tenant=t.cfg.name,
+            round=t.round_id, m=len(subs),
+        ):
+            with obs_tracing.span(
+                "serving.cohort_close", track=track,
+                round=t.round_id, m=len(subs),
+            ):
+                cohort = build_cohort(
+                    subs, t.round_id, t.ladder, t.cfg.staleness,
+                    tenant=t.cfg.name,
+                )
+            try:
+                vec = t.executor.aggregate(cohort)
+            except Exception:  # noqa: BLE001 — same contract as the scheduler
+                self._fail_round(t, cohort)
+                return None
+            return self._finish_round(t, cohort, vec), cohort, vec
 
     def public_state(self, tenant: str) -> Any:
         """The tenant's public per-round feed, as any client —
@@ -487,19 +675,34 @@ class ServingFrontend:
                     header = await reader.readexactly(wire._HEADER.size)
                 except asyncio.IncompleteReadError:
                     break
+                if header == _HTTP_GET_PREFIX:
+                    # the same TCP ingress doubles as the Prometheus
+                    # scrape endpoint: a peer opening with "GET " is an
+                    # HTTP scraper, not a wire client. As a length
+                    # prefix those 4 bytes would name a ~1.2 GB frame —
+                    # technically under MAX_FRAME, so this sniff does
+                    # shadow that one exact frame size, but no serving
+                    # client sends GB-scale control frames and before
+                    # this branch such a peer just hung for 1.2 GB and
+                    # was dropped as a bad frame
+                    await self._serve_http_metrics(reader, writer)
+                    break
                 (length,) = wire._HEADER.unpack(header)
                 if length > wire.MAX_FRAME:
                     # an oversized prefix is as hostile as a tampered
                     # frame — count it, never a silent drop
-                    self.bad_frames += 1
+                    self._count_bad_frame()
                     break
                 body = await reader.readexactly(length)
                 try:
-                    request = wire.decode(body)
+                    with obs_tracing.span(
+                        "serving.ingress.decode", bytes=length
+                    ):
+                        request = wire.decode(body)
                 except Exception:  # noqa: BLE001 — forged/tampered frame
                     # a frame that fails HMAC/unpickle names no trustable
                     # tenant; count it at the frontend and drop the peer
-                    self.bad_frames += 1
+                    self._count_bad_frame()
                     break
                 name = (
                     request.get("tenant")
@@ -516,6 +719,9 @@ class ServingFrontend:
                 # would skew the measured side
                 if t is not None and request.get("kind") == "submit":
                     t.ingress_bytes += wire._HEADER.size + length
+                    if obs_runtime.STATE.enabled:
+                        t.telemetry.ingress_bytes.inc(wire._HEADER.size + length)
+                        t.telemetry.submit_frames.inc()
                 await wire.send_obj(writer, self.handle_request(request))
         finally:
             writer.close()
@@ -523,6 +729,36 @@ class ServingFrontend:
                 await writer.wait_closed()
             except Exception:  # noqa: BLE001 — peer already gone
                 pass
+
+    def _count_bad_frame(self) -> None:
+        self.bad_frames += 1
+        if obs_runtime.STATE.enabled:
+            self._m_bad_frames.inc()
+
+    async def _serve_http_metrics(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """Answer one HTTP GET on the wire ingress with the process
+        metrics registry in Prometheus text exposition format (0.0.4).
+        The request is drained up to its blank line (bounded) so the
+        scraper sees a clean close; rendering is an in-memory string
+        build, safe on the admission loop."""
+        data = b""
+        while b"\r\n\r\n" not in data and len(data) < _HTTP_MAX_REQUEST:
+            chunk = await reader.read(1024)
+            if not chunk:
+                break
+            data += chunk
+        _publish_wire_info()
+        body = obs_metrics.registry().prometheus_text().encode()
+        writer.write(
+            b"HTTP/1.0 200 OK\r\n"
+            b"Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+            + f"Content-Length: {len(body)}\r\n".encode()
+            + b"Connection: close\r\n\r\n"
+            + body
+        )
+        await writer.drain()
 
     # -- introspection ---------------------------------------------------
 

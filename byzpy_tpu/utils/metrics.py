@@ -1,194 +1,33 @@
-"""Structured metrics + profiling hooks.
+"""Deprecated shim — the metrics/profiling helpers moved to
+:mod:`byzpy_tpu.observability.compat`.
 
-The reference is ``print()``-based (SURVEY §5: ``context.py:805-808``,
-``remote.py:290``); the survey flags structured metrics and jax.profiler
-integration as required additions. This module provides:
-
-* :class:`MetricsLogger` — step-keyed scalar metrics with an in-memory
-  history, optional JSONL sink, and summaries;
-* :func:`trace` — context manager around ``jax.profiler`` trace capture;
-* :class:`StepTimer` — wall-clock timing with ``block_until_ready`` so
-  device async dispatch doesn't fake the numbers.
+The seed-era :class:`MetricsLogger`/:class:`StepTimer` now live in the
+telemetry subsystem and publish into its process-wide metrics registry
+(``byzpy_logged_<key>`` gauges, the ``byzpy_step_seconds`` histogram)
+while keeping their exact public behavior; :func:`trace`,
+:func:`force_result` and :func:`timed_call_s` moved with them. This
+module re-exports everything so existing imports keep working, and
+will be removed in a future major version — import from
+``byzpy_tpu.observability`` instead.
 """
 
 from __future__ import annotations
 
-import contextlib
-import json
-import time
-from collections import defaultdict
-from typing import Any, Dict, Iterator, List, Optional
+import warnings
 
-import jax
+from ..observability.compat import (  # noqa: F401 — re-exports
+    MetricsLogger,
+    StepTimer,
+    force_result,
+    timed_call_s,
+    trace,
+)
 
-
-def _scalar(value: Any) -> Any:
-    """Coerce device values to JSON-able python, recursively: 0-d arrays
-    become numbers, n-d arrays nested lists, containers are walked, and
-    anything else non-serializable falls back to ``str``."""
-    ndim = getattr(value, "ndim", None)
-    if ndim == 0 and hasattr(value, "item"):
-        try:
-            return value.item()
-        except Exception:  # noqa: BLE001
-            return str(value)
-    if ndim is not None and ndim > 0 and hasattr(value, "tolist"):
-        try:
-            return value.tolist()
-        except Exception:  # noqa: BLE001
-            return str(value)
-    if isinstance(value, dict):
-        return {str(k): _scalar(v) for k, v in value.items()}
-    if isinstance(value, (list, tuple)):
-        return [_scalar(v) for v in value]
-    if value is None or isinstance(value, (bool, int, float, str)):
-        return value
-    return str(value)
-
-
-class MetricsLogger:
-    """Step-keyed metrics with history and an optional JSONL file sink."""
-
-    def __init__(self, sink_path: Optional[str] = None) -> None:
-        self.history: List[Dict[str, Any]] = []
-        self._sink_path = sink_path
-        self._sink = open(sink_path, "a") if sink_path else None
-
-    def log(self, step: int, **values: Any) -> Dict[str, Any]:
-        record = {"step": int(step), "time": time.time()}
-        record.update({k: _scalar(v) for k, v in values.items()})
-        self.history.append(record)
-        if self._sink is not None:
-            self._sink.write(json.dumps(record) + "\n")
-            self._sink.flush()
-        return record
-
-    def series(self, key: str) -> List[Any]:
-        return [r[key] for r in self.history if key in r]
-
-    def latest(self, key: str) -> Any:
-        for r in reversed(self.history):
-            if key in r:
-                return r[key]
-        raise KeyError(key)
-
-    def summary(self) -> Dict[str, Dict[str, float]]:
-        """min/max/mean/last per numeric key."""
-        by_key: Dict[str, List[float]] = defaultdict(list)
-        for r in self.history:
-            for k, v in r.items():
-                if k in ("step", "time"):
-                    continue
-                if isinstance(v, (int, float)):
-                    by_key[k].append(float(v))
-        return {
-            k: {
-                "min": min(vs),
-                "max": max(vs),
-                "mean": sum(vs) / len(vs),
-                "last": vs[-1],
-                "count": len(vs),
-            }
-            for k, vs in by_key.items()
-        }
-
-    def close(self) -> None:
-        if self._sink is not None:
-            self._sink.close()
-            self._sink = None
-
-    def __enter__(self) -> "MetricsLogger":
-        return self
-
-    def __exit__(self, *exc: Any) -> None:
-        self.close()
-
-
-@contextlib.contextmanager
-def trace(log_dir: str) -> Iterator[None]:
-    """Capture a jax.profiler trace (view with TensorBoard / Perfetto)."""
-    jax.profiler.start_trace(log_dir, create_perfetto_link=False)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-def force_result(out: Any) -> Any:
-    """Synchronize harder than ``block_until_ready``: materialize one
-    element of every array output on the host. Remote-device tunnels have
-    been observed to return from ``block_until_ready`` before the compute
-    chain finishes; a host copy cannot."""
-    import numpy as np
-
-    def pull(leaf: Any) -> Any:
-        if isinstance(leaf, jax.Array):
-            return np.asarray(leaf.ravel()[:1] if leaf.ndim else leaf)
-        return leaf
-
-    return jax.tree_util.tree_map(pull, out)
-
-
-def timed_call_s(fn, *args: Any, warmup: int = 2, repeat: int = 20) -> float:
-    """Mean wall seconds per call over a chained loop, synchronized by host
-    materialization of the final output (:func:`force_result`) — on remote
-    tunnel devices ``block_until_ready`` has been observed returning before
-    the compute chain finishes (sub-physical sub-ms readings); a host copy
-    of the last output cannot. Input perturbation per rep was tried and
-    rejected: the extra 256MB-scale allocation per rep cost ~5x the actual
-    workload through the tunnel allocator, and no result-caching effect is
-    observable once force_result is the sync."""
-    import time as _time
-
-    for _ in range(warmup):
-        force_result(fn(*args))
-    t0 = _time.perf_counter()
-    out = None
-    for _ in range(repeat):
-        out = fn(*args)
-    force_result(out)
-    return (_time.perf_counter() - t0) / repeat
-
-
-class StepTimer:
-    """Accurate step timing: blocks on the step's outputs before reading
-    the clock, so XLA async dispatch can't make steps look instant."""
-
-    def __init__(self) -> None:
-        self.times_s: List[float] = []
-        self._t0: Optional[float] = None
-
-    def start(self) -> None:
-        self._t0 = time.perf_counter()
-
-    def stop(self, *outputs: Any) -> float:
-        if self._t0 is None:
-            raise RuntimeError("StepTimer.stop() without start()")
-        if outputs:
-            jax.block_until_ready(outputs)
-        dt = time.perf_counter() - self._t0
-        self.times_s.append(dt)
-        self._t0 = None
-        return dt
-
-    @contextlib.contextmanager
-    def measure(self, *outputs_holder: list) -> Iterator[None]:
-        self.start()
-        try:
-            yield
-        finally:
-            self.stop(*outputs_holder)
-
-    @property
-    def mean_s(self) -> float:
-        return sum(self.times_s) / len(self.times_s) if self.times_s else 0.0
-
-    @property
-    def median_s(self) -> float:
-        if not self.times_s:
-            return 0.0
-        s = sorted(self.times_s)
-        return s[len(s) // 2]
-
+warnings.warn(
+    "byzpy_tpu.utils.metrics is deprecated; import MetricsLogger/StepTimer/"
+    "trace from byzpy_tpu.observability (registry-backed ports)",
+    DeprecationWarning,
+    stacklevel=2,
+)
 
 __all__ = ["MetricsLogger", "trace", "StepTimer", "force_result", "timed_call_s"]

@@ -1,0 +1,439 @@
+"""Observability core: spans, metrics registry, exporters, recorder, CLI.
+
+The three contracts under test:
+
+* **disabled is free** — ``span()`` with telemetry off returns one
+  shared no-op object (no allocation) and records nothing;
+* **formats are real** — the chrome-trace export opens as chrome-trace
+  JSON, the Prometheus text endpoint renders exposition format 0.0.4
+  (cumulative buckets, ``_sum``/``_count``), JSONL round-trips;
+* **the shared percentile rule is the seed-era rule** — the stats
+  views (overlap, serving) delegate to ``percentile_of_sorted`` and
+  their outputs must be bit-identical to the formulas they replaced.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from byzpy_tpu import observability as obs
+from byzpy_tpu.observability import metrics as obs_metrics
+from byzpy_tpu.observability import tracing as obs_tracing
+from byzpy_tpu.observability.recorder import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Every test starts disabled with a clean tracer ring."""
+    obs.disable()
+    obs_tracing.tracer().clear()
+    yield
+    obs.disable()
+    obs_tracing.tracer().clear()
+
+
+# ---------------------------------------------------------------------------
+# spans / tracer
+# ---------------------------------------------------------------------------
+
+
+class TestSpans:
+    def test_disabled_span_is_shared_noop(self):
+        s1 = obs_tracing.span("a", round=1)
+        s2 = obs_tracing.span("b")
+        assert s1 is s2 is obs_tracing.NULL_SPAN
+        assert obs_tracing.device_span("c") is obs_tracing.NULL_SPAN
+        with s1:
+            s1.set(x=1)  # no-op, must not raise
+        obs_tracing.instant("d")
+        assert obs_tracing.tracer().events() == []
+
+    def test_span_records_complete_events_with_args(self):
+        obs.enable()
+        with obs_tracing.span("outer", track="test:track", round=7):
+            with obs_tracing.span("inner") as sp:
+                sp.set(m=3)
+        events = obs_tracing.tracer().events()
+        names = [ev["name"] for ev in events]
+        assert names == ["inner", "outer"]  # closed in LIFO order
+        inner, outer = events
+        assert inner["ph"] == outer["ph"] == "X"
+        assert inner["args"]["m"] == 3
+        assert outer["args"]["round"] == 7
+        assert inner["dur"] <= outer["dur"]
+
+    def test_span_exception_path_records_error_and_propagates(self):
+        obs.enable()
+        with pytest.raises(ValueError):
+            with obs_tracing.span("boom"):
+                raise ValueError("x")
+        (ev,) = obs_tracing.tracer().events()
+        assert ev["args"]["error"] == "ValueError"
+
+    def test_instant_events(self):
+        obs.enable()
+        obs_tracing.instant("tick", track="chaos", who="c1")
+        (ev,) = obs_tracing.tracer().events()
+        assert ev["ph"] == "i" and ev["args"]["who"] == "c1"
+
+    def test_ring_is_bounded_and_counts_drops(self):
+        tr = obs_tracing.Tracer(capacity=8)
+        for i in range(20):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.events()) == 8
+        assert tr.dropped == 12
+
+    def test_chrome_trace_export(self, tmp_path):
+        obs.enable()
+        with obs_tracing.span("stage", track="tenant:m0", round=1):
+            pass
+        path = str(tmp_path / "trace.json")
+        n = obs_tracing.tracer().export_chrome_trace(path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert n == len(doc["traceEvents"]) == 2  # metadata + span
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert meta[0]["args"]["name"] == "tenant:m0"
+        span = [e for e in doc["traceEvents"] if e["ph"] == "X"][0]
+        assert span["tid"] == meta[0]["tid"]
+        assert {"ts", "dur", "pid"} <= set(span)
+
+    def test_device_span_records_host_span(self):
+        obs.enable()
+        with obs_tracing.device_span("fold", m=4):
+            pass
+        (ev,) = obs_tracing.tracer().events()
+        assert ev["name"] == "fold" and ev["args"]["m"] == 4
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+class TestMetrics:
+    def test_counter_gauge_basics(self):
+        reg = obs_metrics.MetricsRegistry()
+        c = reg.counter("byzpy_t_total", "help", {"tenant": "a"})
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = reg.gauge("byzpy_t_depth")
+        g.set(5)
+        g.dec()
+        assert g.value == 4
+
+    def test_registry_get_or_create_identity_and_type_conflict(self):
+        reg = obs_metrics.MetricsRegistry()
+        a = reg.counter("byzpy_x_total", labels={"k": "v"})
+        b = reg.counter("byzpy_x_total", labels={"k": "v"})
+        assert a is b
+        c = reg.counter("byzpy_x_total", labels={"k": "w"})
+        assert c is not a
+        with pytest.raises(ValueError):
+            reg.gauge("byzpy_x_total")
+        with pytest.raises(ValueError):
+            reg.counter("not a name!")
+
+    def test_histogram_buckets_and_percentiles(self):
+        h = obs_metrics.Histogram("h", buckets=(1.0, 2.0, 4.0, 8.0))
+        for v in (0.5, 1.5, 1.6, 3.0, 100.0):
+            h.observe(v)
+        assert h.count == 5
+        assert h.sum == pytest.approx(106.6)
+        assert h.counts == [1, 2, 1, 0, 1]  # last bin = overflow
+        # p50 (rank 2) lands in the (1, 2] bucket
+        assert 1.0 <= h.percentile(50) <= 2.0
+        # p100 lands in overflow — clamped to the top finite edge
+        assert h.percentile(100) == 8.0
+        assert obs_metrics.Histogram("e").percentile(50) == 0.0
+
+    def test_prometheus_text_format(self):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("byzpy_a_total", "things", {"tenant": "x"}).inc(3)
+        reg.gauge("byzpy_b", "level").set(2.5)
+        h = reg.histogram("byzpy_c_seconds", "lat", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = reg.prometheus_text()
+        lines = text.strip().split("\n")
+        assert "# TYPE byzpy_a_total counter" in lines
+        assert 'byzpy_a_total{tenant="x"} 3' in lines
+        assert "# HELP byzpy_b level" in lines
+        assert "byzpy_b 2.5" in lines
+        # histogram: cumulative buckets + +Inf + sum/count
+        assert 'byzpy_c_seconds_bucket{le="0.1"} 1' in lines
+        assert 'byzpy_c_seconds_bucket{le="1"} 1' in lines
+        assert 'byzpy_c_seconds_bucket{le="+Inf"} 2' in lines
+        assert "byzpy_c_seconds_count 2" in lines
+        assert any(line.startswith("byzpy_c_seconds_sum 5.05") for line in lines)
+        # one TYPE header per family
+        assert sum(1 for line in lines if line.startswith("# TYPE")) == 3
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("byzpy_j_total", labels={"t": "a"}).inc(7)
+        h = reg.histogram("byzpy_j_seconds", buckets=(1.0,))
+        h.observe(0.5)
+        h.observe(2.0)
+        path = str(tmp_path / "m.jsonl")
+        assert reg.to_jsonl(path) == 2
+        recs = {r["name"]: r for r in obs_metrics.iter_jsonl(path)}
+        assert recs["byzpy_j_total"]["value"] == 7
+        assert recs["byzpy_j_total"]["labels"] == {"t": "a"}
+        assert recs["byzpy_j_seconds"]["count"] == 2
+        assert recs["byzpy_j_seconds"]["overflow"] == 1
+
+    def test_percentile_of_sorted_matches_seed_formulas(self):
+        rng = np.random.default_rng(0)
+        for n in (1, 2, 5, 33, 100):
+            vals = sorted(rng.normal(size=n).tolist())
+            for pct in (0, 10, 50, 90, 99, 100):
+                # the pre-telemetry RoundOverlapStats.lag_percentile rule
+                rank = max(0, min(n - 1, int(round(pct / 100.0 * (n - 1)))))
+                assert obs_metrics.percentile_of_sorted(vals, pct) == vals[rank]
+                # the pre-telemetry RoundStats.latency_percentiles_s rule
+                top = n - 1
+                assert (
+                    obs_metrics.percentile_of_sorted(vals, pct)
+                    == vals[min(top, int(round((pct / 100.0) * top)))]
+                )
+        assert obs_metrics.percentile_of_sorted([], 50) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def _rounds(self, n):
+        for r in range(n):
+            with obs_tracing.span("serving.round", round=r):
+                # stage spans ALSO carry a round arg — only the
+                # round-lifecycle span may count as a window boundary,
+                # or a 3-round window would shrink to one round
+                with obs_tracing.span("serving.bucket_pad", round=r):
+                    pass
+                with obs_tracing.span("serving.fold"):
+                    pass
+
+    def test_dump_keeps_last_n_rounds(self, tmp_path):
+        obs.enable()
+        self._rounds(10)
+        fr = FlightRecorder(last_rounds=3)
+        dump = fr.dump(str(tmp_path / "dump.json"), reason="test")
+        rounds = {
+            ev["args"]["round"]
+            for ev in dump["events"]
+            if ev["name"] == "serving.round"
+        }
+        assert rounds == {7, 8, 9}
+        # the retained rounds come with ALL their stage spans
+        pads = {
+            ev["args"]["round"]
+            for ev in dump["events"]
+            if ev["name"] == "serving.bucket_pad"
+        }
+        assert pads == {7, 8, 9}
+        assert dump["reason"] == "test"
+        assert isinstance(dump["metrics"], dict)
+        with open(tmp_path / "dump.json") as fh:
+            assert json.load(fh)["kind"] == "byzpy_tpu.flight_recorder"
+
+    def test_crash_hook_dumps_and_uninstalls(self, tmp_path):
+        import sys
+
+        obs.enable()
+        self._rounds(2)
+        path = str(tmp_path / "crash.json")
+        fr = FlightRecorder(last_rounds=8)
+        prev = sys.excepthook
+        fr.install(path)
+        try:
+            assert sys.excepthook is not prev
+            # simulate an unhandled exception reaching the hook chain
+            # (the chained previous hook prints the traceback to stderr)
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError:
+                sys.excepthook(*sys.exc_info())
+        finally:
+            fr.uninstall()
+        assert sys.excepthook is prev
+        with open(path) as fh:
+            dump = json.load(fh)
+        assert dump["reason"] == "excepthook:RuntimeError"
+        assert len(dump["events"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI summarizer
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _trace_file(self, tmp_path):
+        obs.enable()
+        for r in range(3):
+            with obs_tracing.span(
+                "serving.round", track="tenant:m0", round=r, tenant="m0"
+            ):
+                with obs_tracing.span("serving.fold", m=4):
+                    pass
+        path = str(tmp_path / "t.json")
+        obs_tracing.tracer().export_chrome_trace(path)
+        return path
+
+    def test_summarize_text(self, tmp_path, capsys):
+        from byzpy_tpu.observability.__main__ import main
+
+        assert main([self._trace_file(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "serving.round" in out and "serving.fold" in out
+        assert "per-stage latency breakdown" in out
+        assert "slow rounds" in out
+
+    def test_summarize_json_structure(self, tmp_path, capsys):
+        from byzpy_tpu.observability.__main__ import main
+
+        assert main([self._trace_file(tmp_path), "--json", "--top", "2"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        stages = {s["stage"] for s in doc["stages"]}
+        assert stages == {"serving.round", "serving.fold"}
+        assert len(doc["slow_rounds"]) == 2
+        assert doc["slow_rounds"][0]["tenant"] == "m0"
+        for s in doc["stages"]:
+            assert s["count"] == 3
+            assert s["p99_ms"] >= s["p50_ms"] >= 0
+
+    def test_summarize_flight_dump(self, tmp_path, capsys):
+        from byzpy_tpu.observability.__main__ import main
+
+        obs.enable()
+        with obs_tracing.span("serving.round", round=0):
+            pass
+        path = str(tmp_path / "d.json")
+        FlightRecorder().dump(path)
+        assert main([path]) == 0
+        assert "serving.round" in capsys.readouterr().out
+
+    def test_wire_residual_section(self, tmp_path, capsys):
+        from byzpy_tpu.observability.__main__ import main
+        from byzpy_tpu.parallel.comms import serving_ingress_bytes
+
+        reg = obs_metrics.MetricsRegistry()
+        law = serving_ingress_bytes(512, precision="off", signed=False)
+        reg.counter(
+            "byzpy_serving_ingress_bytes_total", labels={"tenant": "m0"}
+        ).inc(10 * law)
+        reg.counter(
+            "byzpy_serving_submit_frames_total", labels={"tenant": "m0"}
+        ).inc(10)
+        reg.gauge("byzpy_serving_tenant_dim", labels={"tenant": "m0"}).set(512)
+        reg.gauge(
+            "byzpy_wire_info", labels={"precision": "off", "signed": "0"}
+        ).set(1)
+        mpath = str(tmp_path / "m.jsonl")
+        reg.to_jsonl(mpath)
+        trace = self._trace_file(tmp_path)
+        assert main([trace, "--metrics", mpath, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        (row,) = doc["wire_residuals"]
+        assert row["tenant"] == "m0" and row["frames"] == 10
+        assert row["residual"] == pytest.approx(0.0, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# stats views on the shared machinery
+# ---------------------------------------------------------------------------
+
+
+class TestStatsViews:
+    def test_overlap_stats_thin_view(self):
+        from byzpy_tpu.engine.overlap import RoundOverlapStats
+
+        stats = RoundOverlapStats(mode="stream")
+        lags = [0.5, 0.1, 0.9, 0.3]
+        for v in lags:
+            stats.observe_lag(v)
+        assert stats.ingest_lags_s == lags  # raw per-round samples kept
+        s = sorted(lags)
+        for pct in (0, 50, 99, 100):
+            rank = max(0, min(3, int(round(pct / 100.0 * 3))))
+            assert stats.lag_percentile(pct) == s[rank]
+
+    def test_overlap_stats_publish_into_registry_when_enabled(self):
+        from byzpy_tpu.engine.overlap import RoundOverlapStats
+
+        hist = obs_metrics.registry().histogram(
+            "byzpy_overlap_ingest_lag_seconds"
+        )
+        before = hist.count
+        stats = RoundOverlapStats()
+        stats.observe_lag(0.01)  # disabled: list only
+        assert hist.count == before
+        obs.enable()
+        stats.observe_lag(0.02)
+        assert hist.count == before + 1
+
+    def test_round_stats_percentiles_unchanged(self):
+        from byzpy_tpu.serving.credits import RoundStats
+
+        rs = RoundStats()
+        rng = np.random.default_rng(1)
+        for v in rng.uniform(0, 1, size=57):
+            rs.record(float(v), 4)
+        data = sorted(rs.latencies_s)
+        top = len(data) - 1
+        p50, p99 = rs.latency_percentiles_s(50, 99)
+        assert p50 == data[min(top, int(round(0.50 * top)))]
+        assert p99 == data[min(top, int(round(0.99 * top)))]
+        assert RoundStats().latency_percentiles_s(50, 99) == (0.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# compat ports (utils.metrics shim)
+# ---------------------------------------------------------------------------
+
+
+class TestCompat:
+    def test_metrics_logger_publishes_gauges(self):
+        from byzpy_tpu.observability.compat import MetricsLogger
+
+        with MetricsLogger() as log:
+            log.log(0, loss=2.5, note="text")
+            log.log(1, loss=1.25)
+        g = obs_metrics.registry().gauge("byzpy_logged_loss")
+        assert g.value == 1.25
+        assert log.series("loss") == [2.5, 1.25]
+
+    def test_step_timer_feeds_histogram(self):
+        from byzpy_tpu.observability.compat import StepTimer
+
+        h = obs_metrics.registry().histogram("byzpy_step_seconds")
+        before = h.count
+        t = StepTimer()
+        t.start()
+        assert t.stop() >= 0.0
+        assert h.count == before + 1
+
+    def test_utils_metrics_shim_warns_and_reexports(self):
+        import importlib
+        import sys
+        import warnings
+
+        sys.modules.pop("byzpy_tpu.utils.metrics", None)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            mod = importlib.import_module("byzpy_tpu.utils.metrics")
+        assert any(
+            issubclass(w.category, DeprecationWarning) for w in caught
+        )
+        from byzpy_tpu.observability.compat import MetricsLogger
+
+        assert mod.MetricsLogger is MetricsLogger
