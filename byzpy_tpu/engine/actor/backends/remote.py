@@ -164,7 +164,19 @@ class RemoteActorBackend:
     async def start(self) -> None:
         if self._started:
             return
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        # dial under the shared retry policy (decorrelated-jitter
+        # backoff): a remote host still booting — or restarting after a
+        # crash the elastic PS is about to readmit it from — is ridden
+        # out instead of failing the caller on the first RST. In-flight
+        # REQUESTS are never replayed (no idempotency key on the actor
+        # wire); only the connect leg retries.
+        from ...actor.transports.tcp import dial_policy
+        from ....resilience.retry import connect_with_retry
+
+        self._reader, self._writer = await connect_with_retry(
+            self.host, self.port, policy=dial_policy(),
+            component="remote_actor",
+        )
         self._send_lock = asyncio.Lock()
         self._reader_task = asyncio.ensure_future(self._read_replies())
         channel_router.register(self.get_endpoint(), self)

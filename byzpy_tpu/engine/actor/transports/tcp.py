@@ -3,13 +3,26 @@
 Used when a local backend must deliver a channel payload to an actor hosted
 on a remote ``RemoteActorServer`` and no persistent client connection exists
 (ref: ``byzpy/engine/actor/transports/tcp.py:27-67``).
+
+Resilience: the DIAL is retried under a
+:class:`~byzpy_tpu.resilience.retry.RetryPolicy` (decorrelated-jitter
+backoff — a restarting remote server is ridden out instead of failing the
+round), but a request that was already SENT is never replayed: channel
+puts are at-least-once effects with no idempotency key, so an ambiguous
+send/receive failure surfaces to the caller (the elastic PS layer treats
+it as a node failure, which is the correct semantic). Tune via
+``BYZPY_TPU_TCP_RETRIES`` / ``BYZPY_TPU_TCP_RETRY_DEADLINE_S`` (dial
+attempts and total seconds; ``BYZPY_TPU_TCP_RETRIES=1`` restores the
+pre-retry single-try dial).
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import Any
 
+from ....resilience.retry import RetryPolicy, connect_with_retry
 from .. import wire
 from ..channels import Endpoint
 
@@ -19,9 +32,32 @@ def _split(address: str) -> tuple[str, int]:
     return host, int(port)
 
 
+def dial_policy() -> RetryPolicy:
+    """Dial retry policy from the environment (read per call — cheap,
+    and tests can flip it without reimporting)."""
+    try:
+        attempts = int(os.environ.get("BYZPY_TPU_TCP_RETRIES", "4"))
+    except ValueError:
+        attempts = 4
+    try:
+        deadline = float(
+            os.environ.get("BYZPY_TPU_TCP_RETRY_DEADLINE_S", "10")
+        )
+    except ValueError:
+        deadline = 10.0
+    return RetryPolicy(
+        max_attempts=max(1, attempts),
+        base_s=0.05,
+        cap_s=1.0,
+        deadline_s=max(0.1, deadline),
+    )
+
+
 async def _roundtrip(address: str, msg: dict) -> Any:
     host, port = _split(address)
-    reader, writer = await asyncio.open_connection(host, port)
+    reader, writer = await connect_with_retry(
+        host, port, policy=dial_policy(), component="actor_tcp"
+    )
     try:
         await wire.send_obj(writer, {**msg, "req_id": 0})
         reply = await wire.recv_obj(reader)
@@ -53,4 +89,4 @@ async def chan_get(endpoint: Endpoint, name: str) -> Any:
     )
 
 
-__all__ = ["chan_put", "chan_get"]
+__all__ = ["chan_get", "chan_put", "dial_policy"]

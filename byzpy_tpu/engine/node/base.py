@@ -26,6 +26,22 @@ class Node(abc.ABC):
     def apply_server_gradient(self, gradient: Any) -> None:
         """Apply the aggregated gradient to local model state."""
 
+    def ping(self) -> bool:
+        """Cheap liveness probe (see
+        :class:`~byzpy_tpu.resilience.heartbeat.NodeLivenessProbe`):
+        answering at all is the signal. Subclasses whose health is more
+        than process reachability (a device that must respond, a data
+        loader that must be open) should override and actually check."""
+        return True
+
+    def resync_params(self, state: Any) -> None:
+        """Receive authoritative state on re-admission after a
+        crash/restart (the :class:`~byzpy_tpu.engine.parameter_server.
+        elastic.ElasticPolicy` ``resync`` path). Default: no-op — nodes
+        that keep no cross-round state need nothing; stateful nodes
+        override to load params/opt state before their next gradient
+        counts."""
+
 
 class HonestNode(Node):
     """A node that computes true gradients on its own shard."""

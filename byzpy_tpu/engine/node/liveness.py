@@ -13,6 +13,13 @@ envelopes through :class:`DecentralizedNode` messaging) — no extra
 sockets, works identically over in-process, subprocess, hub-TCP and mesh
 contexts. Detection is deliberately conservative: only CONSECUTIVE
 misses count, one pong resets the counter.
+
+The suspicion state machine itself lives in :class:`LivenessTracker`,
+transport-free, so the actor-mode parameter server's direct node probe
+(:class:`~byzpy_tpu.resilience.heartbeat.NodeLivenessProbe`) shares the
+exact same rules — consecutive-miss suspicion, one-reply recovery,
+startup grace for peers that have never answered — instead of a
+second, drifting copy.
 """
 
 from __future__ import annotations
@@ -37,6 +44,103 @@ class PeerLiveness:
     pongs: int = 0
 
 
+class LivenessTracker:
+    """Transport-free suspicion bookkeeping shared by every monitor.
+
+    The cycle both monitors drive: :meth:`account_pending` charges the
+    PREVIOUS tick's unanswered probes (so a reply has the whole interval
+    to arrive), then each peer probed this tick is :meth:`mark_pending`;
+    a reply at any point calls :meth:`record_reply`. Transitions fire
+    ``on_suspect``/``on_recover`` exactly once per edge, crash-guarded —
+    a raising policy callback must not kill the heartbeat loop."""
+
+    def __init__(
+        self,
+        *,
+        max_missed: int = 3,
+        startup_grace: float = 0.0,
+        on_suspect: Optional[Callable[[str], None]] = None,
+        on_recover: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        if max_missed < 1:
+            raise ValueError(f"max_missed must be >= 1 (got {max_missed})")
+        if startup_grace < 0:
+            raise ValueError(
+                f"startup_grace must be >= 0 (got {startup_grace})"
+            )
+        self.max_missed = max_missed
+        # A peer that has NEVER replied is not suspected until this many
+        # seconds after start: a slow-starting peer (e.g. a subprocess
+        # context importing jax) would otherwise be declared dead before
+        # its first reply could possibly arrive. Peers that HAVE replied
+        # are unaffected — a genuine death is still caught in
+        # max_missed * interval.
+        self.startup_grace = startup_grace
+        self.on_suspect = on_suspect
+        self.on_recover = on_recover
+        self.peers: Dict[str, PeerLiveness] = {}
+        self._pending: Dict[str, bool] = {}
+        self._started_at: Optional[float] = None
+
+    def start_clock(self, now: float) -> None:
+        """Anchor the startup-grace window at ``now``."""
+        self._started_at = now
+
+    def ensure(self, peer: str) -> PeerLiveness:
+        """Begin (or continue) tracking ``peer``."""
+        return self.peers.setdefault(peer, PeerLiveness())
+
+    def mark_pending(self, peer: str) -> None:
+        """A probe went out to ``peer`` this tick."""
+        self.ensure(peer)
+        self._pending[peer] = True
+
+    def record_reply(self, peer: str) -> None:
+        """``peer`` answered: reset its miss streak; fire recovery on
+        the suspect→alive edge."""
+        self._pending.pop(peer, None)
+        rec = self.ensure(peer)
+        rec.pongs += 1
+        rec.missed = 0
+        if rec.suspect:
+            rec.suspect = False
+            self._fire(self.on_recover, peer)
+
+    def account_pending(self, now: float) -> None:
+        """Charge every still-unanswered probe as one consecutive miss;
+        peers crossing ``max_missed`` become suspect (edge-triggered)."""
+        in_grace = (
+            self._started_at is not None
+            and now - self._started_at < self.startup_grace
+        )
+        for peer, rec in self.peers.items():
+            if self._pending.get(peer):
+                if rec.pongs == 0 and in_grace:
+                    continue  # still booting; see startup_grace
+                rec.missed += 1
+                if rec.missed >= self.max_missed and not rec.suspect:
+                    rec.suspect = True
+                    self._fire(self.on_suspect, peer)
+
+    def _fire(self, callback, peer: str) -> None:
+        if callback is None:
+            return
+        try:
+            callback(peer)
+        except Exception:  # noqa: BLE001 — log, keep monitoring
+            _log.exception("liveness callback failed for peer %r", peer)
+
+    def suspects(self) -> List[str]:
+        """Peers currently considered failed."""
+        return sorted(p for p, r in self.peers.items() if r.suspect)
+
+    def alive(self) -> List[str]:
+        """Peers that answered at least once and are not suspect."""
+        return sorted(
+            p for p, r in self.peers.items() if r.pongs > 0 and not r.suspect
+        )
+
+
 class HeartbeatMonitor:
     """Drive heartbeats from one node to its in-topology neighbors.
 
@@ -56,29 +160,32 @@ class HeartbeatMonitor:
         on_recover: Optional[Callable[[str], None]] = None,
         startup_grace: float = 0.0,
     ) -> None:
-        if max_missed < 1:
-            raise ValueError(f"max_missed must be >= 1 (got {max_missed})")
-        if startup_grace < 0:
-            raise ValueError(
-                f"startup_grace must be >= 0 (got {startup_grace})"
-            )
         self.node = node
         self.interval = interval
-        self.max_missed = max_missed
-        self.on_suspect = on_suspect
-        self.on_recover = on_recover
-        # A peer that has NEVER ponged is not suspected until this many
-        # seconds after start(): a slow-starting peer (e.g. a subprocess
-        # context importing jax) would otherwise be declared dead before
-        # its first reply could possibly arrive. Peers that HAVE ponged
-        # are unaffected — a genuine death is still caught in
-        # max_missed * interval.
-        self.startup_grace = startup_grace
-        self.peers: Dict[str, PeerLiveness] = {}
+        self.tracker = LivenessTracker(
+            max_missed=max_missed,
+            startup_grace=startup_grace,
+            on_suspect=on_suspect,
+            on_recover=on_recover,
+        )
         self._task: Optional[asyncio.Task] = None
-        self._pending: Dict[str, bool] = {}
         self._handlers_installed = False
-        self._started_at: Optional[float] = None
+
+    # back-compat views: the pre-tracker public surface
+    @property
+    def peers(self) -> Dict[str, PeerLiveness]:
+        """Per-peer liveness records (the tracker's live dict)."""
+        return self.tracker.peers
+
+    @property
+    def max_missed(self) -> int:
+        """Consecutive misses before a peer is suspected."""
+        return self.tracker.max_missed
+
+    @property
+    def startup_grace(self) -> float:
+        """Grace window for peers that have never ponged."""
+        return self.tracker.startup_grace
 
     # -- message plumbing ---------------------------------------------------
 
@@ -98,14 +205,7 @@ class HeartbeatMonitor:
         self.install_responder(node)
 
         async def on_pong(message) -> None:
-            sender = message.sender
-            self._pending.pop(sender, None)
-            rec = self.peers.setdefault(sender, PeerLiveness())
-            rec.pongs += 1
-            rec.missed = 0
-            if rec.suspect:
-                rec.suspect = False
-                self._fire(self.on_recover, sender)
+            self.tracker.record_reply(message.sender)
 
         node.register_handler(PONG, on_pong)
 
@@ -120,8 +220,8 @@ class HeartbeatMonitor:
             self._install_handlers()
             self._handlers_installed = True
         for peer in self._neighbor_ids():
-            self.peers.setdefault(peer, PeerLiveness())
-        self._started_at = asyncio.get_running_loop().time()
+            self.tracker.ensure(peer)
+        self.tracker.start_clock(asyncio.get_running_loop().time())
         self._task = asyncio.ensure_future(self._loop())
 
     async def stop(self) -> None:
@@ -140,38 +240,16 @@ class HeartbeatMonitor:
             if peer != self.node.node_id
         ]
 
-    def _fire(self, callback, peer: str) -> None:
-        # a raising policy callback must not kill the heartbeat task —
-        # detection outlives one bad drop/alert attempt
-        if callback is None:
-            return
-        try:
-            callback(peer)
-        except Exception:  # noqa: BLE001 — log, keep monitoring
-            _log.exception("liveness callback failed for peer %r", peer)
-
     async def _loop(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
             # account the PREVIOUS tick's unanswered pings first, so a
             # pong has the whole interval to arrive
-            in_grace = (
-                self._started_at is not None
-                and loop.time() - self._started_at < self.startup_grace
-            )
-            for peer, rec in self.peers.items():
-                if self._pending.get(peer):
-                    if rec.pongs == 0 and in_grace:
-                        continue  # still booting; see startup_grace
-                    rec.missed += 1
-                    if rec.missed >= self.max_missed and not rec.suspect:
-                        rec.suspect = True
-                        self._fire(self.on_suspect, peer)
+            self.tracker.account_pending(loop.time())
             for peer in self._neighbor_ids():
                 # late-bound neighbors join the accounting here, so a dead
                 # peer added after start() still gets declared suspect
-                self.peers.setdefault(peer, PeerLiveness())
-                self._pending[peer] = True
+                self.tracker.mark_pending(peer)
                 try:
                     await self.node.send_message(peer, PING, {})
                 except Exception:  # noqa: BLE001 — unreachable peer: stays pending
@@ -182,13 +260,11 @@ class HeartbeatMonitor:
 
     def suspects(self) -> List[str]:
         """Peers currently considered failed."""
-        return sorted(p for p, r in self.peers.items() if r.suspect)
+        return self.tracker.suspects()
 
     def alive(self) -> List[str]:
         """Peers that answered at least once and are not suspect."""
-        return sorted(
-            p for p, r in self.peers.items() if r.pongs > 0 and not r.suspect
-        )
+        return self.tracker.alive()
 
 
-__all__ = ["HeartbeatMonitor", "PeerLiveness", "PING", "PONG"]
+__all__ = ["HeartbeatMonitor", "LivenessTracker", "PeerLiveness", "PING", "PONG"]

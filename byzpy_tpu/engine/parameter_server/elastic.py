@@ -72,19 +72,36 @@ class ElasticPolicy:
         gradient gather AND the apply fan-out). Ids must be this
         module's ``node_id`` strings (``"honest:3"``); an external
         monitor speaks its own peer-id namespace, so bridge it with a
-        mapping, e.g.::
+        mapping (:class:`~byzpy_tpu.resilience.heartbeat.
+        NodeLivenessProbe` already speaks ``node_id`` strings and plugs
+        in directly), e.g.::
 
             peer_to_slot = {"worker-a": "honest:0", "worker-b": "honest:1"}
             policy = ElasticPolicy(external_suspects=lambda: [
                 peer_to_slot[p] for p in monitor.suspects()
                 if p in peer_to_slot
             ])
+    ``resync``
+        Optional zero-arg callable returning the CURRENT authoritative
+        training state (params / opt state — whatever the deployment's
+        nodes need to rejoin coherently). When set, a suspected node due
+        for a re-admission probe is first sent that state via its
+        ``resync_method`` (default ``resync_params``); only nodes whose
+        resync call succeeds rejoin the round's gradient gather — a
+        restarted worker therefore computes its first counted gradient
+        on fresh params, never on whatever its reborn process
+        initialized. Without it, probes go straight to the gradient
+        call (the pre-resync behavior).
+    ``resync_method``
+        Node method name the resync payload is delivered through.
     """
 
     min_quorum: int = 1
     call_timeout: Optional[float] = None
     readmit_every: int = 1
     external_suspects: Optional[Callable[[], Sequence[str]]] = None
+    resync: Optional[Callable[[], Any]] = None
+    resync_method: str = "resync_params"
 
     def __post_init__(self) -> None:
         if self.min_quorum < 1:

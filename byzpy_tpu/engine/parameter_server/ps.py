@@ -341,6 +341,36 @@ class ParameterServer:
                 out.append((nid, node))
         return out
 
+    async def _resync_gate(
+        self, rotation: List[Any], round_no: int
+    ) -> List[Any]:
+        """Degraded-mode re-admission with state push: suspects due for
+        a probe receive the policy's authoritative ``resync`` payload
+        FIRST; only those whose resync lands stay in the rotation, so a
+        restarted worker's first counted gradient is computed on fresh
+        params (its reborn process's init state never enters the
+        aggregate). No-op without ``ElasticPolicy.resync`` or without
+        suspects in the rotation."""
+        policy, state = self.elastic, self.elastic_state
+        if policy.resync is None:
+            return rotation
+        probes = [(nid, n) for nid, n in rotation if nid in state.suspects]
+        if not probes:
+            return rotation
+        payload = policy.resync()
+        for nid, _ in probes:
+            state.note(round_no, nid, "resync")
+        ok = await elastic_gather(
+            probes, policy.resync_method, (payload,),
+            policy=policy, state=state, round_no=round_no,
+        )
+        ok_ids = {nid for nid, _ in ok}
+        probe_ids = {nid for nid, _ in probes}
+        return [
+            (nid, n) for nid, n in rotation
+            if nid not in probe_ids or nid in ok_ids
+        ]
+
     async def _elastic_chain_apply_compute(self, node: Any, aggregated: Any) -> Any:
         """Prefetch chain with elastic timeouts baked into each leg (see
         :func:`~byzpy_tpu.engine.parameter_server.elastic.elastic_settle`):
@@ -376,7 +406,9 @@ class ParameterServer:
             if policy.external_suspects is not None
             else set()
         )
-        rotation = self._rotation("honest", self.honest_nodes, external)
+        rotation = await self._resync_gate(
+            self._rotation("honest", self.honest_nodes, external), rnd
+        )
         pending = self._pending_elastic or {}
         self._pending_elastic = None
         settle_pairs: List[Any] = []
@@ -417,7 +449,10 @@ class ParameterServer:
             )
         honest = [g for _, g in honest_pairs]
         byz_pairs = await elastic_gather(
-            self._rotation("byzantine", self.byzantine_nodes, external),
+            await self._resync_gate(
+                self._rotation("byzantine", self.byzantine_nodes, external),
+                rnd,
+            ),
             "byzantine_gradient_for_next_batch", (honest,),
             policy=policy, state=state, round_no=rnd,
         )

@@ -1,0 +1,55 @@
+"""Self-healing runtime: retry/backoff, durable round state, degraded mode.
+
+PR 7's chaos fabric *simulates* faults as scenario events; this package
+makes the runtime *survive* the real ones. Podracer-style pod
+orchestration (arXiv:2104.06272) treats worker preemption and restart as
+the normal case rather than an error — the same stance here, in four
+pieces:
+
+* :mod:`~byzpy_tpu.resilience.retry` — :class:`RetryPolicy`
+  (exponential backoff with decorrelated jitter, a total-deadline
+  budget, retryable-vs-fatal classification) and the ``retry_async``
+  driver used by the serving client and the actor transports;
+* :mod:`~byzpy_tpu.resilience.durable` — the per-tenant write-ahead
+  round log + periodic snapshot behind
+  :meth:`~byzpy_tpu.serving.ServingFrontend.recover`: every accepted
+  submission is logged BEFORE its ack, every closed round records what
+  folded, and recovery reconstructs tenants from the latest valid
+  snapshot (corrupt generations fall back) with monotonic round
+  numbering and exactly-once folding;
+* :mod:`~byzpy_tpu.resilience.breaker` — the per-tenant circuit
+  breaker: consecutive failed rounds quarantine the tenant (queue
+  drained, submissions rejected with a reason) instead of crash-looping;
+* :mod:`~byzpy_tpu.resilience.heartbeat` — the node fabric's
+  :class:`~byzpy_tpu.engine.node.liveness.HeartbeatMonitor` generalized
+  to the actor-mode parameter server: probe node handles directly,
+  bridge suspects into :class:`~byzpy_tpu.engine.parameter_server.elastic.
+  ElasticPolicy`, and readmit restarted workers through a param resync.
+
+The kill-and-recover drill (``python -m byzpy_tpu.resilience.drill``)
+exercises the whole stack against a genuine SIGKILL; the chaos bench's
+``recovery`` lane runs it across seeds as a standing regression wall.
+Failure model and invariants: ``docs/fault_tolerance.md``.
+"""
+
+from .breaker import BreakerOpenError, BreakerPolicy, CircuitBreaker
+from .durable import DurabilityConfig, RoundLog, TenantDurability
+from .retry import (
+    RetryBudgetExceededError,
+    RetryPolicy,
+    connect_with_retry,
+    retry_async,
+)
+
+__all__ = [
+    "BreakerOpenError",
+    "BreakerPolicy",
+    "CircuitBreaker",
+    "DurabilityConfig",
+    "RetryBudgetExceededError",
+    "RetryPolicy",
+    "RoundLog",
+    "TenantDurability",
+    "connect_with_retry",
+    "retry_async",
+]

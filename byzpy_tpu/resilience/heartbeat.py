@@ -1,0 +1,132 @@
+"""Heartbeats for the actor-mode parameter server.
+
+:class:`~byzpy_tpu.engine.node.liveness.HeartbeatMonitor` speaks the
+decentralized message plane (ping/pong envelopes through a
+``DecentralizedNode``); the actor-mode PS has no such plane — its nodes
+are plain objects, actor handles, or remote proxies called directly. This
+probe generalizes the SAME suspicion state machine
+(:class:`~byzpy_tpu.engine.node.liveness.LivenessTracker`: consecutive-
+miss suspicion, one-reply recovery, startup grace) over direct node
+calls, so the PS fabric gets proactive failure detection instead of
+paying ``call_timeout`` per dead node per round:
+
+    probe = NodeLivenessProbe(
+        [(node_id("honest", i), n) for i, n in enumerate(nodes)],
+        interval=0.25, max_missed=3,
+    )
+    await probe.start()
+    ps = ParameterServer(..., elastic=ElasticPolicy(
+        external_suspects=probe.suspects,
+        resync=lambda: trainer.params,      # restart ⇒ param resync
+    ))
+
+The probe method defaults to ``ping`` and falls back to a zero-cost
+no-op for local objects without one (their liveness is the process's);
+actor handles RPC any method, and
+:class:`~byzpy_tpu.engine.node.base.Node` ships a default ``ping``. A
+node that answers again after suspicion recovers on the next tick, and
+the :class:`~byzpy_tpu.engine.parameter_server.elastic.ElasticPolicy`
+``resync`` hook then pushes authoritative params before the node's first
+gradient counts (see ``docs/fault_tolerance.md``)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ..engine.node.liveness import LivenessTracker
+from ..engine.parameter_server.elastic import call_node
+from ..observability import metrics as _obs_metrics
+
+
+class NodeLivenessProbe:
+    """Periodic direct-call heartbeats over ``(node_id, node)`` pairs."""
+
+    def __init__(
+        self,
+        nodes: Sequence[Tuple[str, Any]],
+        *,
+        interval: float = 0.5,
+        max_missed: int = 3,
+        call_timeout: Optional[float] = None,
+        probe_method: str = "ping",
+        on_suspect: Optional[Callable[[str], None]] = None,
+        on_recover: Optional[Callable[[str], None]] = None,
+        startup_grace: float = 0.0,
+    ) -> None:
+        self.nodes = list(nodes)
+        self.interval = interval
+        self.call_timeout = (
+            call_timeout if call_timeout is not None else interval
+        )
+        self.probe_method = probe_method
+        self.tracker = LivenessTracker(
+            max_missed=max_missed,
+            startup_grace=startup_grace,
+            on_suspect=on_suspect,
+            on_recover=on_recover,
+        )
+        self._task: Optional[asyncio.Task] = None
+        self._m_probes = _obs_metrics.registry().counter(
+            "byzpy_ps_liveness_probes_total",
+            help="direct-call heartbeat probes sent to PS nodes",
+        )
+
+    async def start(self) -> None:
+        """Begin probing (idempotent-guarded like the message monitor)."""
+        if self._task is not None:
+            raise RuntimeError("probe already running; stop() first")
+        for nid, _ in self.nodes:
+            self.tracker.ensure(nid)
+        self.tracker.start_clock(asyncio.get_running_loop().time())
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _probe_one(self, nid: str, node: Any) -> None:
+        try:
+            await call_node(
+                node, self.probe_method, (), timeout=self.call_timeout
+            )
+        except AttributeError:
+            # a plain local object with no probe method: in-process, so
+            # reachable by construction — count it as a reply rather
+            # than suspecting every legacy node
+            pass
+        except Exception:  # noqa: BLE001 — no reply: stays pending
+            return
+        self.tracker.record_reply(nid)
+
+    async def _loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            self.tracker.account_pending(loop.time())
+            self._m_probes.inc(len(self.nodes))
+            for nid, node in self.nodes:
+                self.tracker.mark_pending(nid)
+            # fire-and-collect concurrently: one hung node must not
+            # serialize the tick past its own timeout
+            await asyncio.gather(
+                *(self._probe_one(nid, node) for nid, node in self.nodes),
+                return_exceptions=True,
+            )
+            await asyncio.sleep(self.interval)
+
+    def suspects(self) -> List[str]:
+        """Node ids currently considered failed — plug directly into
+        ``ElasticPolicy(external_suspects=probe.suspects)``."""
+        return self.tracker.suspects()
+
+    def alive(self) -> List[str]:
+        """Node ids that answered at least once and are not suspect."""
+        return self.tracker.alive()
+
+
+__all__ = ["NodeLivenessProbe"]

@@ -30,11 +30,22 @@ Pieces:
   end: several models share one mesh with independent cohorts, queues,
   and credit ledgers.
 
+Self-healing (``byzpy_tpu.resilience``, re-exported here): attach a
+:class:`DurabilityConfig` for write-ahead round state +
+``ServingFrontend.recover()``, a :class:`BreakerPolicy` per tenant for
+circuit-breaker degraded mode, and a :class:`RetryPolicy` on
+:class:`ServingClient` for reconnect-and-resend under ``(client, seq)``
+idempotency keys (exactly-once folding). Failure model:
+``docs/fault_tolerance.md``.
+
 The serving PS step lives in ``parallel.ps.build_serving_ps_step``; the
 ingress-bandwidth law in ``parallel.comms.serving_ingress_bytes``;
 throughput/latency measurement in ``benchmarks/serving_bench.py``.
 """
 
+from ..resilience.breaker import BreakerPolicy
+from ..resilience.durable import DurabilityConfig
+from ..resilience.retry import RetryPolicy
 from .buckets import BucketLadder
 from .cohort import Cohort, CohortAggregator
 from .credits import CreditLedger, CreditPolicy, TokenBucket
@@ -44,11 +55,14 @@ from .staleness import StalenessPolicy
 
 __all__ = [
     "AdmissionQueue",
+    "BreakerPolicy",
     "BucketLadder",
     "Cohort",
     "CohortAggregator",
     "CreditLedger",
     "CreditPolicy",
+    "DurabilityConfig",
+    "RetryPolicy",
     "ServingClient",
     "ServingFrontend",
     "StalenessPolicy",

@@ -15,12 +15,23 @@ when ``BYZPY_TPU_WIRE_PRECISION`` is ``bf16``/``int8``. A submission
 frame is a dict::
 
     {"kind": "submit", "tenant": str, "client": str,
-     "round": int, "gradient": np.ndarray (d,)}
+     "round": int, "gradient": np.ndarray (d,), "seq": int | None}
 
 answered by ``{"kind": "ack", "accepted": bool, "reason": str,
 "round": int}``; ``{"kind": "stats", "tenant": str}`` returns the
-tenant's accounting snapshot. The analytic per-frame ingress cost is
+tenant's accounting snapshot and ``{"kind": "close_round", "tenant":
+str}`` drives the synchronous round closer (operator/drill door). The
+optional ``seq`` is the per-client monotonic idempotency key — a
+replayed ``(client, seq)`` acks accepted without re-folding. The
+analytic per-frame ingress cost is
 ``parallel.comms.serving_ingress_bytes``.
+
+Resilience (``byzpy_tpu.resilience``; docs/fault_tolerance.md): with a
+``durability=`` config every accept is write-ahead logged before its
+ack and tenants recover across SIGKILL via :meth:`ServingFrontend.
+recover`; a per-tenant ``breaker=`` policy quarantines crash-looping
+tenants; :class:`ServingClient` reconnects and resends under a
+``RetryPolicy``.
 
 The admission path (``submit``) is synchronous and cheap — shape gate,
 staleness gate, token-bucket spend, bounded enqueue — so the asyncio
@@ -32,10 +43,13 @@ device work.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import os
+import random
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -43,6 +57,10 @@ from ..engine.actor import wire
 from ..observability import metrics as obs_metrics
 from ..observability import runtime as obs_runtime
 from ..observability import tracing as obs_tracing
+from ..resilience.breaker import BreakerPolicy, CircuitBreaker
+from ..resilience.durable import DurabilityConfig, TenantDurability
+from ..resilience.retry import RetryPolicy, connect_with_retry, retry_async
+from ..utils.checkpoint import CheckpointNotFoundError
 from .buckets import BucketLadder
 from .cohort import Cohort, CohortAggregator, build_cohort
 from .credits import (
@@ -67,6 +85,27 @@ RoundCallback = Callable[[str, int, Cohort, Any], None]
 #: distinct from a forged frame (peer dropped) and from every admission
 #: rejection (all of which name a well-formed submission).
 REJECTED_MALFORMED = "rejected_malformed"
+
+#: A replayed ``(client, seq)`` the tenant already accepted: answered
+#: ``accepted=True`` (the retrying client must stop resending) but NOT
+#: re-enqueued — the original copy folds exactly once.
+DUPLICATE = "duplicate"
+
+#: Tenant quarantined by its circuit breaker (consecutive failed
+#: rounds): an explicit per-submission rejection, never a crash loop.
+REJECTED_QUARANTINED = "rejected_quarantined"
+
+#: The write-ahead append failed (disk full/unwritable): the ack could
+#: not be made a durable promise, so the submission is refused outright
+#: — retrying the SAME seq later is legitimate (nothing was enqueued).
+REJECTED_UNDURABLE = "rejected_not_durable"
+
+
+def _agg_digest(vec: Any) -> str:
+    """16-hex-char fingerprint of an aggregate's exact bits — what the
+    WAL round records carry, so recovery can prove digest continuity."""
+    a = np.ascontiguousarray(np.asarray(vec, np.float32))
+    return hashlib.sha256(a.tobytes()).hexdigest()[:16]
 
 #: First 4 bytes of an HTTP GET — the ingress sniffs them where the
 #: wire length prefix would sit and serves a Prometheus scrape instead.
@@ -106,6 +145,12 @@ class TenantConfig:
     queue_capacity: int = 1024
     credit: CreditPolicy = field(default_factory=CreditPolicy)
     staleness: StalenessPolicy = field(default_factory=StalenessPolicy)
+    #: optional degraded-mode policy: ``threshold`` CONSECUTIVE failed
+    #: rounds quarantine the tenant (queue drained with accounting, new
+    #: submissions rejected with ``rejected_quarantined``) until a
+    #: ``cooldown_s`` probe round succeeds. ``None`` = pre-existing
+    #: behavior (failed rounds count, serving continues unconditionally).
+    breaker: Optional[BreakerPolicy] = None
 
     def __post_init__(self) -> None:
         if self.dim <= 0:
@@ -215,9 +260,16 @@ class _Tenant:
         "round_id", "ingress_bytes", "last_aggregate", "min_cohort",
         "outstanding", "round_done", "failed_rounds",
         "last_cohort_clients", "held", "telemetry",
+        "seqs", "duplicates", "durability", "breaker", "next_wal_id",
+        "quarantine_drops", "recovered",
     )
 
-    def __init__(self, cfg: TenantConfig) -> None:
+    def __init__(
+        self,
+        cfg: TenantConfig,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
         self.cfg = cfg
         self.queue = AdmissionQueue(cfg.queue_capacity)
         self.ledger = CreditLedger(cfg.credit)
@@ -260,7 +312,38 @@ class _Tenant:
         #: closer (:meth:`ServingFrontend.close_round_nowait`); the async
         #: scheduler keeps its own held list
         self.held: list = []
+        #: per-client highest ACCEPTED idempotency key (LRU-bounded like
+        #: the credit ledger): a replayed ``(client, seq)`` at or below
+        #: it is a duplicate — acked accepted, never re-folded
+        self.seqs: "OrderedDict[str, int]" = OrderedDict()
+        self.duplicates = 0
+        #: write-ahead log + snapshots (attached by the frontend when a
+        #: DurabilityConfig is given); ``next_wal_id`` is the per-tenant
+        #: accept-record identity counter
+        self.durability: Optional[TenantDurability] = None
+        self.next_wal_id = 0
+        self.breaker: Optional[CircuitBreaker] = (
+            CircuitBreaker(cfg.breaker, clock=clock)
+            if cfg.breaker is not None
+            else None
+        )
+        #: queued submissions dropped (with accounting) when the breaker
+        #: opened
+        self.quarantine_drops = 0
+        #: recovery provenance (``RecoveredTenant``), None on fresh start
+        self.recovered: Any = None
         self.telemetry = _TenantTelemetry(cfg.name, cfg.dim)
+
+    def note_seq(self, client: str, seq: int) -> None:
+        """Record an accepted idempotency key (LRU-bounded)."""
+        prev = self.seqs.get(client, -1)
+        self.seqs[client] = max(prev, int(seq))
+        self.seqs.move_to_end(client)
+        if len(self.seqs) > self.cfg.credit.max_tracked_clients:
+            self.seqs.popitem(last=False)
+
+    def is_duplicate(self, client: str, seq: int) -> bool:
+        return self.seqs.get(client, -1) >= int(seq)
 
 
 class ServingFrontend:
@@ -272,6 +355,7 @@ class ServingFrontend:
         *,
         clock: Callable[[], float] = time.monotonic,
         on_round: Optional[RoundCallback] = None,
+        durability: Optional[DurabilityConfig] = None,
     ) -> None:
         if not tenants:
             raise ValueError("at least one tenant is required")
@@ -279,13 +363,24 @@ class ServingFrontend:
         for cfg in tenants:
             if cfg.name in self._tenants:
                 raise ValueError(f"duplicate tenant {cfg.name!r}")
-            self._tenants[cfg.name] = _Tenant(cfg)
+            self._tenants[cfg.name] = _Tenant(cfg, clock=clock)
         self._clock = clock
         self._on_round = on_round
         self._device_lock: Optional[asyncio.Lock] = None
         self._tasks: list = []
         self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set = set()
         self._running = False
+        self._durability = durability
+        #: per-tenant recovery provenance (RecoveredTenant or None) —
+        #: populated when a DurabilityConfig points at a directory with
+        #: prior life; a fresh directory leaves every value None
+        self.recovered: Dict[str, Any] = {}
+        #: strong refs to in-flight off-loop snapshot saves
+        self._snapshot_futs: list = []
+        if durability is not None:
+            for name, t in self._tenants.items():
+                self._attach_durability(t, durability)
         #: frames that failed HMAC verification / deserialization (the
         #: peer is dropped; no tenant can be trusted off a forged frame)
         self.bad_frames = 0
@@ -316,6 +411,159 @@ class ServingFrontend:
             help="submissions naming no configured tenant",
         )
 
+    # -- durability / recovery -------------------------------------------
+
+    @classmethod
+    def recover(
+        cls,
+        tenants: Sequence[TenantConfig],
+        durability: DurabilityConfig,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        on_round: Optional[RoundCallback] = None,
+    ) -> "ServingFrontend":
+        """Reconstruct a frontend from durable state: every tenant is
+        restored from its latest VALID snapshot generation (corrupt ones
+        fall back) plus write-ahead-log replay — round numbering resumes
+        monotonically, accepted-but-unfolded submissions re-enter the
+        queue, and the dedup table rejects stale ``(client, seq)``
+        replays. Raises :class:`~byzpy_tpu.utils.checkpoint.
+        CheckpointNotFoundError` when NO tenant has prior state (use the
+        plain constructor for a maybe-fresh start: it recovers when
+        state exists and starts clean when it doesn't)."""
+        fe = cls(
+            tenants, clock=clock, on_round=on_round, durability=durability
+        )
+        if not any(r is not None for r in fe.recovered.values()):
+            raise CheckpointNotFoundError(
+                f"no durable tenant state under {durability.directory} — "
+                "nothing to recover"
+            )
+        return fe
+
+    def _attach_durability(self, t: _Tenant, cfg: DurabilityConfig) -> None:
+        t.durability = TenantDurability(cfg, t.cfg.name)
+        rec = t.durability.recovered
+        self.recovered[t.cfg.name] = rec
+        if rec is None:
+            return
+        t.round_id = rec.round_id
+        t.last_aggregate = rec.last_aggregate
+        t.seqs = OrderedDict(rec.seqs)
+        t.next_wal_id = rec.next_wal_id
+        t.ledger.totals = dict(rec.ledger_totals)
+        t.failed_rounds = rec.failed_rounds
+        t.ingress_bytes = rec.ingress_bytes
+        t.stats.rounds = rec.stats_rounds
+        # accepted-before-death, never folded: back into the queue (the
+        # arrival stamp is re-issued on THIS process's clock — monotonic
+        # time does not survive a process boundary)
+        now = self._clock()
+        pending = [
+            Submission(
+                client=p["c"], round_submitted=int(p["r"]),
+                gradient=p["g"], arrived_s=now,
+                seq=p["q"], wal_id=int(p["w"]),
+            )
+            for p in rec.pending
+        ]
+        t.queue.restore(pending)
+        t.outstanding = len(pending)
+        t.recovered = rec
+        obs_metrics.registry().counter(
+            "byzpy_recoveries_total",
+            help="tenant recoveries from durable round state",
+            labels={"tenant": t.cfg.name},
+        ).inc()
+
+    def _write_ahead(self, t: _Tenant, sub: Submission) -> None:
+        """Append the accept record BEFORE the ack is returned — the ack
+        must be a durable promise (module contract)."""
+        assert t.durability is not None and sub.wal_id is not None
+        t.durability.record_accept(
+            sub.wal_id, sub.client, sub.seq, sub.round_submitted,
+            sub.arrived_s, sub.gradient,
+        )
+
+    def _maybe_snapshot(self, t: _Tenant) -> None:
+        """Periodic durable snapshot: capture state synchronously (no
+        awaits — consistent with the WAL rotation), persist off the
+        event loop when one is running, inline otherwise. A save that
+        never completes is safe: recovery falls back to the previous
+        generation and replays one segment more."""
+        d = t.durability
+        if d is None or not d.snapshot_due():
+            return
+        state = {
+            "round_id": t.round_id,
+            "last_aggregate": (
+                np.asarray(t.last_aggregate)
+                if t.last_aggregate is not None
+                else None
+            ),
+            "seqs": dict(t.seqs),
+            "next_wal_id": t.next_wal_id,
+            "ledger_totals": dict(t.ledger.totals),
+            "failed_rounds": t.failed_rounds,
+            "ingress_bytes": t.ingress_bytes,
+            "stats_rounds": t.stats.rounds,
+            "pending": [
+                {
+                    "w": s.wal_id, "c": s.client, "q": s.seq,
+                    "r": s.round_submitted, "t": s.arrived_s,
+                    "g": s.gradient,
+                }
+                for s in (*t.queue.snapshot_items(), *t.held)
+            ],
+        }
+        save = d.rotate_and_capture(t.round_id, state)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            save()
+            return
+        fut = loop.run_in_executor(None, save)
+        self._snapshot_futs.append(fut)
+        fut.add_done_callback(self._snapshot_done)
+
+    def _snapshot_done(self, fut) -> None:
+        try:
+            self._snapshot_futs.remove(fut)
+        except ValueError:  # pragma: no cover
+            pass
+        if not fut.cancelled() and fut.exception() is not None:
+            # a failed snapshot is a degraded-durability event, not a
+            # serving outage: the WAL still has everything
+            obs_metrics.registry().counter(
+                "byzpy_snapshot_failures_total",
+                help="snapshot saves that raised (WAL still authoritative)",
+            ).inc()
+
+    def _quarantine_drain(self, t: _Tenant, opened: bool) -> None:
+        """On a breaker OPEN transition, drain the admission queue with
+        accounting: clients see rejections (and, with durability, the
+        WAL records the drop) instead of acks destined for the floor."""
+        if not opened:
+            return
+        dropped = t.queue.drain_nowait(t.queue.capacity + t.cfg.cohort_cap)
+        if dropped:
+            t.outstanding -= len(dropped)
+            t.quarantine_drops += len(dropped)
+            t.round_done.set()
+            if t.durability is not None:
+                t.durability.record_dropped(
+                    t.round_id,
+                    tuple(
+                        s.wal_id for s in dropped if s.wal_id is not None
+                    ),
+                    "quarantine",
+                )
+        obs_metrics.registry().counter(
+            "byzpy_serving_quarantines_total",
+            help="circuit-breaker open transitions (tenant quarantined)",
+            labels={"tenant": t.cfg.name},
+        ).inc()
+
     # -- admission (synchronous, cheap) ----------------------------------
 
     def submit(
@@ -324,13 +572,24 @@ class ServingFrontend:
         client: str,
         round_submitted: int,
         gradient: Any,
+        *,
+        seq: Optional[int] = None,
     ) -> Tuple[bool, str]:
         """Admit one submission: ``(accepted, reason)``.
 
-        Gates, in order: tenant exists; gradient is a ``(dim,)`` float
-        row (non-finite VALUES pass — adversarial payloads are the
-        aggregators' job, shape abuse is the tier's); within the
-        staleness cutoff; client has rate credit; queue has capacity."""
+        Gates, in order: tenant exists; not a replayed idempotency key
+        (a duplicate ``(client, seq)`` answers ``(True, "duplicate")``
+        WITHOUT re-enqueuing — the original folds exactly once, so a
+        client retrying an ack the wire lost cannot double-fold);
+        tenant not quarantined by its circuit breaker; gradient is a
+        ``(dim,)`` float row (non-finite VALUES pass — adversarial
+        payloads are the aggregators' job, shape abuse is the tier's);
+        within the staleness cutoff; client has rate credit; queue has
+        capacity. With durability attached, the accept record hits the
+        write-ahead log before this returns — the ack is a durable
+        promise. ``seq`` keys must be per-client monotonic (the
+        :class:`ServingClient` auto-assigns them); only definitively
+        un-acked submissions should be retried under the same key."""
         t = self._tenants.get(tenant)
         if t is None:
             if obs_runtime.STATE.enabled:
@@ -338,6 +597,17 @@ class ServingFrontend:
             return False, REJECTED_TENANT
         telemetry = obs_runtime.STATE.enabled
         now = self._clock()
+        if seq is not None and t.is_duplicate(client, seq):
+            t.duplicates += 1
+            t.ledger.record(DUPLICATE, client)
+            if telemetry:
+                t.telemetry.outcome(DUPLICATE)
+            return True, DUPLICATE
+        if t.breaker is not None and not t.breaker.allow():
+            t.ledger.record(REJECTED_QUARANTINED, client)
+            if telemetry:
+                t.telemetry.outcome(REJECTED_QUARANTINED)
+            return False, REJECTED_QUARANTINED
         row = np.asarray(gradient)
         if row.ndim != 1 or row.shape[0] != t.cfg.dim or row.dtype.kind != "f":
             t.ledger.record(REJECTED_SHAPE, client)
@@ -355,19 +625,48 @@ class ServingFrontend:
             if telemetry:
                 t.telemetry.outcome(REJECTED_RATE)
             return False, REJECTED_RATE
-        ok = t.queue.offer(
-            Submission(
-                client=client,
-                round_submitted=int(round_submitted),
-                gradient=row,
-                arrived_s=now,
-            )
+        sub = Submission(
+            client=client,
+            round_submitted=int(round_submitted),
+            gradient=row,
+            arrived_s=now,
+            seq=None if seq is None else int(seq),
+            wal_id=(t.next_wal_id if t.durability is not None else None),
         )
+        if t.durability is not None:
+            # capacity gate BEFORE the write-ahead append, so a row is
+            # only ever logged if it will actually enqueue (a logged-
+            # then-rejected row would resurrect on recovery); then the
+            # append BEFORE the enqueue, so a row is only ever queued if
+            # it is durable (an enqueued-but-unlogged row would fold
+            # while its failed ack invites a replay — double fold).
+            # Admission is single-threaded on the owning loop, so the
+            # pre-check cannot race the offer below.
+            if t.queue.depth() >= t.queue.capacity:
+                t.queue.rejected_full += 1
+                t.ledger.record(REJECTED_FULL, client)
+                if telemetry:
+                    t.telemetry.outcome(REJECTED_FULL)
+                return False, REJECTED_FULL
+            try:
+                self._write_ahead(t, sub)
+            except Exception:  # noqa: BLE001 — ENOSPC etc.: the ack
+                # cannot be a durable promise, so refuse it outright
+                # (nothing was enqueued; a retry under the same seq is
+                # NOT a duplicate and may succeed once the disk heals)
+                t.ledger.record(REJECTED_UNDURABLE, client)
+                if telemetry:
+                    t.telemetry.outcome(REJECTED_UNDURABLE)
+                return False, REJECTED_UNDURABLE
+            t.next_wal_id += 1
+        ok = t.queue.offer(sub)
         if not ok:
             t.ledger.record(REJECTED_FULL, client)
             if telemetry:
                 t.telemetry.outcome(REJECTED_FULL)
             return False, REJECTED_FULL
+        if seq is not None:
+            t.note_seq(client, seq)
         t.outstanding += 1
         t.ledger.record(ACCEPTED, client)
         if telemetry:
@@ -390,6 +689,7 @@ class ServingFrontend:
         if kind == "submit":
             tenant = request.get("tenant", "")
             try:
+                seq = request.get("seq")
                 with obs_tracing.span(
                     "serving.admission",
                     tenant=tenant if isinstance(tenant, str) else "?",
@@ -399,6 +699,7 @@ class ServingFrontend:
                         str(request.get("client", "")),
                         int(request.get("round", 0)),
                         request.get("gradient"),
+                        seq=None if seq is None else int(seq),
                     )
             except Exception:  # noqa: BLE001 — client bug, not ours
                 self.malformed_requests += 1
@@ -430,6 +731,32 @@ class ServingFrontend:
                 # latency window + top-ks the rejection map
                 return {"kind": "stats", "stats": self._tenant_stats(t)}
             return {"kind": "ack", "accepted": False, "reason": REJECTED_TENANT}
+        if kind == "close_round":
+            # operator/drill door: drive the synchronous round closer
+            # over the wire — deterministic round boundaries for the
+            # kill-and-recover drill and virtual-clock deployments. Same
+            # exclusivity contract as close_round_nowait (errors if the
+            # async scheduler owns the rounds).
+            name = request.get("tenant", "")
+            t = self._tenants.get(name) if isinstance(name, str) else None
+            if t is None:
+                return {
+                    "kind": "ack", "accepted": False,
+                    "reason": REJECTED_TENANT,
+                }
+            try:
+                closed = self.close_round_nowait(name)
+            except RuntimeError as exc:
+                return {
+                    "kind": "ack", "accepted": False,
+                    "reason": f"close_round_unavailable: {exc}",
+                }
+            return {
+                "kind": "round",
+                "closed": None if closed is None else closed[0],
+                "digest": None if closed is None else _agg_digest(closed[2]),
+                "round": t.round_id,
+            }
         return {"kind": "ack", "accepted": False, "reason": "bad_frame"}
 
     # -- scheduling ------------------------------------------------------
@@ -448,7 +775,8 @@ class ServingFrontend:
         ]
 
     async def close(self) -> None:
-        """Stop schedulers and the TCP server (idempotent)."""
+        """Stop schedulers and the TCP server (idempotent); settle any
+        in-flight snapshot saves and close the WAL segments."""
         self._running = False
         for task in self._tasks:
             task.cancel()
@@ -460,27 +788,70 @@ class ServingFrontend:
         self._tasks = []
         if self._server is not None:
             self._server.close()
+            # drop live ingress connections too: a closed frontend must
+            # not keep admitting on old sockets (its WAL is about to
+            # close, and clients must fail over to the recovered
+            # process — same policy as RemoteActorServer.close)
+            for w in list(self._conns):
+                w.close()
             await self._server.wait_closed()
             self._server = None
+        if self._snapshot_futs:
+            await asyncio.gather(
+                *list(self._snapshot_futs), return_exceptions=True
+            )
+        for t in self._tenants.values():
+            if t.durability is not None:
+                t.durability.close()
 
-    def _fail_round(self, t: _Tenant, cohort: Cohort) -> None:
+    def _fail_round(
+        self, t: _Tenant, cohort: Cohort, subs: Sequence[Submission] = ()
+    ) -> None:
         """Round-drop bookkeeping shared by both round closers: a
         poisoned cohort counts a ``failed_round`` and releases its
-        outstanding rows — never silent, never fatal."""
+        outstanding rows — never silent, never fatal. With durability,
+        the drop is WAL-recorded (recovery must not resurrect it); with
+        a breaker, the failure counts toward quarantine and an OPEN
+        transition drains the queue."""
         t.failed_rounds += 1
         t.outstanding -= cohort.m
         t.round_done.set()
+        if t.durability is not None:
+            t.durability.record_dropped(
+                t.round_id,
+                tuple(s.wal_id for s in subs if s.wal_id is not None),
+                "failed_round",
+            )
+        if t.breaker is not None:
+            self._quarantine_drain(t, t.breaker.record_failure())
         if obs_runtime.STATE.enabled:
             t.telemetry.failed.inc()
             t.telemetry.outstanding.set(t.outstanding)
 
-    def _finish_round(self, t: _Tenant, cohort: Cohort, vec: Any) -> int:
+    def _finish_round(
+        self,
+        t: _Tenant,
+        cohort: Cohort,
+        vec: Any,
+        subs: Sequence[Submission] = (),
+    ) -> int:
         """Round-close bookkeeping shared by the async scheduler and
         :meth:`close_round_nowait` (ONE copy, so the async and
         virtual-time paths cannot drift): publish the aggregate and
-        cohort membership, record telemetry, advance the round counter,
-        release outstanding rows, fire the (crash-guarded) observer.
-        Returns the closed round id."""
+        cohort membership, persist the round record (+ periodic
+        snapshot) when durability is attached, record telemetry, advance
+        the round counter, release outstanding rows, fire the
+        (crash-guarded) observer. Returns the closed round id."""
+        if t.durability is not None:
+            t.durability.record_round(
+                t.round_id,
+                tuple(s.wal_id for s in subs if s.wal_id is not None),
+                _agg_digest(vec),
+                cohort.m,
+            )
+            t.durability.note_round_closed()
+        if t.breaker is not None:
+            t.breaker.record_success()
         t.last_aggregate = vec
         t.last_cohort_clients = cohort.clients
         latency_s = self._clock() - cohort.first_arrival_s
@@ -489,6 +860,7 @@ class ServingFrontend:
         t.round_id += 1
         t.outstanding -= cohort.m
         t.round_done.set()
+        self._maybe_snapshot(t)
         if obs_runtime.STATE.enabled:
             t.telemetry.rounds.inc()
             t.telemetry.latency_s.observe(latency_s)
@@ -556,9 +928,9 @@ class ServingFrontend:
                         )
                 except Exception:  # noqa: BLE001 — a poisoned cohort must
                     # never kill the scheduler: drop the round, keep serving
-                    self._fail_round(t, cohort)
+                    self._fail_round(t, cohort, subs)
                     continue
-                self._finish_round(t, cohort, vec)
+                self._finish_round(t, cohort, vec, subs)
 
     async def drain(self, tenant: str) -> int:
         """Wait until every ADMISSIBLE submission of ``tenant`` has been
@@ -622,9 +994,9 @@ class ServingFrontend:
             try:
                 vec = t.executor.aggregate(cohort)
             except Exception:  # noqa: BLE001 — same contract as the scheduler
-                self._fail_round(t, cohort)
+                self._fail_round(t, cohort, subs)
                 return None
-            return self._finish_round(t, cohort, vec), cohort, vec
+            return self._finish_round(t, cohort, vec, subs), cohort, vec
 
     def public_state(self, tenant: str) -> Any:
         """The tenant's public per-round feed, as any client —
@@ -669,6 +1041,7 @@ class ServingFrontend:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        self._conns.add(writer)
         try:
             while True:
                 try:
@@ -724,6 +1097,7 @@ class ServingFrontend:
                         t.telemetry.submit_frames.inc()
                 await wire.send_obj(writer, self.handle_request(request))
         finally:
+            self._conns.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -796,6 +1170,24 @@ class ServingFrontend:
             ),
             "ingress_bytes": t.ingress_bytes,
             "failed_rounds": t.failed_rounds,
+            # resilience accounting: duplicate replays absorbed by the
+            # idempotency layer, breaker state (None = no breaker),
+            # recovery provenance (round the tenant resumed from)
+            "duplicates": t.duplicates,
+            "quarantine_drops": t.quarantine_drops,
+            "breaker": (
+                t.breaker.snapshot() if t.breaker is not None else None
+            ),
+            "recovered_from": (
+                {
+                    "snapshot": t.recovered.from_snapshot,
+                    "round_id": t.recovered.round_id,
+                    "replayed_pending": len(t.recovered.pending),
+                    "skipped_corrupt": list(t.recovered.skipped_corrupt),
+                }
+                if t.recovered is not None
+                else None
+            ),
             # FRONTEND-GLOBAL counters (not per-tenant — a forged frame
             # names no trustable tenant): nested so a dashboard summing
             # tenant blocks doesn't double-count them
@@ -825,42 +1217,161 @@ def serve_frame(frontend: ServingFrontend, frame_body: bytes) -> bytes:
 
 
 class ServingClient:
-    """Minimal asyncio client for the wire ingress (tests, examples,
-    swarm simulators): one connection, frame-per-call submissions."""
+    """Asyncio client for the wire ingress (tests, examples, swarm
+    simulators): one connection, frame-per-call submissions.
 
-    def __init__(self) -> None:
+    Resilience (all opt-out): every submission carries a per-client
+    monotonic ``seq`` idempotency key, so with a
+    :class:`~byzpy_tpu.resilience.retry.RetryPolicy` attached the client
+    may safely reconnect and RESEND after a dropped connection — the
+    frontend dedupes replayed ``(client, seq)`` frames instead of
+    double-folding them (a replay of an ack the wire lost answers
+    ``accepted=True, reason="duplicate"``). Use as an async context
+    manager so the writer cannot leak when a test raises between
+    ``connect`` and teardown::
+
+        async with ServingClient(retry=RetryPolicy()) as c:
+            await c.connect(host, port)
+            ack = await c.submit("m0", "client-7", round_id, grad)
+    """
+
+    def __init__(
+        self,
+        *,
+        retry: Optional[RetryPolicy] = None,
+        rng: Optional[random.Random] = None,
+    ) -> None:
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
+        self._addr: Optional[Tuple[str, int]] = None
+        self._retry = retry
+        self._rng = rng
+        self._seq = 0
+        #: reconnects performed by the retry driver (introspection)
+        self.reconnects = 0
+
+    async def __aenter__(self) -> "ServingClient":
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
 
     async def connect(self, host: str, port: int) -> None:
-        """Open the connection."""
-        self._reader, self._writer = await asyncio.open_connection(host, port)
+        """Open the connection (dial retried under the policy, so a
+        frontend restart window is ridden out)."""
+        self._addr = (host, port)
+        await self._dial()
+
+    async def _dial(self) -> None:
+        assert self._addr is not None, "connect() first"
+        host, port = self._addr
+        if self._retry is not None:
+            self._reader, self._writer = await connect_with_retry(
+                host, port, policy=self._retry,
+                component="serving_client", rng=self._rng,
+            )
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                host, port
+            )
+
+    def _drop_connection(self) -> None:
+        if self._writer is not None:
+            self._writer.close()  # no wait: the peer is already gone
+        self._writer = None
+        self._reader = None
+
+    async def _call(self, payload: dict, *, resend: bool = True) -> dict:
+        """One request/reply round-trip; with a policy, wire failures
+        drop the dead connection, redial, and RESEND the same frame —
+        safe for submissions (idempotency key) and stats (read-only).
+        ``resend=False`` is for NON-idempotent requests (close_round):
+        the dial still retries, but once the frame may have left this
+        process an ambiguous wire death raises instead of resending —
+        a lost ack must not close two rounds."""
+        if self._retry is None:
+            assert self._writer is not None and self._reader is not None
+            await wire.send_obj(self._writer, payload)
+            return await wire.recv_obj(self._reader)
+
+        class _Ambiguous(RuntimeError):
+            """Sent (maybe) but no ack — unlisted type, so fatal."""
+
+        async def attempt(n: int) -> dict:
+            if n > 0:
+                self.reconnects += 1
+            if self._writer is None:
+                await self._dial()
+            try:
+                await wire.send_obj(self._writer, payload)
+                return await wire.recv_obj(self._reader)
+            except Exception as exc:
+                # whatever happened mid-round-trip, this connection is
+                # no longer trustworthy for framing
+                self._drop_connection()
+                if not resend:
+                    raise _Ambiguous(
+                        "connection died mid-request; the request may "
+                        "or may not have taken effect — refusing to "
+                        "resend a non-idempotent frame"
+                    ) from exc
+                raise
+
+        return await retry_async(
+            attempt, policy=self._retry, component="serving_client",
+            rng=self._rng,
+        )
 
     async def submit(
-        self, tenant: str, client: str, round_submitted: int, gradient: Any
+        self,
+        tenant: str,
+        client: str,
+        round_submitted: int,
+        gradient: Any,
+        *,
+        seq: Optional[int] = None,
     ) -> dict:
-        """Send one submission frame; returns the decoded ack."""
-        assert self._writer is not None and self._reader is not None
-        await wire.send_obj(
-            self._writer,
+        """Send one submission frame; returns the decoded ack. ``seq``
+        defaults to this client object's own monotonic counter (shared
+        across all logical client ids it submits for — still per-client
+        monotonic, which is all the dedup layer needs). An explicit
+        ``seq`` — e.g. replaying ambiguous submissions after a frontend
+        restart — advances the counter past it, so later auto-assigned
+        keys can never collide with the server's recovered high-water
+        mark and be silently absorbed as duplicates. A client reborn
+        WITHOUT its counter must adopt a fresh client id (see
+        docs/fault_tolerance.md §idempotency)."""
+        if seq is None:
+            seq = self._seq
+            self._seq += 1
+        else:
+            self._seq = max(self._seq, int(seq) + 1)
+        return await self._call(
             {
                 "kind": "submit",
                 "tenant": tenant,
                 "client": client,
                 "round": int(round_submitted),
                 "gradient": np.asarray(gradient),
-            },
+                "seq": int(seq),
+            }
         )
-        return await wire.recv_obj(self._reader)
 
     async def stats(self, tenant: str) -> dict:
         """Fetch the tenant's stats snapshot."""
-        assert self._writer is not None and self._reader is not None
-        await wire.send_obj(self._writer, {"kind": "stats", "tenant": tenant})
-        return await wire.recv_obj(self._reader)
+        return await self._call({"kind": "stats", "tenant": tenant})
+
+    async def close_round(self, tenant: str) -> dict:
+        """Drive the synchronous round closer over the wire (the drill/
+        operator door; errors if the async scheduler owns rounds). NOT
+        idempotent — an ambiguous wire failure raises rather than
+        resending (a lost ack must not close two rounds)."""
+        return await self._call(
+            {"kind": "close_round", "tenant": tenant}, resend=False
+        )
 
     async def close(self) -> None:
-        """Close the connection."""
+        """Close the connection (idempotent; safe mid-failure)."""
         if self._writer is not None:
             self._writer.close()
             try:
@@ -872,6 +1383,9 @@ class ServingClient:
 
 
 __all__ = [
+    "DUPLICATE",
+    "REJECTED_MALFORMED",
+    "REJECTED_QUARANTINED",
     "RoundCallback",
     "ServingClient",
     "ServingFrontend",

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import asyncio
 from dataclasses import dataclass
-from typing import Any, List
+from typing import Any, List, Optional, Sequence, Tuple
 
 
 @dataclass(frozen=True)
@@ -29,12 +29,17 @@ class Submission:
     ``gradient`` is the host-side flattened row (numpy ``(d,)``, the
     decoded wire payload); ``round_submitted`` the model round the
     client computed against; ``arrived_s`` the admission timestamp on
-    the frontend clock (monotonic seconds)."""
+    the frontend clock (monotonic seconds). ``seq`` is the client's
+    idempotency key (``None`` for legacy clients — no dedup) and
+    ``wal_id`` the tenant's write-ahead-log identity when durability is
+    on (see ``byzpy_tpu.resilience.durable``)."""
 
     client: str
     round_submitted: int
     gradient: Any
     arrived_s: float
+    seq: Optional[int] = None
+    wal_id: Optional[int] = None
 
 
 class AdmissionQueue:
@@ -64,6 +69,26 @@ class AdmissionQueue:
         if depth > self.depth_high_water:
             self.depth_high_water = depth
         return True
+
+    def snapshot_items(self) -> Tuple[Submission, ...]:
+        """Non-consuming view of everything queued right now — the
+        durable-snapshot capture path, which must record pending
+        submissions WITHOUT dequeuing them. (Reads the asyncio.Queue's
+        internal deque; safe here because all producers/consumers run on
+        the owning event loop or synchronously between its steps.)"""
+        return tuple(self._queue._queue)  # noqa: SLF001 — see docstring
+
+    def restore(self, items: Sequence[Submission]) -> None:
+        """Recovery-time refill: re-enqueue previously-admitted
+        submissions BYPASSING the capacity bound (they were admitted
+        under the bound in a prior life, plus up to one held cohort the
+        scheduler had already popped — rejecting them now would lose
+        acked submissions; the next rounds drain the excess first)."""
+        for sub in items:
+            self._queue._queue.append(sub)  # noqa: SLF001 — see docstring
+        depth = self._queue.qsize()
+        if depth > self.depth_high_water:
+            self.depth_high_water = depth
 
     def drain_nowait(self, max_items: int) -> List[Submission]:
         """Synchronously pop up to ``max_items`` queued submissions

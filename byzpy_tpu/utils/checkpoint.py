@@ -1,20 +1,49 @@
-"""Sharded checkpoint/resume.
+"""Sharded checkpoint/resume + durable host-state snapshots.
 
 The reference has **no** checkpointing (SURVEY §5: examples pull
 ``dump_state_dict()`` off a node actor, ``byzpy/examples/ps/thread/
 mnist.py:117-119``); the survey flags orbax-style sharded checkpointing as
-a required addition for the TPU build. This wraps orbax so training state
-(params / opt state / round counters, arbitrary pytrees) saves and
-restores with shardings preserved — a restore onto a mesh re-shards
-automatically via each array's sharding spec.
+a required addition for the TPU build. Two tiers live here:
+
+* :class:`CheckpointManager` wraps orbax so training state (params / opt
+  state / round counters, arbitrary pytrees) saves and restores with
+  shardings preserved — a restore onto a mesh re-shards automatically via
+  each array's sharding spec. Missing/corrupt state surfaces as the typed
+  :class:`CheckpointNotFoundError` / :class:`CheckpointCorruptError`
+  (never a bare orbax internal error).
+* :class:`SnapshotStore` is the lightweight sibling for HOST-side runtime
+  state (the serving tier's durable round state, dedup tables, credit
+  summaries): one self-contained file per generation with an atomic
+  rename and an embedded SHA-256 integrity digest, so a process killed
+  mid-save can never leave a half-written generation that restore would
+  trust — a torn or tampered file is detected and the PREVIOUS generation
+  answers instead. Saves can run off the event loop
+  (:meth:`SnapshotStore.save_async`).
 """
 
 from __future__ import annotations
 
+import asyncio
+import hashlib
 import os
-from typing import Any, List, Optional
+import pickle
+import re
+import time
+from typing import Any, List, Optional, Tuple
 
 import jax
+
+from ..observability import metrics as _obs_metrics
+
+
+class CheckpointNotFoundError(FileNotFoundError):
+    """No usable checkpoint exists where one was asked for; the message
+    always names the directory searched."""
+
+
+class CheckpointCorruptError(RuntimeError):
+    """State exists but failed integrity/decode checks (every retained
+    generation, for stores that keep several)."""
 
 
 class CheckpointManager:
@@ -59,19 +88,45 @@ class CheckpointManager:
     def restore(self, step: Optional[int] = None, *, like: Any = None) -> Any:
         """Restore ``step`` (default: latest). ``like`` is an abstract or
         concrete pytree prescribing dtypes/shapes/shardings — pass one built
-        on the target mesh to restore directly into a sharded layout."""
+        on the target mesh to restore directly into a sharded layout.
+
+        An empty directory (or an explicit ``step`` that does not exist)
+        raises :class:`CheckpointNotFoundError` naming the directory; a
+        present-but-unreadable step (truncated/tampered files, a ``like``
+        tree that does not match what was saved) raises
+        :class:`CheckpointCorruptError` with the orbax error chained —
+        callers get ONE typed surface instead of whatever orbax's
+        internals raise that week."""
         if step is None:
             step = self.latest_step()
             if step is None:
-                raise FileNotFoundError(
+                raise CheckpointNotFoundError(
                     f"no checkpoints under {self.directory}"
                 )
+        elif step not in self._mgr.all_steps():
+            raise CheckpointNotFoundError(
+                f"no checkpoint for step {step} under {self.directory} "
+                f"(available: {self.all_steps()})"
+            )
         if like is not None:
             abstract = jax.tree_util.tree_map(_as_abstract, like)
             args = self._ocp.args.StandardRestore(abstract)
         else:
             args = self._ocp.args.StandardRestore()
-        return self._mgr.restore(step, args=args)
+        try:
+            return self._mgr.restore(step, args=args)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except FileNotFoundError as exc:
+            raise CheckpointNotFoundError(
+                f"checkpoint step {step} under {self.directory} is missing "
+                f"pieces: {exc}"
+            ) from exc
+        except Exception as exc:  # noqa: BLE001 — typed surface for callers
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} under {self.directory} failed to "
+                f"restore: {type(exc).__name__}: {exc}"
+            ) from exc
 
     def close(self) -> None:
         self._mgr.close()
@@ -105,4 +160,155 @@ def restore_checkpoint(
         return mgr.restore(step, like=like)
 
 
-__all__ = ["CheckpointManager", "save_checkpoint", "restore_checkpoint"]
+# ---------------------------------------------------------------------------
+# host-state snapshot store (atomic rename + integrity digest)
+# ---------------------------------------------------------------------------
+
+_SNAP_MAGIC = b"BZSNAP1\n"
+_SNAP_RE = re.compile(r"^snap-(\d{12})\.bzs$")
+
+
+def _snapshot_latency() -> Any:
+    return _obs_metrics.registry().histogram(
+        "byzpy_checkpoint_save_seconds",
+        help="host-state snapshot save latency (serialize + fsync + rename)",
+    )
+
+
+class SnapshotStore:
+    """Generational, digest-verified pickle snapshots of host state.
+
+    Layout: ``snap-<step:012d>.bzs`` files, each ``MAGIC + sha256-hex +
+    "\\n" + pickle(state)``. A save serializes, writes to a dot-tmp file
+    (flushed; fsync'd when ``fsync=True``), then ``os.replace``\\ s into
+    place — readers only ever see absent or complete generations.
+    :meth:`restore_latest` walks generations newest-first and returns the
+    first that verifies; corrupt generations are reported in the result,
+    and exhaustion raises :class:`CheckpointCorruptError`
+    (:class:`CheckpointNotFoundError` when the directory holds nothing at
+    all). Not a pytree checkpoint: values must pickle (numpy arrays,
+    scalars, containers) — device arrays belong in
+    :class:`CheckpointManager`."""
+
+    def __init__(
+        self, directory: str, *, max_to_keep: int = 3, fsync: bool = False
+    ) -> None:
+        if max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1 (got {max_to_keep})")
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self.fsync = fsync
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"snap-{step:012d}.bzs")
+
+    def all_steps(self) -> List[int]:
+        """Every generation present on disk, ascending (no verification)."""
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _SNAP_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- write ---------------------------------------------------------------
+
+    def save(self, step: int, state: Any) -> str:
+        """Atomically persist ``state`` as generation ``step``; returns
+        the final path. Older generations beyond ``max_to_keep`` are
+        pruned AFTER the new one is durable."""
+        t0 = time.monotonic()
+        payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = hashlib.sha256(payload).hexdigest().encode()
+        final = self._path(step)
+        tmp = os.path.join(
+            self.directory, f".tmp-{step:012d}-{os.getpid()}.bzs"
+        )
+        with open(tmp, "wb") as fh:
+            fh.write(_SNAP_MAGIC + digest + b"\n" + payload)
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, final)
+        for old in self.all_steps()[: -self.max_to_keep]:
+            try:
+                os.remove(self._path(old))
+            except OSError:  # pragma: no cover — already gone
+                pass
+        _snapshot_latency().observe(time.monotonic() - t0)
+        return final
+
+    async def save_async(self, step: int, state: Any) -> str:
+        """:meth:`save` on the default executor — the serving scheduler
+        calls this so snapshot IO never stalls the admission loop."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.save, step, state)
+
+    # -- read ----------------------------------------------------------------
+
+    def load(self, step: int) -> Any:
+        """Load and verify ONE generation; raises
+        :class:`CheckpointNotFoundError` if absent,
+        :class:`CheckpointCorruptError` on any integrity failure."""
+        path = self._path(step)
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except FileNotFoundError as exc:
+            raise CheckpointNotFoundError(
+                f"no snapshot for step {step} under {self.directory}"
+            ) from exc
+        if not blob.startswith(_SNAP_MAGIC):
+            raise CheckpointCorruptError(f"{path}: bad magic")
+        rest = blob[len(_SNAP_MAGIC):]
+        nl = rest.find(b"\n")
+        if nl != 64:  # sha256 hex is exactly 64 bytes
+            raise CheckpointCorruptError(f"{path}: malformed digest header")
+        digest, payload = rest[:nl], rest[nl + 1:]
+        if hashlib.sha256(payload).hexdigest().encode() != digest:
+            raise CheckpointCorruptError(f"{path}: integrity digest mismatch")
+        try:
+            return pickle.loads(payload)
+        except Exception as exc:  # noqa: BLE001 — typed surface
+            raise CheckpointCorruptError(
+                f"{path}: digest ok but unpicklable: {exc}"
+            ) from exc
+
+    def restore_latest(self) -> Tuple[int, Any, List[int]]:
+        """Newest generation that VERIFIES, as ``(step, state,
+        skipped_corrupt_steps)`` — a torn/tampered newest generation
+        falls back to the previous one instead of failing recovery.
+        Raises :class:`CheckpointNotFoundError` on an empty store,
+        :class:`CheckpointCorruptError` when every generation is bad."""
+        steps = self.all_steps()
+        if not steps:
+            raise CheckpointNotFoundError(
+                f"no snapshots under {self.directory}"
+            )
+        skipped: List[int] = []
+        for step in reversed(steps):
+            try:
+                return step, self.load(step), skipped
+            except CheckpointCorruptError:
+                skipped.append(step)
+        raise CheckpointCorruptError(
+            f"every snapshot generation under {self.directory} is corrupt "
+            f"(tried {list(reversed(steps))})"
+        )
+
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointManager",
+    "CheckpointNotFoundError",
+    "SnapshotStore",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

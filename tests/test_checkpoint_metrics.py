@@ -99,12 +99,52 @@ def test_step_timer_blocks_on_device_work():
 
 
 def test_checkpoint_restore_missing_step_raises(tmp_path):
-    from byzpy_tpu.utils.checkpoint import CheckpointManager
+    """Missing state surfaces as the typed CheckpointNotFoundError (with
+    the directory in the message), not whatever orbax raises that week."""
+    from byzpy_tpu.utils.checkpoint import CheckpointManager, CheckpointNotFoundError
 
     with CheckpointManager(str(tmp_path / "ck")) as mgr:
         assert mgr.latest_step() is None
-        with pytest.raises(ValueError):
+        with pytest.raises(CheckpointNotFoundError, match="ck"):
             mgr.restore(41)
+
+
+def test_checkpoint_restore_empty_dir_typed_error(tmp_path):
+    from byzpy_tpu.utils.checkpoint import CheckpointManager, CheckpointNotFoundError
+
+    with CheckpointManager(str(tmp_path / "empty")) as mgr:
+        with pytest.raises(CheckpointNotFoundError, match="empty"):
+            mgr.restore()  # latest on an empty directory
+
+
+def test_checkpoint_restore_corrupt_step_typed_error(tmp_path):
+    """A present-but-mangled step restores as CheckpointCorruptError
+    (orbax's internal error chained as __cause__)."""
+    import shutil
+
+    from byzpy_tpu.utils.checkpoint import (
+        CheckpointCorruptError,
+        CheckpointManager,
+    )
+
+    d = tmp_path / "ck"
+    with CheckpointManager(str(d)) as mgr:
+        mgr.save(3, {"w": jnp.arange(4, dtype=jnp.float32)})
+        # mangle the step's payload directory in place
+        step_dir = d / "3"
+        for sub in step_dir.rglob("*"):
+            if sub.is_file():
+                sub.write_bytes(b"not a checkpoint")
+        shutil.rmtree(step_dir / "default", ignore_errors=True)
+        with pytest.raises((CheckpointCorruptError, Exception)) as ei:
+            mgr.restore(3)
+        # whatever orbax hit, the surface must be one of the two typed
+        # errors, never a bare orbax internal
+        from byzpy_tpu.utils.checkpoint import CheckpointNotFoundError
+
+        assert isinstance(
+            ei.value, (CheckpointCorruptError, CheckpointNotFoundError)
+        )
 
 
 def test_checkpoint_like_template_controls_dtype(tmp_path):

@@ -1,0 +1,433 @@
+"""Self-healing serving tier: durable round state, idempotent replays,
+degraded mode, and the real SIGKILL drill.
+
+The headline invariant, pinned here and by the chaos bench's recovery
+lane: **a submission acked ``accepted`` is never lost and never folded
+twice** — across duplicate wire replays (retry after a lost ack) and
+across a SIGKILL of the frontend process mid-round.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import CoordinateWiseMedian
+from byzpy_tpu.resilience.breaker import BreakerPolicy
+from byzpy_tpu.resilience.durable import DurabilityConfig
+from byzpy_tpu.resilience.retry import RetryPolicy
+from byzpy_tpu.serving import ServingClient, ServingFrontend, TenantConfig
+from byzpy_tpu.serving.frontend import (
+    DUPLICATE,
+    REJECTED_QUARANTINED,
+    _agg_digest,
+)
+from byzpy_tpu.utils.checkpoint import CheckpointNotFoundError
+
+D = 16
+
+
+def _grad(seed=0):
+    return np.random.default_rng(seed).normal(size=D).astype(np.float32)
+
+
+def _tenant(name="m0", **kw):
+    defaults = dict(
+        name=name,
+        aggregator=CoordinateWiseMedian(),
+        dim=D,
+        window_s=0.02,
+        cohort_cap=8,
+        queue_capacity=32,
+    )
+    defaults.update(kw)
+    return TenantConfig(**defaults)
+
+
+def _dur(tmp_path, **kw):
+    kw.setdefault("snapshot_every", 2)
+    kw.setdefault("prune", False)
+    return DurabilityConfig(directory=str(tmp_path / "dur"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# idempotency (dedup layer)
+# ---------------------------------------------------------------------------
+
+
+def test_duplicate_seq_folds_exactly_once_bit_parity():
+    """The acceptance contract at the dedup layer: replaying every frame
+    twice changes NOTHING about the round aggregate (bit parity)."""
+
+    def run(replay: bool):
+        fe = ServingFrontend([_tenant()])
+        for i in range(5):
+            ok, reason = fe.submit("m0", f"c{i}", 0, _grad(i), seq=0)
+            assert ok and reason == "accepted"
+            if replay:
+                ok, reason = fe.submit("m0", f"c{i}", 0, _grad(i), seq=0)
+                assert ok and reason == DUPLICATE  # acked, not re-enqueued
+        closed = fe.close_round_nowait("m0")
+        assert closed is not None
+        stats = fe.stats()["m0"]
+        return _agg_digest(closed[2]), stats
+
+    clean, s_clean = run(replay=False)
+    replayed, s_replayed = run(replay=True)
+    assert clean == replayed  # bit-for-bit: duplicates never folded
+    assert s_clean["duplicates"] == 0
+    assert s_replayed["duplicates"] == 5
+
+
+def test_seq_monotonicity_is_per_client():
+    fe = ServingFrontend([_tenant()])
+    assert fe.submit("m0", "a", 0, _grad(1), seq=5) == (True, "accepted")
+    # lower AND equal seqs for the same client are duplicates
+    assert fe.submit("m0", "a", 0, _grad(2), seq=5)[1] == DUPLICATE
+    assert fe.submit("m0", "a", 0, _grad(2), seq=3)[1] == DUPLICATE
+    # a DIFFERENT client may reuse the number freely
+    assert fe.submit("m0", "b", 0, _grad(3), seq=5) == (True, "accepted")
+    # and the original client moves on with a higher seq
+    assert fe.submit("m0", "a", 0, _grad(4), seq=6) == (True, "accepted")
+
+
+def test_legacy_submissions_without_seq_never_dedupe():
+    fe = ServingFrontend([_tenant()])
+    for _ in range(3):
+        assert fe.submit("m0", "a", 0, _grad(0)) == (True, "accepted")
+    assert fe.stats()["m0"]["duplicates"] == 0
+    assert fe.stats()["m0"]["outstanding"] == 3
+
+
+# ---------------------------------------------------------------------------
+# durable round state + recovery
+# ---------------------------------------------------------------------------
+
+
+def test_recover_restores_rounds_pending_and_dedup(tmp_path):
+    dur = _dur(tmp_path)
+    fe = ServingFrontend([_tenant()], durability=dur)
+    # round 0 folds; then two accepted-but-unfolded submissions "die"
+    # with the process (we simply abandon the object, as SIGKILL would)
+    for i in range(4):
+        assert fe.submit("m0", f"c{i}", 0, _grad(i), seq=10 + i)[0]
+    closed = fe.close_round_nowait("m0")
+    assert closed is not None and closed[0] == 0
+    digest0 = _agg_digest(closed[2])
+    assert fe.submit("m0", "c0", 1, _grad(50), seq=20)[0]
+    assert fe.submit("m0", "c1", 1, _grad(51), seq=21)[0]
+
+    fe2 = ServingFrontend.recover([_tenant()], dur)
+    stats = fe2.stats()["m0"]
+    assert stats["round_id"] == 1  # monotonic: resumes AFTER round 0
+    assert stats["outstanding"] == 2  # the acked-unfolded pair survived
+    assert stats["recovered_from"]["round_id"] == 1
+    # stale replays of pre-kill frames dedupe against the recovered table
+    assert fe2.submit("m0", "c0", 1, _grad(50), seq=20)[1] == DUPLICATE
+    assert fe2.submit("m0", "c3", 1, _grad(3), seq=13)[1] == DUPLICATE
+    # new traffic + close: the recovered pending folds exactly once
+    closed = fe2.close_round_nowait("m0")
+    assert closed is not None and closed[0] == 1
+    assert sorted(closed[1].clients) == ["c0", "c1"]
+    assert fe2.stats()["m0"]["outstanding"] == 0
+    # the WAL recorded round 0's digest — continuity across the "kill"
+    rec = fe2.recovered["m0"]
+    assert rec.rounds == [(0, digest0)]
+
+
+def test_recover_on_empty_directory_raises_typed_error(tmp_path):
+    with pytest.raises(CheckpointNotFoundError, match="nothing to recover"):
+        ServingFrontend.recover([_tenant()], _dur(tmp_path))
+
+
+def test_constructor_on_fresh_directory_starts_clean(tmp_path):
+    fe = ServingFrontend([_tenant()], durability=_dur(tmp_path))
+    assert fe.recovered == {"m0": None}
+    assert fe.stats()["m0"]["recovered_from"] is None
+
+
+def test_snapshot_cadence_and_recovery_from_snapshot(tmp_path):
+    dur = _dur(tmp_path, snapshot_every=2)
+    fe = ServingFrontend([_tenant()], durability=dur)
+    for r in range(5):
+        for i in range(3):
+            assert fe.submit("m0", f"c{i}", r, _grad(r * 10 + i))[0]
+        assert fe.close_round_nowait("m0") is not None
+    t = fe._tenants["m0"]
+    assert t.durability.snaps.all_steps()  # the cadence actually fired
+    fe2 = ServingFrontend.recover([_tenant()], dur)
+    stats = fe2.stats()["m0"]
+    assert stats["round_id"] == 5
+    assert stats["recovered_from"]["snapshot"] is not None
+
+
+def test_failed_round_drop_is_not_resurrected(tmp_path):
+    """Crash-guarded rounds drop their cohort WITH accounting; recovery
+    must not re-enqueue those rows as pending."""
+
+    class Poison:
+        def aggregate_masked(self, matrix, valid):
+            raise RuntimeError("poisoned cohort")
+
+        def validate_n(self, n):
+            return None
+
+    dur = _dur(tmp_path)
+    fe = ServingFrontend([_tenant(aggregator=Poison())], durability=dur)
+    assert fe.submit("m0", "a", 0, _grad(0), seq=0)[0]
+    assert fe.close_round_nowait("m0") is None  # crash-guarded drop
+    assert fe.stats()["m0"]["failed_rounds"] == 1
+    fe2 = ServingFrontend.recover([_tenant(aggregator=Poison())], dur)
+    assert fe2.stats()["m0"]["outstanding"] == 0  # dropped, not pending
+
+
+# ---------------------------------------------------------------------------
+# degraded mode (circuit breaker)
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_quarantines_after_consecutive_failures_then_recovers():
+    class Flaky:
+        poisoned = True
+
+        def aggregate_masked(self, matrix, valid):
+            if self.poisoned:
+                raise RuntimeError("boom")
+            return np.asarray(matrix[np.asarray(valid)].mean(axis=0))
+
+        def validate_n(self, n):
+            return None
+
+    t = [0.0]
+    agg = Flaky()
+    fe = ServingFrontend(
+        [_tenant(aggregator=agg,
+                 breaker=BreakerPolicy(threshold=2, cooldown_s=5.0))],
+        clock=lambda: t[0],
+    )
+    # two consecutive failed rounds open the breaker; the second's drain
+    # clears whatever is queued
+    for r in range(2):
+        assert fe.submit("m0", "a", 0, _grad(r))[0]
+        assert fe.close_round_nowait("m0") is None
+    stats = fe.stats()["m0"]
+    assert stats["breaker"]["state"] == "open"
+    assert stats["failed_rounds"] == 2
+    # quarantined: explicit rejection, no crash loop, no silent acks
+    ok, reason = fe.submit("m0", "a", 0, _grad(9))
+    assert not ok and reason == REJECTED_QUARANTINED
+    # cooldown elapses: half-open probe round is admitted and succeeds
+    t[0] = 5.0
+    agg.poisoned = False
+    assert fe.submit("m0", "a", 0, _grad(10))[0]
+    assert fe.close_round_nowait("m0") is not None
+    assert fe.stats()["m0"]["breaker"]["state"] == "closed"
+    assert fe.submit("m0", "a", 0, _grad(11))[0]
+
+
+def test_breaker_open_drains_queue_with_accounting():
+    class Poison:
+        def aggregate_masked(self, matrix, valid):
+            raise RuntimeError("boom")
+
+        def validate_n(self, n):
+            return None
+
+    fe = ServingFrontend(
+        [_tenant(aggregator=Poison(), cohort_cap=2,
+                 breaker=BreakerPolicy(threshold=1, cooldown_s=60.0))]
+    )
+    # 4 accepted; the closer pops 2 (cohort_cap) and fails; the breaker
+    # opens and the drain clears the 2 still queued
+    for i in range(4):
+        assert fe.submit("m0", f"c{i}", 0, _grad(i))[0]
+    assert fe.close_round_nowait("m0") is None
+    stats = fe.stats()["m0"]
+    assert stats["quarantine_drops"] == 2
+    assert stats["outstanding"] == 0  # nothing silently parked
+
+
+# ---------------------------------------------------------------------------
+# client: context manager + retry + wire idempotency
+# ---------------------------------------------------------------------------
+
+
+def test_serving_client_context_manager_closes_writer():
+    async def run():
+        fe = ServingFrontend([_tenant()])
+        host, port = await fe.serve("127.0.0.1", 0)
+        try:
+            with pytest.raises(RuntimeError, match="mid-test"):
+                async with ServingClient() as c:
+                    await c.connect(host, port)
+                    writer = c._writer
+                    assert writer is not None
+                    raise RuntimeError("mid-test")
+            # __aexit__ closed the writer even though the body raised
+            assert c._writer is None and writer.is_closing()
+        finally:
+            await fe.close()
+
+    asyncio.run(run())
+
+
+def test_serving_client_reconnects_and_dedupes_over_tcp(tmp_path):
+    """Kill the TCP server between acks; the client's retry loop redials
+    the restarted server and replays — the dedup layer + durable state
+    keep folding exactly-once."""
+
+    async def run():
+        dur = _dur(tmp_path)
+        fe = ServingFrontend([_tenant()], durability=dur)
+        host, port = await fe.serve("127.0.0.1", 0)
+        async with ServingClient(
+            retry=RetryPolicy(max_attempts=6, base_s=0.01, cap_s=0.05,
+                              deadline_s=10.0)
+        ) as c:
+            await c.connect(host, port)
+            for i in range(3):
+                ack = await c.submit("m0", f"c{i}", 0, _grad(i))
+                assert ack["accepted"]
+            await fe.close()  # the "crash": connection dies with it
+
+            fe2 = ServingFrontend.recover([_tenant()], dur)
+            host2, port2 = await fe2.serve("127.0.0.1", port)
+            try:
+                # same port: the client's next call rides its retry loop
+                # through the dead connection onto the recovered server
+                ack = await c.submit("m0", "c0", 0, _grad(0), seq=0)
+                assert ack["accepted"] and ack["reason"] == DUPLICATE
+                ack = await c.submit("m0", "c3", 0, _grad(3))
+                assert ack["accepted"] and ack["reason"] == "accepted"
+                assert c.reconnects >= 1
+                r = await c.close_round(TENANT_NAME)
+                assert r["closed"] == 0
+                stats = (await c.stats("m0"))["stats"]
+                assert stats["outstanding"] == 0
+                assert stats["round_id"] == 1
+            finally:
+                await fe2.close()
+
+    TENANT_NAME = "m0"
+    asyncio.run(run())
+
+
+def test_close_round_wire_door_requires_sync_mode():
+    async def run():
+        fe = ServingFrontend([_tenant()])
+        await fe.start()
+        host, port = await fe.serve("127.0.0.1", 0)
+        try:
+            async with ServingClient() as c:
+                await c.connect(host, port)
+                r = await c.close_round("m0")
+                assert r["accepted"] is False
+                assert "close_round_unavailable" in r["reason"]
+        finally:
+            await fe.close()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# the real SIGKILL drill (subprocess; one seeded cycle)
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_drill_zero_invariant_violations(tmp_path):
+    """SIGKILL a real TCP frontend mid-round; recovery must preserve
+    every acked submission exactly once with monotonic rounds and digest
+    continuity (the full 20-seed sweep runs in the chaos bench)."""
+    from byzpy_tpu.resilience.drill import run_kill_recover
+
+    row = run_kill_recover(123, str(tmp_path / "drill"))
+    assert row["violations"] == 0, row
+    assert row["lost"] == 0 and row["double_folded"] == 0
+    assert row["rounds_monotonic"] and row["digest_breaks"] == 0
+    assert row["duplicates_absorbed"] == 5
+    assert row["recovery_metric_exported"]
+
+
+def test_wire_drop_lane_bit_parity():
+    from byzpy_tpu.resilience.drill import run_wire_drop
+
+    row = run_wire_drop(7)
+    assert row["violations"] == 0, row
+    assert row["bit_parity"] and row["duplicates_absorbed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_wal_append_failure_refuses_ack_and_enqueues_nothing(tmp_path):
+    """If the write-ahead append fails (disk full), the ack cannot be a
+    durable promise: the submission is refused with an explicit reason,
+    NOTHING is enqueued (no fold of an unlogged row), and a later retry
+    under the same seq succeeds once the disk heals."""
+    from byzpy_tpu.serving.frontend import REJECTED_UNDURABLE
+
+    fe = ServingFrontend([_tenant()], durability=_dur(tmp_path))
+    t = fe._tenants["m0"]
+    real_append = t.durability.record_accept
+    t.durability.record_accept = lambda *a, **k: (_ for _ in ()).throw(
+        OSError("no space left on device")
+    )
+    ok, reason = fe.submit("m0", "a", 0, _grad(0), seq=5)
+    assert not ok and reason == REJECTED_UNDURABLE
+    assert t.queue.depth() == 0 and t.outstanding == 0  # nothing queued
+    # the seq was NOT consumed: the healed retry is not a duplicate
+    t.durability.record_accept = real_append
+    assert fe.submit("m0", "a", 0, _grad(0), seq=5) == (True, "accepted")
+    assert t.queue.depth() == 1
+
+
+def test_failed_recover_leaves_no_trace_behind(tmp_path):
+    """A recover() attempt on a fresh/wrong directory must not create
+    artifacts that make a SECOND attempt silently 'recover' empty
+    state — both attempts raise the typed error."""
+    dur = _dur(tmp_path)
+    with pytest.raises(CheckpointNotFoundError):
+        ServingFrontend.recover([_tenant()], dur)
+    with pytest.raises(CheckpointNotFoundError):
+        ServingFrontend.recover([_tenant()], dur)  # still nothing there
+    # and a real durable frontend afterwards starts genuinely fresh
+    fe = ServingFrontend([_tenant()], durability=dur)
+    assert fe.recovered == {"m0": None}
+
+
+def test_close_round_never_resent_on_ambiguous_wire_death():
+    """close_round is not idempotent: a connection that dies before the
+    ack must raise, not reconnect-and-resend (two closed rounds)."""
+
+    async def run():
+        served = {"requests": 0}
+
+        async def swallow(reader, writer):
+            from byzpy_tpu.engine.actor import wire
+            try:
+                header = await reader.readexactly(wire._HEADER.size)
+                (length,) = wire._HEADER.unpack(header)
+                await reader.readexactly(length)
+                served["requests"] += 1
+            except Exception:
+                pass
+            writer.close()  # no reply: the ambiguous shape
+
+        server = await asyncio.start_server(swallow, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            async with ServingClient(
+                retry=RetryPolicy(max_attempts=5, base_s=0.01, cap_s=0.02,
+                                  deadline_s=5.0)
+            ) as c:
+                await c.connect("127.0.0.1", port)
+                with pytest.raises(RuntimeError, match="non-idempotent"):
+                    await c.close_round("m0")
+        finally:
+            server.close()
+            await server.wait_closed()
+        assert served["requests"] == 1  # sent once, never replayed
+
+    asyncio.run(run())

@@ -345,3 +345,101 @@ def test_timed_out_sync_node_is_never_reentered_concurrently():
     run(rounds())
     assert "honest:2" in ps.elastic_state.suspects
     assert stalling.max_concurrent == 1, stalling.max_concurrent
+
+
+# ---------------------------------------------------------------------------
+# readmission with param resync (ElasticPolicy.resync)
+# ---------------------------------------------------------------------------
+
+
+class ResyncNode(Node):
+    """Records the authoritative state pushed on re-admission."""
+
+    def __init__(self, value, fail_rounds=0, **kw):
+        super().__init__(value, **kw)
+        self.fail_rounds = fail_rounds
+        self.calls = 0
+        self.resyncs = []
+
+    def honest_gradient_for_next_batch(self):
+        self.calls += 1
+        if self.calls <= self.fail_rounds:
+            raise ConnectionError("node down")
+        return super().honest_gradient_for_next_batch()
+
+    def resync_params(self, state):
+        self.resyncs.append(state)
+
+
+def test_readmit_resyncs_params_before_first_counted_gradient():
+    """A restarted worker receives the authoritative state BEFORE its
+    gradient re-enters the aggregate; the suspicion record clears and
+    the event stream shows resync -> readmitted."""
+    flaky = ResyncNode(4.0, fail_rounds=1)
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0)] + [flaky]
+    authoritative = {"params": np.full(4, 7.0, np.float32), "round": 0}
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(
+            min_quorum=2, resync=lambda: authoritative
+        ),
+    )
+    run(ps.round())  # flaky crashes -> suspected
+    assert "honest:3" in ps.elastic_state.suspects
+    assert flaky.resyncs == []
+    run(ps.round())  # probe: resync first, then the gradient counts
+    assert "honest:3" not in ps.elastic_state.suspects
+    assert len(flaky.resyncs) == 1
+    assert flaky.resyncs[0] is authoritative
+    kinds = [
+        kind for _, nid, kind in ps.elastic_state.events if nid == "honest:3"
+    ]
+    assert "resync" in kinds and "readmitted" in kinds
+    assert kinds.index("resync") < kinds.index("readmitted")
+
+
+def test_readmit_without_resync_hook_keeps_old_path():
+    flaky = ResyncNode(4.0, fail_rounds=1)
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0)] + [flaky]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2),
+    )
+    run(ps.round())
+    run(ps.round())
+    assert "honest:3" not in ps.elastic_state.suspects  # readmitted
+    assert flaky.resyncs == []  # never pushed without the hook
+
+
+def test_failed_resync_keeps_node_suspected():
+    class ResyncRefuses(ResyncNode):
+        def resync_params(self, state):
+            raise ConnectionError("still rebooting")
+
+    flaky = ResyncRefuses(4.0, fail_rounds=1)
+    nodes = [Node(v) for v in (1.0, 2.0, 3.0)] + [flaky]
+    ps = ParameterServer(
+        honest_nodes=nodes,
+        aggregator=CoordinateWiseTrimmedMean(f=0),
+        elastic=ElasticPolicy(min_quorum=2, resync=lambda: {"p": 1}),
+    )
+    run(ps.round())
+    out = run(ps.round())  # resync fails -> node stays out this round
+    assert "honest:3" in ps.elastic_state.suspects
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(64, 2.0), rtol=1e-6)
+
+
+def test_elastic_state_readmit_is_idempotent_and_eventful():
+    from byzpy_tpu.engine.parameter_server.elastic import ElasticState
+
+    state = ElasticState()
+    state.fail(0, "honest:1", ConnectionError("down"))
+    assert "honest:1" in state.suspects
+    state.readmit(1, "honest:1")
+    assert "honest:1" not in state.suspects
+    assert (1, "honest:1", "readmitted") in list(state.events)
+    before = len(state.events)
+    state.readmit(2, "honest:1")  # second readmit: no-op, no event spam
+    assert len(state.events) == before

@@ -179,3 +179,155 @@ def test_startup_grace_shields_never_ponged_peer(make_cluster):
             await cluster.shutdown_all()
 
     asyncio.run(run())
+
+
+def test_heartbeat_partition_then_rejoin(make_cluster):
+    """A PARTITIONED peer (sends to it fail, process alive) must be
+    suspected like a dead one, and must RECOVER — one pong resets the
+    miss streak — when the partition heals. Previously only the
+    permanent-death path had direct coverage."""
+    async def run():
+        cluster = make_cluster(3, topology=Topology.complete(3))
+        await cluster.start_all()
+        nodes = list(cluster.nodes.values())
+        observer, victim = nodes[0], nodes[2]
+        for passive in nodes[1:]:
+            HeartbeatMonitor.install_responder(passive)
+
+        partitioned = {"on": False}
+        real_send = observer.send_message
+
+        async def flaky_send(peer, kind, payload):
+            if partitioned["on"] and peer == victim.node_id:
+                raise ConnectionError("partitioned link")
+            return await real_send(peer, kind, payload)
+
+        observer.send_message = flaky_send
+        events = []
+        mon = HeartbeatMonitor(
+            observer, interval=0.05, max_missed=3,
+            on_suspect=lambda p: events.append(("suspect", p)),
+            on_recover=lambda p: events.append(("recover", p)),
+        )
+        await mon.start()
+        try:
+            ok = await _wait_until(lambda: len(mon.alive()) == 2)
+            assert ok, mon.alive()
+
+            partitioned["on"] = True  # drop the link, keep the process
+            ok = await _wait_until(lambda: victim.node_id in mon.suspects())
+            assert ok, (mon.suspects(), mon.peers)
+            assert ("suspect", victim.node_id) in events
+            assert nodes[1].node_id not in mon.suspects()  # isolation
+
+            partitioned["on"] = False  # heal: one pong must recover it
+            ok = await _wait_until(lambda: victim.node_id in mon.alive())
+            assert ok, (mon.alive(), mon.suspects())
+            assert ("recover", victim.node_id) in events
+            # exactly one suspect + one recover edge: no flapping
+            assert events.count(("suspect", victim.node_id)) == 1
+            assert events.count(("recover", victim.node_id)) == 1
+        finally:
+            await mon.stop()
+            await cluster.shutdown_all()
+
+    asyncio.run(run())
+
+
+def test_node_liveness_probe_suspects_and_recovers():
+    """The actor-PS generalization: the same suspicion rules over direct
+    node calls (no message plane), bridged into ElasticPolicy."""
+    from byzpy_tpu.resilience.heartbeat import NodeLivenessProbe
+
+    class ProbedNode:
+        def __init__(self):
+            self.down = False
+
+        def ping(self):
+            if self.down:
+                raise ConnectionError("dead")
+            return True
+
+    async def run():
+        nodes = [("honest:0", ProbedNode()), ("honest:1", ProbedNode())]
+        events = []
+        probe = NodeLivenessProbe(
+            nodes, interval=0.03, max_missed=3,
+            on_suspect=lambda p: events.append(("suspect", p)),
+            on_recover=lambda p: events.append(("recover", p)),
+        )
+        await probe.start()
+        try:
+            ok = await _wait_until(lambda: probe.alive() == ["honest:0", "honest:1"])
+            assert ok, probe.alive()
+            nodes[1][1].down = True  # crash
+            ok = await _wait_until(lambda: probe.suspects() == ["honest:1"])
+            assert ok, probe.suspects()
+            # the bridge the elastic PS consumes
+            assert probe.suspects() == ["honest:1"]
+            nodes[1][1].down = False  # restart
+            ok = await _wait_until(lambda: probe.suspects() == [])
+            assert ok, probe.suspects()
+            assert ("suspect", "honest:1") in events
+            assert ("recover", "honest:1") in events
+        finally:
+            await probe.stop()
+
+    asyncio.run(run())
+
+
+def test_node_liveness_probe_tolerates_nodes_without_ping():
+    """Plain local objects without a probe method are in-process —
+    reachable by construction, never suspected."""
+    from byzpy_tpu.resilience.heartbeat import NodeLivenessProbe
+
+    class Legacy:
+        pass
+
+    async def run():
+        probe = NodeLivenessProbe(
+            [("honest:0", Legacy())], interval=0.03, max_missed=2
+        )
+        await probe.start()
+        try:
+            ok = await _wait_until(lambda: probe.alive() == ["honest:0"])
+            assert ok, (probe.alive(), probe.suspects())
+            await asyncio.sleep(0.2)
+            assert probe.suspects() == []
+        finally:
+            await probe.stop()
+
+    asyncio.run(run())
+
+
+def test_liveness_tracker_pure_state_machine():
+    """The extracted core both monitors share: consecutive-miss
+    suspicion, one-reply recovery, startup grace for never-repliers."""
+    from byzpy_tpu.engine.node.liveness import LivenessTracker
+
+    events = []
+    tr = LivenessTracker(
+        max_missed=2, startup_grace=10.0,
+        on_suspect=lambda p: events.append(("suspect", p)),
+        on_recover=lambda p: events.append(("recover", p)),
+    )
+    tr.start_clock(0.0)
+    tr.ensure("a")
+    tr.ensure("b")
+    tr.record_reply("a")  # a has replied once; b never has
+    for t in (1.0, 2.0, 3.0):
+        tr.mark_pending("a")
+        tr.mark_pending("b")
+        tr.account_pending(t)
+    # a crossed max_missed; b is shielded by startup grace
+    assert tr.suspects() == ["a"]
+    assert ("suspect", "a") in events
+    # grace expires: b's unanswered probes start counting
+    for t in (11.0, 12.0, 13.0):
+        tr.mark_pending("b")
+        tr.account_pending(t)
+    assert "b" in tr.suspects()
+    # one reply resets everything and fires recovery exactly once
+    tr.record_reply("a")
+    assert tr.alive() == ["a"]
+    assert events.count(("recover", "a")) == 1

@@ -1,0 +1,397 @@
+"""Resilience primitives: retry/backoff, circuit breaker, durable state.
+
+Contracts under test:
+
+* the retry driver follows the decorrelated-jitter schedule exactly
+  (injected rng/clock/sleep), honors both budgets (attempts AND total
+  deadline), never retries fatal errors, and raises the typed
+  budget-exceeded error with the real cause chained;
+* the circuit breaker opens only on CONSECUTIVE failures, quarantines
+  for the cooldown, half-opens one probe, and re-opens on probe failure;
+* the snapshot store is atomic and digest-verified: a torn/tampered
+  newest generation falls back to the previous one, empty and
+  all-corrupt stores raise the typed errors;
+* the write-ahead log replays exactly what was appended and truncates
+  cleanly at a torn tail (the SIGKILL shape);
+* tenant durability round-trips accept/round/drop records into the
+  recovered pending set with exactly-once accounting.
+"""
+
+import asyncio
+import os
+import random
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.resilience.breaker import BreakerPolicy, CircuitBreaker
+from byzpy_tpu.resilience.durable import (
+    DurabilityConfig,
+    RoundLog,
+    TenantDurability,
+)
+from byzpy_tpu.resilience.retry import (
+    RetryBudgetExceededError,
+    RetryPolicy,
+    retry_async,
+)
+from byzpy_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointNotFoundError,
+    SnapshotStore,
+)
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_validation():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match="base_s"):
+        RetryPolicy(base_s=0.5, cap_s=0.1)
+    with pytest.raises(ValueError, match="deadline_s"):
+        RetryPolicy(deadline_s=0)
+
+
+def test_retry_classification_fatal_wins():
+    pol = RetryPolicy(retryable=(OSError,), fatal=(ConnectionRefusedError,))
+    assert pol.is_retryable(ConnectionResetError())
+    assert not pol.is_retryable(ConnectionRefusedError())  # fatal subclass
+    assert not pol.is_retryable(ValueError())  # unlisted = fatal
+
+
+def test_decorrelated_jitter_schedule():
+    pol = RetryPolicy(base_s=0.1, cap_s=1.0)
+    rng = random.Random(7)
+    prev = None
+    for _ in range(32):
+        s = pol.next_backoff_s(prev, rng)
+        assert pol.base_s <= s <= pol.cap_s
+        # decorrelated: bounded by 3x the previous sleep (or base)
+        assert s <= 3.0 * (prev if prev is not None else pol.base_s) + 1e-9
+        prev = s
+
+
+def test_retry_async_succeeds_after_transient_failures():
+    calls = []
+
+    async def fn(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    slept = []
+
+    async def fake_sleep(s):
+        slept.append(s)
+
+    out = asyncio.run(
+        retry_async(
+            fn,
+            policy=RetryPolicy(max_attempts=5, base_s=0.01, cap_s=0.05,
+                               deadline_s=10.0),
+            rng=random.Random(0),
+            sleep=fake_sleep,
+        )
+    )
+    assert out == "ok"
+    assert calls == [0, 1, 2]
+    assert len(slept) == 2 and all(0.01 <= s <= 0.05 for s in slept)
+
+
+def test_retry_async_attempt_budget_raises_typed_error():
+    async def fn(attempt):
+        raise ConnectionResetError(f"always ({attempt})")
+
+    async def fake_sleep(s):
+        pass
+
+    with pytest.raises(RetryBudgetExceededError) as ei:
+        asyncio.run(
+            retry_async(
+                fn,
+                policy=RetryPolicy(max_attempts=3, base_s=0.01, cap_s=0.02,
+                                   deadline_s=10.0),
+                rng=random.Random(0),
+                sleep=fake_sleep,
+            )
+        )
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, ConnectionResetError)
+
+
+def test_retry_async_deadline_budget_stops_early():
+    """A retry that cannot finish before the total deadline is not
+    started — the deadline bounds wall clock, not just attempt count."""
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    async def fn(attempt):
+        t[0] += 0.6  # each attempt burns most of the budget
+        raise ConnectionResetError("slow failure")
+
+    async def fake_sleep(s):
+        t[0] += s
+
+    with pytest.raises(RetryBudgetExceededError):
+        asyncio.run(
+            retry_async(
+                fn,
+                policy=RetryPolicy(max_attempts=50, base_s=0.1, cap_s=0.2,
+                                   deadline_s=1.0),
+                rng=random.Random(0),
+                sleep=fake_sleep,
+                clock=clock,
+            )
+        )
+    assert t[0] < 2.0  # nowhere near 50 attempts' worth
+
+
+def test_retry_async_fatal_raises_immediately():
+    calls = []
+
+    async def fn(attempt):
+        calls.append(attempt)
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        asyncio.run(
+            retry_async(fn, policy=RetryPolicy(max_attempts=5, deadline_s=5.0))
+        )
+    assert calls == [0]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def _breaker(threshold=3, cooldown=10.0):
+    t = [0.0]
+    b = CircuitBreaker(
+        BreakerPolicy(threshold=threshold, cooldown_s=cooldown),
+        clock=lambda: t[0],
+    )
+    return b, t
+
+
+def test_breaker_opens_on_consecutive_failures_only():
+    b, _t = _breaker(threshold=3)
+    assert not b.record_failure()
+    assert not b.record_failure()
+    b.record_success()  # streak broken
+    assert not b.record_failure()
+    assert not b.record_failure()
+    assert b.record_failure()  # third consecutive: opens
+    assert b.state == "open" and b.opens == 1
+    assert not b.allow()
+
+
+def test_breaker_half_open_probe_then_close_or_reopen():
+    b, t = _breaker(threshold=2, cooldown=5.0)
+    b.record_failure()
+    assert b.record_failure()
+    assert not b.allow()
+    t[0] = 5.0  # cooldown elapsed: one probe allowed
+    assert b.allow()
+    assert b.state == "half_open"
+    # probe fails: re-opens immediately (no fresh threshold count)
+    assert b.record_failure()
+    assert not b.allow()
+    t[0] = 10.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    assert b.opens == 2
+
+
+def test_breaker_policy_validation():
+    with pytest.raises(ValueError, match="threshold"):
+        BreakerPolicy(threshold=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        BreakerPolicy(cooldown_s=-1)
+
+
+# ---------------------------------------------------------------------------
+# snapshot store
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_store_roundtrip_and_retention(tmp_path):
+    store = SnapshotStore(str(tmp_path), max_to_keep=2)
+    for step in (1, 2, 3):
+        store.save(step, {"step": step, "w": np.arange(step, dtype=np.float32)})
+    assert store.all_steps() == [2, 3]  # max_to_keep pruned step 1
+    step, state, skipped = store.restore_latest()
+    assert step == 3 and int(state["step"]) == 3 and skipped == []
+    np.testing.assert_array_equal(state["w"], np.arange(3, dtype=np.float32))
+
+
+def test_snapshot_store_empty_raises_typed_not_found(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    with pytest.raises(CheckpointNotFoundError, match=str(tmp_path)):
+        store.restore_latest()
+
+
+def test_snapshot_corrupt_newest_falls_back_to_previous(tmp_path):
+    store = SnapshotStore(str(tmp_path), max_to_keep=3)
+    store.save(1, {"v": 1})
+    path2 = store.save(2, {"v": 2})
+    # torn write: truncate the newest generation mid-payload
+    with open(path2, "r+b") as fh:
+        fh.truncate(os.path.getsize(path2) - 3)
+    step, state, skipped = store.restore_latest()
+    assert step == 1 and state["v"] == 1 and skipped == [2]
+
+
+def test_snapshot_tampered_digest_detected(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    path = store.save(5, {"v": 5})
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF  # flip one payload bit: digest must catch it
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointCorruptError, match="digest"):
+        store.load(5)
+    with pytest.raises(CheckpointCorruptError, match="every snapshot"):
+        store.restore_latest()  # the only generation is bad
+
+
+def test_snapshot_save_async_runs_off_loop(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+
+    async def run():
+        await store.save_async(7, {"v": 7})
+
+    asyncio.run(run())
+    assert store.restore_latest()[0] == 7
+
+
+# ---------------------------------------------------------------------------
+# write-ahead log
+# ---------------------------------------------------------------------------
+
+
+def test_round_log_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "wal.log")
+    log = RoundLog(path)
+    recs = [("a", i, f"c{i}", i, 0, 0.0, np.float32(i)) for i in range(5)]
+    for r in recs:
+        log.append(r)
+    log.close()
+    out, clean = RoundLog.read(path)
+    assert clean and len(out) == 5 and out[0][2] == "c0"
+    # SIGKILL shape: a torn record at the tail truncates, keeps the rest
+    with open(path, "ab") as fh:
+        fh.write(b"\x00\x00\x10\x00partial-record-without-en")
+    out, clean = RoundLog.read(path)
+    assert not clean and len(out) == 5
+
+
+def test_round_log_corrupt_record_stops_replay(tmp_path):
+    path = str(tmp_path / "wal.log")
+    log = RoundLog(path)
+    for i in range(3):
+        log.append(("a", i))
+    log.close()
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # flip a bit mid-file
+    open(path, "wb").write(bytes(blob))
+    out, clean = RoundLog.read(path)
+    assert not clean and len(out) < 3  # nothing after the corruption
+
+
+# ---------------------------------------------------------------------------
+# tenant durability
+# ---------------------------------------------------------------------------
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("snapshot_every", 2)
+    kw.setdefault("prune", False)
+    return DurabilityConfig(directory=str(tmp_path), **kw)
+
+
+def test_tenant_durability_fresh_start_is_none(tmp_path):
+    td = TenantDurability(_cfg(tmp_path), "t0")
+    assert td.recovered is None
+    td.close()
+
+
+def test_tenant_durability_replays_pending_and_rounds(tmp_path):
+    td = TenantDurability(_cfg(tmp_path), "t0")
+    g = np.arange(4, dtype=np.float32)
+    td.record_accept(0, "alice", 3, 0, 1.0, g)
+    td.record_accept(1, "bob", 9, 0, 1.1, g * 2)
+    td.record_round(0, (0,), "d" * 16, 1)  # alice folded, bob pending
+    td.record_accept(2, "carol", 1, 1, 2.0, g * 3)
+    td.record_dropped(1, (2,), "failed_round")  # carol dropped
+    td.close()
+
+    td2 = TenantDurability(_cfg(tmp_path), "t0")
+    rec = td2.recovered
+    td2.close()
+    assert rec is not None
+    assert rec.round_id == 1  # one folded round -> next round is 1
+    assert rec.rounds == [(0, "d" * 16)]
+    assert [p["c"] for p in rec.pending] == ["bob"]  # exactly once, not lost
+    np.testing.assert_array_equal(rec.pending[0]["g"], g * 2)
+    assert rec.seqs == {"alice": 3, "bob": 9, "carol": 1}
+    assert rec.next_wal_id == 3
+
+
+def test_tenant_durability_snapshot_plus_wal_composition(tmp_path):
+    cfg = _cfg(tmp_path)
+    td = TenantDurability(cfg, "t0")
+    g = np.ones(2, np.float32)
+    td.record_accept(0, "a", 0, 0, 0.0, g)
+    td.record_round(0, (0,), "x" * 16, 1)
+    # snapshot at round 1 with one pending row, then more WAL traffic
+    save = td.rotate_and_capture(
+        1,
+        {
+            "round_id": 1, "last_aggregate": g, "seqs": {"a": 0},
+            "next_wal_id": 2,
+            "pending": [{"w": 1, "c": "b", "q": 0, "r": 0, "t": 0.0, "g": g}],
+            "ledger_totals": {"accepted": 2}, "failed_rounds": 0,
+            "ingress_bytes": 0, "stats_rounds": 1,
+        },
+    )
+    save()
+    td.record_accept(2, "c", 0, 1, 1.0, g)
+    td.record_round(1, (1, 2), "y" * 16, 2)  # folds snapshot-pending + new
+    td.close()
+
+    rec = TenantDurability(cfg, "t0").recovered
+    assert rec is not None
+    assert rec.from_snapshot == 1
+    assert rec.round_id == 2
+    assert rec.pending == []  # everything folded across the composition
+    assert rec.rounds[-1] == (1, "y" * 16)
+
+
+def test_tenant_durability_survives_all_corrupt_snapshots(tmp_path):
+    """Every snapshot generation corrupt => recovery degrades to pure
+    WAL replay instead of refusing to start."""
+    cfg = _cfg(tmp_path)
+    td = TenantDurability(cfg, "t0")
+    g = np.ones(2, np.float32)
+    td.record_accept(0, "a", 0, 0, 0.0, g)
+    save = td.rotate_and_capture(
+        0, {"round_id": 0, "seqs": {}, "next_wal_id": 1, "pending": [],
+            "ledger_totals": {}, "failed_rounds": 0, "ingress_bytes": 0,
+            "stats_rounds": 0},
+    )
+    path = save()
+    blob = bytearray(open(path, "rb").read())
+    blob[-2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    td.close()
+    rec = TenantDurability(cfg, "t0").recovered
+    assert rec is not None
+    assert rec.skipped_corrupt == [0]
+    assert [p["c"] for p in rec.pending] == ["a"]  # WAL still authoritative
