@@ -33,6 +33,22 @@ Four lanes, each emitting JSON rows (stdout + ``--out`` JSONL):
   frames, monotonic round numbering and digest continuity; plus an
   in-process ack-drop/retry cycle asserting round-aggregate bit parity
   against the no-fault twin. The standing wall runs ≥ 20 seeds.
+* ``forensics`` — detector scoring for the PR-10 attribution plane
+  (``byzpy_tpu.forensics``): every PR-7 adaptive attacker
+  (influence-ascent, Krum-evasion, staleness-abuse) plus the static
+  sign-flip/outlier attacks, run with the forensics plane attached —
+  per-cell byzantine recall, first-flag round (must beat
+  ``DETECT_BUDGET``), precision, and honest-contamination rate; an
+  honest-only sweep pinning the false-positive rate under
+  ``FP_BOUND``; trace-digest parity forensics-on vs forensics-off
+  (the plane is a pure observer); and an end-to-end audit leg — a
+  REAL durable serving frontend under staleness abuse, evidence
+  verified present in the WAL (``python -m byzpy_tpu.forensics``
+  report path) and on a live Prometheus scrape of the TCP ingress.
+  The headline criterion: the staleness-abuse breach that was
+  operator-invisible in PR 7 (trimmed-mean 8.4×, Multi-Krum 47×) now
+  raises ``staleness_inflation`` flags within ``DETECT_BUDGET``
+  rounds at a pinned honest false-positive rate.
 
 ``--smoke`` shrinks everything for CI and asserts the contracts (zero
 harness-crashed cells, cell replay determinism, swarm liveness, zero
@@ -377,6 +393,270 @@ def run_recovery(args, out) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# forensics lane (detector scoring for the attribution plane)
+# ---------------------------------------------------------------------------
+
+#: Detection budget: every adaptive attacker must raise its first flag
+#: within this many rounds (the PR-7 serving-lane breach was invisible
+#: for the WHOLE run).
+DETECT_BUDGET = 6
+#: Pinned honest-only false-positive bound (fraction of honest
+#: client-round records carrying any flag; measured worst across the
+#: committed sweep: 0.014).
+FP_BOUND = 0.02
+
+_SERVING_STALENESS = dict(
+    engine="serving",
+    staleness_kind="exponential",
+    staleness_gamma=0.5,
+    staleness_cutoff=4,
+)
+
+#: (attack, params, aggregator, agg_params, scenario extras, adaptive?)
+FORENSICS_CELLS = [
+    ("influence_ascent", {"grow": 1.8, "scale0": 0.1},
+     "multi_krum", {"f": 3, "q": 4}, {}, True),
+    ("influence_ascent", {"grow": 1.8, "scale0": 0.1},
+     "cge", {"f": 3}, {}, True),
+    ("krum_evasion", {}, "multi_krum", {"f": 3, "q": 4}, {}, True),
+    ("staleness_abuse",
+     {"kind": "exponential", "gamma": 0.5, "cutoff": 4, "scale": 2.0},
+     "trimmed_mean", {"f": 3}, _SERVING_STALENESS, True),
+    ("staleness_abuse",
+     {"kind": "exponential", "gamma": 0.5, "cutoff": 4, "scale": 2.0},
+     "multi_krum", {"f": 3, "q": 4}, _SERVING_STALENESS, True),
+    ("sign_flip", {"scale": -4.0}, "trimmed_mean", {"f": 3}, {}, False),
+    ("outlier", {"scale": 50.0}, "multi_krum", {"f": 3, "q": 4}, {}, False),
+]
+
+HONEST_CONFIGS = [
+    ("trimmed_mean", {"f": 3}, {}),
+    ("multi_krum", {"f": 3, "q": 4}, {}),
+    ("cge", {"f": 3}, {}),
+    ("trimmed_mean", {"f": 3}, _SERVING_STALENESS),
+]
+
+
+def _forensics_config():
+    from byzpy_tpu.forensics import ForensicsConfig
+
+    return ForensicsConfig()
+
+
+def run_forensics(args, out) -> dict:
+    rows = []
+    fc = _forensics_config()
+    # -- attack cells: recall / first-flag / precision ------------------
+    for att, ap, agg, agp, extra, adaptive in args.forensics_cells:
+        cell = Scenario(
+            name=f"forensics/{att}/{agg}",
+            seed=args.seed,
+            n_clients=args.clients_grid,
+            n_byzantine=args.byzantine,
+            dim=args.dim,
+            rounds=args.rounds,
+            aggregator=agg,
+            aggregator_params=agp,
+            attack=AttackSpec(name=att, params=ap),
+            **extra,
+        )
+        report = ChaosHarness(cell, forensics=fc).run()
+        s = report.forensics_summary()
+        row = {
+            "lane": "forensics",
+            "attack": att,
+            "adaptive": adaptive,
+            "aggregator": agg,
+            "engine": cell.engine,
+            "rounds": report.rounds_completed,
+            "byz_present": s["byz_present"],
+            "byz_flagged": s["byz_flagged"],
+            "recall": s["recall"],
+            "precision": s["precision"],
+            "first_byz_flag_round": s["first_byz_flag_round"],
+            "honest_fp_rate": round(s["honest_fp_rate"], 4),
+            "flags_by_detector": s["flags_by_detector"],
+            "detect_budget": DETECT_BUDGET,
+            "within_budget": (
+                s["first_byz_flag_round"] is not None
+                and s["first_byz_flag_round"] <= DETECT_BUDGET
+            ),
+            "final_error": round(report.final_error, 6),
+            "trace_digest": report.trace.digest(),
+        }
+        rows.append(row)
+        _emit(row, out)
+    # -- honest-only sweep: pinned false-positive bound -----------------
+    worst_fp = 0.0
+    honest_runs = 0
+    for i in range(args.forensics_honest_seeds):
+        for agg, agp, extra in args.honest_configs:
+            cell = Scenario(
+                name=f"forensics-honest/{agg}",
+                seed=args.seed + i,
+                n_clients=args.clients_grid,
+                dim=args.dim,
+                rounds=args.rounds,
+                aggregator=agg,
+                aggregator_params=agp,
+                **extra,
+            )
+            s = ChaosHarness(cell, forensics=fc).run().forensics_summary()
+            worst_fp = max(worst_fp, s["honest_fp_rate"])
+            honest_runs += 1
+    # -- digest parity: the plane is a pure observer --------------------
+    parity_cell = Scenario(
+        name="forensics-parity",
+        seed=args.seed,
+        n_clients=args.clients_grid,
+        n_byzantine=args.byzantine,
+        dim=args.dim,
+        rounds=args.rounds,
+        aggregator="multi_krum",
+        aggregator_params={"f": 3, "q": 4},
+        attack=AttackSpec(
+            name="influence_ascent", params={"grow": 1.8, "scale0": 0.1}
+        ),
+    )
+    with_f = ChaosHarness(parity_cell, forensics=fc).run()
+    without = ChaosHarness(parity_cell).run()
+    digest_parity = (
+        with_f.trace.digest() == without.trace.digest()
+        and with_f.final_error == without.final_error
+    )
+    # -- end-to-end audit: durable frontend + WAL + Prometheus ----------
+    audit_row = _forensics_audit_leg(args)
+    _emit(audit_row, out)
+    summary = {
+        "lane": "forensics_summary",
+        "cells": len(rows),
+        "adaptive_cells": sum(1 for r in rows if r["adaptive"]),
+        "adaptive_all_flagged": all(
+            r["byz_flagged"] == r["byz_present"]
+            for r in rows
+            if r["adaptive"]
+        ),
+        "adaptive_within_budget": all(
+            r["within_budget"] for r in rows if r["adaptive"]
+        ),
+        "staleness_first_flag": {
+            r["aggregator"]: r["first_byz_flag_round"]
+            for r in rows
+            if r["attack"] == "staleness_abuse"
+        },
+        "honest_runs": honest_runs,
+        "honest_worst_fp_rate": round(worst_fp, 4),
+        "fp_bound": FP_BOUND,
+        "fp_within_bound": worst_fp <= FP_BOUND,
+        "digest_parity": digest_parity,
+        "wal_audit_ok": audit_row["wal_audit_ok"],
+        "prometheus_ok": audit_row["prometheus_ok"],
+    }
+    _emit(summary, out)
+    return summary
+
+
+def _forensics_audit_leg(args) -> dict:
+    """A REAL durable ServingFrontend under staleness abuse: evidence
+    must land in the write-ahead log (readable by the forensics CLI's
+    audit path) and the forensics metric families must answer on a
+    live Prometheus scrape of the TCP wire ingress."""
+    import asyncio
+    import tempfile
+
+    import numpy as np
+
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+    from byzpy_tpu.forensics import ForensicsConfig, TrustPolicy, audit
+    from byzpy_tpu.serving import (
+        DurabilityConfig,
+        ServingFrontend,
+        StalenessPolicy,
+        TenantConfig,
+    )
+
+    rounds = max(6, min(10, args.rounds))
+    dim = 16
+
+    async def drive(tmp: str) -> dict:
+        fe = ServingFrontend(
+            [
+                TenantConfig(
+                    name="m0",
+                    aggregator=CoordinateWiseTrimmedMean(f=1),
+                    dim=dim,
+                    staleness=StalenessPolicy(
+                        kind="exponential", gamma=0.5, cutoff=4
+                    ),
+                    forensics=ForensicsConfig(
+                        trust=TrustPolicy(alpha=0.5, readmit_after_rounds=4),
+                        quarantine=True,
+                    ),
+                )
+            ],
+            # prune=False keeps the full forensic history on disk —
+            # the audit must see every round's evidence
+            durability=DurabilityConfig(directory=tmp, prune=False),
+        )
+        rng = np.random.default_rng(args.seed)
+        untrusted_acks = 0
+        for r in range(rounds):
+            for i in range(6):
+                ok, reason = fe.submit(
+                    "m0", f"c{i}", r,
+                    rng.normal(1.0, 0.1, dim).astype(np.float32),
+                )
+                assert ok, reason
+            # the staleness abuser: stamps at the cutoff, pre-inflates
+            # by 1/discount(4) = 16x so the discount cancels at fold
+            inflated = (16.0 * rng.normal(1.0, 0.1, dim)).astype(np.float32)
+            ok, reason = fe.submit("m0", "byz0", max(0, r - 4), inflated)
+            if reason == "rejected_untrusted":
+                untrusted_acks += 1
+            assert fe.close_round_nowait("m0") is not None
+        host, port = await fe.serve()
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+        await writer.drain()
+        scrape = (await reader.read(-1)).decode()
+        writer.close()
+        stats = fe.stats()["m0"]
+        await fe.close()
+        return {"scrape": scrape, "stats": stats, "untrusted": untrusted_acks}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        res = asyncio.run(drive(tmp))
+        report = audit.wal_timeline(os.path.join(tmp, "m0"))
+    byz_entry = report["clients"].get("byz0", {})
+    wal_ok = (
+        report["evidence_rounds"] > 0
+        and not report["digest_mismatches"]
+        and bool(byz_entry.get("flags"))
+        and any(t["event"] == "quarantine" for t in report["transitions"])
+    )
+    prom_ok = all(
+        name in res["scrape"]
+        for name in (
+            "byzpy_anomaly_flags_total",
+            "byzpy_trust_score",
+            "byzpy_client_excluded_total",
+            "byzpy_quarantined_clients",
+        )
+    )
+    return {
+        "lane": "forensics_audit",
+        "rounds": rounds,
+        "wal_evidence_rounds": report["evidence_rounds"],
+        "wal_digest_mismatches": len(report["digest_mismatches"]),
+        "byz_flags": dict(byz_entry.get("flags", {})),
+        "quarantine_transitions": len(report["transitions"]),
+        "rejected_untrusted_acks": res["untrusted"],
+        "wal_audit_ok": wal_ok,
+        "prometheus_ok": prom_ok,
+    }
+
+
+# ---------------------------------------------------------------------------
 # swarm lane
 # ---------------------------------------------------------------------------
 
@@ -487,7 +767,12 @@ def main() -> None:
     ap.add_argument("--swarm-rounds", type=int, default=12)
     ap.add_argument("--recovery-runs", type=int, default=20)
     ap.add_argument(
-        "--lanes", type=str, default="grid,adaptive,serving,swarm,recovery",
+        "--forensics-honest-seeds", type=int, default=5,
+        help="honest-only seeds per config for the FP-rate pin",
+    )
+    ap.add_argument(
+        "--lanes", type=str,
+        default="grid,adaptive,serving,swarm,recovery,forensics",
         help="comma-separated lane subset",
     )
     ap.add_argument("--out", type=str, default=None)
@@ -498,6 +783,8 @@ def main() -> None:
     args.attacks = ATTACK_CELLS
     args.faults = list(FAULT_CELLS)
     args.aggregators = AGG_CELLS
+    args.forensics_cells = FORENSICS_CELLS
+    args.honest_configs = HONEST_CONFIGS
     if args.smoke:
         args.rounds = 10
         args.dim = 32
@@ -508,6 +795,11 @@ def main() -> None:
         args.attacks = [ATTACK_CELLS[0], ATTACK_CELLS[4]]
         args.faults = ["none", "crash_restart"]
         args.aggregators = AGG_CELLS[:2]
+        # keep every ADAPTIVE forensics cell (the smoke's whole point is
+        # "each adaptive attacker gets flagged"); drop the static extras
+        args.forensics_cells = [c for c in FORENSICS_CELLS if c[5]]
+        args.forensics_honest_seeds = 2
+        args.honest_configs = HONEST_CONFIGS[:2] + HONEST_CONFIGS[3:]
     lanes = {s.strip() for s in args.lanes.split(",") if s.strip()}
 
     meta = {
@@ -524,6 +816,7 @@ def main() -> None:
     serving = run_serving(args, args.out) if "serving" in lanes else []
     swarm = run_swarm(args, args.out) if "swarm" in lanes else None
     recovery = run_recovery(args, args.out) if "recovery" in lanes else None
+    forensics = run_forensics(args, args.out) if "forensics" in lanes else None
 
     crashed = [r for r in grid if r.get("harness_crashed")]
     headline = {
@@ -547,6 +840,12 @@ def main() -> None:
             recovery["kill_violations"] + recovery["wire_violations"]
             if recovery
             else None
+        ),
+        "forensics_adaptive_within_budget": (
+            forensics["adaptive_within_budget"] if forensics else None
+        ),
+        "forensics_honest_worst_fp": (
+            forensics["honest_worst_fp_rate"] if forensics else None
         ),
     }
     _emit(headline, args.out)
@@ -579,6 +878,13 @@ def main() -> None:
         assert d1 == d2, "chaos cell not replayable"
     if args.smoke and swarm is not None:
         assert swarm["rounds"] > 0 and swarm["submissions"] > 0
+    if args.smoke and forensics is not None:
+        assert forensics["adaptive_all_flagged"], forensics
+        assert forensics["adaptive_within_budget"], forensics
+        assert forensics["fp_within_bound"], forensics
+        assert forensics["digest_parity"], forensics
+        assert forensics["wal_audit_ok"], forensics
+        assert forensics["prometheus_ok"], forensics
     if args.smoke:
         print("chaos smoke OK")
 

@@ -362,6 +362,70 @@ class Aggregator(Operator, ABC):
             valid = jnp.asarray(valid_rows, bool)
             return unravel(self._masked_jitted()(buffer, valid))
 
+    # -- forensics evidence (per-row score view) ---------------------------
+
+    #: True when :meth:`round_evidence` publishes a binary keep set
+    #: (selection aggregators: Krum families, CGE, MoNNA). Lets
+    #: selection-only consumers (``chaos.influence.selection_mask``)
+    #: skip the score computation entirely for aggregators whose view
+    #: carries scores but no selection (e.g. trimmed-mean clip
+    #: fractions — an O(m·d·log m) host pass that would be discarded).
+    evidence_selects: bool = False
+
+    def round_evidence(
+        self, matrix: Any, valid: Any, *, aggregate: Any = None
+    ) -> Optional[dict]:
+        """Per-row score/selection view of one (padded) cohort for the
+        forensics plane (``byzpy_tpu.forensics``), or ``None`` when the
+        aggregator publishes no per-row scores (or the valid cohort is
+        empty/inadmissible — no defined selection).
+
+        Returns ``{"kind": str, "scores": (n,) float array, "keep":
+        (n,) bool array or None}`` aligned to PADDED slot positions
+        (invalid rows carry NaN scores / False keeps). Computed
+        HOST-SIDE from the same published score programs the aggregate
+        uses (``ops.robust.krum_scores``, per-row norms, …) — never
+        inside the aggregation program, so round aggregates stay
+        digest-identical with forensics on or off. ``aggregate`` (the
+        round's broadcast) is only needed by center-seeking aggregators
+        (geomed/clipping) whose scores are distances to the output."""
+        return None
+
+    def _evidence_rows(self, matrix: Any, valid: Any) -> Optional[tuple]:
+        """Shared preamble for ``round_evidence`` overrides: the
+        compacted valid rows as float32 numpy, their padded indices,
+        and the padded shape — or ``None`` when the valid cohort is
+        empty or inadmissible (``validate_n`` rejects ``m``)."""
+        import numpy as np
+
+        valid = np.asarray(valid, bool)
+        idx = np.flatnonzero(valid)
+        m = int(idx.size)
+        if m == 0:
+            return None
+        try:
+            self.validate_n(m)
+        except ValueError:
+            return None
+        rows = np.asarray(matrix, np.float32)[idx]
+        return rows, idx, valid.shape[0]
+
+    @staticmethod
+    def _evidence_view(
+        kind: str, n: int, idx, scores, keep_local=None
+    ) -> dict:
+        """Scatter compacted per-row ``scores`` (and an optional local
+        keep index set) back to padded positions."""
+        import numpy as np
+
+        full = np.full((n,), np.nan, np.float32)
+        full[idx] = np.asarray(scores, np.float32)
+        keep = None
+        if keep_local is not None:
+            keep = np.zeros((n,), bool)
+            keep[idx[np.asarray(keep_local)]] = True
+        return {"kind": kind, "scores": full, "keep": keep}
+
     def validate_n(self, n: int) -> None:
         """Hook for subclasses to validate hyperparameters against n."""
 

@@ -81,6 +81,43 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.trimmed_mean_stream(xs, f=self.f)
 
+    #: Coordinate cap for the host-side clip-fraction evidence: past
+    #: this, the per-coordinate rank pass samples an evenly-strided
+    #: subset (evidence is a screening signal, not the aggregate).
+    _EVIDENCE_MAX_COORDS = 65536
+
+    def round_evidence(self, matrix, valid, *, aggregate=None):
+        """Per-row clip counts: the fraction of a row's coordinates
+        that fell in the trimmed ``f``-smallest/``f``-largest window
+        (host-side ranks; stable order matches the sort the aggregate
+        trims with). An honest row is clipped on ~``2f/m`` of
+        coordinates by symmetry; a directional attacker concentrates
+        near 1.0. No binary selection (``keep`` is None) — trimming is
+        per-coordinate."""
+        pre = self._evidence_rows(matrix, valid)
+        if pre is None:
+            return None
+        rows, idx, n = pre
+        m, d = rows.shape
+        if self.f == 0:
+            return self._evidence_view(
+                "trim_fraction", n, idx, np.zeros((m,), np.float32)
+            )
+        cols = rows
+        if d > self._EVIDENCE_MAX_COORDS:
+            sample = np.linspace(
+                0, d - 1, self._EVIDENCE_MAX_COORDS, dtype=np.int64
+            )
+            cols = rows[:, sample]
+        order = np.argsort(cols, axis=0, kind="stable")
+        ranks = np.empty_like(order)
+        np.put_along_axis(
+            ranks, order, np.arange(m, dtype=order.dtype)[:, None], axis=0
+        )
+        trimmed = (ranks < self.f) | (ranks >= m - self.f)
+        frac = trimmed.mean(axis=1).astype(np.float32)
+        return self._evidence_view("trim_fraction", n, idx, frac)
+
     # -- arrival-order streaming fold ------------------------------------
 
     def fold_init(self, n: int) -> Any:

@@ -63,6 +63,21 @@ class GeometricMedian(BarrieredIterativeAggregator, Aggregator):
             eps=self.eps, init=self.init,
         )
 
+    def round_evidence(self, matrix, valid, *, aggregate=None):
+        """Weiszfeld-weight view: each row's distance to the published
+        geometric median (its implicit weight is ``∝ 1/distance`` at
+        the fixed point, so a large score = a down-weighted row).
+        Needs the round's ``aggregate``; returns None without it."""
+        if aggregate is None:
+            return None
+        pre = self._evidence_rows(matrix, valid)
+        if pre is None:
+            return None
+        rows, idx, n = pre
+        center = np.asarray(aggregate, np.float32).reshape(-1)
+        dists = np.linalg.norm(rows - center[None, :], axis=1)
+        return self._evidence_view("geomed_distance", n, idx, dists)
+
     # -- barriered hooks (pool mode) -----------------------------------------
 
     def _barrier_params(self):

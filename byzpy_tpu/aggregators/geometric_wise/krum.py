@@ -103,6 +103,7 @@ class MultiKrum(RowScoredAggregator, Aggregator):
         return robust.ranked_mean(matrix, scores, self.q)
 
     supports_masked_finalize = True
+    evidence_selects = True
 
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum(x, f=self.f, q=self.q)
@@ -123,6 +124,18 @@ class MultiKrum(RowScoredAggregator, Aggregator):
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum_stream(xs, f=self.f, q=self.q)
+
+    def round_evidence(self, matrix, valid, *, aggregate=None):
+        """Krum-distance scores + the lowest-``q`` selection, scattered
+        to padded positions (host-side; tie rule = the aggregation
+        program's stable lowest-``q`` pick)."""
+        pre = self._evidence_rows(matrix, valid)
+        if pre is None:
+            return None
+        rows, idx, n = pre
+        scores = np.asarray(robust.krum_scores(jnp.asarray(rows), f=self.f))
+        keep_local = np.argsort(scores, kind="stable")[: int(self.q)]
+        return self._evidence_view("krum_distance", n, idx, scores, keep_local)
 
     # -- arrival-order streaming fold ------------------------------------
 

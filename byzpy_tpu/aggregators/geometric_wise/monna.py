@@ -48,6 +48,7 @@ class MoNNA(RowScoredAggregator, Aggregator):
         return robust.ranked_mean(matrix, scores, matrix.shape[0] - self.f)
 
     supports_masked_finalize = True
+    evidence_selects = True
 
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.monna(x, f=self.f, reference_index=self.reference_index)
@@ -61,6 +62,20 @@ class MoNNA(RowScoredAggregator, Aggregator):
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.monna_stream(xs, f=self.f, reference_index=self.reference_index)
+
+    def round_evidence(self, matrix, valid, *, aggregate=None):
+        """Squared-distance-to-the-trusted-pivot scores + the
+        nearest-``m − f`` selection (host-side, stable tie rule)."""
+        pre = self._evidence_rows(matrix, valid)
+        if pre is None:
+            return None
+        rows, idx, n = pre
+        m = rows.shape[0]
+        jrows = jnp.asarray(rows)
+        ref = jrows[int(self.reference_index) % m]
+        d2 = np.asarray(jnp.sum((jrows - ref[None, :]) ** 2, axis=1))
+        keep_local = np.argsort(d2, kind="stable")[: m - int(self.f)]
+        return self._evidence_view("reference_distance", n, idx, d2, keep_local)
 
 
 __all__ = ["MoNNA"]

@@ -58,6 +58,23 @@ class CenteredClipping(BarrieredIterativeAggregator, Aggregator):
             x, valid, c_tau=self.c_tau, M=self.M, eps=self.eps, init=self.init
         )
 
+    def round_evidence(self, matrix, valid, *, aggregate=None):
+        """Clip-ratio view: each row's distance to the published center
+        over ``c_tau`` (ratio > 1 = the row was clipped to the radius;
+        the excess is the magnitude the clip discarded). Needs the
+        round's ``aggregate``; returns None without it."""
+        if aggregate is None:
+            return None
+        pre = self._evidence_rows(matrix, valid)
+        if pre is None:
+            return None
+        rows, idx, n = pre
+        center = np.asarray(aggregate, np.float32).reshape(-1)
+        dists = np.linalg.norm(rows - center[None, :], axis=1)
+        if self.c_tau > 0:
+            return self._evidence_view("clip_ratio", n, idx, dists / self.c_tau)
+        return self._evidence_view("center_distance", n, idx, dists)
+
     # -- barriered hooks (pool mode) -----------------------------------------
 
     def _barrier_params(self):

@@ -58,6 +58,7 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
         return robust.ranked_mean(matrix, scores, matrix.shape[0] - self.f)
 
     supports_masked_finalize = True
+    evidence_selects = True
 
     def _aggregate_matrix(self, x: jnp.ndarray) -> jnp.ndarray:
         return robust.cge(x, f=self.f)
@@ -72,6 +73,17 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
 
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.cge_stream(xs, f=self.f)
+
+    def round_evidence(self, matrix, valid, *, aggregate=None):
+        """Per-row L2-norm scores + the lowest-``m − f`` selection
+        (host-side; stable tie rule matching the selection program)."""
+        pre = self._evidence_rows(matrix, valid)
+        if pre is None:
+            return None
+        rows, idx, n = pre
+        norms = np.asarray(jnp.linalg.norm(jnp.asarray(rows), axis=1))
+        keep_local = np.argsort(norms, kind="stable")[: rows.shape[0] - int(self.f)]
+        return self._evidence_view("norm", n, idx, norms, keep_local)
 
     # -- arrival-order streaming fold ------------------------------------
 

@@ -72,6 +72,11 @@ class ChaosReport:
     verdict_counts: Dict[str, int] = field(default_factory=dict)
     submissions: List[np.ndarray] = field(default_factory=list)
     trace: EventTrace = field(default_factory=EventTrace)
+    #: per-round :class:`~byzpy_tpu.forensics.evidence.RoundEvidence`
+    #: when the harness was built with a forensics config — the SAME
+    #: schema the online serving plane produces, kept OUT of the event
+    #: trace so digests are bit-identical with forensics on or off
+    evidence: List[Any] = field(default_factory=list)
 
     @property
     def influence_mean(self) -> float:
@@ -82,6 +87,58 @@ class ChaosReport:
     def influence_max(self) -> float:
         """Largest single-round byzantine displacement."""
         return float(np.max(self.influences)) if self.influences else 0.0
+
+    def forensics_summary(self) -> Dict[str, Any]:
+        """Detection metrics over the collected evidence (empty-run
+        safe): per-client first-flag round, flags by detector, and the
+        precision/recall/false-positive accounting the chaos bench's
+        ``forensics`` lane scores detectors with (byzantine clients are
+        the simulator's ``byz…`` ids — ground truth the DETECTORS never
+        see)."""
+        first_flag: Dict[str, int] = {}
+        flags_by_detector: Dict[str, int] = {}
+        honest_records = honest_flagged_records = 0
+        for ev in self.evidence:
+            for rec in ev.records:
+                is_byz = rec.client.startswith("byz")
+                if not is_byz:
+                    honest_records += 1
+                    if rec.flags:
+                        honest_flagged_records += 1
+                if rec.flags:
+                    first_flag.setdefault(rec.client, ev.round_id)
+                    for fl in rec.flags:
+                        flags_by_detector[fl] = flags_by_detector.get(fl, 0) + 1
+        byz_clients = {
+            rec.client
+            for ev in self.evidence
+            for rec in ev.records
+            if rec.client.startswith("byz")
+        }
+        flagged = set(first_flag)
+        flagged_byz = {c for c in flagged if c.startswith("byz")}
+        return {
+            "rounds_with_evidence": len(self.evidence),
+            "first_flag_round": dict(sorted(first_flag.items())),
+            "flags_by_detector": flags_by_detector,
+            "byz_present": len(byz_clients),
+            "byz_flagged": len(flagged_byz),
+            "honest_flagged": len(flagged - flagged_byz),
+            "first_byz_flag_round": (
+                min(first_flag[c] for c in flagged_byz) if flagged_byz else None
+            ),
+            "recall": (
+                len(flagged_byz) / len(byz_clients) if byz_clients else None
+            ),
+            "precision": (
+                len(flagged_byz) / len(flagged) if flagged else None
+            ),
+            "honest_fp_rate": (
+                honest_flagged_records / honest_records
+                if honest_records
+                else 0.0
+            ),
+        }
 
     def summary(self) -> Dict[str, Any]:
         """JSON-ready cell row for the chaos grid."""
@@ -103,10 +160,23 @@ class ChaosReport:
 
 
 class ChaosHarness:
-    """Deterministic executor for one :class:`Scenario` (module docstring)."""
+    """Deterministic executor for one :class:`Scenario` (module docstring).
 
-    def __init__(self, scenario: Scenario) -> None:
+    ``forensics`` (optional :class:`~byzpy_tpu.forensics.ForensicsConfig`)
+    attaches the SAME per-client attribution plane the serving tier runs
+    online: every closed round of the ``direct``/``spmd``/``serving``
+    engines yields a :class:`~byzpy_tpu.forensics.evidence.RoundEvidence`
+    into ``report.evidence`` (one schema, two producers). The plane is
+    a pure observer — event-trace digests and aggregates are
+    bit-identical with it on or off. The ``actor`` engine runs the real
+    PS round loop, which never exposes the cohort matrix, so it
+    collects no evidence."""
+
+    def __init__(
+        self, scenario: Scenario, *, forensics: Optional[Any] = None
+    ) -> None:
         self.s = scenario
+        self._forensics_cfg = forensics
         # independent, order-stable randomness: schedule (faults/timing),
         # per-client noise, per-attack state
         seeds = np.random.SeedSequence(scenario.seed).spawn(
@@ -336,6 +406,15 @@ class ChaosHarness:
             return self._run_serving()
         return self._run_matrix()
 
+    def _make_plane(self):
+        """A FRESH forensics plane per run (replays must not inherit
+        trust state from a prior run), or None when not configured."""
+        if self._forensics_cfg is None:
+            return None
+        from ..forensics.plane import ForensicsPlane
+
+        return ForensicsPlane("chaos", self._forensics_cfg)
+
     # -- direct / spmd engines ---------------------------------------------
 
     def _run_matrix(self) -> ChaosReport:
@@ -348,6 +427,7 @@ class ChaosHarness:
         report = ChaosReport(scenario=s)
         ladder = BucketLadder(max(2, s.n_clients), min_bucket=2)
         aggregator = build_aggregator(s)
+        plane = self._make_plane()
         w = np.zeros((s.dim,), np.float32)
         step = opt_state = None
         if s.engine == "spmd":
@@ -390,7 +470,26 @@ class ChaosHarness:
             report.influences.append(
                 attacker_influence(aggregator, padded, valid, byz)
             )
-            sel = selection_mask(aggregator, padded, valid)
+            if plane is not None:
+                ev = plane.observe_round(
+                    r, padded, valid,
+                    [o.cid for o in owners], agg,
+                    aggregator=aggregator,
+                    deltas=[0] * m, bucket=bucket,
+                )
+                report.evidence.append(ev)
+                # the plane already computed the aggregator's selection
+                # view (same matrix — no weights on this engine):
+                # reconstruct the padded keep mask from the evidence
+                # instead of paying the O(m²·d) score pass twice
+                if ev.records and ev.records[0].selected is not None:
+                    sel = np.zeros((bucket,), bool)
+                    for rec in ev.records:
+                        sel[rec.slot] = bool(rec.selected)
+                else:
+                    sel = None
+            else:
+                sel = selection_mask(aggregator, padded, valid)
             accepted: Dict[str, bool] = {}
             if sel is not None:
                 for i, owner in enumerate(owners):
@@ -459,6 +558,14 @@ class ChaosHarness:
                 jnp.asarray(valid),
                 jnp.asarray(weights),
             )
+        # compile-cache observability: any growth past the bucket set
+        # shows up as byzpy_jit_compiles_total{site="chaos.spmd_step"}
+        try:
+            from ..observability import jitstats as obs_jitstats
+
+            obs_jitstats.note_cache_size("chaos.spmd_step", step._cache_size())
+        except Exception:  # noqa: BLE001 — introspection only
+            pass
         return np.asarray(new_w, np.float32), opt_state
 
     def _publish(
@@ -590,6 +697,7 @@ class ChaosHarness:
         s = self.s
         report = ChaosReport(scenario=s)
         aggregator = build_aggregator(s)
+        plane = self._make_plane()
         self._vclock = 0.0
         fe = ServingFrontend(
             [
@@ -654,6 +762,15 @@ class ChaosHarness:
                 continue
             round_id, cohort, agg_vec = closed
             agg = np.asarray(agg_vec, np.float32)
+            if plane is not None:
+                report.evidence.append(
+                    plane.observe_round(
+                        round_id, cohort.matrix, cohort.valid,
+                        cohort.clients, agg,
+                        aggregator=aggregator,
+                        weights=cohort.weights, bucket=cohort.bucket,
+                    )
+                )
             w = (w - np.float32(s.learning_rate) * agg).astype(np.float32)
             byz_ids = {c.cid for c in self.clients if c.byzantine}
             cohort_byz = np.asarray(

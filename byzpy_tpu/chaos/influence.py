@@ -52,48 +52,28 @@ def selection_mask(
     """Which VALID rows the aggregator's selection kept, or ``None`` for
     non-selection aggregators (means/medians use every row).
 
-    Computed host-side from the published score functions
-    (``ops.robust.krum_scores`` for the Krum families; per-row norm
-    ranking for CGE), over the compacted valid rows, then scattered back
-    to padded positions — the tie rules match the aggregation programs
-    (stable lowest-``q``/lowest-``(n-f)`` pick)."""
-    import jax.numpy as jnp
-
-    from ..aggregators import (
-        ComparativeGradientElimination,
-        MoNNA,
-        MultiKrum,
-    )
-    from ..ops import robust
-
-    valid = np.asarray(valid, bool)
-    idx = np.flatnonzero(valid)
-    m = int(idx.size)
-    if m == 0:
+    Since PR 10 this is a view over the shared forensics evidence
+    schema: :meth:`~byzpy_tpu.aggregators.base.Aggregator.
+    round_evidence` computes the published per-row scores host-side
+    (``ops.robust.krum_scores`` for the Krum families, per-row norms
+    for CGE, reference distances for MoNNA — the exact code that lived
+    here until PR 10, tie rules unchanged: stable lowest-``q``/
+    lowest-``(n-f)`` pick) and this function returns its ``keep`` mask
+    — one schema, two producers (offline influence studies and the
+    online forensics plane), pinned comparable by
+    ``tests/test_forensics.py``. An inadmissible ``m`` (``validate_n``
+    rejects it) has no defined selection and returns ``None``.
+    Aggregators whose evidence view carries scores but no keep set
+    (``evidence_selects`` False — trimmed mean's clip fractions, the
+    center-distance views) short-circuit to ``None`` without paying
+    the score computation."""
+    if not getattr(aggregator, "evidence_selects", False):
         return None
-    try:
-        # an m the aggregator would reject has no defined selection —
-        # without this, the m <= f slices below go negative and
-        # fabricate a non-empty "selected" set
-        aggregator.validate_n(m)
-    except ValueError:
+    view = aggregator.round_evidence(matrix, valid)
+    if view is None:
         return None
-    rows = jnp.asarray(np.asarray(matrix, np.float32)[idx])
-    if isinstance(aggregator, MultiKrum):  # Krum subclasses MultiKrum (q=1)
-        scores = np.asarray(robust.krum_scores(rows, f=int(aggregator.f)))
-        keep = np.argsort(scores, kind="stable")[: int(aggregator.q)]
-    elif isinstance(aggregator, ComparativeGradientElimination):
-        norms = np.asarray(jnp.linalg.norm(rows, axis=1))
-        keep = np.argsort(norms, kind="stable")[: m - int(aggregator.f)]
-    elif isinstance(aggregator, MoNNA):
-        ref = rows[int(getattr(aggregator, "reference_index", 0)) % m]
-        d2 = np.asarray(jnp.sum((rows - ref[None, :]) ** 2, axis=1))
-        keep = np.argsort(d2, kind="stable")[: m - int(aggregator.f)]
-    else:
-        return None
-    mask = np.zeros(valid.shape, bool)
-    mask[idx[np.asarray(keep)]] = True
-    return mask
+    keep = view.get("keep")
+    return None if keep is None else np.asarray(keep, bool)
 
 
 __all__ = ["attacker_influence", "selection_mask"]

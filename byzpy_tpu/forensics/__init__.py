@@ -1,0 +1,56 @@
+"""Byzantine forensics plane: per-client attribution, trust, audit.
+
+The robustness research answers "does the aggregate survive the
+attack"; this package answers the operational question — *which clients
+are Byzantine, and how do we know?* Three layers, threaded through
+every production round path:
+
+* **Evidence** (:mod:`~byzpy_tpu.forensics.evidence`): one schema of
+  per-submission records — cheap model-free features (pre-discount
+  norm z-score, cosine-to-aggregate, staleness-inflation ratio, echo
+  ratio vs the previous broadcast) plus each aggregator's own per-row
+  score view (:meth:`~byzpy_tpu.aggregators.base.Aggregator.
+  round_evidence`: Krum distances, CGE norms, MoNNA reference
+  distances, trimmed-mean clip fractions, geomed/clipping center
+  distances). Host-side, bit-effect-free: aggregates are
+  digest-identical with forensics on or off.
+* **Trust** (:mod:`~byzpy_tpu.forensics.trust`): per-client EWMA
+  reputation fed by exclusion/selection evidence and anomaly flags,
+  LRU-bounded like the credit ledger, with admission hooks —
+  trust-weighted credit refill and an opt-in quarantine
+  (``rejected_untrusted`` acks, WAL-recorded transitions).
+* **Audit** (:mod:`~byzpy_tpu.forensics.audit` + ``python -m
+  byzpy_tpu.forensics``): evidence rides the per-tenant write-ahead
+  log, Prometheus metrics (``byzpy_client_excluded_total``,
+  ``byzpy_anomaly_flags_total{detector}``, ``byzpy_trust_score`` band
+  gauges), and flight-recorder dumps; the CLI reconstructs
+  who-was-excluded-when from a WAL directory or a chaos event trace.
+
+Attach to a serving tenant with ``TenantConfig(forensics=
+ForensicsConfig(...))``; drive offline studies with
+``ChaosHarness(scenario, forensics=ForensicsConfig(...))`` — one
+schema, two producers. Validated against the PR-7 adaptive attackers
+by the ``forensics`` lane of ``benchmarks/chaos_bench.py``
+(detector precision/recall, pinned honest false-positive rate).
+"""
+
+from .evidence import (
+    DETECTORS,
+    DetectorConfig,
+    RoundEvidence,
+    SubmissionEvidence,
+)
+from .plane import ForensicsConfig, ForensicsPlane, recent_evidence
+from .trust import TrustLedger, TrustPolicy
+
+__all__ = [
+    "DETECTORS",
+    "DetectorConfig",
+    "ForensicsConfig",
+    "ForensicsPlane",
+    "RoundEvidence",
+    "SubmissionEvidence",
+    "TrustLedger",
+    "TrustPolicy",
+    "recent_evidence",
+]

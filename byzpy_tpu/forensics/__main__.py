@@ -1,0 +1,106 @@
+"""Forensics report/replay CLI.
+
+Reconstruct who-was-excluded-when — from a tenant's write-ahead log
+(the production audit trail) or from a chaos event-trace dump (the
+offline twin)::
+
+    python -m byzpy_tpu.forensics report --wal DIR [--tenant NAME] [--json]
+    python -m byzpy_tpu.forensics replay --trace trace.jsonl [--json]
+
+``report --wal`` takes either a durability directory (with ``--tenant``
+selecting the subdirectory, or auto-discovering every tenant) or a
+tenant directory directly. Output: the exclusion ledger (round →
+excluded clients), per-client flag/trust/quarantine histories, and the
+evidence-vs-round digest cross-check. Exit code 1 when the audit finds
+digest mismatches (evidence disagreeing with the round it describes),
+else 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from . import audit
+
+
+class _AuditPathError(Exception):
+    """A mistyped --wal/--tenant path: clean message, exit 2, no
+    traceback at the operator."""
+
+
+def _tenant_dirs(wal_dir: str, tenant: str | None) -> List[str]:
+    if not os.path.isdir(wal_dir):
+        raise _AuditPathError(f"no such WAL directory: {wal_dir}")
+    if tenant:
+        tdir = os.path.join(wal_dir, tenant)
+        if not os.path.isdir(tdir):
+            have = sorted(
+                n for n in os.listdir(wal_dir)
+                if os.path.isdir(os.path.join(wal_dir, n))
+            )
+            raise _AuditPathError(
+                f"no such tenant WAL directory: {tdir}"
+                + (f" (tenants here: {', '.join(have)})" if have else "")
+            )
+        return [tdir]
+    # a tenant directory holds wal-*.log segments directly; a durability
+    # root holds tenant subdirectories
+    if any(name.startswith("wal-") for name in os.listdir(wal_dir)):
+        return [wal_dir]
+    return sorted(
+        os.path.join(wal_dir, name)
+        for name in os.listdir(wal_dir)
+        if os.path.isdir(os.path.join(wal_dir, name))
+    )
+
+
+def main(argv: List[str] | None = None) -> int:
+    """CLI entry point; returns the process exit code."""
+    ap = argparse.ArgumentParser(
+        prog="python -m byzpy_tpu.forensics", description=__doc__
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="audit a write-ahead log")
+    rep.add_argument("--wal", required=True, help="durability or tenant dir")
+    rep.add_argument("--tenant", default=None)
+    rep.add_argument("--json", action="store_true")
+    rpl = sub.add_parser("replay", help="replay a chaos EventTrace JSONL")
+    rpl.add_argument("--trace", required=True)
+    rpl.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    mismatches = 0
+    if args.cmd == "report":
+        reports = []
+        try:
+            tenant_dirs = _tenant_dirs(args.wal, args.tenant)
+        except _AuditPathError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        for tdir in tenant_dirs:
+            report = audit.wal_timeline(tdir)
+            reports.append(report)
+            mismatches += len(report["digest_mismatches"])
+        if args.json:
+            print(json.dumps(reports if len(reports) != 1 else reports[0]))
+        else:
+            for report in reports:
+                print(audit.render_text(report))
+    else:
+        if not os.path.isfile(args.trace):
+            print(f"error: no such trace file: {args.trace}", file=sys.stderr)
+            return 2
+        report = audit.trace_timeline(args.trace)
+        if args.json:
+            print(json.dumps(report))
+        else:
+            print(audit.render_text(report))
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,14 @@ required addition):
   endpoint on the serving frontend's TCP ingress.
 * **Flight recorder** (:mod:`~byzpy_tpu.observability.recorder`) — a
   bounded ring of recent spans that dumps the last N rounds (plus a
-  metrics snapshot) on unhandled failure.
+  metrics snapshot, plus any active forensics plane's recent per-client
+  evidence) on unhandled failure.
+
+Adjacent: :mod:`~byzpy_tpu.observability.jitstats` counts XLA compiles
+per dispatch site (``byzpy_jit_compiles_total{site}`` — the
+recompile-cliff alarm), and the Byzantine forensics plane
+(``byzpy_tpu.forensics``) publishes its attribution metrics through
+this registry.
 
 Telemetry is OFF by default and the disabled path is one flag check
 with no allocation (:mod:`~byzpy_tpu.observability.runtime`); enable

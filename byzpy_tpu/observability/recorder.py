@@ -68,8 +68,11 @@ class FlightRecorder:
 
     def record(self, reason: str = "manual") -> Dict[str, Any]:
         """Assemble the dump object (no file IO): tail spans, metrics
-        snapshot, drop counter, and the failure reason."""
-        return {
+        snapshot, drop counter, the failure reason, and — when any
+        forensics plane is active — the last-N rounds' per-client
+        evidence per tenant (who was excluded/flagged going into the
+        incident; ``byzpy_tpu.forensics``)."""
+        dump = {
             "kind": "byzpy_tpu.flight_recorder",
             "time_unix_s": time.time(),
             "reason": reason,
@@ -78,6 +81,16 @@ class FlightRecorder:
             "events": self._tail_events(),
             "metrics": self.registry.snapshot(),
         }
+        try:
+            from ..forensics.plane import recent_evidence
+
+            evidence = recent_evidence()
+        except Exception:  # noqa: BLE001 — a crash dump must never fail
+            # on its optional payloads
+            evidence = {}
+        if evidence:
+            dump["forensics"] = evidence
+        return dump
 
     def dump(self, path: str, reason: str = "manual") -> Dict[str, Any]:
         """Write :meth:`record` as JSON to ``path``; returns the dump.

@@ -59,6 +59,12 @@ _SEG_RE = re.compile(r"^wal-(\d{12})\.log$")
 ACCEPT = "a"
 ROUND = "r"
 DROP = "f"
+#: Forensics evidence / trust-transition records (``byzpy_tpu.
+#: forensics``): appended per closed round (and per quarantine/readmit
+#: transition) when the tenant has a forensics plane and durability.
+#: Recovery replay IGNORES them (they carry no round state) — they are
+#: the auditable who-was-excluded-when trail the forensics CLI reads.
+EVIDENCE = "e"
 
 
 @dataclass(frozen=True)
@@ -210,12 +216,7 @@ class TenantDurability:
         return os.path.join(self.directory, f"wal-{index:012d}.log")
 
     def _segment_indices(self) -> List[int]:
-        out = []
-        for name in os.listdir(self.directory):
-            m = _SEG_RE.match(name)
-            if m:
-                out.append(int(m.group(1)))
-        return sorted(out)
+        return [idx for idx, _ in _segment_files(self.directory)]
 
     # -- write side ----------------------------------------------------------
 
@@ -255,6 +256,13 @@ class TenantDurability:
         """Accepts dropped WITH accounting (crash-guarded round,
         quarantine drain) — recovery must not resurrect them."""
         self._append((DROP, round_id, tuple(wal_ids), reason))
+
+    def record_evidence(self, round_id: int, payload: dict) -> None:
+        """Append one forensics record (a round's evidence, or a
+        quarantine/readmit transition event) to the audit trail.
+        Ignored by recovery replay; read back by
+        ``python -m byzpy_tpu.forensics report``."""
+        self._append((EVIDENCE, int(round_id), payload))
 
     def snapshot_due(self) -> bool:
         """Whether the periodic snapshot cadence has come round."""
@@ -390,9 +398,40 @@ class TenantDurability:
         return rec
 
 
+def _segment_files(directory: str) -> List[Tuple[int, str]]:
+    """The ONE WAL-segment discovery rule: every ``wal-<idx>.log`` in
+    ``directory`` as sorted ``(index, path)`` pairs — shared by the
+    write side's rotation bookkeeping and the read-only audit door, so
+    a naming-scheme change cannot leave one of them scanning a stale
+    subset."""
+    out = []
+    for name in os.listdir(directory):
+        m = _SEG_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def read_wal(tenant_directory: str) -> Tuple[List[Any], int]:
+    """Every intact record across one tenant's WAL segments, in append
+    order, plus the torn-segment count — the read-only audit door
+    (``python -m byzpy_tpu.forensics`` and the drill's exactly-once
+    audit read through this; it opens nothing for writing and leaves
+    no trace on disk)."""
+    records: List[Any] = []
+    torn = 0
+    for _, path in _segment_files(tenant_directory):
+        recs, clean = RoundLog.read(path)
+        records.extend(recs)
+        if not clean:
+            torn += 1
+    return records, torn
+
+
 __all__ = [
     "DurabilityConfig",
     "RecoveredTenant",
     "RoundLog",
     "TenantDurability",
+    "read_wal",
 ]

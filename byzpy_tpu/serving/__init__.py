@@ -38,11 +38,20 @@ circuit-breaker degraded mode, and a :class:`RetryPolicy` on
 idempotency keys (exactly-once folding). Failure model:
 ``docs/fault_tolerance.md``.
 
+Forensics (``byzpy_tpu.forensics``): attach a :class:`ForensicsConfig`
+per tenant for online per-client attribution — round evidence records
+(anomaly features + aggregator score views), an EWMA trust ledger with
+trust-weighted credit refill and opt-in quarantine
+(``rejected_untrusted``), WAL-audited exclusion evidence, and the
+``byzpy_client_excluded_total`` / ``byzpy_anomaly_flags_total`` /
+``byzpy_trust_score`` metric families.
+
 The serving PS step lives in ``parallel.ps.build_serving_ps_step``; the
 ingress-bandwidth law in ``parallel.comms.serving_ingress_bytes``;
 throughput/latency measurement in ``benchmarks/serving_bench.py``.
 """
 
+from ..forensics.plane import ForensicsConfig
 from ..resilience.breaker import BreakerPolicy
 from ..resilience.durable import DurabilityConfig
 from ..resilience.retry import RetryPolicy
@@ -62,6 +71,7 @@ __all__ = [
     "CreditLedger",
     "CreditPolicy",
     "DurabilityConfig",
+    "ForensicsConfig",
     "RetryPolicy",
     "ServingClient",
     "ServingFrontend",

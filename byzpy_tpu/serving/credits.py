@@ -61,14 +61,21 @@ class TokenBucket:
         self.tokens = policy.burst
         self.last = now
 
-    def try_consume(self, now: float, cost: float = 1.0) -> bool:
+    def try_consume(
+        self, now: float, cost: float = 1.0, *, rate_scale: float = 1.0
+    ) -> bool:
         """Refill for the elapsed time, then spend ``cost`` tokens if
-        available. Unlimited-rate policies always succeed."""
+        available. Unlimited-rate policies always succeed.
+        ``rate_scale`` multiplies the refill rate for THIS elapsed
+        window — the forensics plane's trust-weighted refill hook
+        (``scale == 1.0`` is bit-identical to the unscaled arithmetic:
+        IEEE ``x * 1.0 == x``)."""
         if self.policy.rate_per_s <= 0:
             return True
         elapsed = max(0.0, now - self.last)
         self.tokens = min(
-            self.policy.burst, self.tokens + elapsed * self.policy.rate_per_s
+            self.policy.burst,
+            self.tokens + elapsed * self.policy.rate_per_s * rate_scale,
         )
         self.last = now
         if self.tokens >= cost:
@@ -105,10 +112,12 @@ class CreditLedger:
         #: re-appears with a fresh burst — visible, not silent)
         self.evicted = 0
 
-    def admit(self, client: str, now: float) -> bool:
+    def admit(self, client: str, now: float, *, rate_scale: float = 1.0) -> bool:
         """Spend one credit of ``client``'s bucket (created on first
         sight with a full burst allowance; least-recently-seen bucket
-        evicted past ``max_tracked_clients``)."""
+        evicted past ``max_tracked_clients``). ``rate_scale`` is the
+        trust-weighted refill multiplier (1.0 = exact pre-forensics
+        arithmetic)."""
         bucket = self._buckets.get(client)
         if bucket is None:
             bucket = self._buckets[client] = TokenBucket(self.policy, now)
@@ -117,7 +126,7 @@ class CreditLedger:
                 self.evicted += 1
         else:
             self._buckets.move_to_end(client)
-        return bucket.try_consume(now)
+        return bucket.try_consume(now, rate_scale=rate_scale)
 
     def record(self, outcome: str, client: str) -> None:
         """Count one admission outcome (see the reason constants)."""

@@ -43,7 +43,7 @@ device work.
 from __future__ import annotations
 
 import asyncio
-import hashlib
+import logging
 import os
 import random
 import time
@@ -54,6 +54,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..engine.actor import wire
+from ..forensics.evidence import evidence_digest
+from ..forensics.plane import ForensicsConfig, ForensicsPlane
+from ..observability import jitstats as obs_jitstats
 from ..observability import metrics as obs_metrics
 from ..observability import runtime as obs_runtime
 from ..observability import tracing as obs_tracing
@@ -100,12 +103,19 @@ REJECTED_QUARANTINED = "rejected_quarantined"
 #: — retrying the SAME seq later is legitimate (nothing was enqueued).
 REJECTED_UNDURABLE = "rejected_not_durable"
 
+#: Client quarantined by the tenant's forensics trust ledger (opt-in
+#: ``ForensicsConfig(quarantine=True)``): an explicit per-submission
+#: rejection, WAL-recorded at the transition — never a silent drop.
+REJECTED_UNTRUSTED = "rejected_untrusted"
 
-def _agg_digest(vec: Any) -> str:
-    """16-hex-char fingerprint of an aggregate's exact bits — what the
-    WAL round records carry, so recovery can prove digest continuity."""
-    a = np.ascontiguousarray(np.asarray(vec, np.float32))
-    return hashlib.sha256(a.tobytes()).hexdigest()[:16]
+_LOG = logging.getLogger("byzpy_tpu.serving")
+
+
+#: 16-hex-char fingerprint of an aggregate's exact bits — what the WAL
+#: round records carry, so recovery can prove digest continuity. ONE
+#: rule, shared with the forensics evidence records: the audit's
+#: evidence-vs-round cross-check depends on the two never drifting.
+_agg_digest = evidence_digest
 
 #: First 4 bytes of an HTTP GET — the ingress sniffs them where the
 #: wire length prefix would sit and serves a Prometheus scrape instead.
@@ -151,6 +161,13 @@ class TenantConfig:
     #: ``cooldown_s`` probe round succeeds. ``None`` = pre-existing
     #: behavior (failed rounds count, serving continues unconditionally).
     breaker: Optional[BreakerPolicy] = None
+    #: optional per-client forensics plane (``byzpy_tpu.forensics``):
+    #: every closed round yields an evidence record (features +
+    #: aggregator score view + detector flags) feeding a trust ledger,
+    #: Prometheus metrics, the WAL audit trail, and flight-recorder
+    #: dumps. Host-side and bit-effect-free: round aggregates are
+    #: digest-identical with this on or off. ``None`` = no forensics.
+    forensics: Optional[ForensicsConfig] = None
 
     def __post_init__(self) -> None:
         if self.dim <= 0:
@@ -261,7 +278,8 @@ class _Tenant:
         "outstanding", "round_done", "failed_rounds",
         "last_cohort_clients", "held", "telemetry",
         "seqs", "duplicates", "durability", "breaker", "next_wal_id",
-        "quarantine_drops", "recovered",
+        "quarantine_drops", "recovered", "forensics", "compile_site",
+        "compile_warn_high",
     )
 
     def __init__(
@@ -332,6 +350,17 @@ class _Tenant:
         self.quarantine_drops = 0
         #: recovery provenance (``RecoveredTenant``), None on fresh start
         self.recovered: Any = None
+        #: per-client forensics plane (None = not configured)
+        self.forensics: Optional[ForensicsPlane] = (
+            ForensicsPlane(cfg.name, cfg.forensics)
+            if cfg.forensics is not None
+            else None
+        )
+        #: compile-cache observability: the masked-aggregate dispatch
+        #: site this tenant reports into, and the cache size already
+        #: warned about (each NEW excess size warns once)
+        self.compile_site = f"serving.masked_aggregate:{cfg.name}"
+        self.compile_warn_high = 0
         self.telemetry = _TenantTelemetry(cfg.name, cfg.dim)
 
     def note_seq(self, client: str, seq: int) -> None:
@@ -608,6 +637,16 @@ class ServingFrontend:
             if telemetry:
                 t.telemetry.outcome(REJECTED_QUARANTINED)
             return False, REJECTED_QUARANTINED
+        if t.forensics is not None and not t.forensics.allows(
+            client, t.round_id
+        ):
+            # per-CLIENT quarantine (trust ledger), distinct from the
+            # breaker's per-TENANT quarantine above; the transition
+            # itself is WAL-recorded at round close (never silent)
+            t.ledger.record(REJECTED_UNTRUSTED, client)
+            if telemetry:
+                t.telemetry.outcome(REJECTED_UNTRUSTED)
+            return False, REJECTED_UNTRUSTED
         row = np.asarray(gradient)
         if row.ndim != 1 or row.shape[0] != t.cfg.dim or row.dtype.kind != "f":
             t.ledger.record(REJECTED_SHAPE, client)
@@ -620,7 +659,10 @@ class ServingFrontend:
             if telemetry:
                 t.telemetry.outcome(REJECTED_STALE)
             return False, REJECTED_STALE
-        if not t.ledger.admit(client, now):
+        rate_scale = (
+            t.forensics.rate_scale(client) if t.forensics is not None else 1.0
+        )
+        if not t.ledger.admit(client, now, rate_scale=rate_scale):
             t.ledger.record(REJECTED_RATE, client)
             if telemetry:
                 t.telemetry.outcome(REJECTED_RATE)
@@ -834,6 +876,7 @@ class ServingFrontend:
         cohort: Cohort,
         vec: Any,
         subs: Sequence[Submission] = (),
+        forensics_prep: Optional[dict] = None,
     ) -> int:
         """Round-close bookkeeping shared by the async scheduler and
         :meth:`close_round_nowait` (ONE copy, so the async and
@@ -852,6 +895,9 @@ class ServingFrontend:
             t.durability.note_round_closed()
         if t.breaker is not None:
             t.breaker.record_success()
+        if t.forensics is not None:
+            self._observe_forensics(t, cohort, vec, subs, forensics_prep)
+        self._note_compiles(t)
         t.last_aggregate = vec
         t.last_cohort_clients = cohort.clients
         latency_s = self._clock() - cohort.first_arrival_s
@@ -883,6 +929,122 @@ class ServingFrontend:
                     if obs_runtime.STATE.enabled:
                         self._m_callback_errors.inc()
         return closed
+
+    def _forensics_prepare(
+        self, t: _Tenant, cohort: Cohort, vec: Any, subs: Sequence[Submission]
+    ) -> Optional[dict]:
+        """The plane's HEAVY stage (features + the aggregator's score
+        view) for one closed round — pure, so the async scheduler runs
+        it on the fold executor, off the event loop (the O(m²·d) Krum
+        score pass must not stall ingress any more than the fold
+        itself would). Returns None on failure (counted)."""
+        assert t.forensics is not None
+        try:
+            deltas = (
+                [t.round_id - s.round_submitted for s in subs]
+                if len(subs) == cohort.m
+                else None
+            )
+            return t.forensics.prepare(
+                t.round_id,
+                cohort.matrix,
+                cohort.valid,
+                cohort.clients,
+                vec,
+                aggregator=t.executor.aggregator,
+                weights=cohort.weights,
+                deltas=deltas,
+                bucket=cohort.bucket,
+            )
+        except Exception:  # noqa: BLE001 — attribution is an observer,
+            # not a round participant
+            self.callback_errors += 1
+            if obs_runtime.STATE.enabled:
+                self._m_callback_errors.inc()
+            return None
+
+    def _observe_forensics(
+        self,
+        t: _Tenant,
+        cohort: Cohort,
+        vec: Any,
+        subs: Sequence[Submission],
+        prep: Optional[dict] = None,
+    ) -> None:
+        """Feed one closed round to the tenant's forensics plane and
+        persist the evidence + any quarantine/readmit transitions to
+        the WAL (when durability is attached). Host-side work on data
+        the round already produced — the aggregate bits are untouched,
+        and a plane failure must never fail a round that already
+        aggregated (crash-guarded, counted via callback_errors).
+        ``prep`` is a precomputed :meth:`ForensicsPlane.prepare` result
+        (the async scheduler computes it on the fold executor); without
+        one the heavy stage runs inline (sync round closer)."""
+        assert t.forensics is not None
+        if prep is None:
+            prep = self._forensics_prepare(t, cohort, vec, subs)
+            if prep is None:
+                return
+        try:
+            ev = t.forensics.apply(prep)
+        except Exception:  # noqa: BLE001 — same stance as prepare
+            self.callback_errors += 1
+            if obs_runtime.STATE.enabled:
+                self._m_callback_errors.inc()
+            return
+        # drain transitions unconditionally (they must not pile up when
+        # durability is off); persist them when it is on. A failed
+        # append RE-QUEUES the unpersisted transitions — they are
+        # one-shot events the audit trail promises to carry, so the
+        # next round's close retries them (the round's evidence record
+        # itself is not retried: every round produces a fresh one)
+        transitions = t.forensics.pop_transitions()
+        if t.durability is None or not t.forensics.cfg.wal_evidence:
+            return
+        try:
+            t.durability.record_evidence(t.round_id, ev.to_wire())
+            while transitions:
+                t.durability.record_evidence(t.round_id, transitions[0])
+                transitions.pop(0)
+        except Exception:  # noqa: BLE001 — degraded durability, not a
+            # serving outage (same stance as snapshot failures)
+            t.forensics.requeue_transitions(transitions)
+            self.callback_errors += 1
+            if obs_runtime.STATE.enabled:
+                self._m_callback_errors.inc()
+
+    def _note_compiles(self, t: _Tenant) -> None:
+        """Compile-cache observability: report the tenant's
+        masked-aggregate jit-cache size (``byzpy_jit_compiles_total``)
+        and warn when it exceeds the bucket ladder's shape count — the
+        ladder exists so every cohort lands in one of
+        ``len(ladder.sizes)`` compiled programs; more entries means an
+        unexpected recompile (shape/dtype drift), the silent latency
+        cliff."""
+        jitted = getattr(t.executor.aggregator, "_masked_jit_cache", None)
+        if jitted is None:
+            return
+        try:
+            size = int(jitted._cache_size())
+        except Exception:  # noqa: BLE001 — introspection API drift must
+            # never fail a round
+            return
+        obs_jitstats.note_cache_size(t.compile_site, size)
+        expected = len(t.ladder.sizes)
+        if size > expected and size > t.compile_warn_high:
+            t.compile_warn_high = size
+            obs_metrics.registry().counter(
+                "byzpy_serving_recompile_warnings_total",
+                help="masked-aggregate compiles beyond the bucket ladder",
+                labels={"tenant": t.cfg.name},
+            ).inc()
+            _LOG.warning(
+                "tenant %r: masked-aggregate jit cache has %d entries but "
+                "the bucket ladder only has %d shapes — an unexpected "
+                "recompile happened (cohort shape or dtype outside the "
+                "ladder); every extra entry is a silent latency cliff",
+                t.cfg.name, size, expected,
+            )
 
     async def _tenant_loop(self, t: _Tenant) -> None:
         loop = asyncio.get_running_loop()
@@ -919,18 +1081,30 @@ class ServingFrontend:
                     )
                 round_span.set(bucket=cohort.bucket)
                 assert self._device_lock is not None
+
+                def fold_and_prepare(subs=subs, cohort=cohort):
+                    # device work AND the forensics heavy stage (the
+                    # O(m²·d) score pass) both off the event loop:
+                    # ingress keeps admitting while this tenant's
+                    # round aggregates and attributes
+                    v = t.executor.aggregate(cohort)
+                    p = (
+                        self._forensics_prepare(t, cohort, v, subs)
+                        if t.forensics is not None
+                        else None
+                    )
+                    return v, p
+
                 try:
                     async with self._device_lock:
-                        # device work off the event loop: ingress keeps
-                        # admitting while this tenant's round aggregates
-                        vec = await loop.run_in_executor(
-                            None, t.executor.aggregate, cohort
+                        vec, prep = await loop.run_in_executor(
+                            None, fold_and_prepare
                         )
                 except Exception:  # noqa: BLE001 — a poisoned cohort must
                     # never kill the scheduler: drop the round, keep serving
                     self._fail_round(t, cohort, subs)
                     continue
-                self._finish_round(t, cohort, vec, subs)
+                self._finish_round(t, cohort, vec, subs, prep)
 
     async def drain(self, tenant: str) -> int:
         """Wait until every ADMISSIBLE submission of ``tenant`` has been
@@ -1175,6 +1349,11 @@ class ServingFrontend:
             # recovery provenance (round the tenant resumed from)
             "duplicates": t.duplicates,
             "quarantine_drops": t.quarantine_drops,
+            # forensics attribution (None = no plane configured): trust
+            # summary, per-client quarantine state, rejected_untrusted
+            "forensics": (
+                t.forensics.snapshot() if t.forensics is not None else None
+            ),
             "breaker": (
                 t.breaker.snapshot() if t.breaker is not None else None
             ),
@@ -1386,6 +1565,7 @@ __all__ = [
     "DUPLICATE",
     "REJECTED_MALFORMED",
     "REJECTED_QUARANTINED",
+    "REJECTED_UNTRUSTED",
     "RoundCallback",
     "ServingClient",
     "ServingFrontend",

@@ -1,0 +1,782 @@
+"""Forensics plane: evidence extraction, trust ledger, quarantine,
+digest pins, WAL audit, compile-cache observability.
+
+The two load-bearing contracts:
+
+* **bit-effect-free** — round aggregates (serving) and chaos grid
+  digests are IDENTICAL with forensics enabled vs disabled (the plane
+  is a pure observer on data the round already produced);
+* **auditable** — every exclusion/flag/quarantine is reconstructable
+  from the WAL by ``python -m byzpy_tpu.forensics``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import (
+    CenteredClipping,
+    ComparativeGradientElimination,
+    CoordinateWiseMedian,
+    CoordinateWiseTrimmedMean,
+    GeometricMedian,
+    MoNNA,
+    MultiKrum,
+)
+from byzpy_tpu.forensics import (
+    DetectorConfig,
+    ForensicsConfig,
+    ForensicsPlane,
+    RoundEvidence,
+    SubmissionEvidence,
+    TrustLedger,
+    TrustPolicy,
+    audit,
+)
+from byzpy_tpu.forensics.evidence import instant_flags, row_features
+
+
+def _cohort(n=12, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    matrix = rng.normal(1.0, 0.3, (n, d)).astype(np.float32)
+    valid = np.ones((n,), bool)
+    return matrix, valid
+
+
+# ---------------------------------------------------------------------------
+# aggregator round_evidence views
+# ---------------------------------------------------------------------------
+
+
+class TestRoundEvidenceViews:
+    def test_selection_kinds_and_keep_counts(self):
+        matrix, valid = _cohort()
+        cases = [
+            (MultiKrum(f=2, q=4), "krum_distance", 4),
+            (ComparativeGradientElimination(f=2), "norm", 10),
+            (MoNNA(f=2), "reference_distance", 10),
+        ]
+        for agg, kind, kept in cases:
+            view = agg.round_evidence(matrix, valid)
+            assert view["kind"] == kind
+            assert view["keep"].sum() == kept
+            assert np.isfinite(view["scores"][valid]).all()
+
+    def test_selection_mask_delegates_to_evidence(self):
+        # one schema, two producers: chaos influence's selection view IS
+        # the evidence view's keep mask
+        from byzpy_tpu.chaos.influence import selection_mask
+
+        matrix, valid = _cohort()
+        valid[9:] = False
+        for agg in (MultiKrum(f=2, q=3), ComparativeGradientElimination(f=2),
+                    MoNNA(f=2)):
+            view = agg.round_evidence(matrix, valid)
+            mask = selection_mask(agg, matrix, valid)
+            np.testing.assert_array_equal(mask, view["keep"])
+            assert not mask[~valid].any()
+        assert selection_mask(CoordinateWiseMedian(), matrix, valid) is None
+
+    def test_trimmed_mean_clip_fractions(self):
+        matrix, valid = _cohort()
+        matrix[0] = 100.0  # every coordinate of row 0 lands in the top-f
+        view = CoordinateWiseTrimmedMean(f=2).round_evidence(matrix, valid)
+        assert view["kind"] == "trim_fraction"
+        assert view["keep"] is None
+        assert view["scores"][0] == pytest.approx(1.0)
+        # honest rows are clipped on roughly 2f/m of coordinates
+        assert view["scores"][1:12].mean() < 0.6
+
+    def test_center_seeking_views_need_aggregate(self):
+        matrix, valid = _cohort()
+        agg_vec = matrix.mean(axis=0)
+        for agg in (GeometricMedian(), CenteredClipping(c_tau=2.0)):
+            assert agg.round_evidence(matrix, valid) is None
+            view = agg.round_evidence(matrix, valid, aggregate=agg_vec)
+            assert view["keep"] is None
+            assert np.isfinite(view["scores"][valid]).all()
+
+    def test_inadmissible_and_empty_return_none(self):
+        matrix, valid = _cohort()
+        assert MultiKrum(f=2, q=4).round_evidence(
+            matrix, np.zeros_like(valid)
+        ) is None
+        small = np.zeros_like(valid)
+        small[:3] = True  # m=3 rejected by f=2 (needs f < m-1)
+        assert MultiKrum(f=2, q=4).round_evidence(matrix, small) is None
+
+    def test_padded_positions(self):
+        matrix, valid = _cohort()
+        valid[3] = valid[7] = False
+        view = ComparativeGradientElimination(f=1).round_evidence(matrix, valid)
+        assert np.isnan(view["scores"][3]) and np.isnan(view["scores"][7])
+        assert not view["keep"][3] and not view["keep"][7]
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+
+
+class TestDetectors:
+    def test_staleness_inflation_fires_pre_discount(self):
+        matrix, valid = _cohort()
+        weights = np.ones((12,), np.float32)
+        weights[0] = 0.0625  # δ=4 at γ=0.5
+        matrix[0] *= 16.0  # pre-inflated to cancel the discount
+        feats = row_features(matrix, valid, matrix[1:].mean(0), weights=weights)
+        flags = instant_flags(feats, DetectorConfig())
+        assert "staleness_inflation" in flags[0]
+        assert all("staleness_inflation" not in f for f in flags[1:])
+
+    def test_fresh_inflated_row_is_not_staleness(self):
+        matrix, valid = _cohort()
+        matrix[0] *= 16.0  # big but FRESH: norm_outlier's job, not staleness
+        feats = row_features(matrix, valid, matrix[1:].mean(0))
+        flags = instant_flags(feats, DetectorConfig())
+        assert "staleness_inflation" not in flags[0]
+        assert "norm_outlier" in flags[0]
+
+    def test_sign_anomaly_needs_coherence(self):
+        matrix, valid = _cohort()
+        agg = matrix.mean(axis=0)
+        matrix[0] = -4.0 * matrix[0]
+        feats = row_features(matrix, valid, agg)
+        assert "sign_anomaly" in instant_flags(feats, DetectorConfig())[0]
+        # incoherent cohort (half the clients legitimately disagree):
+        # the detector disarms rather than flag honest dissent
+        split = matrix.copy()
+        split[6:] *= -1.0
+        feats2 = row_features(split, valid, agg)
+        assert all(
+            "sign_anomaly" not in f
+            for f in instant_flags(feats2, DetectorConfig())
+        )
+
+    def test_clean_cohort_no_flags(self):
+        matrix, valid = _cohort()
+        feats = row_features(matrix, valid, matrix.mean(0))
+        assert all(not f for f in instant_flags(feats, DetectorConfig()))
+
+    def test_echo_needs_persistence(self):
+        plane = ForensicsPlane("t", ForensicsConfig())
+        matrix, valid = _cohort()
+        clients = [f"c{i}" for i in range(11)] + ["byz0"]
+        agg = matrix[:11].mean(axis=0)
+        flagged_rounds = []
+        for r in range(4):
+            matrix2 = matrix.copy()
+            if r > 0:
+                matrix2[11] = agg  # byz0 echoes the previous broadcast
+            ev = plane.observe_round(r, matrix2, valid, clients, agg)
+            if "echo" in dict(ev.flag_counts):
+                flagged_rounds.append(r)
+        # round 1 is the first echo (streak 1 < echo_rounds=2); flag
+        # fires from round 2 on
+        assert flagged_rounds == [2, 3]
+
+    def test_selection_verdict_scores_discounted_matrix(self):
+        # the serving fold aggregates matrix * weights: the evidence
+        # verdict must match what the aggregator ACTUALLY selected. A
+        # staleness abuser pre-inflates by 1/discount so its DISCOUNTED
+        # row is cohort-central — scoring the raw matrix would claim it
+        # was de-selected in exactly the rounds it folded in.
+        from byzpy_tpu.chaos.influence import selection_mask
+
+        rng = np.random.default_rng(0)
+        matrix = rng.normal(1.0, 0.1, (12, 16)).astype(np.float32)
+        weights = np.ones((12,), np.float32)
+        weights[11] = 0.0625
+        matrix[11] = matrix[:11].mean(0) / weights[11]  # discounts to central
+        valid = np.ones((12,), bool)
+        agg = MultiKrum(f=2, q=4)
+        actual_keep = selection_mask(agg, matrix * weights[:, None], valid)
+        assert actual_keep[11]  # the fold really selects the abuser
+        plane = ForensicsPlane("t", ForensicsConfig())
+        clients = [f"c{i}" for i in range(11)] + ["byz0"]
+        ev = plane.observe_round(
+            0, matrix, valid, clients, matrix[:11].mean(0),
+            aggregator=agg, weights=weights,
+        )
+        by_slot = {r.slot: r for r in ev.records}
+        assert by_slot[11].selected is True
+        for slot in range(12):
+            assert by_slot[slot].selected == bool(actual_keep[slot])
+        # the pre-discount FEATURES still expose the abuse
+        assert "staleness_inflation" in by_slot[11].flags
+
+    def test_streaks_reset_across_absent_rounds(self):
+        # an intermittent client stale on each APPEARANCE must not
+        # accumulate a "consecutive rounds" streak across gaps
+        plane = ForensicsPlane(
+            "t", ForensicsConfig(trust=TrustPolicy(alpha=0.01))
+        )
+        matrix, valid = _cohort(n=6)
+        clients = [f"c{i}" for i in range(5)] + ["slow"]
+        weights = np.ones((6,), np.float32)
+        weights[5] = 0.5
+        pinned = []
+        for r in (0, 1, 5, 9, 13, 17):  # 2 consecutive, then gapped
+            ev = plane.observe_round(
+                r, matrix, valid, clients, matrix[:5].mean(0), weights=weights
+            )
+            if "staleness_pinned" in dict(ev.flag_counts):
+                pinned.append(r)
+        assert pinned == []  # never 4 CONSECUTIVE rounds
+
+    def test_staleness_pinned_streak(self):
+        plane = ForensicsPlane("t", ForensicsConfig())
+        matrix, valid = _cohort()
+        clients = [f"c{i}" for i in range(11)] + ["byz0"]
+        weights = np.ones((12,), np.float32)
+        weights[11] = 0.5  # byz0 stale every round (NOT inflated)
+        first = None
+        for r in range(6):
+            ev = plane.observe_round(
+                r, matrix, valid, clients, matrix[:11].mean(0), weights=weights
+            )
+            if "staleness_pinned" in dict(ev.flag_counts) and first is None:
+                first = r
+        assert first == 3  # streak reaches pinned_rounds=4 on the 4th round
+
+
+# ---------------------------------------------------------------------------
+# trust ledger
+# ---------------------------------------------------------------------------
+
+
+class TestTrustLedger:
+    def test_lru_bound(self):
+        ledger = TrustLedger(TrustPolicy(max_tracked_clients=8))
+        for i in range(32):
+            ledger.observe(f"c{i}", 0, selected=True, flags=())
+        assert len(ledger._clients) == 8
+        assert ledger.evicted == 24
+        # an evicted client restarts at initial trust
+        assert ledger.score("c0") == TrustPolicy().initial
+
+    def test_ewma_direction(self):
+        ledger = TrustLedger(TrustPolicy(alpha=0.5))
+        up = ledger.observe("good", 0, selected=True, flags=())
+        down = ledger.observe("bad", 0, selected=None, flags=("norm_outlier",))
+        assert up > TrustPolicy().initial > down
+        mild = ledger.observe("meh", 0, selected=False, flags=())
+        assert down < mild < up
+
+    def test_quarantine_readmit_state_machine(self):
+        policy = TrustPolicy(alpha=0.5, readmit_after_rounds=3)
+        ledger = TrustLedger(policy)
+        r = 0
+        while not ledger.is_quarantined("byz"):
+            ledger.observe("byz", r, selected=False, flags=("echo",))
+            r += 1
+        entered = ledger.quarantined()["byz"]
+        assert ledger.quarantines_total == 1
+        # quarantined: admission refused until the cooldown elapses
+        assert not ledger.allows("byz", entered + 1)
+        assert not ledger.allows("byz", entered + 2)
+        # readmission on probation trust
+        assert ledger.allows("byz", entered + 3)
+        assert ledger.readmits_total == 1
+        assert ledger.score("byz") == policy.probation_trust
+        assert not ledger.is_quarantined("byz")
+        # probation: one more bad streak re-quarantines quickly
+        rr = entered + 3
+        while not ledger.is_quarantined("byz"):
+            ledger.observe("byz", rr, selected=False, flags=("echo",))
+            rr += 1
+        assert ledger.quarantines_total == 2
+
+    def test_observe_only_mode_never_pins_quarantine_state(self):
+        # quarantine can only be LIFTED via allows(), which the default
+        # (quarantine=False) plane never consults: entering the state
+        # there would pin the client as "quarantined" in gauges and the
+        # audit trail forever while gating nothing
+        plane = ForensicsPlane(
+            "t", ForensicsConfig(trust=TrustPolicy(alpha=0.5), quarantine=False)
+        )
+        matrix, valid = _cohort()
+        clients = [f"c{i}" for i in range(11)] + ["byz0"]
+        weights = np.ones((12,), np.float32)
+        weights[11] = 0.0625
+        bad = matrix.copy()
+        bad[11] = 16.0 * bad[11]  # flagged every round -> trust sinks
+        for r in range(8):
+            ev = plane.observe_round(
+                r, bad, valid, clients, matrix[:11].mean(0), weights=weights
+            )
+        assert plane.ledger.score("byz0") < 0.2  # trust DID collapse
+        assert not plane.ledger.quarantined()  # but no un-liftable state
+        assert not any(
+            t_["event"] == "quarantine" for t_ in plane.pop_transitions()
+        )
+        assert "low_trust" in dict(ev.flag_counts)  # still fully flagged
+
+    def test_prepare_apply_equals_observe_round(self):
+        # the async scheduler splits the plane call (prepare on the fold
+        # executor, apply on the loop): must be the same computation
+        matrix, valid = _cohort()
+        clients = [f"c{i}" for i in range(11)] + ["byz0"]
+        weights = np.ones((12,), np.float32)
+        weights[11] = 0.5
+        matrix[11] *= 8.0
+        agg = MultiKrum(f=2, q=4)
+        one = ForensicsPlane("a", ForensicsConfig())
+        two = ForensicsPlane("b", ForensicsConfig())
+        for r in range(3):
+            ev1 = one.observe_round(
+                r, matrix, valid, clients, matrix[:11].mean(0),
+                aggregator=agg, weights=weights, bucket=16,
+            )
+            ev2 = two.apply(
+                two.prepare(
+                    r, matrix, valid, clients, matrix[:11].mean(0),
+                    aggregator=agg, weights=weights, bucket=16,
+                )
+            )
+            assert ev1.to_wire() == {**ev2.to_wire(), "tenant": "a"}
+
+    def test_rate_scale(self):
+        policy = TrustPolicy(alpha=0.5)
+        ledger = TrustLedger(policy)
+        assert ledger.rate_scale("unseen") == 1.0
+        ledger.observe("good", 0, selected=True, flags=())
+        assert ledger.rate_scale("good") == 1.0  # above initial: exact 1.0
+        for r in range(16):
+            ledger.observe("bad", r, selected=None, flags=("echo",))
+        assert 0.05 <= ledger.rate_scale("bad") < 0.2
+
+    def test_trust_weighted_refill_arithmetic(self):
+        from byzpy_tpu.serving.credits import CreditPolicy, TokenBucket
+
+        policy = CreditPolicy(rate_per_s=10.0, burst=5.0)
+        full = TokenBucket(policy, 0.0)
+        slow = TokenBucket(policy, 0.0)
+        for b in (full, slow):
+            for _ in range(5):
+                assert b.try_consume(0.0)
+        # refill over 0.2 s: full rate earns 2 tokens, half rate 1
+        assert full.try_consume(0.2) and full.try_consume(0.2)
+        assert not full.try_consume(0.2)
+        assert slow.try_consume(0.2, rate_scale=0.5)
+        assert not slow.try_consume(0.2, rate_scale=0.5)
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestSchema:
+    def test_wire_roundtrip(self):
+        rec = SubmissionEvidence(
+            client="c1", slot=3, norm=2.5, norm_z=0.7, cos_to_agg=0.99,
+            echo_ratio=1.2, weight=0.5, delta=1, inflation=1.1,
+            score=4.25, selected=False, flags=("echo",), trust=0.4,
+        )
+        ev = RoundEvidence(
+            tenant="m0", round_id=7, m=1, bucket=2, agg_digest="ab" * 8,
+            score_kind="krum_distance", records=(rec,),
+            flag_counts={"echo": 1},
+        )
+        back = RoundEvidence.from_wire(ev.to_wire())
+        assert back.round_id == 7 and back.score_kind == "krum_distance"
+        assert back.records[0].client == "c1"
+        assert back.records[0].selected is False
+        assert back.records[0].flags == ("echo",)
+        assert back.excluded_clients == ("c1",)
+        assert back.flagged_clients == ("c1",)
+
+
+# ---------------------------------------------------------------------------
+# digest pins: forensics on/off is bit-identical
+# ---------------------------------------------------------------------------
+
+
+def _drive_frontend(forensics_cfg):
+    from byzpy_tpu.serving import ServingFrontend, TenantConfig
+
+    fe = ServingFrontend(
+        [
+            TenantConfig(
+                name="m0",
+                aggregator=MultiKrum(f=2, q=4),
+                dim=8,
+                forensics=forensics_cfg,
+            )
+        ]
+    )
+    rng = np.random.default_rng(7)
+    aggs = []
+    for r in range(5):
+        for i in range(9):
+            ok, reason = fe.submit(
+                "m0", f"c{i}", r, rng.normal(1.0, 0.2, 8).astype(np.float32)
+            )
+            assert ok, reason
+        closed = fe.close_round_nowait("m0")
+        assert closed is not None
+        aggs.append(np.asarray(closed[2], np.float32))
+    return aggs
+
+
+class TestDigestPins:
+    def test_serving_aggregates_bit_identical(self):
+        without = _drive_frontend(None)
+        with_f = _drive_frontend(ForensicsConfig())
+        for a, b in zip(without, with_f, strict=True):
+            np.testing.assert_array_equal(a, b)
+
+    def test_chaos_digest_bit_identical(self):
+        from byzpy_tpu.chaos import AttackSpec, ChaosHarness, Scenario
+
+        cell = Scenario(
+            name="pin",
+            seed=11,
+            n_clients=10,
+            n_byzantine=2,
+            dim=16,
+            rounds=6,
+            aggregator="multi_krum",
+            aggregator_params={"f": 2, "q": 3},
+            attack=AttackSpec(
+                name="influence_ascent", params={"grow": 1.8, "scale0": 0.1}
+            ),
+        )
+        plain = ChaosHarness(cell).run()
+        forensic = ChaosHarness(cell, forensics=ForensicsConfig()).run()
+        assert plain.trace.digest() == forensic.trace.digest()
+        assert plain.final_error == forensic.final_error
+        assert not plain.evidence and len(forensic.evidence) == 6
+
+    def test_chaos_serving_engine_digest_bit_identical(self):
+        from byzpy_tpu.chaos import AttackSpec, ChaosHarness, Scenario
+
+        cell = Scenario(
+            name="pin-serving",
+            seed=11,
+            n_clients=10,
+            n_byzantine=2,
+            dim=16,
+            rounds=6,
+            engine="serving",
+            aggregator="trimmed_mean",
+            aggregator_params={"f": 2},
+            attack=AttackSpec(
+                name="staleness_abuse",
+                params={"kind": "exponential", "gamma": 0.5, "cutoff": 3},
+            ),
+            staleness_kind="exponential",
+            staleness_gamma=0.5,
+            staleness_cutoff=3,
+        )
+        plain = ChaosHarness(cell).run()
+        forensic = ChaosHarness(cell, forensics=ForensicsConfig()).run()
+        assert plain.trace.digest() == forensic.trace.digest()
+        assert plain.final_error == forensic.final_error
+
+
+# ---------------------------------------------------------------------------
+# serving integration: quarantine acks, WAL audit, CLI
+# ---------------------------------------------------------------------------
+
+
+def _abused_frontend(tmp_path, *, quarantine=True):
+    from byzpy_tpu.serving import (
+        DurabilityConfig,
+        ServingFrontend,
+        StalenessPolicy,
+        TenantConfig,
+    )
+
+    fe = ServingFrontend(
+        [
+            TenantConfig(
+                name="m0",
+                aggregator=CoordinateWiseTrimmedMean(f=1),
+                dim=8,
+                staleness=StalenessPolicy(
+                    kind="exponential", gamma=0.5, cutoff=4
+                ),
+                forensics=ForensicsConfig(
+                    trust=TrustPolicy(alpha=0.5, readmit_after_rounds=4),
+                    quarantine=quarantine,
+                ),
+            )
+        ],
+        durability=DurabilityConfig(directory=str(tmp_path), prune=False),
+    )
+    rng = np.random.default_rng(3)
+    untrusted = 0
+    for r in range(8):
+        for i in range(6):
+            ok, reason = fe.submit(
+                "m0", f"c{i}", r, rng.normal(1.0, 0.1, 8).astype(np.float32)
+            )
+            assert ok, reason
+        inflated = (16.0 * rng.normal(1.0, 0.1, 8)).astype(np.float32)
+        ok, reason = fe.submit("m0", "byz0", max(0, r - 4), inflated)
+        if reason == "rejected_untrusted":
+            untrusted += 1
+        assert fe.close_round_nowait("m0") is not None
+    return fe, untrusted
+
+
+class TestServingIntegration:
+    def test_quarantine_rejects_and_accounts(self, tmp_path):
+        fe, untrusted = _abused_frontend(tmp_path)
+        stats = fe.stats()["m0"]
+        assert untrusted > 0
+        assert stats["forensics"]["rejected_untrusted"] == untrusted
+        assert stats["ledger"]["totals"]["rejected_untrusted"] == untrusted
+        assert stats["forensics"]["trust"]["quarantines_total"] >= 1
+        asyncio.run(fe.close())
+
+    def test_wal_audit_reconstructs_exclusion_evidence(self, tmp_path):
+        fe, _ = _abused_frontend(tmp_path)
+        asyncio.run(fe.close())
+        report = audit.wal_timeline(os.path.join(str(tmp_path), "m0"))
+        assert report["evidence_rounds"] > 0
+        assert not report["digest_mismatches"]
+        byz = report["clients"]["byz0"]
+        assert byz["flags"]  # flagged with named detectors
+        assert "staleness_inflation" in byz["flags"]
+        assert byz["last_trust"] is not None and byz["last_trust"] < 0.3
+        assert any(
+            t["event"] == "quarantine" and t["client"] == "byz0"
+            for t in report["transitions"]
+        )
+        # honest clients folded and stayed unflagged
+        assert report["clients"]["c0"]["folded_rounds"]
+        assert not report["clients"]["c0"]["flags"]
+
+    def test_cli_report_and_replay(self, tmp_path, capsys):
+        from byzpy_tpu.forensics.__main__ import main as fmain
+
+        fe, _ = _abused_frontend(tmp_path)
+        asyncio.run(fe.close())
+        rc = fmain(["report", "--wal", str(tmp_path), "--tenant", "m0"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "byz0" in out and "staleness_inflation" in out
+        # auto-discovery without --tenant
+        assert fmain(["report", "--wal", str(tmp_path), "--json"]) == 0
+
+    def test_cli_flags_digest_mismatch(self, tmp_path, capsys):
+        from byzpy_tpu.forensics.__main__ import main as fmain
+        from byzpy_tpu.resilience.durable import DurabilityConfig, TenantDurability
+
+        d = TenantDurability(DurabilityConfig(directory=str(tmp_path)), "m0")
+        d.record_round(0, (0,), "aa" * 8, 1)
+        ev = RoundEvidence(
+            tenant="m0", round_id=0, m=1, bucket=2, agg_digest="bb" * 8,
+            score_kind="", records=(), flag_counts={},
+        )
+        d.record_evidence(0, ev.to_wire())
+        d.close()
+        rc = fmain(["report", "--wal", str(tmp_path), "--tenant", "m0"])
+        capsys.readouterr()
+        assert rc == 1  # tampered/buggy evidence is itself surfaced
+
+    def test_cli_clean_error_on_bad_paths(self, tmp_path, capsys):
+        from byzpy_tpu.forensics.__main__ import main as fmain
+
+        rc = fmain(["report", "--wal", str(tmp_path), "--tenant", "typo"])
+        err = capsys.readouterr().err
+        assert rc == 2 and "no such tenant" in err  # not a traceback
+        rc = fmain(["report", "--wal", str(tmp_path / "missing")])
+        assert rc == 2
+        rc = fmain(["replay", "--trace", str(tmp_path / "missing.jsonl")])
+        assert rc == 2
+
+    def test_failed_evidence_append_requeues_transitions(self, tmp_path):
+        # "WAL-recorded, never silent": a transition popped for
+        # persistence must survive an append failure and retry on the
+        # next round's close
+        fe, _ = _abused_frontend(tmp_path)
+        t = fe._tenants["m0"]
+        plane = t.forensics
+        plane._transitions.append(
+            {"event": "quarantine", "client": "ghost", "round": 99}
+        )
+        real_append = t.durability.record_evidence
+
+        def flaky(round_id, payload):
+            raise OSError("disk full")
+
+        t.durability.record_evidence = flaky
+        errors_before = fe.callback_errors
+        from byzpy_tpu.serving.cohort import build_cohort
+        from byzpy_tpu.serving.queue import Submission
+
+        subs = [
+            Submission(client="c0", round_submitted=t.round_id,
+                       gradient=np.ones(8, np.float32), arrived_s=0.0)
+            for _ in range(3)
+        ]
+        cohort = build_cohort(subs, t.round_id, t.ladder, t.cfg.staleness)
+        fe._observe_forensics(t, cohort, np.ones(8, np.float32), subs)
+        assert fe.callback_errors == errors_before + 1
+        assert {"event": "quarantine", "client": "ghost", "round": 99} in (
+            plane._transitions
+        )  # re-queued, not lost
+        t.durability.record_evidence = real_append
+        fe._observe_forensics(t, cohort, np.ones(8, np.float32), subs)
+        assert not plane._transitions  # retried and persisted
+        asyncio.run(fe.close())
+
+    def test_selection_mask_skips_scoreless_aggregators(self):
+        # selection_mask must not pay trimmed mean's O(m·d·log m) clip
+        # pass only to discard it: non-selecting aggregators
+        # short-circuit before round_evidence is even called
+        from byzpy_tpu.chaos.influence import selection_mask
+
+        matrix, valid = _cohort()
+        agg = CoordinateWiseTrimmedMean(f=2)
+
+        def boom(*a, **k):  # pragma: no cover — must not run
+            raise AssertionError("round_evidence should not be called")
+
+        agg.round_evidence = boom
+        assert selection_mask(agg, matrix, valid) is None
+
+    def test_trace_replay_timeline(self, tmp_path):
+        from byzpy_tpu.chaos import AttackSpec, ChaosHarness, Scenario
+
+        cell = Scenario(
+            name="replay",
+            seed=5,
+            n_clients=10,
+            n_byzantine=2,
+            dim=16,
+            rounds=6,
+            aggregator="multi_krum",
+            aggregator_params={"f": 2, "q": 3},
+            attack=AttackSpec(name="outlier", params={"scale": 50.0}),
+        )
+        report = ChaosHarness(cell).run()
+        path = str(tmp_path / "trace.jsonl")
+        report.trace.to_jsonl(path)
+        timeline = audit.trace_timeline(path)
+        assert timeline["exclusions_by_round"]  # outliers excluded by Krum
+        assert any(
+            c.startswith("byz") and e["excluded_rounds"]
+            for c, e in timeline["clients"].items()
+        )
+
+    def test_recovery_ignores_evidence_records(self, tmp_path):
+        # EVIDENCE WAL records carry no round state: a recovery replay
+        # over a forensics-bearing WAL must reconstruct the same rounds
+        from byzpy_tpu.resilience.durable import DurabilityConfig, TenantDurability
+
+        fe, _ = _abused_frontend(tmp_path)
+        rounds_before = fe.round_of("m0")
+        asyncio.run(fe.close())
+        rec = TenantDurability(
+            DurabilityConfig(directory=str(tmp_path), prune=False), "m0"
+        ).recovered
+        assert rec is not None
+        assert rec.round_id == rounds_before
+
+
+# ---------------------------------------------------------------------------
+# metrics / flight recorder / compile cache
+# ---------------------------------------------------------------------------
+
+
+class TestObservabilitySurfaces:
+    def test_forensics_metrics_in_prometheus_text(self, tmp_path):
+        from byzpy_tpu.observability import metrics as obs_metrics
+
+        fe, _ = _abused_frontend(tmp_path)
+        asyncio.run(fe.close())
+        text = obs_metrics.registry().prometheus_text()
+        for family in (
+            "byzpy_anomaly_flags_total",
+            "byzpy_trust_score",
+            "byzpy_client_excluded_total",
+            "byzpy_quarantined_clients",
+            "byzpy_client_quarantines_total",
+        ):
+            assert family in text
+        assert 'detector="staleness_inflation"' in text
+
+    def test_flight_dump_carries_recent_evidence(self):
+        from byzpy_tpu.observability.recorder import FlightRecorder
+
+        plane = ForensicsPlane("ftest", ForensicsConfig(recent_rounds=4))
+        matrix, valid = _cohort()
+        clients = [f"c{i}" for i in range(12)]
+        for r in range(6):
+            plane.observe_round(r, matrix, valid, clients, matrix.mean(0))
+        dump = FlightRecorder().record()
+        assert "ftest" in dump["forensics"]
+        rounds = [e["round"] for e in dump["forensics"]["ftest"]]
+        assert rounds == [2, 3, 4, 5]  # bounded to recent_rounds
+
+    def test_jitstats_counts_growth_only(self):
+        from byzpy_tpu.observability import jitstats, metrics as obs_metrics
+
+        site = "test.site.a"
+        assert jitstats.note_cache_size(site, 1) == 1
+        assert jitstats.note_cache_size(site, 1) == 0
+        assert jitstats.note_cache_size(site, 3) == 2
+        assert jitstats.note_cache_size(site, 2) == 0  # cache cleared: no negative
+        assert jitstats.note_cache_size(site, None) == 0
+        assert jitstats.compiles_seen(site) == 3
+        counter = obs_metrics.registry().counter(
+            "byzpy_jit_compiles_total", labels={"site": site}
+        )
+        assert counter.value == 3
+
+    def test_serving_recompile_warning(self, caplog):
+        import logging
+
+        from byzpy_tpu.observability import metrics as obs_metrics
+        from byzpy_tpu.serving import ServingFrontend, TenantConfig
+
+        fe = ServingFrontend(
+            [
+                TenantConfig(
+                    name="warn0",
+                    aggregator=CoordinateWiseTrimmedMean(f=1),
+                    dim=4,
+                )
+            ]
+        )
+        t = fe._tenants["warn0"]
+
+        class _FakeJit:
+            def __init__(self, n):
+                self.n = n
+
+            def _cache_size(self):
+                return self.n
+
+        expected = len(t.ladder.sizes)
+        t.executor.aggregator._masked_jit_cache = _FakeJit(expected)
+        with caplog.at_level(logging.WARNING, logger="byzpy_tpu.serving"):
+            fe._note_compiles(t)  # at the ladder bound: no warning
+            assert not caplog.records
+            t.executor.aggregator._masked_jit_cache = _FakeJit(expected + 1)
+            fe._note_compiles(t)  # one past the ladder: warn once
+            fe._note_compiles(t)  # same size again: no repeat
+        warnings = [r for r in caplog.records if "jit cache" in r.message]
+        assert len(warnings) == 1
+        counter = obs_metrics.registry().counter(
+            "byzpy_serving_recompile_warnings_total",
+            labels={"tenant": "warn0"},
+        )
+        assert counter.value == 1
+
+    def test_serving_compile_site_counts(self, tmp_path):
+        from byzpy_tpu.observability import jitstats
+
+        fe, _ = _abused_frontend(tmp_path)
+        asyncio.run(fe.close())
+        # the masked-aggregate cache was observed (one bucket compiled)
+        assert jitstats.compiles_seen("serving.masked_aggregate:m0") >= 1
