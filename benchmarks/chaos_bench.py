@@ -661,6 +661,61 @@ def _forensics_audit_leg(args) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def run_ragged(args, out) -> dict:
+    """Ragged-door parity cell (PR 11): one serving-engine cell replayed
+    through the DEFAULT ragged dispatcher and again through the
+    bucket-ladder escape hatch (``BYZPY_TPU_RAGGED=0``) — the event
+    traces fold every round's exact aggregate bits into their digests,
+    so digest equality IS the bit-parity pin keeping the regression
+    wall honest about which door served it. Asserted unconditionally
+    (the cell is cheap; a parity break must never ride a green wall)."""
+    agg_name, agg_params = args.aggregators[0]
+    scenario = Scenario(
+        name=f"ragged-door/{agg_name}",
+        seed=args.seed,
+        n_clients=args.clients_grid,
+        n_byzantine=args.byzantine,
+        dim=args.dim,
+        rounds=args.rounds,
+        engine="serving",
+        aggregator=agg_name,
+        aggregator_params=agg_params,
+        staleness_kind="exponential",
+        staleness_gamma=0.5,
+        staleness_cutoff=4,
+        attack=AttackSpec(
+            name="staleness_abuse",
+            params={"kind": "exponential", "gamma": 0.5,
+                    "cutoff": 4, "scale": 2.0},
+        ),
+    )
+    prev = os.environ.get("BYZPY_TPU_RAGGED")
+    try:
+        os.environ.pop("BYZPY_TPU_RAGGED", None)
+        ragged = ChaosHarness(scenario).run()
+        os.environ["BYZPY_TPU_RAGGED"] = "0"
+        bucketed = ChaosHarness(scenario).run()
+    finally:
+        if prev is None:
+            os.environ.pop("BYZPY_TPU_RAGGED", None)
+        else:
+            os.environ["BYZPY_TPU_RAGGED"] = prev
+    row = {
+        "lane": "ragged",
+        "aggregator": agg_name,
+        "rounds": ragged.rounds_completed,
+        "ragged_digest": ragged.trace.digest(),
+        "bucketed_digest": bucketed.trace.digest(),
+        "digest_match": ragged.trace.digest() == bucketed.trace.digest(),
+    }
+    _emit(row, out)
+    assert row["digest_match"], (
+        "ragged door diverged from the bucket ladder: "
+        f"{row['ragged_digest']} != {row['bucketed_digest']}"
+    )
+    return row
+
+
 def run_swarm(args, out) -> dict:
     scenario = Scenario(
         name="swarm",
@@ -772,7 +827,7 @@ def main() -> None:
     )
     ap.add_argument(
         "--lanes", type=str,
-        default="grid,adaptive,serving,swarm,recovery,forensics",
+        default="grid,adaptive,serving,swarm,recovery,forensics,ragged",
         help="comma-separated lane subset",
     )
     ap.add_argument("--out", type=str, default=None)
@@ -817,6 +872,7 @@ def main() -> None:
     swarm = run_swarm(args, args.out) if "swarm" in lanes else None
     recovery = run_recovery(args, args.out) if "recovery" in lanes else None
     forensics = run_forensics(args, args.out) if "forensics" in lanes else None
+    ragged = run_ragged(args, args.out) if "ragged" in lanes else None
 
     crashed = [r for r in grid if r.get("harness_crashed")]
     headline = {
@@ -846,6 +902,9 @@ def main() -> None:
         ),
         "forensics_honest_worst_fp": (
             forensics["honest_worst_fp_rate"] if forensics else None
+        ),
+        "ragged_door_digest_match": (
+            ragged["digest_match"] if ragged else None
         ),
     }
     _emit(headline, args.out)

@@ -362,6 +362,81 @@ class Aggregator(Operator, ABC):
             valid = jnp.asarray(valid_rows, bool)
             return unravel(self._masked_jitted()(buffer, valid))
 
+    # -- ragged multi-cohort aggregation (serving-tier flat batches) ------
+
+    #: Score family published by :meth:`ragged_matrix_fn`'s fused
+    #: evidence outputs ("" = the ragged program publishes no per-row
+    #: scores; the forensics plane then falls back to the host
+    #: :meth:`round_evidence` pass).
+    ragged_score_kind: str = ""
+
+    #: Whether multiple cohorts should COALESCE into one ragged device
+    #: call for this aggregator on the XLA fallback. True only where
+    #: the ragged program genuinely shares work across the batch (the
+    #: selection families: ONE Gram / norm pass scores every cohort —
+    #: measured cheaper than separate dispatches). Sort-based
+    #: coordinate-wise programs share nothing on XLA and sorting the
+    #: union is superlinear in rows, so they serve one cohort per call
+    #: — still through ONE compiled program (the ladder kill is
+    #: independent of coalescing). The Pallas path batches everything
+    #: with fill-skip; on-chip policy rides the rerun bundle.
+    ragged_coalesce: bool = False
+
+    @property
+    def supports_ragged(self) -> bool:
+        """True when this aggregator can serve the flat-rows ragged
+        door (``ops.ragged``): any aggregator with a masked program
+        can — the generic per-cohort masked loop is always available —
+        while the hot families override :meth:`ragged_matrix_fn` with
+        programs that share the segmented sort / Gram / norm pass
+        across the whole batch."""
+        return self.supports_masked_finalize
+
+    def ragged_group_key(self) -> tuple:
+        """Hashable compatibility key for cross-tenant batching: two
+        tenants' cohorts may share one ragged device call only when
+        their aggregators trace the SAME program (same class, same
+        static hyperparameters). The gradient dimension joins the key
+        at the dispatcher (it is a property of the arrays, not the
+        aggregator)."""
+        statics = tuple(
+            sorted(
+                (k, v)
+                for k, v in vars(self).items()
+                if isinstance(v, (int, float, str, bool))
+            )
+        )
+        return (type(self).__qualname__, statics)
+
+    def ragged_matrix_fn(self) -> Optional[Callable]:
+        """The bare ragged multi-cohort program ``(flat, seg, offsets,
+        lengths, *, n_cohorts, segment_sum=None) -> (aggregates,
+        score, keep)`` for embedding in one jitted batch dispatch
+        (``serving.ragged``), or ``None`` when the aggregator has no
+        masked program. Pure and trace-safe — no dispatch reads; the
+        caller resolves Pallas/tile pre-trace and passes
+        ``segment_sum``. The default reuses the masked program per
+        cohort (single compile / single dispatch, no shared work, no
+        fused evidence); subclasses with specialized ragged kernels
+        override. Results are bit-identical per cohort to the unpadded
+        ``aggregate`` under the masked contract's preconditions
+        (finite rows, admissible ``m`` — the serving door enforces
+        both)."""
+        if not self.supports_masked_finalize:
+            return None
+        masked = self._aggregate_matrix_masked
+
+        def generic(flat, seg, offsets, lengths, *, n_cohorts,
+                    segment_sum=None):
+            from ..ops import ragged as ragged_ops
+
+            aggs = ragged_ops.ragged_via_masked(
+                masked, flat, seg, n_cohorts=n_cohorts
+            )
+            return aggs, None, None
+
+        return generic
+
     # -- forensics evidence (per-row score view) ---------------------------
 
     #: True when :meth:`round_evidence` publishes a binary keep set

@@ -43,5 +43,27 @@ class CoordinateWiseMedian(FeatureChunkedAggregator, Aggregator):
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.coordinate_median_stream(xs)
 
+    def ragged_matrix_fn(self):
+        """Ragged program, sort strategy resolved pre-trace (see
+        ``CoordinateWiseTrimmedMean.ragged_matrix_fn``): segmented
+        program on TPU (finite rows only — the serving ragged door
+        routes non-finite cohorts to the exact fallback, and on finite
+        data the masked program's NaN rewrite is a no-op, so parity
+        stays bit-for-bit), per-cohort masked program on the XLA
+        fallback."""
+        from ...ops import ragged as ragged_ops
+        from ...ops.pallas_kernels import _on_tpu
+
+        if not _on_tpu():
+            return super().ragged_matrix_fn()
+
+        def fn(flat, seg, offsets, lengths, *, n_cohorts, segment_sum=None):
+            aggs = ragged_ops.ragged_median(
+                flat, seg, offsets, lengths, n_cohorts=n_cohorts
+            )
+            return aggs, None, None
+
+        return fn
+
 
 __all__ = ["CoordinateWiseMedian"]

@@ -125,6 +125,27 @@ class MultiKrum(RowScoredAggregator, Aggregator):
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.multi_krum_stream(xs, f=self.f, q=self.q)
 
+    ragged_score_kind = "krum_distance"
+    #: one shared Gram scores the whole batch — coalescing wins
+    ragged_coalesce = True
+
+    def ragged_matrix_fn(self):
+        """Specialized ragged program: ONE shared Gram scores every
+        cohort in the batch (``ops.ragged.ragged_multi_krum``); the
+        Krum-distance scores + lowest-``q`` keep set ride along as the
+        fused forensics view."""
+        from ...ops import ragged as ragged_ops
+
+        f, q = self.f, self.q
+
+        def fn(flat, seg, offsets, lengths, *, n_cohorts, segment_sum=None):
+            return ragged_ops.ragged_multi_krum(
+                flat, seg, lengths, f=f, q=q, n_cohorts=n_cohorts,
+                segment_sum=segment_sum,
+            )
+
+        return fn
+
     def round_evidence(self, matrix, valid, *, aggregate=None):
         """Krum-distance scores + the lowest-``q`` selection, scattered
         to padded positions (host-side; tie rule = the aggregation

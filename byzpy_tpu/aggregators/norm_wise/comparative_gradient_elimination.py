@@ -74,6 +74,26 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
     def _aggregate_stream_matrix(self, xs: jnp.ndarray) -> jnp.ndarray:
         return robust.cge_stream(xs, f=self.f)
 
+    ragged_score_kind = "norm"
+    #: one shared norm pass scores the whole batch — coalescing wins
+    ragged_coalesce = True
+
+    def ragged_matrix_fn(self):
+        """Specialized ragged program: ONE squared-norm pass scores
+        every cohort in the batch (``ops.ragged.ragged_cge``); the
+        published L2 norms + keep set are the fused forensics view."""
+        from ...ops import ragged as ragged_ops
+
+        f = self.f
+
+        def fn(flat, seg, offsets, lengths, *, n_cohorts, segment_sum=None):
+            return ragged_ops.ragged_cge(
+                flat, seg, lengths, f=f, n_cohorts=n_cohorts,
+                segment_sum=segment_sum,
+            )
+
+        return fn
+
     def round_evidence(self, matrix, valid, *, aggregate=None):
         """Per-row L2-norm scores + the lowest-``m − f`` selection
         (host-side; stable tie rule matching the selection program)."""

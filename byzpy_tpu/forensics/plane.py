@@ -224,6 +224,7 @@ class ForensicsPlane:
         weights: Any = None,
         deltas: Optional[Sequence[int]] = None,
         bucket: Optional[int] = None,
+        precomputed: Optional[Mapping[str, Any]] = None,
     ) -> dict:
         """The HEAVY half of :meth:`observe_round`: features + the
         aggregator's score view (the O(m²·d) Krum distances / O(m·d)
@@ -237,7 +238,17 @@ class ForensicsPlane:
         mask, ``clients`` the valid rows' client ids (slot order),
         ``aggregate`` the round's broadcast. ``weights`` (optional) the
         per-slot staleness discounts; ``deltas`` (optional) per valid
-        row staleness in rounds (−1 recorded when unknown)."""
+        row staleness in rounds (−1 recorded when unknown).
+
+        ``precomputed`` (optional) is a ``{"kind", "scores", "keep"}``
+        score view that already rode the aggregation kernel (the
+        serving ragged door's fused evidence outputs,
+        ``serving.ragged.RaggedView.precomputed``): the aggregator's
+        host score pass — the expensive O(m²·d) half of this stage —
+        is skipped entirely, the kernel having computed the same
+        quantities on the same discounted rows the fold aggregated.
+        ``scores``/``keep`` are indexed by VALID-row order and
+        scattered to padded slots here."""
         valid_arr = np.asarray(valid, bool)
         idx = np.flatnonzero(valid_arr)
         feats = row_features(
@@ -247,7 +258,18 @@ class ForensicsPlane:
         flags = instant_flags(feats, self.cfg.detectors)
         score_kind = ""
         scores = keep = None
-        if aggregator is not None:
+        if precomputed is not None:
+            score_kind = str(precomputed.get("kind", ""))
+            n_slots = int(valid_arr.shape[0])
+            pre_scores = precomputed.get("scores")
+            if pre_scores is not None:
+                scores = np.full((n_slots,), np.nan, np.float32)
+                scores[idx] = np.asarray(pre_scores, np.float32)
+            pre_keep = precomputed.get("keep")
+            if pre_keep is not None:
+                keep = np.zeros((n_slots,), bool)
+                keep[idx] = np.asarray(pre_keep, bool)
+        elif aggregator is not None:
             # score what the aggregator actually judged: the serving
             # fold scales stale rows by their discount BEFORE the
             # robust aggregate, so the selection verdict must be

@@ -1832,6 +1832,144 @@ def _nnm_selection_mean_stream_call(
 
 
 # ---------------------------------------------------------------------------
+# Ragged segment sum (flat multi-cohort batches, serving tier)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_segment_sum_kernel(
+    fill_ref, w_ref, x_ref, out_ref, *, rows_tile: int
+):
+    """One (row-tile, feature-tile) step of the ragged segment sum:
+    accumulate ``Wᵀ @ x`` for this row tile into the shared
+    ``(C_pad, tile)`` output block (``W`` columns are the per-cohort
+    weight vectors — selection/window masks with their reciprocal
+    weights baked in). The batch's actual fill (total occupied rows,
+    scalar-prefetched so it is known before the body runs) gates the
+    accumulation — row tiles past the fill are pure capacity padding
+    and skip their MXU work entirely, the Ragged-Paged-Attention
+    economics: compute follows the DATA, the compiled shape only
+    bounds it. Grid steps run sequentially on TPU, so ``+=`` over the
+    shared block is safe; the first row tile initializes."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(i * rows_tile < fill_ref[0])
+    def _():
+        out_ref[:] += jax.lax.dot_general(
+            w_ref[:], x_ref[:],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def ragged_segment_sum_pallas(
+    x: Array,
+    weights: Array,
+    *,
+    fill: Optional[Array] = None,
+    rows_tile: Optional[int] = None,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Per-cohort weighted row sums over a flat ragged batch:
+    ``out[c] = Σ_r weights[c, r]·x[r]`` for ``x: (R, d)`` and
+    ``weights: (C, R)`` (one weight row per cohort — zero outside the
+    cohort's block, window/selection masks with reciprocal weights
+    baked in by the caller). This is the contraction every ragged
+    aggregate ends in, tiled over (row tiles × feature tiles) with the
+    batch ``fill`` (an int32 scalar, default ``R``) scalar-prefetched
+    so capacity row tiles skip their MXU work — the padding a dense
+    program would pay for is skipped, not multiplied. Tile resolved
+    here, pre-trace (family ``"ragged"``: ``BYZPY_TPU_TILE_RAGGED``
+    env override / autotune cache). The weight-transpose dot mirrors
+    the XLA fallback's per-cohort einsum contraction row-for-row;
+    interpret mode reproduces it bit-for-bit, Mosaic's MXU tiling is
+    expected ulp-level — so the serving ragged door keeps the XLA
+    program authoritative for its bit-parity contract and routes here
+    only on explicit opt-in (``BYZPY_TPU_RAGGED_PALLAS=1``; see
+    ``serving.ragged``). On-chip timing/parity capture rides the
+    queued rerun bundle."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    n, d = x.shape
+    n_cohorts = weights.shape[0]
+    if tile is None:
+        # cache keys carry the sublane-padded row count, like every
+        # sibling family (autotune.sweep stores them that way)
+        tuned = _tuned_tile(
+            "ragged", max(_SUBLANES, _round_up(n, _SUBLANES)), d
+        )
+        tile = tuned if tuned is not None else max(
+            _LANES, min(4096, _round_up(d, _LANES))
+        )
+    if rows_tile is None:
+        rows_tile = max(_SUBLANES, min(256, _round_up(n, _SUBLANES)))
+    if fill is None:
+        fill = jnp.asarray([n], jnp.int32)
+    else:
+        fill = jnp.asarray(fill, jnp.int32).reshape((1,))
+    return _ragged_segment_sum_call(
+        x, weights, fill, n_cohorts=int(n_cohorts),
+        rows_tile=int(rows_tile), tile=int(tile), interpret=bool(interpret),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_cohorts", "rows_tile", "tile", "interpret"),
+)
+def _ragged_segment_sum_call(
+    x: Array,
+    weights: Array,
+    fill: Array,
+    *,
+    n_cohorts: int,
+    rows_tile: int,
+    tile: int,
+    interpret: bool,
+) -> Array:
+    n, d = x.shape
+    n_pad = _round_up(max(n, 1), rows_tile)
+    d_pad = _round_up(max(d, 1), tile)
+    c_pad = max(_SUBLANES, _round_up(n_cohorts, _SUBLANES))
+    xp = jnp.zeros((n_pad, d_pad), jnp.float32).at[:n, :d].set(
+        x.astype(jnp.float32)
+    )
+    ohp = jnp.zeros((n_pad, c_pad), jnp.float32).at[:n, :n_cohorts].set(
+        weights.T.astype(jnp.float32)
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pad // rows_tile, d_pad // tile),
+        # index maps receive the scalar-prefetch ref as a trailing arg
+        in_specs=[
+            pl.BlockSpec(
+                (rows_tile, c_pad), lambda i, j, fill: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rows_tile, tile), lambda i, j, fill: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (c_pad, tile), lambda i, j, fill: (0, j), memory_space=pltpu.VMEM
+        ),
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_segment_sum_kernel, rows_tile=rows_tile),
+        out_shape=jax.ShapeDtypeStruct((c_pad, d_pad), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(fill, ohp, xp)
+    return out[:n_cohorts, :d].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
 # Dispatch policy
 # ---------------------------------------------------------------------------
 
@@ -1945,6 +2083,7 @@ __all__ = [
     "nnm_pallas",
     "nnm_stream_pallas",
     "nnm_selection_mean_stream_pallas",
+    "ragged_segment_sum_pallas",
     "selection_mean_from_gram_pallas",
     "selection_mean_pallas",
     "sorted_reduce_stream_pallas",

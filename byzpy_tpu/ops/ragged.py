@@ -1,0 +1,396 @@
+"""Ragged multi-cohort aggregation: one compiled program, any cohort mix.
+
+The serving tier's bucket ladder (``serving.buckets``) solved the
+recompile-per-cohort-size problem by padding every cohort into one of
+``log2(cap)+1`` power-of-two shapes — at the cost of padded FLOPs/HBM on
+every non-full cohort, a ladder of compiled programs per tenant, and one
+device dispatch per cohort serialized on the frontend's device lock.
+This module is the Ragged-Paged-Attention-style replacement (PAPERS.md
+arXiv:2604.15464): ONE compiled program consumes a batch of cohorts in
+**flat-rows layout** and produces every cohort's aggregate in a single
+device dispatch — no per-cohort padding shape, no ladder, and cohorts
+from *different tenants* coalesce into the same call (the Podracer
+pod-batching shape, arXiv:2104.06272).
+
+Flat-rows layout (the kernel ABI every function here shares):
+
+* ``flat``: ``(R, d)`` float32 — cohort ``c``'s rows occupy the
+  contiguous block ``[offsets[c], offsets[c] + lengths[c])`` in
+  admission order; all remaining rows are exact zeros. ``R`` is the
+  batch's static row capacity (jit shape key), the fill is data.
+* ``seg``: ``(R,)`` int32 — the cohort index of each row, ``C`` (one
+  past the last cohort) for unoccupied capacity rows.
+* ``offsets`` / ``lengths``: ``(C,)`` int32, traced — cohort start rows
+  and sizes. ``C`` (``n_cohorts``) is static; a dispatch carrying fewer
+  cohorts than ``C`` pads with ``lengths = 0`` entries whose outputs
+  are garbage by construction and must be discarded by the caller.
+
+Bit-parity contract (the serving tier's masked contract, extended):
+every cohort's aggregate is **bit-for-bit identical** (f32, finite
+rows) to the unpadded ``aggregate`` of that cohort alone, for any batch
+composition. The recipe is the ``ops.robust`` masked one — zero-padded
+einsum row contractions, reciprocal-multiply traced divisions, +inf
+sort padding, valid-only selection ranks — with two ragged twists:
+
+* ONE two-key ``lax.sort`` (segment id, value key) sorts every cohort's
+  columns in a single pass: within a segment the value order is exactly
+  the per-cohort sort's, and segments stay contiguous, so the windowed
+  reductions read each cohort's sorted block at its offset (this is
+  what replaces C separate bucket sorts);
+* ONE shared Gram / norm pass scores every cohort's rows at once;
+  cross-cohort entries are masked to ``+inf`` before the row sort, so
+  each row's sorted distance prefix matches the compacted cohort's.
+
+Everything here is pure and trace-safe — NO dispatch decisions (env
+vars, tile caches) are read inside these functions; the Pallas gate and
+tile resolve in the callers' Python wrappers pre-trace
+(``serving.ragged.ragged_dispatch``, the PR-2 wrapper pattern) and
+arrive as static arguments. Callers also pre-validate each cohort
+host-side (``validate_n``, finiteness) and route inadmissible or
+non-finite cohorts through the exact ``aggregate_masked`` door — the
+same fallback stance as ``fold_finalize_masked``.
+
+Forensics rides the same program: :func:`ragged_evidence` adds per-row
+norms and cosines-to-own-aggregate as extra outputs, and the selection
+families return their per-row scores and keep sets — the O(m²·d) host
+score pass ``forensics.plane`` previously paid per round
+(``Aggregator.round_evidence``) comes out of the kernel for free.
+
+Parity pinned by ``tests/test_ragged.py`` (every streaming aggregator ×
+cohort grids × mixed-size multi-cohort batches).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from .robust import (
+    _masked_recip,
+    _selected_rows_mean,
+    gram_matrix,
+)
+
+Array = jnp.ndarray
+
+#: eps matching ``forensics.evidence``'s cosine denominator floor.
+_EVIDENCE_EPS = 1e-12
+
+
+def segment_ids(offsets, lengths, n_rows: int, n_cohorts: int) -> Array:
+    """Per-row segment ids from traced ``offsets``/``lengths``:
+    ``seg[r] = c`` for rows inside cohort ``c``'s block, ``n_cohorts``
+    for unoccupied capacity rows. (Host callers usually build ``seg``
+    directly in numpy; this traced builder serves the jitted serving
+    step, where only offsets/lengths cross the jit boundary.)"""
+    pos = jnp.arange(n_rows)
+    seg = jnp.full((n_rows,), n_cohorts, jnp.int32)
+    for c in range(n_cohorts):
+        inside = (pos >= offsets[c]) & (pos < offsets[c] + lengths[c])
+        seg = jnp.where(inside, jnp.int32(c), seg)
+    return seg
+
+
+def segmented_sort(flat: Array, seg: Array) -> Array:
+    """Sort every cohort's columns in ONE pass: a two-key ``lax.sort``
+    over (segment id, monotone int32 value key) leaves each segment's
+    block contiguous with its values in exactly the order
+    ``robust.sort_rows`` would produce for the compacted cohort
+    (same key map — NaN canonicalization and -0.0/+0.0 ordering
+    caveats included). Capacity rows (``seg == C``) sort after every
+    cohort. f32 only (the serving flat layout's dtype)."""
+    from .pallas_kernels import _float_sort_keys, _keys_to_float
+
+    keys = _float_sort_keys(flat)
+    segcol = jnp.broadcast_to(seg[:, None], keys.shape)
+    _, sorted_keys = lax.sort((segcol, keys), dimension=0, num_keys=2)
+    return _keys_to_float(sorted_keys, flat.dtype)
+
+
+def _segment_positions(seg: Array, offsets: Array, n_cohorts: int) -> Array:
+    """Each row's position within its segment block (garbage for
+    capacity rows — always mask by ``seg`` before use)."""
+    pos = jnp.arange(seg.shape[0])
+    off = jnp.concatenate([offsets, jnp.zeros((1,), offsets.dtype)])
+    return pos - off[jnp.minimum(seg, n_cohorts)]
+
+
+def _cohort_row_at(s: Array, pos) -> Array:
+    """Row of the (segment-sorted) matrix at traced position ``pos``."""
+    idx = jnp.broadcast_to(pos, (1, s.shape[1]))
+    return jnp.take_along_axis(s, idx, axis=0)[0]
+
+
+def ragged_trimmed_mean(
+    flat: Array,
+    seg: Array,
+    offsets: Array,
+    lengths: Array,
+    *,
+    f: int,
+    n_cohorts: int,
+    segment_sum: Optional[Callable] = None,
+) -> Array:
+    """f-trimmed coordinate mean of every cohort in one program:
+    one segmented sort, then per cohort the same zero-masked windowed
+    einsum contraction as ``robust.masked_trimmed_mean`` — the kept
+    values enter the row accumulation in the same order with exact
+    zeros elsewhere, so each cohort's result is bit-identical to the
+    unpadded ``trimmed_mean`` (callers guarantee ``2f < lengths[c]``
+    for real cohorts). ``segment_sum`` (static) overrides the windowed
+    contraction with a fused kernel (the Pallas path)."""
+    s = segmented_sort(flat, seg)
+    rel = _segment_positions(seg, offsets, n_cohorts)
+    ones = jnp.ones((flat.shape[0],), flat.dtype)
+    windows = [
+        (seg == c) & (rel >= f) & (rel < lengths[c] - f)
+        for c in range(n_cohorts)
+    ]
+    recips = jnp.stack(
+        [_masked_recip(lengths[c] - 2 * f, s.dtype) for c in range(n_cohorts)]
+    )
+    if segment_sum is not None:
+        totals = segment_sum(
+            s, jnp.stack([w.astype(s.dtype) for w in windows])
+        )
+        return totals * recips[:, None]
+    outs = []
+    for c in range(n_cohorts):
+        kept = jnp.where(windows[c][:, None], s, jnp.zeros((), s.dtype))
+        outs.append(jnp.einsum("n,nd->d", ones, kept) * recips[c])
+    return jnp.stack(outs)
+
+
+def ragged_median(
+    flat: Array,
+    seg: Array,
+    offsets: Array,
+    lengths: Array,
+    *,
+    n_cohorts: int,
+) -> Array:
+    """Coordinate-wise median of every cohort in one program (finite
+    rows — the ragged door routes non-finite cohorts to the exact
+    fallback, which keeps ``jnp.median``'s NaN column semantics).
+    Gathers the two middle rows of each cohort's sorted block at
+    traced positions, midpoint ``(a+b)*0.5`` exactly as
+    ``masked_coordinate_median``."""
+    s = segmented_sort(flat, seg)
+    outs = []
+    for c in range(n_cohorts):
+        m = lengths[c]
+        lo, hi = (m - 1) // 2, m // 2
+        s_lo = _cohort_row_at(s, offsets[c] + lo)
+        s_hi = _cohort_row_at(s, offsets[c] + hi)
+        outs.append(
+            jnp.where(
+                lo == hi, s_lo, (s_lo + s_hi) * jnp.asarray(0.5, s.dtype)
+            )
+        )
+    return jnp.stack(outs)
+
+
+def ragged_segment_ranks(
+    scores: Array, seg: Array, n_cohorts: int
+) -> Array:
+    """Per-row selection rank among the row's OWN cohort, under the
+    (isnan, score, index) key of ``robust._nan_last_ranks``: cohort
+    rows sit in admission order (= the compacted matrix's row order),
+    so each row's rank equals its rank in the compacted cohort.
+    Capacity rows rank ``R`` and are never selected."""
+    n = scores.shape[0]
+    idx = jnp.arange(n)
+    isnan = jnp.isnan(scores)
+    s = jnp.where(isnan, jnp.zeros_like(scores), scores)
+    nan_lt = (~isnan[None, :]) & isnan[:, None]
+    nan_eq = isnan[None, :] == isnan[:, None]
+    lt = nan_lt | (nan_eq & (s[None, :] < s[:, None]))
+    eq = nan_eq & (s[None, :] == s[:, None])
+    coseg = (seg[None, :] == seg[:, None]) & (seg[None, :] < n_cohorts)
+    before = (lt | (eq & (idx[None, :] < idx[:, None]))) & coseg
+    return jnp.where(seg < n_cohorts, jnp.sum(before, axis=1), n)
+
+
+def ragged_selection_mean(
+    flat: Array,
+    seg: Array,
+    scores: Array,
+    keep_counts: Array,
+    *,
+    n_cohorts: int,
+    any_bad: Array,
+    segment_sum: Optional[Callable] = None,
+) -> Tuple[Array, Array]:
+    """Mean of each cohort's ``keep_counts[c]`` lowest-score rows —
+    the ragged mirror of ``robust.masked_selection_mean``, sharing its
+    conditional-mask contraction semantics per cohort (identical
+    branches for finite data; ``any_bad`` routes the whole batch to
+    the masked branch, exactly like the bucket path's guard). Returns
+    ``((C, d) means, (R,) keep mask)``."""
+    ranks = ragged_segment_ranks(scores, seg, n_cohorts)
+    q_of = jnp.concatenate([keep_counts, jnp.ones((1,), keep_counts.dtype)])
+    q_row = q_of[jnp.minimum(seg, n_cohorts)]
+    keep = (ranks < q_row) & (seg < n_cohorts)
+    if segment_sum is not None:
+        w_rows = jnp.stack(
+            [
+                jnp.where(
+                    keep & (seg == c),
+                    _masked_recip(keep_counts[c], flat.dtype),
+                    0.0,
+                ).astype(flat.dtype)
+                for c in range(n_cohorts)
+            ]
+        )
+        return segment_sum(flat, w_rows), keep
+    outs = [
+        _selected_rows_mean(flat, keep & (seg == c), keep_counts[c], any_bad)
+        for c in range(n_cohorts)
+    ]
+    return jnp.stack(outs), keep
+
+
+def ragged_cge(
+    flat: Array,
+    seg: Array,
+    lengths: Array,
+    *,
+    f: int,
+    n_cohorts: int,
+    segment_sum: Optional[Callable] = None,
+) -> Tuple[Array, Array, Array]:
+    """CGE over every cohort in one program: ONE squared-norm pass
+    scores all rows (per-row reductions are layout-independent, so the
+    scores match ``masked_cge``'s bit-for-bit), selection keeps each
+    cohort's ``lengths[c] - f`` smallest. Returns ``(aggregates,
+    scores, keep)`` — the scores/keep are the fused forensics view."""
+    norms = jnp.sum(flat * flat, axis=1)
+    scores = jnp.where(seg < n_cohorts, norms, jnp.asarray(jnp.inf, norms.dtype))
+    any_bad = ~jnp.all(jnp.where(seg < n_cohorts, jnp.isfinite(norms), True))
+    aggs, keep = ragged_selection_mean(
+        flat, seg, scores, lengths - f, n_cohorts=n_cohorts,
+        any_bad=any_bad, segment_sum=segment_sum,
+    )
+    # selection ranks on the squared norms (the aggregation program's
+    # quantity); the PUBLISHED score is the L2 norm — the unit
+    # ``Aggregator.round_evidence``'s "norm" view reports (monotone,
+    # so the keep set is unchanged)
+    return aggs, jnp.sqrt(scores), keep
+
+
+def ragged_krum_scores(
+    flat: Array, seg: Array, lengths: Array, *, f: int, n_cohorts: int
+) -> Tuple[Array, Array]:
+    """Krum scores for every cohort's rows from ONE shared Gram: the
+    within-cohort dot products of the flat Gram are bit-identical to
+    each compacted cohort's (the contraction runs over the same ``d``
+    axis), cross-cohort and capacity columns are pushed to ``+inf``
+    before the row sort, and each row's ``m_c - f - 1``
+    nearest-distance sum reads through the same masked positional
+    window as ``masked_krum_scores_from_gram``. Returns ``(scores,
+    any_bad)``."""
+    gram = gram_matrix(flat)
+    norms = jnp.diagonal(gram)
+    d2 = jnp.maximum(norms[:, None] + norms[None, :] - 2.0 * gram, 0.0)
+    coseg = (seg[None, :] == seg[:, None]) & (seg[None, :] < n_cohorts)
+    d2 = jnp.where(coseg, d2, jnp.asarray(jnp.inf, d2.dtype))
+    row_sorted = jnp.sort(d2, axis=1)
+    m_of = jnp.concatenate([lengths, jnp.zeros((1,), lengths.dtype)])
+    m_row = m_of[jnp.minimum(seg, n_cohorts)]
+    pos = jnp.arange(flat.shape[0])[None, :]
+    window = (pos >= 1) & (pos < (m_row[:, None] - f))
+    kept = jnp.where(window, row_sorted, jnp.zeros((), d2.dtype))
+    scores = jnp.einsum(
+        "nk,k->n", kept, jnp.ones((flat.shape[0],), kept.dtype)
+    )
+    scores = jnp.where(
+        seg < n_cohorts, scores, jnp.asarray(jnp.inf, d2.dtype)
+    )
+    diag_ok = jnp.where(seg < n_cohorts, jnp.isfinite(norms), True)
+    return scores, ~jnp.all(diag_ok)
+
+
+def ragged_multi_krum(
+    flat: Array,
+    seg: Array,
+    lengths: Array,
+    *,
+    f: int,
+    q: int,
+    n_cohorts: int,
+    segment_sum: Optional[Callable] = None,
+) -> Tuple[Array, Array, Array]:
+    """Multi-Krum over every cohort in one program (shared Gram, one
+    selection pass). Returns ``(aggregates, scores, keep)`` — the
+    Krum-distance scores and lowest-``q`` keep set double as the fused
+    forensics view (callers guarantee ``f < m_c - 1`` and
+    ``q <= m_c - f`` per real cohort)."""
+    scores, any_bad = ragged_krum_scores(
+        flat, seg, lengths, f=f, n_cohorts=n_cohorts
+    )
+    q_counts = jnp.full_like(lengths, q)
+    aggs, keep = ragged_selection_mean(
+        flat, seg, scores, q_counts, n_cohorts=n_cohorts,
+        any_bad=any_bad, segment_sum=segment_sum,
+    )
+    return aggs, scores, keep
+
+
+def ragged_via_masked(
+    masked_fn: Callable[[Array, Array], Array],
+    flat: Array,
+    seg: Array,
+    *,
+    n_cohorts: int,
+) -> Array:
+    """Generic ragged door for any aggregator with a masked program:
+    evaluate ``masked_fn(flat, seg == c)`` per cohort inside ONE
+    program. The masked contract holds at ANY padded shape, so each
+    cohort's result is bit-identical to its unpadded aggregate; the
+    per-cohort passes don't share work (no segmented sort / shared
+    Gram), which is why the hot families above have specialized
+    programs — this door buys the single-compile/single-dispatch
+    economics for the long tail (median/meamed/geomed/clipping/
+    MoNNA)."""
+    return jnp.stack(
+        [masked_fn(flat, seg == c) for c in range(n_cohorts)]
+    )
+
+
+def ragged_evidence(
+    flat: Array, seg: Array, aggregates: Array, *, n_cohorts: int
+) -> Tuple[Array, Array]:
+    """Fused per-row forensics features: L2 norm and cosine to the own
+    cohort's (just-computed) aggregate — ``(R,)`` each, 0 for capacity
+    rows. Note these are computed on the rows the fold aggregated
+    (post-staleness-discount); the host plane keeps pre-discount
+    features, the kernel outputs serve as the screening view that used
+    to cost a second full read of the cohort."""
+    sq = jnp.sum(flat * flat, axis=1)
+    norm = jnp.sqrt(sq)
+    agg_pad = jnp.concatenate(
+        [aggregates, jnp.zeros((1, flat.shape[1]), aggregates.dtype)]
+    )
+    agg_rows = agg_pad[jnp.minimum(seg, n_cohorts)]
+    agg_norm = jnp.sqrt(jnp.sum(agg_rows * agg_rows, axis=1))
+    dot = jnp.sum(flat * agg_rows.astype(flat.dtype), axis=1)
+    cos = dot / (norm * agg_norm + _EVIDENCE_EPS)
+    live = seg < n_cohorts
+    return jnp.where(live, norm, 0.0), jnp.where(live, cos, 0.0)
+
+
+__all__ = [
+    "ragged_cge",
+    "ragged_evidence",
+    "ragged_krum_scores",
+    "ragged_median",
+    "ragged_multi_krum",
+    "ragged_segment_ranks",
+    "ragged_selection_mean",
+    "ragged_trimmed_mean",
+    "ragged_via_masked",
+    "segment_ids",
+    "segmented_sort",
+]

@@ -498,6 +498,94 @@ def build_serving_ps_step(
     return step, opt.init(bundle.params)
 
 
+def build_ragged_serving_ps_step(
+    bundle: ModelBundle,
+    ragged_aggregate: Callable,
+    *,
+    row_capacity: int,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    learning_rate: float = 0.05,
+    momentum: float = 0.9,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[Callable, Any]:
+    """The serving update step over the RAGGED flat-rows layout — the
+    ladder-free twin of :func:`build_serving_ps_step`.
+
+    ``step(params, opt_state, flat, offsets, lengths, weights)``
+    consumes the tenant's round as ``flat: (row_capacity, d)`` (cohort
+    rows first, zero rows after), ``offsets``/``lengths``: ``(1,)``
+    int32 (the cohort's placement — traced, so the ACTUAL cohort size
+    is data), and ``weights``: ``(row_capacity,)`` staleness discounts
+    (0 for capacity rows). ``ragged_aggregate`` is an
+    ``Aggregator.ragged_matrix_fn()``; its per-cohort bit-parity
+    contract makes this step's aggregate bit-identical to the bucketed
+    step's for the same cohort. The jit-cache economics are the point:
+    the compiled shape is ``(row_capacity, d)`` ALONE — one program per
+    tenant for every cohort-size distribution, vs one per ladder rung
+    (``jax.jit`` via :func:`jit_ragged_serving_ps_step`).
+
+    Same preconditions as the bucketed step (admissible ``m``, finite
+    rows — the guarded doors live in ``serving``); with ``mesh`` the
+    flat matrix is constrained feature-sharded like every other round
+    path. Returns ``(step, opt_state0)``.
+    """
+    opt = optimizer or optax.sgd(learning_rate, momentum=momentum)
+    ravel, unravel = ravel_pytree_fn(bundle.params)
+    param_dtype = ravel(bundle.params).dtype
+    feat_spec = None
+    if mesh is not None:
+        axis = node_axis(mesh)
+        extra = tuple(
+            a for a in mesh.axis_names if a != axis and mesh.shape[a] > 1
+        )
+        feat_spec = NamedSharding(mesh, P(None, (axis, *extra)))
+    rows = int(row_capacity)
+
+    def step(params, opt_state, flat, offsets, lengths, weights):
+        from ..ops import ragged as ragged_ops
+
+        with jax.named_scope("serving.ragged_scale"):
+            flat = flat * weights[:, None].astype(flat.dtype)
+        if feat_spec is not None:
+            flat = jax.lax.with_sharding_constraint(flat, feat_spec)
+        seg = ragged_ops.segment_ids(offsets, lengths, rows, 1)
+        with jax.named_scope("serving.ragged_aggregate"):
+            aggs, _, _ = ragged_aggregate(
+                flat, seg, offsets, lengths, n_cohorts=1
+            )
+            agg_flat = aggs[0].astype(param_dtype)
+        agg = unravel(agg_flat)
+        with jax.named_scope("serving.opt_update"):
+            updates, new_opt_state = opt.update(agg, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        metrics = {
+            "agg_grad_norm": jnp.sqrt(jnp.sum(jnp.square(agg_flat))),
+            "cohort_m": lengths[0],
+        }
+        return params, new_opt_state, metrics
+
+    return step, opt.init(bundle.params)
+
+
+def jit_ragged_serving_ps_step(
+    bundle: ModelBundle,
+    ragged_aggregate: Callable,
+    *,
+    row_capacity: int,
+    donate: bool = False,
+    **kwargs: Any,
+) -> Tuple[Callable, Any]:
+    """:func:`build_ragged_serving_ps_step` + ``jax.jit`` — ONE
+    compiled program per tenant (the flat capacity is the only shape
+    key; cohort size is traced data). ``donate=True`` donates
+    params/opt-state as in :func:`jit_serving_ps_step`."""
+    step, opt_state0 = build_ragged_serving_ps_step(
+        bundle, ragged_aggregate, row_capacity=row_capacity, **kwargs
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums), opt_state0
+
+
 def adaptive_attack_rows(
     attack: Any, n_byz: int, *, honest: Optional[jnp.ndarray] = None
 ) -> jnp.ndarray:
@@ -573,7 +661,9 @@ __all__ = [
     "as_sharded_update",
     "default_optimizer",
     "build_ps_train_step",
+    "build_ragged_serving_ps_step",
     "build_serving_ps_step",
     "jit_ps_train_step",
+    "jit_ragged_serving_ps_step",
     "jit_serving_ps_step",
 ]

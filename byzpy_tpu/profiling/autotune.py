@@ -45,6 +45,7 @@ CANDIDATES: Dict[str, Tuple[int, ...]] = {
     "sorted_reduce": (512, 1024, 2048, 4096),
     "meamed": (256, 512, 1024, 2048),
     "quant": (1024, 2048, 4096, 8192, 16384),
+    "ragged": (512, 1024, 2048, 4096, 8192),
 }
 
 
@@ -76,6 +77,21 @@ def _kernel_runner(family: str) -> Callable:
         return lambda x, tile: quantize_blockwise(
             x, tile=tile, use_pallas=True
         ).values
+    if family == "ragged":
+        import jax.numpy as jnp
+
+        # a representative serving batch: 4 cohorts splitting the rows,
+        # one 0/1 weight row per cohort — the (C, R) weight-matrix form
+        # of the segment-sum contraction every ragged aggregate ends in
+        def _ragged(x, tile):
+            n = x.shape[0]
+            seg = (jnp.arange(n, dtype=jnp.int32) * 4) // max(n, 1)
+            weights = (
+                seg[None, :] == jnp.arange(4, dtype=jnp.int32)[:, None]
+            ).astype(x.dtype)
+            return pk.ragged_segment_sum_pallas(x, weights, tile=tile)
+
+        return _ragged
     raise ValueError(f"unknown kernel family {family!r}")
 
 

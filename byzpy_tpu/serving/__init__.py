@@ -19,7 +19,13 @@ Pieces:
   the queue);
 * :mod:`~byzpy_tpu.serving.queue` — the bounded admission queue
   (backpressure = reject at the door, never unbounded growth);
-* :mod:`~byzpy_tpu.serving.buckets` — the power-of-two bucket ladder;
+* :mod:`~byzpy_tpu.serving.buckets` — the power-of-two bucket ladder
+  (the ESCAPE HATCH since the ragged door landed: ``BYZPY_TPU_RAGGED=0``
+  or an aggregator without a masked program serves through it);
+* :mod:`~byzpy_tpu.serving.ragged` — the default dispatch door: ONE
+  compiled flat-rows program per tenant group (no ladder, no padding
+  shape per cohort) with cross-tenant batch coalescing and fused
+  forensics outputs;
 * :mod:`~byzpy_tpu.serving.staleness` — round-lag discount policies
   (a round-``k`` gradient folds into round ``k + δ`` scaled by
   ``discount(δ)``; ``δ = 0`` is the exact identity);
@@ -60,6 +66,13 @@ from .cohort import Cohort, CohortAggregator
 from .credits import CreditLedger, CreditPolicy, TokenBucket
 from .frontend import ServingClient, ServingFrontend, TenantConfig, serve_frame
 from .queue import AdmissionQueue, Submission
+from .ragged import (
+    RaggedBatcher,
+    RaggedExecutor,
+    RaggedRuntime,
+    RaggedView,
+    ragged_enabled,
+)
 from .staleness import StalenessPolicy
 
 __all__ = [
@@ -72,7 +85,12 @@ __all__ = [
     "CreditPolicy",
     "DurabilityConfig",
     "ForensicsConfig",
+    "RaggedBatcher",
+    "RaggedExecutor",
+    "RaggedRuntime",
+    "RaggedView",
     "RetryPolicy",
+    "ragged_enabled",
     "ServingClient",
     "ServingFrontend",
     "StalenessPolicy",

@@ -21,7 +21,7 @@ the aggregate; ``δ = 0`` rows are bit-identical (weight exactly 1.0).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -63,17 +63,20 @@ class Cohort:
 def build_cohort(
     submissions: Sequence[Submission],
     server_round: int,
-    ladder: BucketLadder,
+    ladder: Optional[BucketLadder],
     staleness: StalenessPolicy,
     *,
     tenant: str = "",
 ) -> Cohort:
     """Pad one round's submissions into the smallest bucket that holds
     them, stamping per-row staleness discounts against ``server_round``.
-    ``tenant`` (optional) attributes the telemetry span to the owning
-    tenant's trace row."""
+    ``ladder=None`` packs the cohort at its EXACT size (``bucket ==
+    m``) — the ragged door's layout, where the compiled shape lives in
+    the flat batch (``serving.ragged``), not in this cohort. ``tenant``
+    (optional) attributes the telemetry span to the owning tenant's
+    trace row."""
     m = len(submissions)
-    bucket = ladder.bucket_for(m)
+    bucket = m if ladder is None else ladder.bucket_for(m)
     with obs_tracing.span(
         "serving.bucket_pad",
         track=f"tenant:{tenant}" if tenant else None,

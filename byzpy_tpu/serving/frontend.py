@@ -66,6 +66,7 @@ from ..resilience.retry import RetryPolicy, connect_with_retry, retry_async
 from ..utils.checkpoint import CheckpointNotFoundError
 from .buckets import BucketLadder
 from .cohort import Cohort, CohortAggregator, build_cohort
+from .ragged import RaggedRuntime, RaggedView, ragged_enabled
 from .credits import (
     ACCEPTED,
     REJECTED_FULL,
@@ -395,6 +396,14 @@ class ServingFrontend:
             self._tenants[cfg.name] = _Tenant(cfg, clock=clock)
         self._clock = clock
         self._on_round = on_round
+        #: the ragged dispatch plane (``serving.ragged``): grouped
+        #: one-compile-per-tenant executors + the cross-tenant batcher.
+        #: ``BYZPY_TPU_RAGGED=0`` (read HERE, at construction) keeps
+        #: every tenant on the bucket ladder; tenants whose aggregator
+        #: has no masked program fall back to the ladder automatically.
+        self._ragged: Optional[RaggedRuntime] = (
+            RaggedRuntime(tenants) if ragged_enabled() else None
+        )
         self._device_lock: Optional[asyncio.Lock] = None
         self._tasks: list = []
         self._server: Optional[asyncio.AbstractServer] = None
@@ -809,6 +818,8 @@ class ServingFrontend:
             return
         self._running = True
         self._device_lock = asyncio.Lock()
+        if self._ragged is not None:
+            await self._ragged.start(self._device_lock)
         self._tasks = [
             asyncio.create_task(
                 self._tenant_loop(t), name=f"serving-{name}"
@@ -828,6 +839,8 @@ class ServingFrontend:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
         self._tasks = []
+        if self._ragged is not None:
+            await self._ragged.close()
         if self._server is not None:
             self._server.close()
             # drop live ingress connections too: a closed frontend must
@@ -931,13 +944,21 @@ class ServingFrontend:
         return closed
 
     def _forensics_prepare(
-        self, t: _Tenant, cohort: Cohort, vec: Any, subs: Sequence[Submission]
+        self,
+        t: _Tenant,
+        cohort: Cohort,
+        vec: Any,
+        subs: Sequence[Submission],
+        precomputed: Optional[dict] = None,
     ) -> Optional[dict]:
         """The plane's HEAVY stage (features + the aggregator's score
         view) for one closed round — pure, so the async scheduler runs
         it on the fold executor, off the event loop (the O(m²·d) Krum
         score pass must not stall ingress any more than the fold
-        itself would). Returns None on failure (counted)."""
+        itself would). On the ragged path ``precomputed`` carries the
+        score view that rode the aggregation kernel
+        (``RaggedView.precomputed``) and the host score pass is
+        skipped entirely. Returns None on failure (counted)."""
         assert t.forensics is not None
         try:
             deltas = (
@@ -955,6 +976,7 @@ class ServingFrontend:
                 weights=cohort.weights,
                 deltas=deltas,
                 bucket=cohort.bucket,
+                precomputed=precomputed,
             )
         except Exception:  # noqa: BLE001 — attribution is an observer,
             # not a round participant
@@ -1048,6 +1070,9 @@ class ServingFrontend:
 
     async def _tenant_loop(self, t: _Tenant) -> None:
         loop = asyncio.get_running_loop()
+        ragged_served = (
+            self._ragged is not None and self._ragged.serves(t.cfg.name)
+        )
         # adopt anything a prior synchronous round closer parked in
         # t.held (sequential sync -> async handover): those rows were
         # admitted and count in `outstanding`, so abandoning them would
@@ -1075,12 +1100,48 @@ class ServingFrontend:
                     "serving.cohort_close", track=track,
                     round=t.round_id, m=len(subs),
                 ):
+                    # ragged tenants pack at the EXACT cohort size (the
+                    # compiled shape lives in the flat batch); ladder
+                    # tenants pad to their bucket as before
                     cohort = build_cohort(
-                        subs, t.round_id, t.ladder, t.cfg.staleness,
-                        tenant=t.cfg.name,
+                        subs, t.round_id,
+                        None if ragged_served else t.ladder,
+                        t.cfg.staleness, tenant=t.cfg.name,
                     )
                 round_span.set(bucket=cohort.bucket)
                 assert self._device_lock is not None
+
+                if ragged_served:
+                    assert self._ragged is not None
+                    try:
+                        # ONE awaited hop: the batcher's dispatch thread
+                        # gates finiteness, runs the ragged program (or
+                        # the exact fallback for a non-finite cohort),
+                        # and coalesces other tenants' pending cohorts
+                        # into the same device call
+                        view = await self._ragged.aggregate_async(
+                            t.cfg.name, cohort, t.executor
+                        )
+                        prep = None
+                        if t.forensics is not None:
+                            # host features still run off-loop; the
+                            # O(m²·d) score pass rode the kernel
+                            prep = await loop.run_in_executor(
+                                None,
+                                lambda v=view, c=cohort, s=subs:
+                                self._forensics_prepare(
+                                    t, c, v.vector, s,
+                                    precomputed=v.precomputed(),
+                                ),
+                            )
+                    except Exception:  # noqa: BLE001 — poisoned
+                        # batch/round: drop it, keep serving
+                        self._fail_round(t, cohort, subs)
+                        continue
+                    self._finish_round(
+                        t, cohort, view.vector, subs, prep
+                    )
+                    continue
 
                 def fold_and_prepare(subs=subs, cohort=cohort):
                     # device work AND the forensics heavy stage (the
@@ -1152,6 +1213,9 @@ class ServingFrontend:
         if len(t.held) < t.min_cohort:
             return None
         subs, t.held = t.held, []
+        ragged_served = (
+            self._ragged is not None and self._ragged.serves(t.cfg.name)
+        )
         track = f"tenant:{t.cfg.name}"
         with obs_tracing.span(
             "serving.round", track=track, tenant=t.cfg.name,
@@ -1162,15 +1226,34 @@ class ServingFrontend:
                 round=t.round_id, m=len(subs),
             ):
                 cohort = build_cohort(
-                    subs, t.round_id, t.ladder, t.cfg.staleness,
-                    tenant=t.cfg.name,
+                    subs, t.round_id,
+                    None if ragged_served else t.ladder,
+                    t.cfg.staleness, tenant=t.cfg.name,
                 )
             try:
-                vec = t.executor.aggregate(cohort)
+                view: Optional[RaggedView] = None
+                if ragged_served and bool(np.isfinite(cohort.matrix).all()):
+                    assert self._ragged is not None
+                    view = self._ragged.aggregate_sync(t.cfg.name, cohort)
+                if view is not None:
+                    vec = view.vector
+                    prep = (
+                        self._forensics_prepare(
+                            t, cohort, vec, subs,
+                            precomputed=view.precomputed(),
+                        )
+                        if t.forensics is not None
+                        else None
+                    )
+                else:
+                    vec = t.executor.aggregate(cohort)
+                    prep = None
             except Exception:  # noqa: BLE001 — same contract as the scheduler
                 self._fail_round(t, cohort, subs)
                 return None
-            return self._finish_round(t, cohort, vec, subs), cohort, vec
+            return (
+                self._finish_round(t, cohort, vec, subs, prep), cohort, vec
+            )
 
     def public_state(self, tenant: str) -> Any:
         """The tenant's public per-round feed, as any client —
@@ -1314,6 +1397,15 @@ class ServingFrontend:
         """Current server round of ``tenant``."""
         return self._tenants[tenant].round_id
 
+    def reset_round_stats(self) -> None:
+        """Zero every tenant's round-latency/cohort statistics window —
+        the warmup→measure boundary for benchmarks (compile-round
+        latencies must not pollute the measured p99). Accounting state
+        (ledgers, round counters, ingress bytes, dedup tables) is
+        untouched."""
+        for t in self._tenants.values():
+            t.stats = RoundStats()
+
     def last_aggregate(self, tenant: str) -> Any:
         """Most recent round's aggregated vector (None before round 0)."""
         return self._tenants[tenant].last_aggregate
@@ -1367,6 +1459,12 @@ class ServingFrontend:
                 if t.recovered is not None
                 else None
             ),
+            # which door serves this tenant's rounds (False = bucket
+            # ladder: ragged disabled, or no masked program)
+            "ragged_served": (
+                self._ragged is not None
+                and self._ragged.serves(t.cfg.name)
+            ),
             # FRONTEND-GLOBAL counters (not per-tenant — a forged frame
             # names no trustable tenant): nested so a dashboard summing
             # tenant blocks doesn't double-count them
@@ -1374,6 +1472,13 @@ class ServingFrontend:
                 "bad_frames": self.bad_frames,
                 "malformed_requests": self.malformed_requests,
                 "callback_errors": self.callback_errors,
+                # ragged dispatch accounting (None = escape hatch on):
+                # groups/executors, device calls, batch coalescing
+                "ragged": (
+                    self._ragged.snapshot()
+                    if self._ragged is not None
+                    else None
+                ),
             },
         }
 

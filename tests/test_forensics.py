@@ -773,9 +773,14 @@ class TestObservabilitySurfaces:
         )
         assert counter.value == 1
 
-    def test_serving_compile_site_counts(self, tmp_path):
+    def test_serving_compile_site_counts(self, tmp_path, monkeypatch):
         from byzpy_tpu.observability import jitstats
 
+        # the bucket-ladder door (escape hatch): since PR 11 the default
+        # path is the ragged dispatcher, whose own compile site is
+        # pinned in tests/test_ragged.py — this pin keeps the masked-
+        # aggregate site honest for ladder-served tenants
+        monkeypatch.setenv("BYZPY_TPU_RAGGED", "0")
         fe, _ = _abused_frontend(tmp_path)
         asyncio.run(fe.close())
         # the masked-aggregate cache was observed (one bucket compiled)
