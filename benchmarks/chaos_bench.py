@@ -716,6 +716,137 @@ def run_ragged(args, out) -> dict:
     return row
 
 
+def run_shard(args, out) -> dict:
+    """Sharded-tier cell (ISSUE 12): (1) hierarchical-fold BIT PARITY —
+    the same deterministic client population served by a 2-shard
+    :class:`~byzpy_tpu.serving.ShardedCoordinator` and by ONE
+    :class:`~byzpy_tpu.serving.ServingFrontend` fed the concatenated
+    (shard-order) cohorts must produce digest-identical aggregates
+    every round; (2) the compromised-shard adversary — a Byzantine
+    shard forging its PartialFold (rows tampered after the digest, a
+    ghost-client claim, poisoned extras) must be flagged by the root's
+    evidence-digest cross-check every round it forges, with the merged
+    aggregate bit-identical to the honest-shards-only reference.
+    Asserted unconditionally (a parity or detection break must never
+    ride a green wall)."""
+    from byzpy_tpu.aggregators import MultiKrum
+    from byzpy_tpu.chaos.shards import CompromisedShard
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.serving import (
+        ServingFrontend,
+        ShardedCoordinator,
+        TenantConfig,
+    )
+    from byzpy_tpu.serving.sharded import shard_for
+    from byzpy_tpu.serving.staleness import StalenessPolicy
+
+    dim = args.dim
+    rounds = max(4, args.rounds // 4)
+    n_clients = max(8, args.clients_grid)
+    rng = np.random.default_rng(args.seed)
+    clients = [f"c{i:04d}" for i in range(n_clients)]
+    grads = {c: rng.normal(size=dim).astype(np.float32) for c in clients}
+
+    def mk_tenants():
+        return [
+            TenantConfig(
+                name="m0",
+                aggregator=MultiKrum(f=args.byzantine, q=args.byzantine + 1),
+                dim=dim,
+                cohort_cap=max(n_clients, 8),
+                staleness=StalenessPolicy(
+                    kind="exponential", gamma=0.5, cutoff=8
+                ),
+            )
+        ]
+
+    # -- parity cell: 2 shards vs one frontend, digest equality ----------
+    n_shards = 2
+    co = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
+    fe = ServingFrontend(mk_tenants())
+    order = [
+        c
+        for s in range(n_shards)
+        for c in clients
+        if shard_for(c, n_shards) == s
+    ]
+    parity_digests = []
+    for r in range(rounds):
+        for c in clients:
+            ok, reason = co.submit("m0", c, r, grads[c], seq=r)
+            assert ok, (c, reason)
+        res = co.close_round_nowait("m0")
+        assert res is not None
+        for c in order:
+            ok, reason = fe.submit("m0", c, r, grads[c], seq=r)
+            assert ok, (c, reason)
+        ref = fe.close_round_nowait("m0")
+        assert ref is not None
+        sharded_digest = evidence_digest(res[2])
+        single_digest = evidence_digest(ref[2])
+        parity_digests.append(
+            {"round": r, "sharded": sharded_digest, "single": single_digest}
+        )
+        assert sharded_digest == single_digest, (
+            f"hierarchical fold diverged at round {r}: "
+            f"{sharded_digest} != {single_digest}"
+        )
+
+    # -- compromised-shard cells: each forgery mode vs the root ----------
+    forge_rows = {}
+    for mode in ("bitflip", "ghost_clients", "extras"):
+        n3 = 3
+        co3 = ShardedCoordinator(
+            mk_tenants(), n3, quorum=1, extras_policy="verify"
+        )
+        byz = 2
+        co3.shards[byz] = CompromisedShard(
+            co3.shards[byz], mode=mode, seed=args.seed, n_shards=n3
+        )
+        honest_clients = [c for c in clients if shard_for(c, n3) != byz]
+        ref_co = ShardedCoordinator(mk_tenants(), n3, quorum=1)
+        for r in range(rounds):
+            for c in clients:
+                ok, _ = co3.submit("m0", c, r, grads[c], seq=r)
+                assert ok
+            for c in honest_clients:
+                ok, _ = ref_co.submit("m0", c, r, grads[c], seq=r)
+                assert ok
+            res = co3.close_round_nowait("m0")
+            ref = ref_co.close_round_nowait("m0")
+            assert res is not None and ref is not None
+            # the forged partial was excluded: the merged aggregate is
+            # bit-identical to the honest-shards-only deployment
+            assert np.array_equal(res[2], ref[2]), (mode, r)
+        detected = co3.stats()["root"]["m0"]["forged_partials"]
+        events = [
+            e for e in co3.shard_events if e["event"] == "shard_forged"
+        ]
+        assert detected == rounds, (mode, detected, rounds)
+        assert len(events) == rounds and all(
+            e["shard"] == byz for e in events
+        ), mode
+        forge_rows[mode] = {
+            "rounds": rounds,
+            "forged_detected": detected,
+            "evidence_events": len(events),
+            "aggregate_parity_vs_honest_only": "bit-identical",
+        }
+
+    row = {
+        "lane": "shard",
+        "aggregator": "multi-krum",
+        "clients": n_clients,
+        "shards_parity_cell": n_shards,
+        "rounds": rounds,
+        "parity": "bit-identical",
+        "parity_digest_last": parity_digests[-1]["sharded"],
+        "forgery": forge_rows,
+    }
+    _emit(row, out)
+    return row
+
+
 def run_swarm(args, out) -> dict:
     scenario = Scenario(
         name="swarm",
@@ -827,7 +958,7 @@ def main() -> None:
     )
     ap.add_argument(
         "--lanes", type=str,
-        default="grid,adaptive,serving,swarm,recovery,forensics,ragged",
+        default="grid,adaptive,serving,swarm,recovery,forensics,ragged,shard",
         help="comma-separated lane subset",
     )
     ap.add_argument("--out", type=str, default=None)
@@ -873,6 +1004,7 @@ def main() -> None:
     recovery = run_recovery(args, args.out) if "recovery" in lanes else None
     forensics = run_forensics(args, args.out) if "forensics" in lanes else None
     ragged = run_ragged(args, args.out) if "ragged" in lanes else None
+    shard = run_shard(args, args.out) if "shard" in lanes else None
 
     crashed = [r for r in grid if r.get("harness_crashed")]
     headline = {
@@ -906,6 +1038,11 @@ def main() -> None:
         "ragged_door_digest_match": (
             ragged["digest_match"] if ragged else None
         ),
+        "shard_forged_detected": (
+            {k: v["forged_detected"] for k, v in shard["forgery"].items()}
+            if shard
+            else None
+        ),
     }
     _emit(headline, args.out)
 
@@ -937,6 +1074,14 @@ def main() -> None:
         assert d1 == d2, "chaos cell not replayable"
     if args.smoke and swarm is not None:
         assert swarm["rounds"] > 0 and swarm["submissions"] > 0
+    if args.smoke and shard is not None:
+        # run_shard asserts parity + detection internally; pin the
+        # headline shape so a silently-skipped lane can't look green
+        assert shard["parity"] == "bit-identical", shard
+        assert all(
+            v["forged_detected"] == v["rounds"]
+            for v in shard["forgery"].values()
+        ), shard
     if args.smoke and forensics is not None:
         assert forensics["adaptive_all_flagged"], forensics
         assert forensics["adaptive_within_budget"], forensics

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import gc
 import json
 import os
 import sys
@@ -576,6 +577,386 @@ def _run_ragged(args, refs) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# scale lane (ISSUE 12: sharded frontend tier toward million-client serving)
+# ---------------------------------------------------------------------------
+
+
+def _scale_tenant(args, agg) -> "TenantConfig":
+    from byzpy_tpu.serving.credits import CreditPolicy
+
+    return TenantConfig(
+        name="scale",
+        aggregator=agg,
+        dim=args.scale_dim,
+        cohort_cap=args.scale_round_submissions,
+        queue_capacity=args.scale_round_submissions + 16,
+        # the lane measures the tier, not the rate limiter: rate <= 0
+        # disables credit spending; the tracked-client bound must hold
+        # the whole identity space so (client, seq) dedup stays exact
+        credit=CreditPolicy(
+            rate_per_s=0.0,
+            burst=1e9,
+            max_tracked_clients=max(65536, args.scale_clients + 1),
+        ),
+        staleness=StalenessPolicy(kind="exponential", gamma=0.5, cutoff=16),
+    )
+
+
+def _drive_shard_partition(
+    co, shard_idx, clients, grads, bodies, r
+) -> tuple:
+    """Drive one shard's client partition through the per-submission
+    work a shard ingress pays — ONE wire-frame decode (the PR-6
+    frontend's dominant cost and the reason a single process tops out
+    near 10k/sec) plus the full admission plane — timed in isolation:
+    shards share no state, so the serially-measured leg equals what a
+    dedicated shard process would measure."""
+    shard_clients = clients[shard_idx]
+    t0 = time.monotonic()
+    accepted = 0
+    for j, c in enumerate(shard_clients):
+        req = wire.decode(bodies[j % len(bodies)])
+        ok, _reason = co.submit(
+            "scale", c, r, req["gradient"], seq=r
+        )
+        accepted += ok
+    return accepted, time.monotonic() - t0
+
+
+def _run_scale(args) -> dict:
+    """Sharded-tier scaling: the SAME per-round submission load (drawn
+    from ``--scale-clients`` distinct identities) through 1, 2 and 4
+    frontend shards. Per-shard admission legs are measured in isolation
+    and combined as the parallel makespan ``max(shard legs) + root
+    merge`` — on a multi-core host the legs genuinely overlap (each
+    shard is its own process with its own queue and ledgers; nothing is
+    shared until the PartialFold hits the root), so the makespan is the
+    tier's round time; the row carries ``timing_model`` naming the
+    measurement honestly, plus the serial wall-clock actually spent.
+    Per round, the hierarchical fold's BIT PARITY vs the exact
+    unsharded aggregate of the same merged cohort is asserted, and one
+    round's PartialFold frames are measured against the
+    ``parallel.comms.sharded_round_wire_bytes`` law (< 2%)."""
+    from byzpy_tpu.parallel.comms import (
+        partial_fold_bytes,
+        sharded_round_wire_bytes,
+    )
+    from byzpy_tpu.serving import ShardedCoordinator
+    from byzpy_tpu.serving.sharded import encode_partial_fold, shard_for
+
+    from byzpy_tpu.aggregators import ComparativeGradientElimination
+
+    rng = np.random.default_rng(7)
+    d = args.scale_dim
+    per_round = args.scale_round_submissions
+    grads = [rng.normal(size=d).astype(np.float32) for _ in range(64)]
+    # pre-encoded representative submit frames: the timed leg decodes
+    # one per submission (the ingress cost), encoding is the client's
+    bodies = [
+        wire.encode(
+            {
+                "kind": "submit", "tenant": "scale", "client": "c000000",
+                "round": 0, "gradient": g, "seq": 0,
+            }
+        )[4:]
+        for g in grads
+    ]
+    identity = [f"c{i:06d}" for i in range(args.scale_clients)]
+    results = {}
+    for n_shards in args.scale_shards:
+        agg = ComparativeGradientElimination(f=args.byzantine)
+        ref_agg = ComparativeGradientElimination(f=args.byzantine)
+        co = ShardedCoordinator(
+            [_scale_tenant(args, agg)], n_shards, quorum=1
+        )
+        # rotate a per-round window of the identity space, partitioned
+        # by the router's sticky hash (what a deployment's load looks
+        # like: every identity exists, a slice is active per round)
+        wire_row = None
+        per_round_leg = []
+        per_round_merge = []
+        total_accepted = 0
+        wall0 = time.monotonic()
+        for r in range(args.scale_rounds + 1):
+            warmup = r == 0
+            lo = (r * per_round) % max(1, args.scale_clients - per_round + 1)
+            window = identity[lo: lo + per_round]
+            partition = [
+                [c for c in window if shard_for(c, n_shards) == s]
+                for s in range(n_shards)
+            ]
+            legs = []
+            partials = []
+            # gc hygiene: a collection landing inside ONE serially-
+            # measured leg would charge that shard's wall for garbage
+            # the whole process produced — real shard processes don't
+            # share a collector. Collect between rounds instead.
+            gc.collect()
+            gc.disable()
+            try:
+                for s in range(n_shards):
+                    # a shard's round work = its ingress leg + its own
+                    # close (drain, cohort build, partial extraction,
+                    # digest) — all of it runs on the shard process
+                    accepted, leg_s = _drive_shard_partition(
+                        co, s, partition, grads, bodies, r
+                    )
+                    t0 = time.monotonic()
+                    p = co.shards[s].close_partial("scale")
+                    leg_s += time.monotonic() - t0
+                    if p is not None:
+                        partials.append(p)
+                    if not warmup:
+                        total_accepted += accepted
+                    legs.append(leg_s)
+            finally:
+                gc.enable()
+            if warmup:
+                # round 0 is the warmup boundary: the merged masked
+                # program compiles here, and the frame-law pin measures
+                # one round's shard->root partials against the law
+                measured = sum(
+                    len(encode_partial_fold(p)) for p in partials
+                )
+                law = sum(
+                    partial_fold_bytes(
+                        p.m, d, client_id_bytes=7,
+                        extras_bytes=p.m * 4,  # CGE norms
+                    )
+                    for p in partials
+                )
+                round_law = sharded_round_wire_bytes(
+                    n_shards, sum(p.m for p in partials), d,
+                    client_id_bytes=7,
+                    extras_bytes_per_shard=(
+                        sum(p.m for p in partials) / max(n_shards, 1) * 4
+                    ),
+                )
+                wire_row = {
+                    "partial_frames_measured_bytes": measured,
+                    "partial_frames_law_bytes": round(law, 1),
+                    "partial_law_error": round(
+                        abs(measured - law) / measured, 4
+                    ),
+                    "round_law_bytes": round(round_law, 1),
+                }
+            # the ROOT's work: verify + hierarchical merge + finalize +
+            # confirm/broadcast — merge_partials is the exact door a
+            # remote root runs on decoded wire frames
+            t_merge0 = time.monotonic()
+            res = co.merge_partials("scale", partials)
+            merge_s = time.monotonic() - t_merge0
+            assert res is not None, (n_shards, r)
+            _closed, merged_rows, vec = res
+            if warmup:
+                continue
+            # bit-parity pin: the hierarchical fold vs the exact
+            # unsharded aggregate of the same merged cohort, every round
+            ref = np.asarray(
+                ref_agg.aggregate(
+                    [merged_rows[i] for i in range(merged_rows.shape[0])]
+                )
+            )
+            assert np.array_equal(np.asarray(vec), ref), (
+                f"hierarchical fold diverged at {n_shards} shards round {r}"
+            )
+            per_round_merge.append(merge_s)
+            per_round_leg.append(max(legs))
+        wall = time.monotonic() - wall0
+        st = co.stats()["root"]["scale"]
+        # steady-state throughput: shard admission (the next window) and
+        # the root's merge run in DIFFERENT processes, so a pipelined
+        # deployment's round period is max(slowest leg, merge); round
+        # LATENCY (p99 below) still pays leg + merge end to end
+        per_round_period = [
+            max(leg, m)
+            for leg, m in zip(per_round_leg, per_round_merge, strict=True)
+        ]
+        per_round_latency = [
+            leg + m
+            for leg, m in zip(per_round_leg, per_round_merge, strict=True)
+        ]
+        # throughput from the MEDIAN round period: a single-core host
+        # running every shard's leg serially eats occasional scheduler/
+        # GC spikes that a dedicated shard process would not share; the
+        # p99 latency below keeps every spike (bounded-p99 evidence)
+        period_median = float(np.median(per_round_period))
+        accepted_per_round = total_accepted / max(1, len(per_round_period))
+        results[n_shards] = {
+            "accepted": total_accepted,
+            "period_median_ms": round(1e3 * period_median, 2),
+            "period_total_s": round(float(np.sum(per_round_period)), 3),
+            "accepted_per_sec": round(accepted_per_round / period_median, 1),
+            "serial_wall_s": round(wall, 3),
+            "p99_round_latency_ms": round(
+                1e3 * float(np.percentile(per_round_latency, 99)), 2
+            ),
+            "mean_leg_ms": round(1e3 * float(np.mean(per_round_leg)), 2),
+            "mean_merge_ms": round(
+                1e3 * float(np.mean(per_round_merge)), 2
+            ),
+            "rounds": st["rounds"] - 1,  # warmup excluded
+            "mean_cohort": st["mean_cohort"],
+            "failed_rounds": st["failed_rounds"],
+            "forged_partials": st["forged_partials"],
+            "wire": wire_row,
+        }
+    base = results[args.scale_shards[0]]["accepted_per_sec"]
+    speedups = {
+        n: round(results[n]["accepted_per_sec"] / base, 2)
+        for n in args.scale_shards
+    }
+    row = {
+        "lane": "scale",
+        "clients": args.scale_clients,
+        "dim": d,
+        "round_submissions": per_round,
+        "rounds": args.scale_rounds,
+        "aggregator": f"cge-f{args.byzantine}",
+        "timing_model": (
+            "per-shard ingress legs (frame decode + full admission) "
+            "measured in isolation — shards share no state, so the "
+            "serial leg equals a dedicated shard process's; round "
+            "period = max(slowest leg, root merge) (admission of the "
+            "next window pipelines with the root's merge across "
+            "processes), round latency = slowest leg + merge; "
+            "serial_wall_s is the single-core wall clock actually spent"
+        ),
+        "shards": results,
+        "speedup_vs_1shard": speedups,
+        "parity": "bit-identical",
+    }
+    return row
+
+
+class _DieBeforeConfirm:
+    """Failover-drill shard wrapper: ships its partial, then 'dies'
+    before the root's confirmation lands — the ambiguous window whose
+    exactly-once resolution is the root dedup table's whole job."""
+
+    def __init__(self, shard):
+        self._shard = shard
+
+    def __getattr__(self, name):
+        return getattr(self._shard, name)
+
+    def confirm(self, *a, **k):
+        # the confirmation is lost: no WAL round record is written, so
+        # recovery will replay these accepts as pending
+        self._shard._inflight.clear()
+
+
+def _run_failover(args) -> dict:
+    """Shard failover drill over ``--failover-seeds`` seeds: (a) kill a
+    shard mid-round (in-memory state discarded, WAL kept), assert the
+    round still closes as a QUORUM close; (b) recover the shard from
+    its WAL alone and fold its replayed pending rows; (c) the ambiguous
+    ship-folded-but-unconfirmed window (``_DieBeforeConfirm``): the
+    recovered shard re-ships rows the root already folded and the root
+    dedup drops them as ``root_duplicate``. Every seed's WALs are then
+    audited by ``audit_sharded_exactly_once`` — the acceptance bar is
+    ZERO invariant violations across all seeds."""
+    import tempfile
+
+    from byzpy_tpu.resilience.durable import DurabilityConfig
+    from byzpy_tpu.serving import ShardedCoordinator
+    from byzpy_tpu.serving.sharded import (
+        audit_sharded_exactly_once,
+        shard_for,
+    )
+
+    n_shards = 2
+    dim = 64
+    n_clients = 40
+    violations = 0
+    quorum_closes = 0
+    root_dups = 0
+    replayed = 0
+    for seed in range(args.failover_seeds):
+        rng = np.random.default_rng(1000 + seed)
+        clients = [f"c{i:04d}" for i in range(n_clients)]
+        grads = {
+            c: rng.normal(size=dim).astype(np.float32) for c in clients
+        }
+        seqs = dict.fromkeys(clients, 0)
+
+        def submit_all(co, r, only_shard=None, expect_down=None):
+            count = 0
+            for c in clients:
+                home = shard_for(c, n_shards)
+                if only_shard is not None and home != only_shard:
+                    continue
+                ok, reason = co.submit(
+                    "m0", c, r, grads[c], seq=seqs[c]
+                )
+                if expect_down is not None and home == expect_down:
+                    assert not ok and reason == "rejected_shard_down"
+                    continue
+                assert ok, (c, reason)
+                seqs[c] += 1
+                count += 1
+            return count
+
+        with tempfile.TemporaryDirectory() as tmp:
+            agg = CoordinateWiseTrimmedMean(f=2)
+            co = ShardedCoordinator(
+                [
+                    TenantConfig(
+                        name="m0", aggregator=agg, dim=dim,
+                        cohort_cap=n_clients,
+                        staleness=StalenessPolicy(
+                            kind="exponential", gamma=0.5, cutoff=8
+                        ),
+                    )
+                ],
+                n_shards,
+                quorum=1,
+                durability=DurabilityConfig(directory=tmp),
+            )
+            for r in range(2):
+                submit_all(co, r)
+                assert co.close_round_nowait("m0") is not None
+            # (c) ambiguous window: shard 1 ships + root folds, but the
+            # confirmation is lost before the shard records it
+            co.shards[1] = _DieBeforeConfirm(co.shards[1])
+            submit_all(co, 2)
+            assert co.close_round_nowait("m0") is not None
+            # (a) the shard is now dead mid-deployment: in-memory state
+            # gone, only its WAL survives; the next round must still
+            # close (quorum=1) as a degraded quorum close
+            co.shards[1] = co.shards[1]._shard
+            co.kill_shard(1)
+            submit_all(co, 3, expect_down=1)
+            res = co.close_round_nowait("m0")
+            assert res is not None, "quorum close failed"
+            # (b) WAL-only recovery: the unconfirmed round-2 accepts
+            # replay as pending; the root dedup must drop every one
+            # (they already folded) — exactly once, never twice
+            shard = co.recover_shard(1)
+            pending = shard.frontend.stats()["m0"]["queue_depth"]
+            replayed += pending
+            submit_all(co, 4, only_shard=0)
+            res = co.close_round_nowait("m0")
+            assert res is not None
+            st = co.stats()["root"]["m0"]
+            quorum_closes += st["quorum_closes"]
+            root_dups += st["root_duplicates"]
+            audit = audit_sharded_exactly_once(tmp, "m0", n_shards)
+            violations += len(audit["violations"])
+            assert not audit["violations"], audit["violations"]
+    return {
+        "lane": "shard_failover",
+        "seeds": args.failover_seeds,
+        "shards": n_shards,
+        "clients": n_clients,
+        "quorum_closes": quorum_closes,
+        "wal_replayed_pending": replayed,
+        "root_duplicates_dropped": root_dups,
+        "invariant_violations": violations,
+    }
+
+
+# ---------------------------------------------------------------------------
 # wire accounting lane
 # ---------------------------------------------------------------------------
 
@@ -638,11 +1019,19 @@ def main() -> None:
     ap.add_argument("--burst", type=float, default=40.0)
     ap.add_argument("--byzantine", type=int, default=2)
     ap.add_argument("--bucket-rounds", type=int, default=36)
+    ap.add_argument("--scale-clients", type=int, default=100_000,
+                    help="distinct client identities in the scale lane")
+    ap.add_argument("--scale-round-submissions", type=int, default=20_000,
+                    help="submissions per round (rotating identity window)")
+    ap.add_argument("--scale-rounds", type=int, default=6)
+    ap.add_argument("--scale-dim", type=int, default=256)
+    ap.add_argument("--failover-seeds", type=int, default=10)
     ap.add_argument("--out", type=str, default=None)
     ap.add_argument("--smoke", action="store_true",
                     help="small CI run with contract assertions")
     args = ap.parse_args()
 
+    args.scale_shards = (1, 2, 4)
     if args.smoke:
         args.clients = 300
         args.dim = 512
@@ -650,6 +1039,12 @@ def main() -> None:
         args.cohort_cap = 32
         args.queue_capacity = 256
         args.bucket_rounds = 10
+        args.scale_clients = 2000
+        args.scale_round_submissions = 600
+        args.scale_rounds = 5
+        args.scale_dim = 64
+        args.scale_shards = (1, 2)
+        args.failover_seeds = 3
 
     meta = {
         "lane": "meta",
@@ -723,6 +1118,12 @@ def main() -> None:
     wire_row = _run_wire(args)
     _emit(wire_row, args.out)
 
+    scale = _run_scale(args)
+    _emit(scale, args.out)
+
+    failover = _run_failover(args)
+    _emit(failover, args.out)
+
     headline = {
         "lane": "headline",
         "metric": "serving_submissions_per_sec",
@@ -757,6 +1158,19 @@ def main() -> None:
             k: v["compile_entries"]
             for k, v in ragged_row["results"].items()
         },
+        "sharded_accepted_per_sec": {
+            str(n): scale["shards"][n]["accepted_per_sec"]
+            for n in args.scale_shards
+        },
+        "sharded_speedup": {
+            str(n): scale["speedup_vs_1shard"][n]
+            for n in args.scale_shards
+        },
+        "sharded_p99_round_latency_ms": {
+            str(n): scale["shards"][n]["p99_round_latency_ms"]
+            for n in args.scale_shards
+        },
+        "failover_invariant_violations": failover["invariant_violations"],
     }
     _emit(headline, args.out)
 
@@ -778,6 +1192,21 @@ def main() -> None:
         assert swarm_mod["ragged_dispatch"]["max_batch"] >= 2, (
             swarm_mod["ragged_dispatch"]
         )
+        # sharded tier: hierarchical-fold bit parity was asserted per
+        # round inside the lane; the 2-shard makespan speedup must be
+        # near-linear (full-scale bar: >=1.7x at 2, >=3x at 4) and the
+        # partial-fold frame law within tolerance
+        assert scale["parity"] == "bit-identical"
+        assert scale["speedup_vs_1shard"][2] >= 1.4, scale["speedup_vs_1shard"]
+        for n in args.scale_shards:
+            w = scale["shards"][n]["wire"]
+            assert w["partial_law_error"] < 0.02, w
+            assert scale["shards"][n]["failed_rounds"] == 0
+        # failover drill: quorum close under a killed shard + WAL
+        # replay preserved exactly-once folding on every seed
+        assert failover["invariant_violations"] == 0, failover
+        assert failover["quorum_closes"] >= args.failover_seeds, failover
+        assert failover["root_duplicates_dropped"] > 0, failover
         print("serving smoke OK")
 
 

@@ -437,6 +437,169 @@ class Aggregator(Operator, ABC):
 
         return generic
 
+    # -- hierarchical partial folds (sharded serving tier) -----------------
+
+    #: Every aggregator can serve the hierarchical two-level fold
+    #: (``serving.sharded``): the default partial carries one shard's
+    #: compacted, staleness-discounted rows and the merged finalize runs
+    #: the SAME masked door the single frontend uses — bit-identical to
+    #: the single-frontend aggregate by the masked-finalize contract.
+    #: Streaming families additionally attach their sublinear fold
+    #: accumulators (:meth:`_partial_extras`): trimmed-mean running sum
+    #: + extreme buffers, Multi-Krum's local Gram block, CGE's squared
+    #: norms — merged exactly at the root (order-stat merge, cross-block
+    #: Gram assembly, concatenation) and reused for the root's
+    #: forensics score view (:meth:`merged_score_view`) and the
+    #: compromised-shard consistency cross-check (extras are
+    #: deterministic functions of the rows they summarize).
+    @property
+    def supports_fold_merge(self) -> bool:
+        """Whether :meth:`fold_partial`/:meth:`fold_merge`/
+        :meth:`fold_merge_finalize` are available (always True: the
+        row-carrying default is universal — aggregators without a
+        masked program finalize through the exact-subset door)."""
+        return True
+
+    def fold_partial(
+        self, matrix: Any, valid: Any, weights: Any = None
+    ) -> dict:
+        """Extract one shard's wire-compact partial fold from its local
+        cohort: ``{"rows": (m, d) float32, "m": int[, "extras": ...]}``.
+
+        ``rows`` are the VALID rows of the padded ``matrix`` in
+        admission (slot) order, scaled by their staleness ``weights``
+        when any differ from 1.0 — elementwise, so scaling per shard is
+        bit-identical to scaling the concatenated cohort. ``extras``
+        (streaming families) are the sublinear fold accumulators
+        computed from those discounted rows."""
+        import numpy as np
+
+        valid_arr = np.asarray(valid, bool)
+        rows = np.ascontiguousarray(
+            np.asarray(matrix, np.float32)[valid_arr]
+        )
+        if weights is not None and rows.shape[0]:
+            w = np.asarray(weights, np.float32)[valid_arr]
+            if bool((w != 1.0).any()):
+                rows = rows * w[:, None]
+        partial: dict = {"rows": rows, "m": int(rows.shape[0])}
+        extras = self._partial_extras(rows)
+        if extras:
+            partial["extras"] = extras
+        return partial
+
+    def _partial_extras(self, rows: Any) -> dict:
+        """Family-specific sublinear fold accumulators over one shard's
+        discounted rows (empty for aggregators whose fold state is the
+        rows themselves). Must be a DETERMINISTIC function of ``rows``
+        — the sharded tier's root recomputes it to cross-check a
+        shard's claimed extras against the rows it shipped."""
+        return {}
+
+    def fold_merge(self, partials: Sequence[Mapping[str, Any]]) -> dict:
+        """Merge shard partials, IN SHARD ORDER, into one root fold
+        state: ``{"rows": (Σm, d), "m": int, "offsets": per-shard row
+        starts[, "extras": merged accumulators]}``. Row order is the
+        canonical sharded cohort order (shard index, then admission
+        order within the shard) — the order the single-frontend parity
+        reference uses."""
+        import numpy as np
+
+        mats = [np.asarray(p["rows"], np.float32) for p in partials]
+        if not mats:
+            raise ValueError("fold_merge needs at least one partial")
+        dims = {m.shape[1] for m in mats if m.ndim == 2}
+        if len(dims) > 1:
+            raise ValueError(
+                f"partials disagree on gradient dimension: {sorted(dims)}"
+            )
+        rows = np.concatenate(mats, axis=0)
+        offsets = np.cumsum([0] + [m.shape[0] for m in mats])[:-1]
+        merged: dict = {
+            "rows": rows,
+            "m": int(rows.shape[0]),
+            "offsets": [int(o) for o in offsets],
+        }
+        extras_list = [p.get("extras") for p in partials]
+        if any(e for e in extras_list):
+            merged["extras"] = self._merge_extras(extras_list, partials)
+        return merged
+
+    def _merge_extras(
+        self,
+        extras_list: Sequence[Optional[Mapping[str, Any]]],
+        partials: Sequence[Mapping[str, Any]],
+    ) -> dict:
+        """Merge the shards' sublinear accumulators (family-specific;
+        the base class carries none)."""
+        return {}
+
+    def fold_merge_finalize(
+        self, merged: Mapping[str, Any], *, bucket: Optional[int] = None
+    ) -> jnp.ndarray:
+        """Finalize a merged root fold to the ``(d,)`` aggregate —
+        BIT-IDENTICAL (f32, finite cohorts) to the single-frontend
+        aggregate of the concatenated cohort: the merged rows run
+        through the same :meth:`aggregate_masked` door (same masked
+        program, same jit cache, same exact-subset and non-finite
+        fallbacks) the one-frontend serving path uses. ``bucket``
+        (optional, ≥ the merged row count) zero-pads the merged matrix
+        to a ladder shape first, so a root serving many distinct merged
+        sizes keeps one compiled program per bucket instead of one per
+        size — exactness is the masked contract's padding invariance.
+
+        Merged cohorts reach 10⁴–10⁵ rows, so the host-side gates run
+        once over the COMPACT rows (the padding is zeros this method
+        wrote itself): one f64 sum screens finiteness in a single pass
+        (a sum stays finite iff every addend is — an inf never cancels
+        without producing NaN first), and the masked program is invoked
+        directly — the same per-aggregator jit cache and bit semantics
+        as :meth:`aggregate_masked`, minus its full padded-matrix
+        ``isfinite`` rescan."""
+        import numpy as np
+
+        rows = np.ascontiguousarray(np.asarray(merged["rows"], np.float32))
+        m = int(rows.shape[0])
+        if m == 0:
+            raise ValueError("fold_merge_finalize on an empty merge")
+        self.validate_n(m)
+        finite = bool(np.isfinite(rows.sum(dtype=np.float64)))
+        if not (self.supports_masked_finalize and finite):
+            # the exact compacted-subset path aggregate_masked would
+            # take for the same inputs (non-finite cohorts, families
+            # without a masked program)
+            return self.aggregate(list(rows))
+        if bucket is not None and bucket > m:
+            padded = np.zeros((bucket, rows.shape[1]), np.float32)
+            padded[:m] = rows
+            valid = np.zeros((bucket,), bool)
+            valid[:m] = True
+        else:
+            padded = rows
+            valid = np.ones((m,), bool)
+        return self._masked_jitted()(
+            jnp.asarray(padded), jnp.asarray(valid)
+        )
+
+    def merged_score_view(
+        self, merged: Mapping[str, Any], *, aggregate: Any = None
+    ) -> Optional[dict]:
+        """Per-row ``{"kind", "scores", "keep"}`` view of the MERGED
+        cohort for the root's forensics fan-out (sliced per shard and
+        fed to each shard plane as ``precomputed``), reusing the merged
+        extras where the family published them (Gram blocks, norms)
+        instead of paying the host score pass again. Falls back to
+        :meth:`round_evidence` on the merged rows. ``None`` when the
+        aggregator publishes no per-row scores."""
+        import numpy as np
+
+        rows = np.asarray(merged["rows"], np.float32)
+        if rows.shape[0] == 0:
+            return None
+        return self.round_evidence(
+            rows, np.ones((rows.shape[0],), bool), aggregate=aggregate
+        )
+
     # -- forensics evidence (per-row score view) ---------------------------
 
     #: True when :meth:`round_evidence` publishes a binary keep set

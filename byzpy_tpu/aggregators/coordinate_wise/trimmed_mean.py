@@ -144,6 +144,63 @@ class CoordinateWiseTrimmedMean(FeatureChunkedAggregator, Aggregator):
         frac = trimmed.mean(axis=1).astype(np.float32)
         return self._evidence_view("trim_fraction", n, idx, frac)
 
+    # -- hierarchical partial fold (sharded serving tier) -----------------
+
+    def _partial_extras(self, rows) -> dict:
+        """Sublinear streaming summary of one shard's discounted rows:
+        the running coordinate sum plus the ``f``-smallest/``f``-largest
+        extreme buffers (±inf-padded below ``f`` rows, exactly like the
+        streaming fold's init), and a finite flag. Extreme buffers merge
+        EXACTLY across shards (order statistics of a multiset compose),
+        so the root can maintain the same O(f·d) streaming state the
+        overlapped fold keeps — and cross-check a shard's claim against
+        the rows it shipped (deterministic recompute)."""
+        d = rows.shape[1] if rows.ndim == 2 else 0
+        extras: dict = {
+            "total": rows.sum(axis=0, dtype=np.float32),
+            "finite": bool(np.isfinite(rows).all()),
+        }
+        if self.f > 0:
+            lo_pad = np.full((self.f, d), np.inf, np.float32)
+            hi_pad = np.full((self.f, d), -np.inf, np.float32)
+            extras["low"] = np.sort(
+                np.concatenate([rows, lo_pad], axis=0), axis=0
+            )[: self.f]
+            extras["high"] = np.sort(
+                np.concatenate([rows, hi_pad], axis=0), axis=0
+            )[-self.f:]
+        return extras
+
+    def _merge_extras(self, extras_list, partials) -> dict:
+        """Exact root merge: totals left-fold in shard order; the
+        merged extreme buffers are the per-coordinate ``f`` smallest/
+        largest of the concatenated shard buffers — bit-equal to the
+        extremes of the full concatenated cohort (multiset order
+        statistics). A shard that shipped no extras has them recomputed
+        from its rows (extras are deterministic summaries)."""
+        import functools
+
+        fixed = [
+            e if e else self._partial_extras(
+                np.asarray(p["rows"], np.float32)
+            )
+            for e, p in zip(extras_list, partials, strict=True)
+        ]
+        merged: dict = {
+            "total": functools.reduce(
+                np.add, [np.asarray(e["total"], np.float32) for e in fixed]
+            ),
+            "finite": all(bool(e.get("finite", True)) for e in fixed),
+        }
+        if self.f > 0:
+            merged["low"] = np.sort(
+                np.concatenate([e["low"] for e in fixed], axis=0), axis=0
+            )[: self.f]
+            merged["high"] = np.sort(
+                np.concatenate([e["high"] for e in fixed], axis=0), axis=0
+            )[-self.f:]
+        return merged
+
     # -- arrival-order streaming fold ------------------------------------
 
     def fold_init(self, n: int) -> Any:

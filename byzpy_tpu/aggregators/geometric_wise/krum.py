@@ -158,6 +158,69 @@ class MultiKrum(RowScoredAggregator, Aggregator):
         keep_local = np.argsort(scores, kind="stable")[: int(self.q)]
         return self._evidence_view("krum_distance", n, idx, scores, keep_local)
 
+    # -- hierarchical partial fold (sharded serving tier) -----------------
+
+    def _partial_extras(self, rows) -> dict:
+        """One shard's local Gram block over its discounted rows — the
+        streaming Gram accumulation's sharded form. The root reuses it
+        as the diagonal block of the merged cohort's full Gram; only
+        the cross-shard blocks remain to compute at merge. An
+        adversarial NaN/inf row yields NaN Gram entries — advisory
+        only: the merged finalize reads rows, not extras, and routes
+        non-finite cohorts to the exact path."""
+        with np.errstate(invalid="ignore", over="ignore"):
+            return {"gram": (rows @ rows.T).astype(np.float32)}
+
+    def _merge_extras(self, extras_list, partials) -> dict:
+        """Assemble the merged cohort's ``(m, m)`` Gram: shard-local
+        blocks dropped onto the diagonal (recomputed when a shard
+        shipped none — the summary is deterministic), cross-shard
+        blocks via one matmul per shard pair (the irreducible
+        remainder: cross inner products need both shards' rows)."""
+        mats = [np.asarray(p["rows"], np.float32) for p in partials]
+        sizes = [m.shape[0] for m in mats]
+        offs = np.cumsum([0] + sizes)
+        total = int(offs[-1])
+        gram = np.zeros((total, total), np.float32)
+        with np.errstate(invalid="ignore", over="ignore"):
+            for i, mi in enumerate(mats):
+                e = extras_list[i]
+                block = (
+                    np.asarray(e["gram"], np.float32)
+                    if e and "gram" in e
+                    else (mi @ mi.T).astype(np.float32)
+                )
+                gram[offs[i]:offs[i + 1], offs[i]:offs[i + 1]] = block
+                for j in range(i + 1, len(mats)):
+                    cross = (mi @ mats[j].T).astype(np.float32)
+                    gram[offs[i]:offs[i + 1], offs[j]:offs[j + 1]] = cross
+                    gram[offs[j]:offs[j + 1], offs[i]:offs[i + 1]] = cross.T
+        return {"gram": gram}
+
+    def merged_score_view(self, merged, *, aggregate=None):
+        """Krum-distance scores straight from the merged Gram (pairwise
+        squared distances are a Gram read: ``g_ii + g_jj − 2 g_ij``) —
+        the root's forensics view without a second O(m²·d) row pass.
+        Tie rule matches :meth:`round_evidence` (stable lowest-``q``)."""
+        extras = merged.get("extras") or {}
+        gram = extras.get("gram")
+        m = int(merged["m"])
+        if gram is None or m == 0:
+            return super().merged_score_view(merged, aggregate=aggregate)
+        try:
+            self.validate_n(m)
+        except ValueError:
+            return None
+        g = np.asarray(gram, np.float32)
+        diag = np.diag(g)
+        d2 = np.maximum(diag[:, None] + diag[None, :] - 2.0 * g, 0.0)
+        np.fill_diagonal(d2, np.inf)
+        d2.sort(axis=1)
+        scores = d2[:, : m - self.f - 1].sum(axis=1).astype(np.float32)
+        keep = np.zeros((m,), bool)
+        keep[np.argsort(scores, kind="stable")[: self.q]] = True
+        return {"kind": "krum_distance", "scores": scores, "keep": keep}
+
     # -- arrival-order streaming fold ------------------------------------
 
     def fold_init(self, n: int) -> Any:

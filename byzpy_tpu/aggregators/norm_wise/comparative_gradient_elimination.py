@@ -105,6 +105,47 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
         keep_local = np.argsort(norms, kind="stable")[: rows.shape[0] - int(self.f)]
         return self._evidence_view("norm", n, idx, norms, keep_local)
 
+    # -- hierarchical partial fold (sharded serving tier) -----------------
+
+    def _partial_extras(self, rows) -> dict:
+        """Per-row squared norms of one shard's discounted rows — CGE's
+        whole streaming state; norms are row-local, so the sharded fold
+        summary is exactly the per-arrival norm fold, batched."""
+        return {
+            "sqnorms": np.einsum("ij,ij->i", rows, rows).astype(np.float32)
+        }
+
+    def _merge_extras(self, extras_list, partials) -> dict:
+        """Concatenate shard norm vectors in shard order (recomputed
+        for shards that shipped none — the summary is deterministic)."""
+        parts = [
+            np.asarray(e["sqnorms"], np.float32)
+            if e and "sqnorms" in e
+            else self._partial_extras(
+                np.asarray(p["rows"], np.float32)
+            )["sqnorms"]
+            for e, p in zip(extras_list, partials, strict=True)
+        ]
+        return {"sqnorms": np.concatenate(parts)}
+
+    def merged_score_view(self, merged, *, aggregate=None):
+        """L2-norm scores + the lowest-``m − f`` keep set from the
+        merged norm vector alone (no row pass at the root); tie rule
+        matches :meth:`round_evidence` (stable ascending norms)."""
+        extras = merged.get("extras") or {}
+        sq = extras.get("sqnorms")
+        m = int(merged["m"])
+        if sq is None or m == 0:
+            return super().merged_score_view(merged, aggregate=aggregate)
+        try:
+            self.validate_n(m)
+        except ValueError:
+            return None
+        norms = np.sqrt(np.asarray(sq, np.float32))
+        keep = np.zeros((m,), bool)
+        keep[np.argsort(norms, kind="stable")[: m - self.f]] = True
+        return {"kind": "norm", "scores": norms, "keep": keep}
+
     # -- arrival-order streaming fold ------------------------------------
 
     def fold_init(self, n: int) -> Any:

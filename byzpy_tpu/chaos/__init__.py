@@ -22,6 +22,7 @@ from .drills import DRILL_SCENARIOS, run_drill
 from .events import ChaosEvent, EventTrace
 from .harness import ChaosHarness, ChaosReport
 from .influence import attacker_influence, selection_mask
+from .shards import FORGE_MODES, CompromisedShard
 from .scenario import (
     ArrivalModel,
     AttackSpec,
@@ -42,7 +43,9 @@ __all__ = [
     "run_drill",
     "ChaosHarness",
     "ChaosReport",
+    "CompromisedShard",
     "CrashModel",
+    "FORGE_MODES",
     "EventTrace",
     "FaultPlan",
     "PartitionEvent",

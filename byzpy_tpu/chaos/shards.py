@@ -1,0 +1,159 @@
+"""Compromised-shard adversaries for the chaos wall.
+
+The sharded frontend tier (``byzpy_tpu.serving.sharded``) introduces a
+new adversary CLASS: a Byzantine *shard* — a whole ingress replica that
+forges its per-round :class:`~byzpy_tpu.serving.PartialFold` instead of
+(or on top of) hosting Byzantine clients. This module wraps a real
+:class:`~byzpy_tpu.serving.ShardFrontend` with deterministic forgery
+modes so the chaos wall can replay the attack and assert the root's
+cross-checks catch it (``benchmarks/chaos_bench.py --lanes shard``):
+
+* ``"bitflip"`` — tamper the shipped rows AFTER the digest was taken
+  (wire corruption, bit rot, or a lazy forger): the root recomputes the
+  digest from the row bits and excludes the partial;
+* ``"ghost_clients"`` — append fabricated rows for client ids the
+  shard does not own: sticky routing makes the claim a protocol
+  violation the root detects from the ids alone;
+* ``"replay_seqs"`` — re-claim ``(client, seq)`` pairs the root
+  already folded (the double-fold attack): the root's cross-shard
+  dedup authority drops the rows as ``root_duplicate``;
+* ``"extras"`` — ship honest rows + honest digest but forged streaming
+  accumulators (a poisoned Gram block would corrupt the root's fused
+  forensics score view): caught by ``extras_policy="verify"``
+  (deterministic recompute) — and harmless to the AGGREGATE under any
+  policy, because the merged finalize reads only the rows.
+
+A shard that forges *consistently* — fabricated rows with a matching
+digest for clients it legitimately owns — is indistinguishable from a
+shard whose clients are Byzantine, and is bounded the same way (the
+robust aggregator's f-out-of-n contract plus the per-shard row cap);
+``docs/serving.md`` §sharded tier spells the threat model out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..serving.sharded import PartialFold, ShardFrontend, shard_for
+from ..forensics.evidence import evidence_digest
+
+FORGE_MODES = ("bitflip", "ghost_clients", "replay_seqs", "extras")
+
+
+class CompromisedShard:
+    """A Byzantine ingress shard: proxies a real
+    :class:`~byzpy_tpu.serving.ShardFrontend` (admission, drains,
+    confirms all run the production code) but forges every
+    :class:`PartialFold` it ships to the root, per ``mode``.
+
+    Deterministic: same seed ⇒ same forged bits (the chaos wall's
+    replay contract). Install with ``coordinator.shards[i] =
+    CompromisedShard(coordinator.shards[i], mode=...)``."""
+
+    def __init__(
+        self,
+        shard: ShardFrontend,
+        *,
+        mode: str = "bitflip",
+        seed: int = 0,
+        scale: float = 1e3,
+        n_shards: Optional[int] = None,
+    ) -> None:
+        if mode not in FORGE_MODES:
+            raise ValueError(f"mode must be one of {FORGE_MODES}")
+        if mode == "ghost_clients" and not n_shards:
+            # without the shard count the ghost id cannot be made
+            # provably foreign — it could hash to the sender's own
+            # shard, pass every root check, and silently stop being an
+            # attack the lane can assert on
+            raise ValueError(
+                "ghost_clients mode requires n_shards (the ghost id "
+                "must provably belong to ANOTHER shard)"
+            )
+        self._shard = shard
+        self.mode = mode
+        self.rng = np.random.default_rng(seed)
+        self.scale = float(scale)
+        self.n_shards = n_shards
+        #: partials this shard forged (the lane's ground truth)
+        self.forged_sent = 0
+        #: ``(client, seq)`` pairs to re-claim in ``replay_seqs`` mode
+        #: (the lane feeds it pairs the root already folded)
+        self.replay_pairs: list = []
+
+    def __getattr__(self, name):
+        return getattr(self._shard, name)
+
+    # -- forged close path -------------------------------------------------
+
+    def build_partial(self, tenant, subs, cohort) -> PartialFold:
+        honest = self._shard.build_partial(tenant, subs, cohort)
+        return self._forge(honest)
+
+    def close_partial(self, tenant: str) -> Optional[PartialFold]:
+        drained = self._shard.drain_cohort(tenant)
+        if drained is None:
+            return None
+        return self.build_partial(tenant, *drained)
+
+    def _forge(self, p: PartialFold) -> PartialFold:
+        self.forged_sent += 1
+        if self.mode == "bitflip":
+            rows = np.array(p.rows, copy=True)
+            if rows.size:
+                rows[0] = rows[0] * np.float32(self.scale) + np.float32(1.0)
+            # digest deliberately NOT recomputed: the claim describes
+            # the honest rows, the payload carries the forged ones
+            return dataclasses.replace(p, rows=rows)
+        if self.mode == "ghost_clients":
+            d = p.rows.shape[1] if p.rows.ndim == 2 else 0
+            ghost = (
+                self.rng.normal(size=(1, d)).astype(np.float32) * self.scale
+            )
+            rows = np.concatenate([p.rows, ghost], axis=0)
+            name, k = "ghost-0", 0
+            if self.n_shards:
+                # provably foreign: an id whose home shard is NOT the
+                # sender (the attack being modeled)
+                while shard_for(name, self.n_shards) == p.shard:
+                    k += 1
+                    name = f"ghost-{k}"
+            # a consistent forger recomputes the digest — the home-shard
+            # check catches the claim anyway
+            return dataclasses.replace(
+                p,
+                rows=rows,
+                clients=(*p.clients, name),
+                seqs=(*p.seqs, 0),
+                wal_ids=(*p.wal_ids, None),
+                extras={},
+                digest=evidence_digest(rows),
+            )
+        if self.mode == "replay_seqs":
+            if not self.replay_pairs:
+                return p
+            client, seq, row = self.replay_pairs[0]
+            rows = np.concatenate([p.rows, row[None, :]], axis=0)
+            return dataclasses.replace(
+                p,
+                rows=rows,
+                clients=(*p.clients, client),
+                seqs=(*p.seqs, seq),
+                wal_ids=(*p.wal_ids, None),
+                extras={},
+                digest=evidence_digest(rows),
+            )
+        # "extras": honest rows, honest digest, poisoned accumulators
+        if not p.extras:
+            return p  # family ships no extras: nothing to poison
+        extras = {
+            k: np.zeros_like(np.asarray(v)) if hasattr(v, "shape") else v
+            for k, v in p.extras.items()
+        }
+        return dataclasses.replace(p, extras=extras)
+
+
+__all__ = ["FORGE_MODES", "CompromisedShard"]

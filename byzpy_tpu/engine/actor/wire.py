@@ -320,13 +320,19 @@ def _frame_counters(direction: str, nbytes: int) -> None:
     nbytes_counter.inc(nbytes)
 
 
-def encode(obj: Any) -> bytes:
+def encode(obj: Any, *, precision: Optional[str] = None) -> bytes:
     """Pickle ``obj`` into a length-prefixed (optionally HMAC-signed) frame
     body. With ``BYZPY_TPU_WIRE_PRECISION`` set (``bf16``/``int8``), large
     finite float arrays ship as compressed frames (per-block scales in the
     header); the HMAC — unchanged — signs the whole body, compressed
-    payload and scale headers included."""
-    body = cloudpickle.dumps(compress_payload(obj, wire_precision()))
+    payload and scale headers included. ``precision`` overrides the env
+    policy for THIS frame (``"off"`` forces lossless — frames whose bits
+    are load-bearing, e.g. the sharded tier's partial folds, must not
+    ride the lossy submit fabric)."""
+    mode = wire_precision() if precision is None else (
+        precision if precision in ("bf16", "int8") else "off"
+    )
+    body = cloudpickle.dumps(compress_payload(obj, mode))
     key = _wire_key()
     if key is not None:
         body = _sign(body, key) + body

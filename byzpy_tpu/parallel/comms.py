@@ -339,6 +339,105 @@ def serving_ingress_bytes(
     return 4 + (32 if signed else 0) + envelope_bytes + payload
 
 
+#: Measured cloudpickle envelope of one PartialFold frame (dict keys,
+#: tenant/digest strings, array headers — everything but the length
+#: prefix, HMAC tag, per-row identity fields, row payload and extras)
+#: and the per-row identity cost at the default ~6-char client ids
+#: (pickled client string ≈ id + 7 framing bytes, seq/wal small ints).
+#: Pinned within tolerance by ``tests/test_sharded_serving.py``.
+_PARTIAL_FOLD_ENVELOPE_BYTES = 310
+_PARTIAL_FOLD_ROW_FRAMING_BYTES = 7
+#: Measured envelope of the root's merge-result broadcast frame.
+_MERGE_BROADCAST_ENVELOPE_BYTES = 229
+
+
+def partial_fold_bytes(
+    m: int,
+    n_params: int,
+    *,
+    signed: bool = False,
+    extras_bytes: float = 0.0,
+    client_id_bytes: int = 6,
+    dtype_bytes: int = 4,
+    envelope_bytes: Optional[int] = None,
+) -> float:
+    """Analytic wire bytes of ONE shard's :class:`~byzpy_tpu.serving.
+    PartialFold` frame on the shard→root hop (``serving.sharded``): the
+    4-byte length prefix, the 32-byte HMAC tag when ``signed``, the
+    frame envelope, ``m`` per-row identities (client id + seq + wal id
+    pickle framing), the ``m · n_params`` float32 row payload — ALWAYS
+    lossless: the rows' exact bits are load-bearing (digest cross-check
+    + the hierarchical fold's bit-parity contract), so the submit
+    fabric's ``BYZPY_TPU_WIRE_PRECISION`` compression never applies to
+    this hop — and the family's streaming-accumulator ``extras_bytes``
+    (trimmed mean ``(2f+1)·d·4``; Multi-Krum ``m²·4`` Gram block; CGE
+    ``m·4`` norms; 0 for families without extras)."""
+    per_row = client_id_bytes + _PARTIAL_FOLD_ROW_FRAMING_BYTES
+    if envelope_bytes is None:
+        envelope_bytes = _PARTIAL_FOLD_ENVELOPE_BYTES
+    return (
+        4
+        + (32 if signed else 0)
+        + envelope_bytes
+        + m * per_row
+        + m * n_params * dtype_bytes
+        + extras_bytes
+    )
+
+
+def sharded_round_wire_bytes(
+    n_shards: int,
+    n_clients_round: int,
+    n_params: int,
+    *,
+    precision: str = "off",
+    signed: bool = False,
+    quant_block: int = 256,
+    extras_bytes_per_shard: float = 0.0,
+    client_id_bytes: int = 6,
+    dtype_bytes: int = 4,
+) -> float:
+    """Closed-form per-ROUND wire bytes of the sharded frontend tier
+    (``serving.sharded``), three hops:
+
+    * **client → home shard**: ``n_clients_round`` submit frames, each
+      priced by :func:`serving_ingress_bytes` (the PR-6 law — this hop
+      rides the compressed fabric when configured);
+    * **shard → root**: one :func:`partial_fold_bytes` frame per shard
+      carrying its ``n_clients_round / n_shards`` rows LOSSLESS (the
+      bit-parity hop; the aggregate per-round row payload is the same
+      ``n · d · 4`` the single frontend would fold — sharding moves it
+      across a wire once, it does not multiply it);
+    * **root → shard**: the merge-result broadcast, one lossless
+      ``(d,)`` aggregate frame per shard.
+
+    Sub-laws are exposed separately; the measured side is
+    ``benchmarks/serving_bench.py``'s scale lane (pinned < 2%)."""
+    submits = n_clients_round * serving_ingress_bytes(
+        n_params,
+        precision=precision,
+        signed=signed,
+        quant_block=quant_block,
+        dtype_bytes=dtype_bytes,
+    )
+    per_shard_m = n_clients_round / max(n_shards, 1)
+    partials = n_shards * partial_fold_bytes(
+        per_shard_m,
+        n_params,
+        signed=signed,
+        extras_bytes=extras_bytes_per_shard,
+        client_id_bytes=client_id_bytes,
+        dtype_bytes=dtype_bytes,
+    )
+    broadcast = n_shards * (
+        4
+        + (32 if signed else 0)
+        + _MERGE_BROADCAST_ENVELOPE_BYTES
+        + n_params * dtype_bytes
+    )
+    return submits + partials + broadcast
+
+
 def scaling_model(
     *,
     flops_per_chip: float,
@@ -378,7 +477,9 @@ __all__ = [
     "compression_factor",
     "measured_opt_state_bytes",
     "opt_state_bytes",
+    "partial_fold_bytes",
     "ps_round_wire_bytes",
     "scaling_model",
     "serving_ingress_bytes",
+    "sharded_round_wire_bytes",
 ]

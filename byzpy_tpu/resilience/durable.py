@@ -41,6 +41,7 @@ import os
 import pickle
 import re
 import struct
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -109,15 +110,23 @@ class RoundLog:
         # fresh RoundLog always targets a fresh file — enforced by
         # TenantDurability's rotation
         self._fh = open(path, "ab")
+        # appends may race across threads (the sharded root's async
+        # close runs failure accounting on an executor while the loop
+        # keeps appending accepts): each record must hit the file as
+        # one atomic unit or a torn record eats the segment tail
+        self._lock = threading.Lock()
 
     def append(self, record: Any) -> None:
-        """Durably append one record (flushed; fsync'd per policy)."""
+        """Durably append one record (flushed; fsync'd per policy).
+        Thread-safe: concurrent appends interleave between records,
+        never inside one."""
         payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
         digest = hashlib.sha256(payload).digest()[:_DIGEST_LEN]
-        self._fh.write(_LEN.pack(len(payload)) + digest + payload)
-        self._fh.flush()
-        if self.fsync:
-            os.fsync(self._fh.fileno())
+        with self._lock:
+            self._fh.write(_LEN.pack(len(payload)) + digest + payload)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
 
     def close(self) -> None:
         self._fh.close()

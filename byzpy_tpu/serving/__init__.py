@@ -73,6 +73,13 @@ from .ragged import (
     RaggedView,
     ragged_enabled,
 )
+from .sharded import (
+    PartialFold,
+    ShardFrontend,
+    ShardRouter,
+    ShardedCoordinator,
+    audit_sharded_exactly_once,
+)
 from .staleness import StalenessPolicy
 
 __all__ = [
@@ -85,6 +92,7 @@ __all__ = [
     "CreditPolicy",
     "DurabilityConfig",
     "ForensicsConfig",
+    "PartialFold",
     "RaggedBatcher",
     "RaggedExecutor",
     "RaggedRuntime",
@@ -93,9 +101,13 @@ __all__ = [
     "ragged_enabled",
     "ServingClient",
     "ServingFrontend",
+    "ShardFrontend",
+    "ShardRouter",
+    "ShardedCoordinator",
     "StalenessPolicy",
     "Submission",
     "TenantConfig",
     "TokenBucket",
+    "audit_sharded_exactly_once",
     "serve_frame",
 ]

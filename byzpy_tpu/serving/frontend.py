@@ -386,9 +386,19 @@ class ServingFrontend:
         clock: Callable[[], float] = time.monotonic,
         on_round: Optional[RoundCallback] = None,
         durability: Optional[DurabilityConfig] = None,
+        shard: Optional[int] = None,
     ) -> None:
         if not tenants:
             raise ValueError("at least one tenant is required")
+        #: ingress-shard index when this frontend is one shard of a
+        #: sharded tier (``serving.sharded``): stamps a ``shard`` dim
+        #: onto the serving spans so a merged trace attributes
+        #: admission/round work to the owning shard. None = the classic
+        #: single-frontend deployment (no extra span arg).
+        self.shard = shard
+        self._shard_tag: Dict[str, Any] = (
+            {} if shard is None else {"shard": int(shard)}
+        )
         self._tenants: Dict[str, _Tenant] = {}
         for cfg in tenants:
             if cfg.name in self._tenants:
@@ -744,6 +754,7 @@ class ServingFrontend:
                 with obs_tracing.span(
                     "serving.admission",
                     tenant=tenant if isinstance(tenant, str) else "?",
+                    **self._shard_tag,
                 ):
                     accepted, reason = self.submit(
                         tenant if isinstance(tenant, str) else "",
@@ -1094,7 +1105,7 @@ class ServingFrontend:
             track = f"tenant:{t.cfg.name}"
             with obs_tracing.span(
                 "serving.round", track=track, tenant=t.cfg.name,
-                round=t.round_id, m=len(subs),
+                round=t.round_id, m=len(subs), **self._shard_tag,
             ) as round_span:
                 with obs_tracing.span(
                     "serving.cohort_close", track=track,
@@ -1219,7 +1230,7 @@ class ServingFrontend:
         track = f"tenant:{t.cfg.name}"
         with obs_tracing.span(
             "serving.round", track=track, tenant=t.cfg.name,
-            round=t.round_id, m=len(subs),
+            round=t.round_id, m=len(subs), **self._shard_tag,
         ):
             with obs_tracing.span(
                 "serving.cohort_close", track=track,

@@ -1,0 +1,1527 @@
+"""Sharded frontend tier: N ingress shards + one root merge per round.
+
+PR 6 proved 10k clients through ONE :class:`~byzpy_tpu.serving.
+ServingFrontend` — a single asyncio process, one admission queue, one
+device lock — and PR 11's ragged door made the device dispatch cheap
+enough that the frontend PROCESS is now the throughput ceiling (~9.6k
+accepted/sec of wire decode + admission in one Python loop). This
+module is the scale-out past that ceiling, in the spirit of Podracer's
+pod-scale actor orchestration (arXiv:2104.06272) and the actor-vs-
+learner stage split MPMD pipeline work formalizes (arXiv:2412.14374):
+
+* **N frontend shards**, client-hash partitioned (:class:`ShardRouter`,
+  sticky: a client's submissions always land on its home shard). Each
+  shard is a FULL single-frontend admission plane — bounded queue,
+  credit ledger, staleness gate, ``(client, seq)`` dedup, forensics
+  trust gating, write-ahead durability — run against its own ledgers,
+  so the per-submission work parallelizes across shard processes with
+  nothing shared.
+* **One root coordinator** that closes rounds with a shard barrier:
+  each shard drains its queue, builds its local cohort, and extracts a
+  :class:`PartialFold` — a wire type on the PR-3 HMAC frames carrying
+  the aggregator's streaming fold contribution (the discounted rows
+  plus the family's sublinear accumulators: trimmed-mean running sum +
+  extreme buffers, Multi-Krum's local Gram block, CGE's norms — see
+  ``Aggregator.fold_partial``). The root verifies, merges
+  (``Aggregator.fold_merge``) and finalizes (``fold_merge_finalize``)
+  — **bit-identical** (f32, finite cohorts) to the single-frontend
+  aggregate of the concatenated cohort, because the merged rows run
+  the same masked program the one-frontend path uses (the PR-6 masked-
+  finalize parity recipe is the contract, pinned by
+  ``tests/test_partial_fold.py`` and the chaos wall's ``shard`` lane).
+
+Round protocol (root-driven barrier):
+
+1. the root opens global round ``r``; every live shard's admission
+   plane stamps staleness against ``r``;
+2. on the window trigger the root asks every live shard for its
+   partial. Shards that answer within ``shard_timeout_s`` form the
+   round; stragglers are **accounted as a partition** (their drained
+   rows re-enter their held list and fold next round, one round
+   staler — never lost, never double-folded) and the round closes
+   **degraded** when at least ``quorum`` shards responded;
+3. the root cross-checks every partial (below), merges in shard order,
+   pads to the root bucket ladder (one compiled program per bucket,
+   not per merged size), finalizes, confirms each shard's folded rows
+   (the shard then writes its WAL round record), fans the global
+   forensics score view back to the shard planes, and broadcasts.
+
+Federated correctness state:
+
+* ``(client, seq)`` dedup is two-level: the home shard's high-water
+  table absorbs ordinary retries; the ROOT keeps its own high-water
+  table as the cross-shard authority — after a shard failover the
+  recovered shard replays its WAL-pending accepts, and any row the
+  root already folded is dropped at merge (acked to the shard as
+  ``root_duplicate``, WAL-accounted) — exactly-once folding across
+  shard death (audited by :func:`audit_sharded_exactly_once`).
+* credit/trust ledgers live on the home shard (sticky routing makes
+  them authoritative); on failover they are rebuilt by ledger-delta
+  replay through the shard's PR-9 WAL (:meth:`ShardedCoordinator.
+  recover_shard` reconstructs the shard frontend from its durability
+  directory alone — in-memory state is deliberately discarded, the
+  SIGKILL shape).
+
+Compromised-shard threat model (the chaos wall's ``shard`` lane): a
+Byzantine SHARD is a new adversary class — it can forge its partial
+fold wholesale. The root's cross-checks catch, per partial: (a) a rows
+↔ digest mismatch (``PartialFold.digest`` is recomputed from the
+shipped row bits — any post-hoc tamper, bit rot, or lazy forgery);
+(b) rows claiming clients whose home shard is not the sender (sticky
+routing makes cross-shard client claims a protocol violation — the
+replay-another-shard's-clients attack); (c) ``(client, seq)`` already
+folded (the root dedup table); (d) with ``extras_policy="verify"``,
+claimed streaming accumulators that do not reproduce from the rows
+(extras are deterministic summaries). A shard that forges
+*consistently* — fabricated rows with a matching digest for clients it
+legitimately owns — is indistinguishable from a shard whose clients
+are Byzantine: its influence is bounded by the robust aggregator
+itself (its rows are a minority of the merged cohort) plus the
+per-shard row cap, which is exactly the f-out-of-n contract the tier
+already runs on. Detected forgeries exclude the partial, count
+``byzpy_shard_forged_folds_total``, and append an auditable evidence
+event to the root WAL (riding the PR-10 forensics schema).
+
+Wire: a :class:`PartialFold` rides the actor wire verbatim
+(``PartialFold.to_wire()`` → ``wire.encode`` → HMAC when
+``BYZPY_TPU_WIRE_KEY`` is set); the analytic per-frame cost is
+``parallel.comms.partial_fold_bytes`` and the whole tier's round law
+``parallel.comms.sharded_round_wire_bytes``. In-process deployments
+(the bench's Podracer-style N-shards-on-one-host swarm) skip the
+socket but keep the frames; docs/serving.md §sharded tier covers the
+process-per-shard layout. On the REMOTE-root door
+(:meth:`ShardedCoordinator.merge_partials` over decoded frames) the
+claimed shard INDEX is only as trustworthy as the transport: the
+shared-key HMAC authenticates the fabric, not which shard sent a
+frame, so the root rejects unknown indices and a second partial for a
+shard it already heard from this round (without touching any real
+shard's state) — a deployment where shards may be individually
+compromised should give each shard its own wire key and verify
+sender↔index at the socket layer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..engine.actor import wire
+from ..forensics.evidence import evidence_digest
+from ..observability import metrics as obs_metrics
+from ..observability import runtime as obs_runtime
+from ..observability import tracing as obs_tracing
+from ..resilience.durable import DurabilityConfig, TenantDurability, read_wal
+from .cohort import Cohort, build_cohort
+from .credits import RoundStats
+from .frontend import ServingFrontend, TenantConfig
+
+#: Wire frame kind of one shard's per-round fold contribution.
+PARTIAL_FOLD = "partial_fold"
+
+#: Submission ack when the client's home shard is down (sticky routing:
+#: the row must not silently land elsewhere — the client retries until
+#: the shard recovers or the operator re-provisions).
+REJECTED_SHARD_DOWN = "rejected_shard_down"
+
+#: Per-shard WAL drop reason for rows the ROOT refused as already
+#: folded (post-failover replays) — the exactly-once account.
+ROOT_DUPLICATE = "root_duplicate"
+
+
+def shard_for(client: str, n_shards: int) -> int:
+    """Sticky client→shard assignment: a stable (process- and
+    platform-independent) hash of the client id — every participant
+    (router, root cross-check, remote shard ingress) derives the same
+    home shard for the same client."""
+    import hashlib
+
+    h = hashlib.blake2s(str(client).encode(), digest_size=8).digest()
+    return int.from_bytes(h, "big") % int(n_shards)
+
+
+class ShardRouter:
+    """Client-hash partitioner over ``n_shards`` frontend shards.
+
+    Assignments are memoized (bounded — cleared past ``2^17`` distinct
+    ids): the blake2s costs ~1 µs and sits on BOTH hot paths (every
+    submission's routing, every merged row's home-shard cross-check),
+    while repeat clients are the common case."""
+
+    __slots__ = ("n_shards", "_cache")
+
+    _CACHE_MAX = 1 << 17
+
+    def __init__(self, n_shards: int) -> None:
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.n_shards = int(n_shards)
+        self._cache: Dict[str, int] = {}
+
+    def shard_for(self, client: str) -> int:
+        """Home shard of ``client`` (sticky)."""
+        s = self._cache.get(client)
+        if s is None:
+            s = shard_for(client, self.n_shards)
+            if len(self._cache) >= self._CACHE_MAX:
+                self._cache.clear()
+            self._cache[client] = s
+        return s
+
+
+@dataclass(frozen=True)
+class PartialFold:
+    """One shard's per-round streaming fold contribution (wire type).
+
+    ``rows``: the shard cohort's VALID rows, staleness-discounted, in
+    admission order — the exact bits the single-frontend fold would
+    have aggregated for these submissions. ``extras``: the
+    aggregator family's sublinear fold accumulators over those rows
+    (``Aggregator._partial_extras``; empty dict when the family has
+    none). ``digest``: 16-hex fingerprint of the row bits
+    (:func:`~byzpy_tpu.forensics.evidence.evidence_digest`) — the
+    root recomputes it from the shipped rows; a mismatch is a forged
+    fold. ``clients``/``seqs``/``wal_ids`` align with ``rows`` and
+    carry the identities the root's cross-shard dedup and the shard's
+    exactly-once WAL accounting need."""
+
+    tenant: str
+    round_id: int
+    shard: int
+    rows: np.ndarray
+    clients: Tuple[str, ...]
+    seqs: Tuple[Optional[int], ...]
+    wal_ids: Tuple[Optional[int], ...]
+    extras: dict
+    digest: str
+    first_arrival_s: float
+
+    @property
+    def m(self) -> int:
+        """Row count of this partial."""
+        return int(self.rows.shape[0])
+
+    def to_wire(self) -> dict:
+        """Frame body for the HMAC actor wire (``wire.encode``)."""
+        return {
+            "kind": PARTIAL_FOLD,
+            "tenant": self.tenant,
+            "round": int(self.round_id),
+            "shard": int(self.shard),
+            "rows": np.asarray(self.rows, np.float32),
+            "clients": list(self.clients),
+            "seqs": list(self.seqs),
+            "wal_ids": list(self.wal_ids),
+            "extras": self.extras,
+            "digest": self.digest,
+            "first_arrival_s": float(self.first_arrival_s),
+        }
+
+    @classmethod
+    def from_wire(cls, frame: dict) -> "PartialFold":
+        """Decode one wire frame body (raises ``ValueError`` on a frame
+        that is not a well-formed partial fold — malformed frames from
+        a buggy shard must be an explicit rejection, not a crash)."""
+        if not isinstance(frame, dict) or frame.get("kind") != PARTIAL_FOLD:
+            raise ValueError("not a partial_fold frame")
+        rows = np.asarray(frame["rows"], np.float32)
+        if rows.ndim != 2:
+            raise ValueError("partial_fold rows must be (m, d)")
+        clients = tuple(str(c) for c in frame["clients"])
+        seqs = tuple(
+            None if q is None else int(q) for q in frame["seqs"]
+        )
+        wal_ids = tuple(
+            None if w is None else int(w) for w in frame["wal_ids"]
+        )
+        if not (len(clients) == len(seqs) == len(wal_ids) == rows.shape[0]):
+            raise ValueError("partial_fold field lengths disagree")
+        return cls(
+            tenant=str(frame["tenant"]),
+            round_id=int(frame["round"]),
+            shard=int(frame["shard"]),
+            rows=rows,
+            clients=clients,
+            seqs=seqs,
+            wal_ids=wal_ids,
+            extras=dict(frame.get("extras") or {}),
+            digest=str(frame["digest"]),
+            first_arrival_s=float(frame.get("first_arrival_s", 0.0)),
+        )
+
+
+def encode_partial_fold(p: "PartialFold") -> bytes:
+    """One shard→root wire frame: the partial fold on the HMAC actor
+    wire, payload forced LOSSLESS regardless of
+    ``BYZPY_TPU_WIRE_PRECISION`` — the rows' exact bits are
+    load-bearing (the digest cross-check and the bit-parity contract
+    both read them), so the submit fabric's lossy compression must not
+    apply to this hop. Analytic cost:
+    ``parallel.comms.partial_fold_bytes``."""
+    return wire.encode(p.to_wire(), precision="off")
+
+
+def decode_partial_fold(body: bytes) -> "PartialFold":
+    """Inverse of :func:`encode_partial_fold` (HMAC verified by
+    ``wire.decode`` when signing is configured)."""
+    return PartialFold.from_wire(wire.decode(body))
+
+
+class ShardFrontend:
+    """One ingress shard: a full single-frontend admission plane whose
+    rounds are driven by the coordinator (it never aggregates — its
+    round close extracts a :class:`PartialFold` instead).
+
+    Wraps a real :class:`~byzpy_tpu.serving.ServingFrontend` so every
+    admission gate — shape, staleness vs the GLOBAL round, credits,
+    ``(client, seq)`` dedup, forensics trust, write-ahead durability —
+    is the production code path, per shard, against shard-local
+    ledgers."""
+
+    def __init__(
+        self,
+        index: int,
+        tenants: Sequence[TenantConfig],
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        durability: Optional[DurabilityConfig] = None,
+    ) -> None:
+        self.index = int(index)
+        self.clock = clock
+        self.frontend = ServingFrontend(
+            tenants, clock=clock, durability=durability, shard=index
+        )
+        self.alive = True
+        #: injectable close-path delay (seconds) — the straggler drill's
+        #: hook: the coordinator's barrier timeout must survive a shard
+        #: that answers late
+        self.close_delay_s = 0.0
+        #: drained-but-unconfirmed rounds: ``(tenant, round) -> (subs,
+        #: cohort)`` — requeued on a missed close, retired on confirm
+        self._inflight: Dict[Tuple[str, int], Tuple[list, Cohort]] = {}
+
+    # -- admission (delegates to the inner frontend) ----------------------
+
+    def submit(
+        self,
+        tenant: str,
+        client: str,
+        round_submitted: int,
+        gradient: Any,
+        *,
+        seq: Optional[int] = None,
+    ) -> Tuple[bool, str]:
+        """One submission through the shard's full admission plane."""
+        return self.frontend.submit(
+            tenant, client, round_submitted, gradient, seq=seq
+        )
+
+    def sync_round(self, tenant: str, round_id: int) -> None:
+        """Advance the shard's staleness clock to the global round (the
+        coordinator drives it after every close — including closes this
+        shard missed, which is exactly how a partitioned shard's held
+        rows become one round staler)."""
+        self.frontend._tenants[tenant].round_id = int(round_id)
+
+    # -- round close (coordinator-driven) ---------------------------------
+
+    def drain_cohort(self, tenant: str) -> Optional[Tuple[list, Cohort]]:
+        """Loop-side half of the shard close: drain the admission queue
+        (plus anything requeued from a missed close) and build the
+        shard cohort at its EXACT size — cheap, event-loop-safe work.
+        Returns ``None`` when the shard has nothing this round."""
+        t = self.frontend._tenants[tenant]
+        t.held.extend(
+            t.queue.drain_nowait(max(0, t.cfg.cohort_cap - len(t.held)))
+        )
+        if not t.held:
+            return None
+        subs, t.held = t.held, []
+        cohort = build_cohort(
+            subs, t.round_id, None, t.cfg.staleness, tenant=t.cfg.name
+        )
+        self._inflight[(tenant, t.round_id)] = (subs, cohort)
+        return subs, cohort
+
+    def build_partial(
+        self, tenant: str, subs: list, cohort: Cohort
+    ) -> PartialFold:
+        """Executor-side half: extract the aggregator's partial fold
+        from the drained cohort and fingerprint the row bits. Pure
+        numpy on data the drain already assembled (the O(m·d) copy and
+        any family extras — e.g. the Multi-Krum Gram block — run off
+        the event loop)."""
+        if self.close_delay_s > 0:
+            time.sleep(self.close_delay_s)
+        t = self.frontend._tenants[tenant]
+        with obs_tracing.span(
+            "serving.shard_close",
+            track=f"shard:{self.index}",
+            shard=self.index, tenant=tenant,
+            round=t.round_id, m=cohort.m,
+        ):
+            partial = t.executor.aggregator.fold_partial(
+                cohort.matrix, cohort.valid, cohort.weights
+            )
+            rows = partial["rows"]
+            return PartialFold(
+                tenant=tenant,
+                round_id=t.round_id,
+                shard=self.index,
+                rows=rows,
+                clients=cohort.clients,
+                seqs=tuple(s.seq for s in subs),
+                wal_ids=tuple(s.wal_id for s in subs),
+                extras=partial.get("extras", {}),
+                digest=evidence_digest(rows),
+                first_arrival_s=cohort.first_arrival_s,
+            )
+
+    def close_partial(self, tenant: str) -> Optional[PartialFold]:
+        """Synchronous shard close (drain + build in one call — the
+        sync round closer and the drills use this)."""
+        drained = self.drain_cohort(tenant)
+        if drained is None:
+            return None
+        return self.build_partial(tenant, *drained)
+
+    def requeue(self, tenant: str, round_id: int) -> None:
+        """A drained-but-unmerged cohort (below-quorum window, straggler
+        past the barrier timeout, stale partial) returns to the FRONT
+        of the held list — admitted rows are never lost, they fold next
+        round (one round staler, the partition account)."""
+        entry = self._inflight.pop((tenant, round_id), None)
+        if entry is None:
+            return
+        subs, _cohort = entry
+        t = self.frontend._tenants[tenant]
+        t.held[:0] = subs
+
+    def discard_inflight(self, tenant: str, round_id: int) -> None:
+        """Drop a drained cohort without requeue (the root excluded
+        this shard's partial as forged — its rows are untrustworthy),
+        WITH the same release accounting as a failed round: the rows'
+        ``outstanding`` is freed (a leak here would wedge ``drain()``
+        and pin the gauge forever — the chaos drills wrap REAL shards)
+        and the drop is WAL-recorded so recovery never resurrects
+        rows the root refused."""
+        entry = self._inflight.pop((tenant, round_id), None)
+        if entry is None:
+            return
+        subs, _cohort = entry
+        t = self.frontend._tenants[tenant]
+        t.outstanding -= len(subs)
+        t.round_done.set()
+        if t.durability is not None:
+            t.durability.record_dropped(
+                round_id,
+                tuple(s.wal_id for s in subs if s.wal_id is not None),
+                "forged_partial",
+            )
+
+    def confirm(
+        self,
+        tenant: str,
+        round_id: int,
+        folded: Sequence[int],
+        duplicates: Sequence[int],
+        agg_digest: str,
+        aggregate: Any,
+        precomputed: Optional[dict] = None,
+    ) -> None:
+        """Root confirmation of a merged round: write the shard's WAL
+        round record for the rows the root folded (exactly-once
+        accounting joins on these wal_ids), WAL-account rows the root
+        refused as already-folded (``root_duplicate``), feed the
+        shard's forensics plane (global aggregate + the root's sliced
+        score view), release ``outstanding``, and record round stats."""
+        entry = self._inflight.pop((tenant, round_id), None)
+        if entry is None:
+            return
+        subs, cohort = entry
+        t = self.frontend._tenants[tenant]
+        # defensive: the indices describe the PARTIAL's rows; a forged
+        # partial (extra fabricated rows) can reference positions the
+        # honest inflight record never had — never let a Byzantine
+        # payload crash an honest shard's bookkeeping
+        folded = [i for i in folded if 0 <= i < len(subs)]
+        duplicates = [i for i in duplicates if 0 <= i < len(subs)]
+        folded_subs = [subs[i] for i in folded]
+        dup_subs = [subs[i] for i in duplicates]
+        if t.durability is not None:
+            t.durability.record_round(
+                round_id,
+                tuple(
+                    s.wal_id for s in folded_subs if s.wal_id is not None
+                ),
+                agg_digest,
+                len(folded_subs),
+            )
+            if dup_subs:
+                t.durability.record_dropped(
+                    round_id,
+                    tuple(
+                        s.wal_id for s in dup_subs if s.wal_id is not None
+                    ),
+                    ROOT_DUPLICATE,
+                )
+            t.durability.note_round_closed()
+        if t.forensics is not None and folded_subs:
+            fold_cohort = (
+                cohort
+                if len(folded_subs) == len(subs)
+                else build_cohort(
+                    folded_subs, round_id, None, t.cfg.staleness,
+                    tenant=t.cfg.name,
+                )
+            )
+            prep = self.frontend._forensics_prepare(
+                t, fold_cohort, aggregate, folded_subs,
+                precomputed=precomputed,
+            )
+            if prep is not None:
+                self.frontend._observe_forensics(
+                    t, fold_cohort, aggregate, folded_subs, prep
+                )
+        t.last_aggregate = aggregate
+        t.last_cohort_clients = tuple(s.client for s in folded_subs)
+        t.outstanding -= len(subs)
+        t.round_done.set()
+        t.stats.record(self.clock() - cohort.first_arrival_s, len(folded_subs))
+        self.frontend._maybe_snapshot(t)
+
+    def account_failed(self, tenant: str, round_id: int) -> None:
+        """The root's merged finalize crashed: this shard's contributed
+        rows are dropped WITH accounting (WAL drop record, outstanding
+        release) — the single frontend's ``_fail_round`` contract,
+        distributed."""
+        entry = self._inflight.pop((tenant, round_id), None)
+        if entry is None:
+            return
+        subs, cohort = entry
+        t = self.frontend._tenants[tenant]
+        t.failed_rounds += 1
+        t.outstanding -= len(subs)
+        t.round_done.set()
+        if t.durability is not None:
+            t.durability.record_dropped(
+                round_id,
+                tuple(s.wal_id for s in subs if s.wal_id is not None),
+                "failed_round",
+            )
+
+    def shutdown(self) -> None:
+        """Release the shard's durable handles (flush-per-append makes
+        this equivalent to SIGKILL for WAL purposes — nothing buffered
+        is lost either way; the drill kills WITHOUT calling this)."""
+        self.alive = False
+        for t in self.frontend._tenants.values():
+            if t.durability is not None:
+                t.durability.close()
+
+    def stats(self) -> dict:
+        """The inner frontend's per-tenant accounting snapshot."""
+        return self.frontend.stats()
+
+
+class _RootLadder:
+    """Root-merge bucket sizes ``{b·2^k, b·3·2^(k−1)}``: worst-case
+    padding overshoot 4/3, where the serving tier's power-of-two
+    ladder allows 2×. The trade is right at the root and wrong at the
+    tenant frontends: a merged cohort is 10⁴+ rows, the masked program
+    streams O(bucket·d) bytes, and the extra padding is real
+    milliseconds per round — while the compile count stays O(log cap)
+    (~2× the power-of-two ladder's)."""
+
+    __slots__ = ("sizes",)
+
+    def __init__(self, cap: int, *, min_bucket: int = 2) -> None:
+        if cap <= 0 or min_bucket <= 0:
+            raise ValueError("cap and min_bucket must be >= 1")
+        sizes = set()
+        b = max(2, int(min_bucket))
+        while True:
+            sizes.add(b)
+            sizes.add(b + b // 2)
+            if b >= cap:
+                break
+            b *= 2
+        self.sizes: Tuple[int, ...] = tuple(sorted(sizes))
+
+    @property
+    def cap(self) -> int:
+        """Largest bucket."""
+        return self.sizes[-1]
+
+    def bucket_for(self, m: int) -> int:
+        """Smallest ladder size holding an ``m``-row merged cohort."""
+        if m <= 0:
+            raise ValueError(f"cohort size must be >= 1 (got {m})")
+        import bisect
+
+        i = bisect.bisect_left(self.sizes, m)
+        if i == len(self.sizes):
+            raise ValueError(
+                f"merged cohort of {m} exceeds the root cap {self.cap}"
+            )
+        return self.sizes[i]
+
+
+class _RootTenant:
+    """Root-side per-tenant state: the global round counter, the merged
+    bucket ladder, the cross-shard dedup authority, quorum accounting,
+    and (optionally) the root's own WAL of merge evidence."""
+
+    __slots__ = (
+        "cfg", "round_id", "last_aggregate", "ladder", "stats",
+        "min_cohort", "seqs", "max_tracked", "quorum_failures",
+        "failed_rounds", "quorum_closes", "partitions", "forged",
+        "root_duplicates", "durability", "rounds",
+    )
+
+    def __init__(
+        self,
+        cfg: TenantConfig,
+        n_shards: int,
+        *,
+        max_tracked: int,
+        durability: Optional[TenantDurability],
+    ) -> None:
+        self.cfg = cfg
+        self.round_id = 0
+        self.last_aggregate: Any = None
+        self.rounds = 0
+        # merged cohorts can reach n_shards x cohort_cap rows; the root
+        # ladder keeps one compiled masked program per bucket, not one
+        # per distinct merged size (the single frontend's jit-cache
+        # economics, moved up a level — with the finer _RootLadder
+        # steps, because padding overshoot is O(bucket·d) device bytes
+        # at these row counts)
+        self.ladder = _RootLadder(
+            max(2, n_shards * cfg.cohort_cap), min_bucket=cfg.min_bucket
+        )
+        self.stats = RoundStats()
+        # the tenant's global admissibility floor (the aggregator's
+        # smallest admissible n, same probe the single frontend runs)
+        floor = cfg.min_cohort
+        for m in range(1, self.ladder.cap + 1):
+            try:
+                cfg.aggregator.validate_n(m)
+            except ValueError:
+                continue
+            floor = max(floor, m)
+            break
+        self.min_cohort = floor
+        #: cross-shard dedup authority: per-client highest ROOT-FOLDED
+        #: seq (LRU-bounded like the shard tables)
+        self.seqs: "OrderedDict[str, int]" = OrderedDict()
+        self.max_tracked = int(max_tracked)
+        self.quorum_failures = 0
+        self.failed_rounds = 0
+        self.quorum_closes = 0
+        self.partitions = 0
+        self.forged = 0
+        self.root_duplicates = 0
+        self.durability = durability
+
+    def is_folded(self, client: str, seq: Optional[int]) -> bool:
+        if seq is None:
+            return False
+        return self.seqs.get(client, -1) >= int(seq)
+
+    def note_folded(self, client: str, seq: Optional[int]) -> None:
+        if seq is None:
+            return
+        self.seqs[client] = max(self.seqs.get(client, -1), int(seq))
+        self.seqs.move_to_end(client)
+        if len(self.seqs) > self.max_tracked:
+            self.seqs.popitem(last=False)
+
+
+class ShardedCoordinator:
+    """The sharded tier's root: shard fan-out, barrier close, partial
+    verification, hierarchical merge, and failover (module docstring).
+
+    In-process deployment (tests, drills, the Podracer-style bench
+    swarm): the coordinator owns its :class:`ShardFrontend` objects
+    directly. Process-per-shard deployment: each shard runs its inner
+    frontend's TCP ingress (``coordinator.shards[i].frontend.serve()``)
+    and ships ``PartialFold.to_wire()`` frames over the HMAC wire; the
+    verification, merge and confirm protocol is identical — the root
+    decodes with :meth:`PartialFold.from_wire`."""
+
+    def __init__(
+        self,
+        tenants: Sequence[TenantConfig],
+        n_shards: int,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        shard_timeout_s: float = 0.25,
+        quorum: Optional[int] = None,
+        durability: Optional[DurabilityConfig] = None,
+        on_round: Optional[Callable[[str, int, Any, Any], None]] = None,
+        extras_policy: str = "trust",
+        max_tracked_clients: int = 1 << 16,
+    ) -> None:
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        if quorum is not None and not 1 <= quorum <= n_shards:
+            raise ValueError(f"quorum must be in [1, {n_shards}]")
+        if extras_policy not in ("trust", "verify", "recompute"):
+            raise ValueError(
+                "extras_policy must be 'trust', 'verify' or 'recompute' "
+                f"(got {extras_policy!r})"
+            )
+        self.router = ShardRouter(n_shards)
+        self._clock = clock
+        self.shard_timeout_s = float(shard_timeout_s)
+        #: shards required for a close; default = majority
+        self.quorum = quorum if quorum is not None else n_shards // 2 + 1
+        self.extras_policy = extras_policy
+        self._on_round = on_round
+        self.callback_errors = 0
+        self._durability = durability
+        self.shards: List[ShardFrontend] = [
+            ShardFrontend(
+                i, tenants, clock=clock,
+                durability=self._shard_durability(i),
+            )
+            for i in range(n_shards)
+        ]
+        self._roots: Dict[str, _RootTenant] = {}
+        for cfg in tenants:
+            root_dur = None
+            if durability is not None:
+                root_dur = TenantDurability(
+                    dataclasses.replace(
+                        durability,
+                        directory=os.path.join(durability.directory, "root"),
+                    ),
+                    cfg.name,
+                )
+            rt = _RootTenant(
+                cfg, n_shards, max_tracked=max_tracked_clients,
+                durability=root_dur,
+            )
+            if root_dur is not None and root_dur.recovered is not None:
+                rt.round_id = root_dur.recovered.round_id
+            self._roots[cfg.name] = rt
+        for shard in self.shards:
+            for name, rt in self._roots.items():
+                shard.sync_round(name, rt.round_id)
+        #: shard events the audit trail sees even without durability
+        #: (forged folds, partitions, quorum closes) — bounded tail
+        self.shard_events: List[dict] = []
+        self._tenant_cfgs = list(tenants)
+        self._running = False
+        self._tasks: list = []
+        self._device_lock: Optional[asyncio.Lock] = None
+        reg = obs_metrics.registry()
+        self._m_accepted = {
+            (cfg.name, i): reg.counter(
+                "byzpy_shard_accepted_total",
+                help="submissions accepted per frontend shard",
+                labels={"tenant": cfg.name, "shard": str(i)},
+            )
+            for cfg in tenants
+            for i in range(n_shards)
+        }
+        self._m_merge_s = {
+            cfg.name: reg.histogram(
+                "byzpy_shard_merge_seconds",
+                help="root-side verify+merge+finalize latency per round",
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
+        self._m_rounds = {
+            cfg.name: reg.counter(
+                "byzpy_shard_rounds_total",
+                help="rounds closed by the sharded root",
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
+        self._m_quorum = {
+            cfg.name: reg.counter(
+                "byzpy_shard_quorum_closes_total",
+                help="degraded closes (quorum met, >=1 shard missing)",
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
+        self._m_partitions = {
+            (cfg.name, i): reg.counter(
+                "byzpy_shard_partitions_total",
+                help="shard-rounds accounted as a partition",
+                labels={"tenant": cfg.name, "shard": str(i)},
+            )
+            for cfg in tenants
+            for i in range(n_shards)
+        }
+        self._m_forged = {
+            (cfg.name, i): reg.counter(
+                "byzpy_shard_forged_folds_total",
+                help="partial folds excluded by root cross-checks",
+                labels={"tenant": cfg.name, "shard": str(i)},
+            )
+            for cfg in tenants
+            for i in range(n_shards)
+        }
+        self._m_live = reg.gauge(
+            "byzpy_shards_live", help="frontend shards currently alive"
+        )
+        self._m_live.set(n_shards)
+
+    def _shard_durability(self, index: int) -> Optional[DurabilityConfig]:
+        if self._durability is None:
+            return None
+        return dataclasses.replace(
+            self._durability,
+            directory=os.path.join(self._durability.directory, f"shard{index}"),
+        )
+
+    @property
+    def n_shards(self) -> int:
+        """Configured shard count (dead shards included)."""
+        return self.router.n_shards
+
+    def live_shards(self) -> List[ShardFrontend]:
+        """Shards currently serving."""
+        return [s for s in self.shards if s.alive]
+
+    # -- admission (sticky routing) ---------------------------------------
+
+    def submit(
+        self,
+        tenant: str,
+        client: str,
+        round_submitted: int,
+        gradient: Any,
+        *,
+        seq: Optional[int] = None,
+    ) -> Tuple[bool, str]:
+        """Route one submission to the client's home shard."""
+        shard = self.shards[self.router.shard_for(client)]
+        if not shard.alive:
+            return False, REJECTED_SHARD_DOWN
+        ok, reason = shard.submit(
+            tenant, client, round_submitted, gradient, seq=seq
+        )
+        if ok and obs_runtime.STATE.enabled:
+            self._m_accepted[(tenant, shard.index)].inc()
+        return ok, reason
+
+    # -- partial verification ---------------------------------------------
+
+    def _verify_partial(
+        self, rt: _RootTenant, p: PartialFold
+    ) -> Tuple[Optional[Tuple[List[int], List[int]]], str]:
+        """Root cross-checks of one shard's partial. Returns
+        ``((folded row indices, duplicate row indices), measured_digest)``
+        — the first element ``None`` when the whole partial is excluded
+        as forged (digest mismatch, field nonsense, row-cap abuse,
+        extras inconsistency under ``extras_policy='verify'``). The
+        measured digest rides back so the evidence event does not hash
+        the same rows a second time."""
+        rows = p.rows
+        agg = rt.cfg.aggregator
+        if (
+            rows.ndim != 2
+            or rows.shape[0] != len(p.clients)
+            or rows.shape[0] > rt.cfg.cohort_cap
+            or (rows.shape[0] and rows.shape[1] != rt.cfg.dim)
+        ):
+            return None, ""
+        measured = evidence_digest(rows)
+        if measured != p.digest:
+            return None, measured
+        if p.extras and self.extras_policy == "verify":
+            want = agg._partial_extras(np.asarray(rows, np.float32))
+            for key, val in want.items():
+                got = p.extras.get(key)
+                # equal_nan: admission deliberately passes non-finite
+                # VALUES (adversarial payloads are the aggregator's
+                # job), and a NaN gradient propagates into the extras
+                # (a NaN Gram entry, a NaN running sum) — the honest
+                # recompute reproduces the same NaNs, which plain
+                # array_equal would call a mismatch, branding an honest
+                # shard forged off one client's NaN row
+                if got is None or not np.array_equal(
+                    np.asarray(val), np.asarray(got), equal_nan=True
+                ):
+                    return None, measured
+        folded: List[int] = []
+        dups: List[int] = []
+        for j, (client, seq) in enumerate(
+            zip(p.clients, p.seqs, strict=True)
+        ):
+            if self.router.shard_for(client) != p.shard:
+                # a client this shard does not own: sticky routing makes
+                # the claim a protocol violation — the whole partial is
+                # untrustworthy (the replay-another-shard attack)
+                return None, measured
+            if rt.is_folded(client, seq):
+                dups.append(j)
+            else:
+                folded.append(j)
+        return (folded, dups), measured
+
+    def _note_event(self, event: dict) -> None:
+        self.shard_events.append(event)
+        if len(self.shard_events) > 1024:
+            del self.shard_events[:512]
+
+    # -- round close (sync door) ------------------------------------------
+
+    def close_round_nowait(
+        self, tenant: str
+    ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """Synchronously drive one root round: barrier every live shard
+        (a shard whose close raises is accounted as a partition), check
+        quorum, verify + merge + finalize, confirm, broadcast. Returns
+        ``(closed_round_id, merged_rows, aggregate)`` or ``None`` while
+        the window stays open (no admissible cohort / below quorum).
+        The virtual-clock twin of the async scheduler — the chaos
+        ``shard`` lane and the drills run rounds through here."""
+        if self._tasks:
+            raise RuntimeError(
+                "close_round_nowait cannot run next to the async root "
+                "scheduler (start() was called) — use one round closer"
+            )
+        rt = self._roots[tenant]
+        partials: List[PartialFold] = []
+        responders = 0
+        missing: List[int] = []
+        for shard in self.shards:
+            if not shard.alive:
+                missing.append(shard.index)
+                continue
+            try:
+                p = shard.close_partial(tenant)
+            except Exception:  # noqa: BLE001 — a crashing shard close is
+                # a partition, not a root outage; anything it drained
+                # before crashing returns to its held list (the async
+                # twin's contract — rows are never lost)
+                shard.requeue(tenant, rt.round_id)
+                missing.append(shard.index)
+                continue
+            responders += 1
+            if p is not None:
+                partials.append(p)
+        if responders < self.quorum:
+            for p in partials:
+                self.shards[p.shard].requeue(tenant, p.round_id)
+            rt.quorum_failures += 1
+            return None
+        return self.merge_partials(tenant, partials, missing=missing)
+
+    def merge_partials(
+        self,
+        tenant: str,
+        partials: Sequence[PartialFold],
+        *,
+        missing: Sequence[int] = (),
+    ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """The ROOT half of a round close, as a standalone door: verify
+        + hierarchical merge + finalize + confirm/broadcast for
+        already-extracted partials (quorum is the caller's call —
+        :meth:`close_round_nowait` and the async scheduler both land
+        here; a remote-root deployment feeds it
+        :func:`decode_partial_fold` results off the wire). ``missing``
+        names shards to account as a partition in this close."""
+        rt = self._roots[tenant]
+        actions: List[tuple] = []
+        computed = self._verify_and_merge(rt, partials, actions)
+        self._apply_shard_actions(tenant, actions)
+        if computed is None:
+            return None
+        verified, merged, vec, t0 = computed
+        return self._finish(rt, verified, merged, vec, list(missing), t0)
+
+    def _apply_shard_actions(
+        self, tenant: str, actions: Sequence[tuple]
+    ) -> None:
+        """Execute the shard-state side effects :meth:`_verify_and_merge`
+        deferred — requeues, forged-partial discards, failed-round
+        accounting. Runs on the EVENT LOOP in the async path: these
+        mutate loop-confined tenant state (``outstanding``, held lists,
+        ``round_done``) that the admission path touches concurrently,
+        so the executor half must only describe them. Shard indices
+        are bounds-checked here: a forged frame on the remote-root door
+        may claim any index."""
+        for kind, idx, round_id in actions:
+            if not 0 <= idx < len(self.shards):
+                continue
+            shard = self.shards[idx]
+            if kind == "requeue":
+                shard.requeue(tenant, round_id)
+            elif kind == "discard":
+                shard.discard_inflight(tenant, round_id)
+            elif kind == "fail":
+                shard.account_failed(tenant, round_id)
+
+    def _verify_and_merge(
+        self,
+        rt: _RootTenant,
+        partials: Sequence[PartialFold],
+        actions: List[tuple],
+    ) -> Optional[tuple]:
+        """The heavy, loop-free middle of a close: verify every partial
+        (forged → excluded + counted + evidence event; stale → requeued
+        as a partition), merge the survivors in shard order, finalize
+        at the root bucket shape under the device span. Shard-state
+        side effects are NOT applied here — they are appended to
+        ``actions`` for :meth:`_apply_shard_actions` to run loop-side
+        (the async path executes this whole method on an executor
+        thread, and ``outstanding``/held-list/``round_done`` state is
+        loop-confined). Returns ``(verified, merged, vec, t0)``;
+        ``None`` means no close this window (below the admissibility
+        floor, or the finalize failed — accounting described in
+        ``actions``)."""
+        tenant = rt.cfg.name
+        t0 = self._clock()
+        verified: List[Tuple[PartialFold, List[int], List[int]]] = []
+        seen_shards: set = set()
+        for p in sorted(partials, key=lambda p: p.shard):
+            known = 0 <= p.shard < len(self.shards)
+            if (
+                not known
+                or p.shard in seen_shards
+                or p.tenant != tenant
+                or p.round_id != rt.round_id
+            ):
+                if not known or p.shard in seen_shards:
+                    # an unknown shard index, or a second partial
+                    # claiming a shard this close already heard from —
+                    # only possible on the remote-root door (in-process
+                    # closes iterate the coordinator's own shards):
+                    # reject WITHOUT touching any real shard's state (a
+                    # forged index must not discard a victim's cohort)
+                    rt.forged += 1
+                    self._note_event(
+                        {
+                            "event": "shard_forged",
+                            "tenant": tenant,
+                            "round": rt.round_id,
+                            "shard": int(p.shard),
+                            "reason": (
+                                "unknown_shard" if not known
+                                else "duplicate_shard"
+                            ),
+                            "m": p.m,
+                        }
+                    )
+                    continue
+                # stale or misaddressed partial: the shard's rows go
+                # back to its held list (a partition, not a forgery)
+                actions.append(("requeue", p.shard, p.round_id))
+                rt.partitions += 1
+                if obs_runtime.STATE.enabled:
+                    self._m_partitions[(tenant, p.shard)].inc()
+                continue
+            seen_shards.add(p.shard)
+            checks, measured = self._verify_partial(rt, p)
+            if checks is None:
+                rt.forged += 1
+                actions.append(("discard", p.shard, p.round_id))
+                if obs_runtime.STATE.enabled:
+                    self._m_forged[(tenant, p.shard)].inc()
+                event = {
+                    "event": "shard_forged",
+                    "tenant": tenant,
+                    "round": rt.round_id,
+                    "shard": p.shard,
+                    "claimed_digest": p.digest,
+                    "measured_digest": measured,
+                    "m": p.m,
+                }
+                self._note_event(event)
+                if rt.durability is not None:
+                    rt.durability.record_evidence(rt.round_id, event)
+                continue
+            verified.append((p, *checks))
+        m_total = sum(len(f) for _, f, _ in verified)
+        if m_total < rt.min_cohort:
+            # under the global admissibility floor: hold the window
+            # open — every shard's rows return to its held list (and
+            # the duplicate rows are NOT counted: they will be
+            # re-verified when the window finally closes)
+            for p, _f, _d in verified:
+                actions.append(("requeue", p.shard, p.round_id))
+            return None
+        rt.root_duplicates += sum(len(d) for _, _, d in verified)
+        merge_partials = []
+        for p, folded, dups in verified:
+            if dups:
+                # rows were dropped: the shipped extras describe the
+                # full row set and no longer apply — recompute at merge
+                merge_partials.append(
+                    {"rows": p.rows[folded], "m": len(folded)}
+                )
+            elif self.extras_policy == "recompute" or not p.extras:
+                merge_partials.append({"rows": p.rows, "m": p.m})
+            else:
+                merge_partials.append(
+                    {"rows": p.rows, "m": p.m, "extras": p.extras}
+                )
+        agg = rt.cfg.aggregator
+        with obs_tracing.span(
+            "serving.fold_merge", track="root", tenant=tenant,
+            round=rt.round_id, shards=len(verified), m=m_total,
+        ):
+            merged = agg.fold_merge(merge_partials)
+            try:
+                with obs_tracing.device_span(
+                    "serving.device_step", track="root", tenant=tenant,
+                    m=m_total, bucket=rt.ladder.bucket_for(m_total),
+                ):
+                    vec = np.asarray(
+                        agg.fold_merge_finalize(
+                            merged, bucket=rt.ladder.bucket_for(m_total)
+                        )
+                    )
+            except Exception:  # noqa: BLE001 — a poisoned merged cohort
+                # must not kill the root: the round fails with per-shard
+                # accounting, serving continues
+                rt.failed_rounds += 1
+                for p, _f, _d in verified:
+                    actions.append(("fail", p.shard, rt.round_id))
+                return None
+        return verified, merged, vec, t0
+
+    def _finish(
+        self,
+        rt: _RootTenant,
+        verified: Sequence[Tuple[PartialFold, List[int], List[int]]],
+        merged: dict,
+        vec: np.ndarray,
+        missing: Sequence[int],
+        t0: float,
+    ) -> Tuple[int, np.ndarray, np.ndarray]:
+        """Bookkeeping half of a successful close (loop-side on the
+        async path): root dedup update, root WAL merge evidence, shard
+        confirmations + forensics fan-out, stats, round advance."""
+        tenant = rt.cfg.name
+        digest = evidence_digest(vec)
+        view = None
+        try:
+            view = rt.cfg.aggregator.merged_score_view(
+                merged, aggregate=vec
+            )
+        except Exception:  # noqa: BLE001 — the score view is forensics
+            # input, never a round participant
+            self.callback_errors += 1
+        offsets = list(merged.get("offsets", []))
+        m_total = int(merged["m"])
+        closed = rt.round_id
+        for idx, (p, folded, dups) in enumerate(verified):
+            for j in folded:
+                rt.note_folded(p.clients[j], p.seqs[j])
+            pre = None
+            if view is not None and not dups and idx < len(offsets):
+                start = offsets[idx]
+                stop = start + len(folded)
+                pre = {
+                    "kind": view["kind"],
+                    "scores": (
+                        None
+                        if view.get("scores") is None
+                        else np.asarray(view["scores"])[start:stop]
+                    ),
+                    "keep": (
+                        None
+                        if view.get("keep") is None
+                        else np.asarray(view["keep"])[start:stop]
+                    ),
+                }
+            self.shards[p.shard].confirm(
+                tenant, closed, folded, dups, digest, vec, pre
+            )
+        if rt.durability is not None:
+            rt.durability.record_evidence(
+                closed,
+                {
+                    "event": "merge",
+                    "round": closed,
+                    "m": m_total,
+                    "agg_digest": digest,
+                    "shards": {
+                        int(p.shard): {
+                            "digest": p.digest,
+                            "m": p.m,
+                            "folded": [
+                                [p.clients[j], p.seqs[j]] for j in folded
+                            ],
+                            "duplicates": len(dups),
+                        }
+                        for p, folded, dups in verified
+                    },
+                },
+            )
+            rt.durability.record_round(closed, (), digest, m_total)
+            rt.durability.note_round_closed()
+        rt.last_aggregate = vec
+        rt.rounds += 1
+        first_arrival = min(
+            (p.first_arrival_s for p, _f, _d in verified), default=t0
+        )
+        rt.stats.record(self._clock() - first_arrival, m_total)
+        degraded = bool(missing)
+        if degraded:
+            rt.quorum_closes += 1
+            for i in missing:
+                rt.partitions += 1
+                if obs_runtime.STATE.enabled:
+                    self._m_partitions[(tenant, i)].inc()
+            self._note_event(
+                {
+                    "event": "quorum_close",
+                    "tenant": tenant,
+                    "round": closed,
+                    "missing": list(missing),
+                }
+            )
+            if rt.durability is not None:
+                rt.durability.record_evidence(
+                    closed,
+                    {
+                        "event": "quorum_close",
+                        "round": closed,
+                        "missing": list(missing),
+                    },
+                )
+        rt.round_id += 1
+        for shard in self.shards:
+            if shard.alive:
+                shard.sync_round(tenant, rt.round_id)
+        if obs_runtime.STATE.enabled:
+            self._m_rounds[tenant].inc()
+            self._m_merge_s[tenant].observe(self._clock() - t0)
+            if degraded:
+                self._m_quorum[tenant].inc()
+        if self._on_round is not None:
+            try:
+                self._on_round(tenant, closed, merged, vec)
+            except Exception:  # noqa: BLE001 — observer bug, counted
+                self.callback_errors += 1
+        return closed, merged["rows"], vec
+
+    # -- async root scheduler ---------------------------------------------
+
+    async def start(self) -> None:
+        """Launch one root round loop per tenant (window-triggered
+        barrier closes with the straggler timeout)."""
+        if self._running:
+            return
+        self._running = True
+        self._device_lock = asyncio.Lock()
+        self._tasks = [
+            asyncio.create_task(
+                self._root_loop(cfg), name=f"sharded-root-{cfg.name}"
+            )
+            for cfg in self._tenant_cfgs
+        ]
+
+    async def close(self) -> None:
+        """Stop the root scheduler and release shard durable handles
+        (idempotent)."""
+        self._running = False
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks = []
+        for shard in self.shards:
+            if shard.alive:
+                shard.shutdown()
+        for rt in self._roots.values():
+            if rt.durability is not None:
+                rt.durability.close()
+
+    async def _root_loop(self, cfg: TenantConfig) -> None:
+        while self._running:
+            await asyncio.sleep(cfg.window_s)
+            try:
+                await self._close_async(cfg.name)
+            except Exception:  # noqa: BLE001 — a failed window must not
+                # kill the root scheduler
+                self.callback_errors += 1
+
+    async def _close_async(self, tenant: str) -> Optional[tuple]:
+        """One async barrier close: drain every live shard on the loop
+        (queue access is loop-confined), build partials concurrently on
+        the executor under the straggler timeout, then merge+finalize
+        off-loop under the device lock and finish on the loop (WAL
+        writes stay loop-confined)."""
+        loop = asyncio.get_running_loop()
+        rt = self._roots[tenant]
+        drained: Dict[int, tuple] = {}
+        missing: List[int] = []
+        responders = 0
+        for shard in self.shards:
+            if not shard.alive:
+                missing.append(shard.index)
+                continue
+            responders += 1
+            d = shard.drain_cohort(tenant)
+            if d is not None:
+                drained[shard.index] = d
+        if responders < self.quorum:
+            for i, (subs, _c) in drained.items():
+                self.shards[i].requeue(tenant, rt.round_id)
+            rt.quorum_failures += 1
+            return None
+        futs = {
+            loop.run_in_executor(
+                None, self.shards[i].build_partial, tenant, subs, cohort
+            ): i
+            for i, (subs, cohort) in drained.items()
+        }
+        partials: List[PartialFold] = []
+        crashed = 0
+        if futs:
+            done, pending = await asyncio.wait(
+                futs.keys(), timeout=self.shard_timeout_s
+            )
+            for fut in done:
+                i = futs[fut]
+                try:
+                    partials.append(fut.result())
+                except Exception:  # noqa: BLE001 — crashing shard close
+                    crashed += 1
+                    missing.append(i)
+                    self.shards[i].requeue(tenant, rt.round_id)
+            stragglers = sorted(futs[f] for f in pending)
+            missing.extend(stragglers)
+            round_id = rt.round_id
+            for fut in pending:
+                # past the barrier: when the late build completes, its
+                # rows return to the shard's held list for next round
+                fut.add_done_callback(
+                    lambda f, i=futs[fut], r=round_id: self.shards[
+                        i
+                    ].requeue(tenant, r)
+                )
+            # stragglers and crashes ate into the quorum: re-check with
+            # the shards that actually answered the barrier
+            responders -= len(stragglers) + crashed
+            if responders < self.quorum:
+                for p in partials:
+                    self.shards[p.shard].requeue(tenant, p.round_id)
+                rt.quorum_failures += 1
+                return None
+        if not partials:
+            return None
+        assert self._device_lock is not None
+        actions: List[tuple] = []
+        async with self._device_lock:
+            computed = await loop.run_in_executor(
+                None, self._verify_and_merge, rt, partials, actions
+            )
+        # shard-state side effects (requeues/discards/failure accounting)
+        # run HERE, back on the loop — the executor half only described
+        # them (outstanding/held/round_done are loop-confined state the
+        # admission path touches concurrently)
+        self._apply_shard_actions(tenant, actions)
+        if computed is None:
+            return None
+        verified, merged, vec, t0 = computed
+        return self._finish(rt, verified, merged, vec, missing, t0)
+
+    # -- failover ----------------------------------------------------------
+
+    def kill_shard(self, index: int) -> None:
+        """Drill door: the shard is dead (its in-memory queues, held
+        cohorts and ledgers are GONE — the SIGKILL shape; only its WAL
+        survives). Routing keeps its clients sticky: their submissions
+        are rejected ``rejected_shard_down`` until recovery."""
+        shard = self.shards[index]
+        shard.alive = False
+        shard._inflight.clear()
+        self._m_live.set(len(self.live_shards()))
+
+    def recover_shard(self, index: int) -> ShardFrontend:
+        """Rebuild a dead shard FROM ITS WAL ALONE (ledger-delta
+        replay): a fresh inner frontend recovers pending accepts, the
+        dedup table, and credit-ledger totals from the shard's
+        durability directory; the staleness clock is re-synced to the
+        global round. Rows the dead shard had acked-but-not-folded
+        re-enter its queue and fold in a later round — the root dedup
+        table guarantees exactly-once if any were already merged."""
+        if self._durability is None:
+            raise ValueError(
+                "recover_shard needs the coordinator's durability config"
+            )
+        old = self.shards[index]
+        if old.alive:
+            raise ValueError(f"shard {index} is still alive")
+        shard = ShardFrontend(
+            index,
+            self._tenant_cfgs,
+            clock=self._clock,
+            durability=self._shard_durability(index),
+        )
+        for name, rt in self._roots.items():
+            shard.sync_round(name, rt.round_id)
+        self.shards[index] = shard
+        self._m_live.set(len(self.live_shards()))
+        return shard
+
+    # -- introspection ------------------------------------------------------
+
+    def round_of(self, tenant: str) -> int:
+        """Current global round of ``tenant``."""
+        return self._roots[tenant].round_id
+
+    def last_aggregate(self, tenant: str) -> Any:
+        """Most recent merged broadcast (None before round 0)."""
+        return self._roots[tenant].last_aggregate
+
+    def reset_round_stats(self) -> None:
+        """Zero the root latency/cohort windows (bench warmup boundary;
+        accounting state untouched — the single-frontend contract)."""
+        for rt in self._roots.values():
+            rt.stats = RoundStats()
+
+    def stats(self) -> dict:
+        """Root + per-shard accounting snapshot."""
+        out: dict = {"shards": {}, "root": {}}
+        for shard in self.shards:
+            out["shards"][shard.index] = (
+                shard.stats() if shard.alive else None
+            )
+        for name, rt in self._roots.items():
+            p50, p99 = rt.stats.latency_percentiles_s(50, 99)
+            out["root"][name] = {
+                "round_id": rt.round_id,
+                "rounds": rt.rounds,
+                "min_cohort": rt.min_cohort,
+                "quorum": self.quorum,
+                "quorum_failures": rt.quorum_failures,
+                "quorum_closes": rt.quorum_closes,
+                "partitions": rt.partitions,
+                "forged_partials": rt.forged,
+                "root_duplicates": rt.root_duplicates,
+                "failed_rounds": rt.failed_rounds,
+                "p50_round_latency_s": p50,
+                "p99_round_latency_s": p99,
+                "mean_cohort": (
+                    float(np.mean(rt.stats.cohort_sizes))
+                    if rt.stats.cohort_sizes
+                    else 0.0
+                ),
+                "ladder": list(rt.ladder.sizes),
+            }
+        return out
+
+
+def audit_sharded_exactly_once(
+    directory: str, tenant: str, n_shards: int
+) -> dict:
+    """Cross-WAL exactly-once audit of one sharded deployment: reads
+    every shard's WAL plus the root's merge evidence and checks the
+    tier's invariants —
+
+    1. every ``(client, seq)`` the root folded appears in EXACTLY one
+       merge record (no double-folds across failovers);
+    2. per shard, every wal_id named by a round record was accepted in
+       that shard's WAL (no folds of phantom rows);
+    3. no shard wal_id is both round-folded and drop-accounted (a row
+       either folded or was dropped with accounting, never both);
+    4. every accepted wal_id is folded, dropped, or still pending (no
+       silent loss).
+
+    Returns ``{"violations": [...], "folded": n, "accepted": n,
+    "root_rounds": n, "pending": n}`` — the drill asserts an empty
+    violations list over many seeds."""
+    violations: List[str] = []
+    folded_pairs: Dict[Tuple[str, int], int] = {}
+    root_rounds = 0
+    root_dir = os.path.join(directory, "root", tenant)
+    if os.path.isdir(root_dir):
+        records, _torn = read_wal(root_dir)
+        for rec in records:
+            if rec[0] == "e" and isinstance(rec[2], dict):
+                ev = rec[2]
+                if ev.get("event") != "merge":
+                    continue
+                root_rounds += 1
+                for info in ev.get("shards", {}).values():
+                    for client, seq in info.get("folded", ()):
+                        if seq is None:
+                            continue
+                        key = (str(client), int(seq))
+                        folded_pairs[key] = folded_pairs.get(key, 0) + 1
+    for key, count in folded_pairs.items():
+        if count > 1:
+            violations.append(
+                f"(client, seq) {key} folded {count} times at the root"
+            )
+    accepted_total = 0
+    pending_total = 0
+    for i in range(n_shards):
+        shard_dir = os.path.join(directory, f"shard{i}", tenant)
+        if not os.path.isdir(shard_dir):
+            continue
+        records, _torn = read_wal(shard_dir)
+        accepted: Dict[int, tuple] = {}
+        folded: set = set()
+        dropped: set = set()
+        for rec in records:
+            kind = rec[0]
+            if kind == "a":
+                accepted[int(rec[1])] = (rec[2], rec[3])
+            elif kind == "r":
+                for w in rec[2]:
+                    if w in folded:
+                        violations.append(
+                            f"shard{i} wal_id {w} folded twice"
+                        )
+                    if w not in accepted:
+                        violations.append(
+                            f"shard{i} folded phantom wal_id {w}"
+                        )
+                    folded.add(w)
+            elif kind == "f":
+                dropped.update(int(w) for w in rec[2])
+        both = folded & dropped
+        for w in sorted(both):
+            violations.append(
+                f"shard{i} wal_id {w} both folded and dropped"
+            )
+        accepted_total += len(accepted)
+        pending_total += len(
+            set(accepted) - folded - dropped
+        )
+    return {
+        "violations": violations,
+        "folded": sum(folded_pairs.values()),
+        "accepted": accepted_total,
+        "pending": pending_total,
+        "root_rounds": root_rounds,
+    }
+
+
+__all__ = [
+    "PARTIAL_FOLD",
+    "REJECTED_SHARD_DOWN",
+    "ROOT_DUPLICATE",
+    "PartialFold",
+    "ShardFrontend",
+    "ShardRouter",
+    "ShardedCoordinator",
+    "audit_sharded_exactly_once",
+    "decode_partial_fold",
+    "encode_partial_fold",
+    "shard_for",
+]

@@ -1,0 +1,314 @@
+"""fold_partial / fold_merge / fold_merge_finalize parity: a cohort
+folded in k shard partitions and merged at the root must finalize
+BIT-IDENTICAL (f32) to the single-fold aggregate of the same rows.
+
+The sharded serving tier's correctness contract (ISSUE 12): every
+aggregator's ``fold_merge_finalize`` runs the concatenated rows through
+the SAME masked door the single frontend uses, so the hierarchical
+result is indistinguishable from the one-frontend result — for any
+partition count, at every admissible cohort size, with and without
+staleness discounts, and regardless of root-side bucket padding. The
+family extras (trimmed-mean extremes + running sums, Multi-Krum Gram
+blocks, CGE norms) are pinned as exact merges of deterministic
+summaries.
+"""
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import (
+    CAF,
+    CenteredClipping,
+    ComparativeGradientElimination,
+    CoordinateWiseMedian,
+    CoordinateWiseTrimmedMean,
+    GeometricMedian,
+    Krum,
+    MeanOfMedians,
+    MinimumDiameterAveraging,
+    MoNNA,
+    MultiKrum,
+    SMEA,
+)
+from byzpy_tpu.serving.staleness import StalenessPolicy
+
+N = 8
+D = 193
+
+CASES = [
+    (lambda: CoordinateWiseMedian(), "median"),
+    (lambda: CoordinateWiseTrimmedMean(f=0), "trimmed-f0"),
+    (lambda: CoordinateWiseTrimmedMean(f=1), "trimmed-f1"),
+    (lambda: MeanOfMedians(f=0), "meamed-f0"),
+    (lambda: MeanOfMedians(f=2), "meamed-f2"),
+    (lambda: MultiKrum(f=1, q=2), "multikrum"),
+    (lambda: Krum(f=1), "krum"),
+    (lambda: ComparativeGradientElimination(f=0), "cge-f0"),
+    (lambda: ComparativeGradientElimination(f=1), "cge-f1"),
+    (lambda: MoNNA(f=1), "monna"),
+    (lambda: GeometricMedian(), "geomed"),
+    (lambda: CenteredClipping(c_tau=1.0), "clip"),
+    (lambda: CAF(f=1), "caf"),
+    (lambda: MinimumDiameterAveraging(f=1), "mda"),
+    (lambda: SMEA(f=1), "smea"),
+]
+MAKERS = [c[0] for c in CASES]
+IDS = [c[1] for c in CASES]
+
+
+def _rows(m, d=D, seed=0):
+    rng = np.random.default_rng(seed)
+    scales = rng.uniform(0.1, 50.0, m).astype(np.float32)
+    return (rng.normal(size=(m, d)).astype(np.float32) * scales[:, None])
+
+
+def _admissible(agg, m):
+    try:
+        agg.validate_n(m)
+        return True
+    except ValueError:
+        return False
+
+
+def _partition(m, k):
+    """Split ``m`` rows into ``k`` contiguous shard slices (possibly
+    empty — an empty shard must contribute a neutral partial)."""
+    bounds = np.linspace(0, m, k + 1).astype(int)
+    return [slice(int(bounds[i]), int(bounds[i + 1])) for i in range(k)]
+
+
+def _merge_via_partials(agg, rows, k, weights=None, bucket=None):
+    m = rows.shape[0]
+    partials = []
+    for sl in _partition(m, k):
+        shard_rows = rows[sl]
+        valid = np.ones(shard_rows.shape[0], bool)
+        w = None if weights is None else weights[sl]
+        partials.append(agg.fold_partial(shard_rows, valid, w))
+    merged = agg.fold_merge(partials)
+    return merged, np.asarray(agg.fold_merge_finalize(merged, bucket=bucket))
+
+
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+@pytest.mark.parametrize("m", [1, N // 2, N - 1, N])
+@pytest.mark.parametrize("k", [2, 3, N])
+def test_fold_merge_bitwise_parity(make_agg, m, k):
+    """k-partition merge == single fold, bit for bit, at every cohort
+    size in the satellite's m grid."""
+    agg = make_agg()
+    rows = _rows(m)
+    if not _admissible(agg, m):
+        partials = [
+            agg.fold_partial(rows[sl], np.ones(rows[sl].shape[0], bool))
+            for sl in _partition(m, k)
+        ]
+        with pytest.raises(ValueError):
+            agg.fold_merge_finalize(agg.fold_merge(partials))
+        return
+    ref = np.asarray(agg.aggregate([rows[i] for i in range(m)]))
+    _merged, out = _merge_via_partials(agg, rows, k)
+    np.testing.assert_array_equal(out, ref, err_msg=f"{agg.name} m={m} k={k}")
+
+
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+@pytest.mark.parametrize("m", [1, N // 2, N - 1, N])
+def test_fold_merge_with_staleness_discounts(make_agg, m):
+    """Per-shard discount application is bit-identical to global
+    application: the merged finalize of discounted partials equals the
+    single fold of the hand-discounted rows."""
+    agg = make_agg()
+    if not _admissible(agg, m):
+        pytest.skip("inadmissible m for this aggregator")
+    rows = _rows(m, seed=3)
+    pol = StalenessPolicy(kind="exponential", gamma=0.5)
+    deltas = [i % 3 for i in range(m)]
+    weights = np.asarray(
+        [pol.discount(d) for d in deltas], np.float32
+    )
+    scaled = rows * weights[:, None]
+    ref = np.asarray(agg.aggregate([scaled[i] for i in range(m)]))
+    for k in (2, 3):
+        _merged, out = _merge_via_partials(agg, rows, k, weights=weights)
+        np.testing.assert_array_equal(
+            out, ref, err_msg=f"{agg.name} m={m} k={k} stale"
+        )
+    # δ=0 everywhere is the exact identity: weight-1.0 partials carry
+    # the untouched bits
+    ones = np.ones(m, np.float32)
+    _merged, out = _merge_via_partials(agg, rows, 2, weights=ones)
+    ref0 = np.asarray(agg.aggregate([rows[i] for i in range(m)]))
+    np.testing.assert_array_equal(out, ref0, err_msg=f"{agg.name} fresh")
+
+
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+def test_fold_merge_root_bucket_padding_is_exact(make_agg):
+    """The root's bucket-ladder padding (one compiled program per
+    bucket instead of one per merged size) is bit-invariant — the
+    masked contract, up a level."""
+    agg = make_agg()
+    m = N - 1
+    if not _admissible(agg, m):
+        pytest.skip("inadmissible m for this aggregator")
+    rows = _rows(m, seed=5)
+    _merged, exact = _merge_via_partials(agg, rows, 3)
+    _merged, padded = _merge_via_partials(agg, rows, 3, bucket=16)
+    np.testing.assert_array_equal(padded, exact, err_msg=agg.name)
+
+
+def test_fold_merge_empty_shard_is_neutral():
+    """A shard with no admitted rows contributes a (0, d) partial that
+    does not perturb the merge."""
+    agg = CoordinateWiseTrimmedMean(f=1)
+    rows = _rows(6, seed=7)
+    full = agg.fold_partial(rows, np.ones(6, bool))
+    empty = agg.fold_partial(
+        np.zeros((0, D), np.float32), np.zeros(0, bool)
+    )
+    ref = np.asarray(agg.fold_merge_finalize(agg.fold_merge([full])))
+    out = np.asarray(
+        agg.fold_merge_finalize(agg.fold_merge([empty, full, empty]))
+    )
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_fold_merge_nonfinite_rows_take_exact_path():
+    """An adversarial NaN/inf row routes the merged finalize through
+    the exact-subset fallback — still bit-identical to the single
+    fold (the masked door's non-finite contract, inherited)."""
+    for make_agg in (
+        lambda: CoordinateWiseMedian(),
+        lambda: CoordinateWiseTrimmedMean(f=1),
+        lambda: MultiKrum(f=1, q=2),
+    ):
+        agg = make_agg()
+        rows = _rows(6, seed=11)
+        rows[1, ::7] = np.inf
+        rows[2, 3] = np.nan
+        ref = np.asarray(agg.aggregate([rows[i] for i in range(6)]))
+        _merged, out = _merge_via_partials(agg, rows, 2)
+        np.testing.assert_array_equal(out, ref, err_msg=agg.name)
+
+
+def test_fold_merge_rejects_dimension_mismatch_and_empty():
+    agg = CoordinateWiseMedian()
+    a = agg.fold_partial(_rows(2, d=8), np.ones(2, bool))
+    b = agg.fold_partial(_rows(2, d=9), np.ones(2, bool))
+    with pytest.raises(ValueError):
+        agg.fold_merge([a, b])
+    with pytest.raises(ValueError):
+        agg.fold_merge([])
+    empty = agg.fold_partial(np.zeros((0, 8), np.float32), np.zeros(0, bool))
+    with pytest.raises(ValueError):
+        agg.fold_merge_finalize(agg.fold_merge([empty]))
+
+
+# ---------------------------------------------------------------------------
+# family extras: exact merges of deterministic streaming summaries
+# ---------------------------------------------------------------------------
+
+
+def test_trimmed_mean_extras_merge_exactly():
+    """Merged extreme buffers == the extremes of the full cohort
+    (multiset order statistics compose exactly); totals merge to the
+    shard-order left-fold sum; extras are deterministic recomputes."""
+    agg = CoordinateWiseTrimmedMean(f=2)
+    rows = _rows(9, seed=13)
+    partials = [
+        agg.fold_partial(rows[sl], np.ones(rows[sl].shape[0], bool))
+        for sl in _partition(9, 3)
+    ]
+    merged = agg.fold_merge(partials)
+    extras = merged["extras"]
+    srt = np.sort(rows, axis=0)
+    np.testing.assert_array_equal(extras["low"], srt[:2])
+    np.testing.assert_array_equal(extras["high"], srt[-2:])
+    assert extras["finite"]
+    # left-fold of shard sums, deterministically
+    want = np.asarray(partials[0]["extras"]["total"])
+    for p in partials[1:]:
+        want = want + np.asarray(p["extras"]["total"])
+    np.testing.assert_array_equal(extras["total"], want)
+    # determinism: the recompute the root's extras verification relies on
+    again = agg._partial_extras(np.asarray(partials[1]["rows"]))
+    for key, val in partials[1]["extras"].items():
+        np.testing.assert_array_equal(np.asarray(val), np.asarray(again[key]))
+    # below-f shards pad with ±inf exactly like the streaming fold
+    tiny = agg.fold_partial(rows[:1], np.ones(1, bool))
+    assert np.isinf(tiny["extras"]["low"][1]).all()
+    assert np.isinf(tiny["extras"]["high"][0]).all()
+
+
+def test_multikrum_gram_extras_assemble_full_gram():
+    """Shard-local Gram blocks + root cross-blocks == the full cohort
+    Gram (diagonal blocks land bitwise; cross blocks to matmul
+    tolerance), and the merged score view matches ``round_evidence``'s
+    keep set with score agreement at float tolerance."""
+    agg = MultiKrum(f=1, q=3)
+    rows = _rows(8, seed=17) / 50.0  # moderate scale for gram conditioning
+    slices = _partition(8, 3)
+    partials = [
+        agg.fold_partial(rows[sl], np.ones(rows[sl].shape[0], bool))
+        for sl in slices
+    ]
+    merged = agg.fold_merge(partials)
+    gram = merged["extras"]["gram"]
+    assert gram.shape == (8, 8)
+    # diagonal blocks are the shards' own (deterministic recompute)
+    for sl, p in zip(slices, partials, strict=True):
+        np.testing.assert_array_equal(
+            gram[sl, sl], np.asarray(p["extras"]["gram"])
+        )
+    full = rows @ rows.T
+    np.testing.assert_allclose(gram, full, rtol=2e-5, atol=2e-5)
+    view = agg.merged_score_view(merged)
+    ev = agg.round_evidence(rows, np.ones(8, bool))
+    assert view["kind"] == ev["kind"] == "krum_distance"
+    np.testing.assert_array_equal(view["keep"], ev["keep"])
+    np.testing.assert_allclose(view["scores"], ev["scores"], rtol=1e-4)
+
+
+def test_cge_norm_extras_concatenate_and_score():
+    agg = ComparativeGradientElimination(f=2)
+    rows = _rows(7, seed=19)
+    partials = [
+        agg.fold_partial(rows[sl], np.ones(rows[sl].shape[0], bool))
+        for sl in _partition(7, 2)
+    ]
+    merged = agg.fold_merge(partials)
+    sq = merged["extras"]["sqnorms"]
+    np.testing.assert_allclose(
+        sq, np.einsum("ij,ij->i", rows, rows), rtol=1e-6
+    )
+    view = agg.merged_score_view(merged)
+    ev = agg.round_evidence(rows, np.ones(7, bool))
+    assert view["kind"] == ev["kind"] == "norm"
+    np.testing.assert_array_equal(view["keep"], ev["keep"])
+    np.testing.assert_allclose(view["scores"], ev["scores"], rtol=1e-5)
+
+
+def test_merge_recomputes_missing_extras():
+    """A partial without extras (rows dropped at the root, or a shard
+    that shipped none) gets them recomputed from its rows — the merged
+    accumulators never silently describe a subset."""
+    agg = CoordinateWiseTrimmedMean(f=1)
+    rows = _rows(6, seed=23)
+    a = agg.fold_partial(rows[:3], np.ones(3, bool))
+    b = {"rows": rows[3:], "m": 3}  # stripped: no extras
+    merged = agg.fold_merge([a, b])
+    srt = np.sort(rows, axis=0)
+    np.testing.assert_array_equal(merged["extras"]["low"], srt[:1])
+    np.testing.assert_array_equal(merged["extras"]["high"], srt[-1:])
+
+
+def test_merged_score_view_without_extras_falls_back_to_evidence():
+    """Families without extras (median, geomed) still publish the
+    root score view through ``round_evidence`` on the merged rows."""
+    agg = GeometricMedian()
+    rows = _rows(5, seed=29)
+    merged = agg.fold_merge(
+        [agg.fold_partial(rows, np.ones(5, bool))]
+    )
+    vec = np.asarray(agg.fold_merge_finalize(merged))
+    view = agg.merged_score_view(merged, aggregate=vec)
+    assert view is not None and view["kind"] == "geomed_distance"
+    assert np.isfinite(view["scores"]).all()
