@@ -3,7 +3,7 @@ the artifacts.
 
 Drives the REAL production path with telemetry enabled — TCP ingress
 (actor wire frames) → admission → async cohort scheduler → masked
-bucketed aggregate → round close — then asserts the three deliverables
+bucketed aggregate → round close — then asserts the deliverables
 exist and are well-formed:
 
 1. a chrome-trace export containing a span for EVERY lifecycle stage
@@ -14,7 +14,15 @@ exist and are well-formed:
 3. a non-empty flight-recorder dump, and a clean run of the
    ``python -m byzpy_tpu.observability`` summarizer over the trace +
    metrics JSONL (including the wire-bytes-vs-law residual, which must
-   stay within tolerance of ``comms.serving_ingress_bytes``).
+   stay within tolerance of ``comms.serving_ingress_bytes``);
+4. the critical-path summarizer over the recorded trace: every round
+   tree's per-stage blame sums to its makespan within tolerance, and a
+   round with an INJECTED slow stage (the aggregator wrapped in a
+   sleep) is attributed to that stage, not averaged away;
+5. the SLO watchdog path: an impossible latency objective breaches,
+   publishes ``byzpy_slo_*``, and triggers a flight-recorder dump
+   whose reason names the burned objective and which embeds the
+   critical-path + SLO state.
 
 CI runs this as the observability leg; byzlint/ruff cover the package
 through their whole-tree gates.
@@ -152,6 +160,23 @@ def main() -> None:
     assert row["frames"] == ROUNDS * M
     assert abs(row["residual"]) < 0.05, row
 
+    # 4) critical-path attribution over the recorded trace: blame sums
+    # to each round's makespan, and an injected slow stage is blamed
+    from byzpy_tpu.observability import critical_path as obs_cp
+
+    with open(trace_path) as fh:
+        trace_events = json.load(fh)["traceEvents"]
+    cp_summary = obs_cp.summarize(trace_events)
+    assert cp_summary["rounds"], "no round trees in the recorded trace"
+    assert cp_summary["max_blame_residual"] < 1e-6, cp_summary[
+        "max_blame_residual"
+    ]
+    # ...and the slow-stage + SLO-breach leg: an injected slow fold is
+    # blamed by the critical path, burns an impossible latency SLO,
+    # and the breach triggers a flight dump (the full alarm chain)
+    slo_dump_path = os.path.join(out_dir, "slo_flight.json")
+    slow_blame, slo_rows = _slow_stage_and_slo_breach(slo_dump_path)
+
     print(
         json.dumps(
             {
@@ -161,11 +186,88 @@ def main() -> None:
                 "lifecycle_stages": len(LIFECYCLE),
                 "flight_dump_events": len(dump["events"]),
                 "wire_residual": row["residual"],
+                "critical_path_rounds": len(cp_summary["rounds"]),
+                "max_blame_residual": cp_summary["max_blame_residual"],
+                "slow_stage_share": slow_blame,
+                "slo_breaches": len(slo_rows),
                 "out_dir": out_dir,
             }
         )
     )
     print("observability smoke OK")
+
+
+def _slow_stage_and_slo_breach(dump_path: str):
+    """Close one round whose FOLD is artificially slow (the aggregator
+    wrapped in a 50 ms sleep) under an impossible latency SLO, then
+    assert the whole alarm chain: the critical path blames the slow
+    stage (attribution, not averaging), the watchdog breaches,
+    ``byzpy_slo_*`` publish, the breach instant lands on the tracer,
+    and the flight dump carries the critical-path + SLO state. Returns
+    ``(blamed share, breach rows)``."""
+    import time
+
+    from byzpy_tpu.observability import critical_path as obs_cp
+    from byzpy_tpu.observability.slo import SLOWatchdog, TenantSLO
+    from byzpy_tpu.serving import ServingFrontend, TenantConfig
+
+    class _SlowAggregator(CoordinateWiseTrimmedMean):
+        def aggregate_masked(self, matrix, valid):
+            time.sleep(0.05)
+            return super().aggregate_masked(matrix, valid)
+
+    obs_tracing.tracer().clear()
+    # the watchdog FIRST: it baselines the registry at construction and
+    # scores only what happens on its watch
+    watchdog = SLOWatchdog(
+        [TenantSLO(tenant="slowstage", accepted_p99_s=1e-9, window_s=60.0)],
+        flight_path=dump_path,
+    )
+    fe = ServingFrontend(
+        [
+            TenantConfig(
+                name="slowstage",
+                aggregator=_SlowAggregator(f=1),
+                dim=64,
+                window_s=0.01,
+                cohort_cap=16,
+            )
+        ]
+    )
+    rng = np.random.default_rng(1)
+    for i in range(4):
+        ok, reason = fe.submit(
+            "slowstage", f"c{i}", 0, rng.normal(size=64).astype(np.float32)
+        )
+        assert ok, reason
+    assert fe.close_round_nowait("slowstage") is not None
+
+    summary = obs_cp.summarize(obs_tracing.tracer().events())
+    (round_row,) = summary["rounds"]
+    top = round_row["stages"][0]
+    # the sleep lives inside the device_step span (under fold): the
+    # critical path must put the round's majority blame there
+    assert top["stage"] == "serving.device_step", round_row["stages"]
+    assert top["share"] > 0.5, round_row["stages"]
+
+    rows = [r for r in watchdog.evaluate() if r["breached"]]
+    assert rows, "impossible SLO did not breach"
+    assert watchdog.flight_dumps == 1, "breach did not trigger a flight dump"
+    with open(dump_path) as fh:
+        dump = json.load(fh)
+    assert dump["reason"] == "slo:slowstage:accepted_p99", dump["reason"]
+    assert dump["slo"], "dump missing SLO state"
+    assert dump.get("critical_path", {}).get("rounds"), (
+        "dump missing critical-path summaries"
+    )
+    text = obs_metrics.registry().prometheus_text()
+    assert "byzpy_slo_burn_rate" in text and "byzpy_slo_breaches_total" in text
+    breach_instants = [
+        ev for ev in obs_tracing.tracer().events() if ev["name"] == "slo.breach"
+    ]
+    assert breach_instants, "breach instant missing from the tracer"
+    watchdog.close()
+    return top["share"], rows
 
 
 if __name__ == "__main__":

@@ -623,6 +623,57 @@ def _drive_shard_partition(
     return accepted, time.monotonic() - t0
 
 
+def _scale_round_trace_events(
+    n_shards: int, legs_rounds: list, merges: list
+) -> list:
+    """Render the scale lane's measured per-round numbers as a
+    round-causality trace on the lane's parallel-makespan model:
+    per round, one ``serving.sharded_round`` root spanning
+    ``max(legs) + merge``, each shard's ingress+close leg as a child
+    starting at the barrier open (legs overlap on their own lanes —
+    dedicated shard processes share nothing until the PartialFold hits
+    the root), and the root merge chained after the slowest leg. The
+    events carry the same ``span``/``parent``/``shard`` ids the live
+    tracer stamps, so ``observability.critical_path`` attributes them
+    exactly like a recorded trace — the virtual-clock-trace precedent
+    is the chaos ``EventTrace.to_chrome_trace``."""
+    events = []
+    t = 0.0
+    for r, (legs, merge_s) in enumerate(
+        zip(legs_rounds, merges, strict=True)
+    ):
+        makespan = max(legs) + merge_s
+        root = f"scale{n_shards}.r{r}"
+        events.append(
+            {
+                "name": "serving.sharded_round", "ph": "X",
+                "ts": t * 1e6, "dur": makespan * 1e6, "tid": 0,
+                "args": {"span": root, "round": r, "tenant": "scale"},
+            }
+        )
+        for s, leg in enumerate(legs):
+            events.append(
+                {
+                    "name": "serving.shard_ingress", "ph": "X",
+                    "ts": t * 1e6, "dur": leg * 1e6, "tid": 1 + s,
+                    "args": {
+                        "span": f"{root}.s{s}", "parent": root,
+                        "shard": s, "round": r,
+                    },
+                }
+            )
+        events.append(
+            {
+                "name": "serving.fold_merge", "ph": "X",
+                "ts": (t + max(legs)) * 1e6, "dur": merge_s * 1e6,
+                "tid": 0,
+                "args": {"span": f"{root}.m", "parent": root, "round": r},
+            }
+        )
+        t += makespan
+    return events
+
+
 def _run_scale(args) -> dict:
     """Sharded-tier scaling: the SAME per-round submission load (drawn
     from ``--scale-clients`` distinct identities) through 1, 2 and 4
@@ -636,7 +687,20 @@ def _run_scale(args) -> dict:
     Per round, the hierarchical fold's BIT PARITY vs the exact
     unsharded aggregate of the same merged cohort is asserted, and one
     round's PartialFold frames are measured against the
-    ``parallel.comms.sharded_round_wire_bytes`` law (< 2%)."""
+    ``parallel.comms.sharded_round_wire_bytes`` law (< 2%).
+
+    Tracing is ON for the whole lane (ISSUE 13): the per-round parity
+    assert therefore doubles as the aggregates-bit-identical-with-
+    propagation pin, and the measured legs/merges are rendered as a
+    round-causality trace on the lane's own parallel-makespan model
+    (each shard's leg overlapping on its own lane, the root merge
+    after the barrier — exactly the timing_model, as a span tree) and
+    attributed by ``observability.critical_path``: the committed
+    ``critical_path_blame`` table replaces the "root merge looks like
+    the next bottleneck" folklore with per-stage/per-shard makespan
+    shares."""
+    from byzpy_tpu import observability as obs
+    from byzpy_tpu.observability import critical_path as obs_cp
     from byzpy_tpu.parallel.comms import (
         partial_fold_bytes,
         sharded_round_wire_bytes,
@@ -646,6 +710,8 @@ def _run_scale(args) -> dict:
 
     from byzpy_tpu.aggregators import ComparativeGradientElimination
 
+    telemetry_was_on = obs.enabled()
+    obs.enable()
     rng = np.random.default_rng(7)
     d = args.scale_dim
     per_round = args.scale_round_submissions
@@ -674,6 +740,7 @@ def _run_scale(args) -> dict:
         # like: every identity exists, a slice is active per round)
         wire_row = None
         per_round_leg = []
+        per_round_legs_full = []
         per_round_merge = []
         total_accepted = 0
         wall0 = time.monotonic()
@@ -762,6 +829,7 @@ def _run_scale(args) -> dict:
             )
             per_round_merge.append(merge_s)
             per_round_leg.append(max(legs))
+            per_round_legs_full.append(list(legs))
         wall = time.monotonic() - wall0
         st = co.stats()["root"]["scale"]
         # steady-state throughput: shard admission (the next window) and
@@ -782,6 +850,17 @@ def _run_scale(args) -> dict:
         # p99 latency below keeps every spike (bounded-p99 evidence)
         period_median = float(np.median(per_round_period))
         accepted_per_round = total_accepted / max(1, len(per_round_period))
+        # critical-path blame over the modeled round trace: per-stage/
+        # per-shard makespan shares (blame sums to the summed makespan;
+        # asserted by the smoke below)
+        cp_summary = obs_cp.summarize(
+            _scale_round_trace_events(
+                n_shards, per_round_legs_full, per_round_merge
+            )
+        )
+        assert cp_summary["max_blame_residual"] < 1e-6, cp_summary[
+            "max_blame_residual"
+        ]
         results[n_shards] = {
             "accepted": total_accepted,
             "period_median_ms": round(1e3 * period_median, 2),
@@ -800,6 +879,18 @@ def _run_scale(args) -> dict:
             "failed_rounds": st["failed_rounds"],
             "forged_partials": st["forged_partials"],
             "wire": wire_row,
+            "critical_path_blame": cp_summary["stages"],
+            # the headline number the ISSUE-12 bottleneck claim becomes:
+            # the fraction of the round makespan the ROOT MERGE owns on
+            # the critical path at this shard count
+            "root_merge_blame_share": next(
+                (
+                    r["share"]
+                    for r in cp_summary["stages"]
+                    if r["stage"] == "serving.fold_merge"
+                ),
+                0.0,
+            ),
         }
     base = results[args.scale_shards[0]]["accepted_per_sec"]
     speedups = {
@@ -825,7 +916,15 @@ def _run_scale(args) -> dict:
         "shards": results,
         "speedup_vs_1shard": speedups,
         "parity": "bit-identical",
+        "telemetry": "on (trace-context propagation active; per-round "
+                     "parity assert doubles as the propagation pin)",
+        "root_merge_blame_share": {
+            n: results[n]["root_merge_blame_share"]
+            for n in args.scale_shards
+        },
     }
+    if not telemetry_was_on:
+        obs.disable()
     return row
 
 

@@ -30,6 +30,7 @@ from .scenario import (
     FaultPlan,
     PartitionEvent,
     Scenario,
+    SLOSpec,
     StragglerModel,
     build_aggregator,
     build_attack,
@@ -49,6 +50,7 @@ __all__ = [
     "EventTrace",
     "FaultPlan",
     "PartitionEvent",
+    "SLOSpec",
     "Scenario",
     "StragglerModel",
     "attacker_influence",

@@ -72,6 +72,11 @@ class ChaosReport:
     verdict_counts: Dict[str, int] = field(default_factory=dict)
     submissions: List[np.ndarray] = field(default_factory=list)
     trace: EventTrace = field(default_factory=EventTrace)
+    #: virtual-clock SLO evaluation (serving engine with a
+    #: ``Scenario.slo`` attached): final watchdog state + the breach
+    #: rows in virtual-round order. A pure observer — kept OUT of the
+    #: event trace so digests are bit-identical with SLOs on or off
+    slo: Optional[Dict[str, Any]] = None
     #: per-round :class:`~byzpy_tpu.forensics.evidence.RoundEvidence`
     #: when the harness was built with a forensics config — the SAME
     #: schema the online serving plane produces, kept OUT of the event
@@ -142,7 +147,7 @@ class ChaosReport:
 
     def summary(self) -> Dict[str, Any]:
         """JSON-ready cell row for the chaos grid."""
-        return {
+        row = {
             "scenario": self.scenario.name,
             "engine": self.scenario.engine,
             "aggregator": self.scenario.aggregator,
@@ -157,6 +162,9 @@ class ChaosReport:
             "events": self.trace.counts(),
             "trace_digest": self.trace.digest(),
         }
+        if self.slo is not None:
+            row["slo_breaches"] = len(self.slo["breaches"])
+        return row
 
 
 class ChaosHarness:
@@ -699,6 +707,49 @@ class ChaosHarness:
         aggregator = build_aggregator(s)
         plane = self._make_plane()
         self._vclock = 0.0
+        watchdog = None
+        breaches: List[dict] = []
+        telemetry_was_on = True
+        if s.slo is not None:
+            from .. import observability as _obs
+            from ..observability.slo import SLOWatchdog, TenantSLO
+
+            # the watchdog reads the registry the frontend publishes
+            # into, and the frontend only publishes with telemetry ON —
+            # a Scenario.slo without telemetry would score every window
+            # as a silent, plausible-looking zero. Enable for the run
+            # (restored below); digests are pinned identical telemetry
+            # AND SLO on/off, so this changes no replay contract.
+            telemetry_was_on = _obs.enabled()
+            _obs.enable()
+            # the watchdog ticks on the harness's VIRTUAL clock: SLO
+            # burn under injected faults is replayable per seed
+            watchdog = SLOWatchdog(
+                [
+                    TenantSLO(
+                        tenant="chaos",
+                        accepted_p99_s=s.slo.accepted_p99_s,
+                        failed_round_rate=s.slo.failed_round_rate,
+                        quarantine_rate=s.slo.quarantine_rate,
+                        window_s=s.slo.window_s,
+                        burn_threshold=s.slo.burn_threshold,
+                    )
+                ],
+                clock=lambda: self._vclock,
+            )
+
+        def slo_tick(round_idx: int, window_end: float) -> None:
+            """One virtual-clock watchdog evaluation at a round window's
+            close (shared by the held/failed and completed branches)."""
+            if watchdog is None:
+                return
+            self._vclock = window_end
+            breaches.extend(
+                {**row, "round": round_idx}
+                for row in watchdog.evaluate()
+                if row["breached"]
+            )
+
         fe = ServingFrontend(
             [
                 TenantConfig(
@@ -759,6 +810,7 @@ class ChaosHarness:
                 detail = "failed" if failed_now > failed_seen else "held"
                 failed_seen = failed_now
                 report.trace.emit(t + s.window_s, r, "round_close", "", detail)
+                slo_tick(r, t + s.window_s)
                 continue
             round_id, cohort, agg_vec = closed
             agg = np.asarray(agg_vec, np.float32)
@@ -832,8 +884,19 @@ class ChaosHarness:
                 f"m={cohort.m} round={round_id} agg={array_digest(agg)}",
             )
             report.rounds_completed += 1
+            slo_tick(r, t + s.window_s)
         report.final_params = w
         report.final_error = float(np.linalg.norm(w - self.honest_target))
+        if watchdog is not None:
+            report.slo = {
+                "state": watchdog.state()["objectives"],
+                "breaches": breaches,
+            }
+            watchdog.close()
+            if not telemetry_was_on:
+                from .. import observability as _obs
+
+                _obs.disable()
         return report
 
 

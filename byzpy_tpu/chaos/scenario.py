@@ -140,6 +140,30 @@ class AttackSpec:
 
 
 @dataclass(frozen=True)
+class SLOSpec:
+    """Serving-engine SLO objectives, evaluated per round on the
+    harness's VIRTUAL clock (``observability.slo.SLOWatchdog`` with
+    ``clock=`` the harness clock): declarative targets for accepted
+    p99 latency (virtual seconds), failed-round rate and quarantine
+    rate, scored over ``window_s`` of virtual time. A pure observer —
+    trace digests and aggregates are bit-identical with or without an
+    SLO attached (the watchdog only reads the metrics registry, which
+    requires telemetry to be enabled to be populated)."""
+
+    accepted_p99_s: Optional[float] = None
+    failed_round_rate: Optional[float] = None
+    quarantine_rate: Optional[float] = None
+    window_s: float = 1.0
+    burn_threshold: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if self.burn_threshold <= 0:
+            raise ValueError("burn_threshold must be > 0")
+
+
+@dataclass(frozen=True)
 class Scenario:
     """One replayable chaos run (see module docstring).
 
@@ -179,6 +203,9 @@ class Scenario:
     staleness_cutoff: Optional[int] = None
     credit_rate_per_s: float = 0.0
     credit_burst: float = 20.0
+    #: serving-engine SLO objectives evaluated on the virtual clock
+    #: (None = no watchdog; pure observer either way)
+    slo: Optional[SLOSpec] = None
 
     def __post_init__(self) -> None:
         if self.n_clients < 1:
@@ -244,6 +271,8 @@ class Scenario:
             d["faults"] = FaultPlan(**f)
         if d.get("client_values") is not None:
             d["client_values"] = tuple(float(v) for v in d["client_values"])
+        if isinstance(d.get("slo"), Mapping):
+            d["slo"] = SLOSpec(**d["slo"])
         return cls(**d)
 
     def to_json(self) -> str:
@@ -442,6 +471,7 @@ __all__ = [
     "CrashModel",
     "FaultPlan",
     "PartitionEvent",
+    "SLOSpec",
     "Scenario",
     "StragglerModel",
     "build_aggregator",

@@ -34,6 +34,7 @@ import numpy as np
 
 from ...observability import metrics as _obs_metrics
 from ...observability import runtime as _obs_runtime
+from ...observability import tracing as _obs_tracing
 
 _HEADER = struct.Struct(">I")
 MAX_FRAME = 1 << 31
@@ -320,6 +321,12 @@ def _frame_counters(direction: str, nbytes: int) -> None:
     nbytes_counter.inc(nbytes)
 
 
+#: Reserved frame key carrying the sender's ``(trace_id, span_id)``
+#: trace context across the process boundary (dict frames only; popped
+#: and restored on decode — consumers never see it).
+TRACE_CTX_KEY = "_trace_ctx"
+
+
 def encode(obj: Any, *, precision: Optional[str] = None) -> bytes:
     """Pickle ``obj`` into a length-prefixed (optionally HMAC-signed) frame
     body. With ``BYZPY_TPU_WIRE_PRECISION`` set (``bf16``/``int8``), large
@@ -328,10 +335,23 @@ def encode(obj: Any, *, precision: Optional[str] = None) -> bytes:
     payload and scale headers included. ``precision`` overrides the env
     policy for THIS frame (``"off"`` forces lossless — frames whose bits
     are load-bearing, e.g. the sharded tier's partial folds, must not
-    ride the lossy submit fabric)."""
+    ride the lossy submit fabric).
+
+    Trace propagation: with telemetry enabled and a span open in the
+    caller (``tracing.wire_context()``), dict frames are stamped with a
+    ``_trace_ctx`` key so the receiver's spans link as children of the
+    sender's (client submit → shard admission, shard close → root
+    merge). The stamp rides INSIDE the signed body — no frame-format
+    change — and never touches the payload the consumer decodes
+    (:func:`decode` pops it). Telemetry disabled: one flag check, the
+    frame bytes are byte-identical to the pre-propagation wire."""
     mode = wire_precision() if precision is None else (
         precision if precision in ("bf16", "int8") else "off"
     )
+    if _obs_runtime.STATE.enabled and type(obj) is dict:
+        ctx = _obs_tracing.wire_context()
+        if ctx is not None and TRACE_CTX_KEY not in obj:
+            obj = {**obj, TRACE_CTX_KEY: (ctx[0], ctx[1])}
     body = cloudpickle.dumps(compress_payload(obj, mode))
     key = _wire_key()
     if key is not None:
@@ -344,7 +364,15 @@ def encode(obj: Any, *, precision: Optional[str] = None) -> bytes:
 def decode(body: bytes) -> Any:
     """Inverse of :func:`encode` (verifies the HMAC when signing is
     configured, then expands any compressed tensor frames — so a tampered
-    code or scale byte fails verification before dequantization)."""
+    code or scale byte fails verification before dequantization).
+
+    A ``_trace_ctx`` stamp on a dict frame is popped (consumers see the
+    payload they were sent) and — when telemetry is enabled — restored
+    as the decoding task's current trace context, so the very next span
+    this task opens (the admission span, the root's merge span) becomes
+    the remote sender's child. Frames without a stamp leave the local
+    context untouched (a decode inside an open local span must not
+    orphan it)."""
     if _obs_runtime.STATE.enabled:
         _frame_counters("rx", _HEADER.size + len(body))
     key = _wire_key()
@@ -357,7 +385,12 @@ def decode(body: bytes) -> Any:
                 "frame HMAC verification failed: wrong BYZPY_TPU_WIRE_KEY "
                 "or tampered/unsigned frame"
             )
-    return decompress_payload(cloudpickle.loads(body))
+    obj = decompress_payload(cloudpickle.loads(body))
+    if type(obj) is dict and TRACE_CTX_KEY in obj:
+        ctx = obj.pop(TRACE_CTX_KEY)
+        if _obs_runtime.STATE.enabled:
+            _obs_tracing.adopt_context(ctx)
+    return obj
 
 
 def host_view(obj: Any) -> Any:
@@ -411,6 +444,7 @@ async def recv_obj(reader: asyncio.StreamReader) -> Any:
 
 
 __all__ = [
+    "TRACE_CTX_KEY",
     "send_obj",
     "recv_obj",
     "encode",

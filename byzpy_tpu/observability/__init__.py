@@ -20,6 +20,18 @@ required addition):
   metrics snapshot, plus any active forensics plane's recent per-client
   evidence) on unhandled failure.
 
+On top of the pillars, the round-causality layer (PR 13): spans carry
+a propagated **trace context** (:mod:`~byzpy_tpu.observability.
+tracing`: contextvar-threaded ``trace``/``span``/``parent`` ids,
+stamped onto wire frames and restored on decode, so a sharded round
+stitches into one causal tree across shards and processes);
+:mod:`~byzpy_tpu.observability.critical_path` reconstructs each
+round's tree from a trace export and attributes per-stage/per-shard
+**blame** for the makespan; and :mod:`~byzpy_tpu.observability.slo`
+evaluates declarative per-tenant objectives as rolling-window burn
+rates off the registry, publishing ``byzpy_slo_*`` and triggering
+flight dumps on breach.
+
 Adjacent: :mod:`~byzpy_tpu.observability.jitstats` counts XLA compiles
 per dispatch site (``byzpy_jit_compiles_total{site}`` — the
 recompile-cliff alarm), and the Byzantine forensics plane
@@ -44,7 +56,9 @@ __all__ = [
     "FlightRecorder",
     "MetricsLogger",
     "MetricsRegistry",
+    "SLOWatchdog",
     "StepTimer",
+    "TenantSLO",
     "Tracer",
     "device_span",
     "disable",
@@ -67,6 +81,8 @@ _LAZY = {
     "FlightRecorder": ("recorder", "FlightRecorder"),
     "MetricsLogger": ("compat", "MetricsLogger"),
     "StepTimer": ("compat", "StepTimer"),
+    "SLOWatchdog": ("slo", "SLOWatchdog"),
+    "TenantSLO": ("slo", "TenantSLO"),
 }
 
 

@@ -10,6 +10,12 @@ prints:
   does the time live" answer;
 * the **top-k slow rounds** — the longest round-lifecycle spans with
   their tenant/round attributes;
+* with ``--critical-path``, the **per-stage/per-shard blame table** —
+  each round's causal tree reconstructed from the trace-context ids,
+  the makespan-dominating chain extracted, and blame aggregated per
+  (stage, shard) (:mod:`~byzpy_tpu.observability.critical_path`): the
+  "which stage on which shard owns the round's wall-clock" answer the
+  per-stage averages above cannot give;
 * with ``--metrics``, the **wire-bytes law residuals** — measured
   serving ingress bytes per submit frame against the analytic
   ``parallel.comms.serving_ingress_bytes`` law for the recorded tenant
@@ -174,6 +180,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("trace", help="chrome-trace JSON or flight-recorder dump")
     ap.add_argument("--metrics", help="metrics JSONL (registry.to_jsonl output)")
     ap.add_argument("--top", type=int, default=5, help="slow rounds to show")
+    ap.add_argument(
+        "--critical-path", action="store_true",
+        help="reconstruct round trees and print per-stage/per-shard blame",
+    )
     ap.add_argument("--json", action="store_true", help="emit one JSON object")
     args = ap.parse_args(argv)
 
@@ -184,6 +194,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         "stages": stage_breakdown(events),
         "slow_rounds": slow_rounds(events, args.top),
     }
+    if args.critical_path:
+        from . import critical_path as _critical_path
+
+        summary["critical_path"] = _critical_path.summarize(events)
     if args.metrics:
         summary["wire_residuals"] = wire_residuals(args.metrics)
 
@@ -217,6 +231,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                 ("at_ms", lambda r: f"{r['ts_ms']:.3f}"),
             ],
         )
+    if "critical_path" in summary:
+        cp = summary["critical_path"]
+        print(
+            f"\n== critical-path blame ({len(cp['rounds'])} rounds, "
+            f"max blame residual {cp['max_blame_residual']:.2e}) =="
+        )
+        if cp["stages"]:
+            _print_table(
+                cp["stages"],
+                [
+                    ("stage", lambda r: r["stage"]),
+                    (
+                        "shard",
+                        lambda r: "-" if r["shard"] is None else str(r["shard"]),
+                    ),
+                    ("rounds", lambda r: str(r["rounds"])),
+                    ("blame_ms", lambda r: f"{r['blame_us'] / 1e3:.3f}"),
+                    ("mean_ms", lambda r: f"{r['mean_us'] / 1e3:.3f}"),
+                    ("share", lambda r: f"{100 * r['share']:.1f}%"),
+                ],
+            )
+        else:
+            print(
+                "(no round trees found — trace was recorded without "
+                "trace-context propagation?)"
+            )
     if "wire_residuals" in summary:
         print("\n== wire bytes vs comms law ==")
         if summary["wire_residuals"]:

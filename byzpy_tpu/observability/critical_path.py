@@ -1,0 +1,287 @@
+"""Critical-path attribution: from a trace export to per-stage blame.
+
+PR 8's tracer records spans; the trace-context ids (``trace``/``span``/
+``parent`` in each event's ``args``, :mod:`~byzpy_tpu.observability.
+tracing`) make them a FOREST of causal trees. This module reconstructs
+each round's tree from an exported trace (chrome-trace JSON, a tracer
+snapshot, or a flight-recorder dump), walks the chain that *determines*
+the round's end time — the critical path — and aggregates per-stage /
+per-shard **blame**: the fraction of the round's makespan each stage
+owns on that chain. That replaces "the root merge looks like the next
+bottleneck" folklore with a number per stage per shard, which is what
+the shard-autoscaling and MPMD-cut roadmap items need as input.
+
+The attribution rule: within a round-root span, walk backwards from
+the root's end; the child whose end dominates the frontier owns the
+chain up to its end, recursively; gaps between dominating children are
+the parent's own time. Every microsecond of the makespan is attributed
+to exactly ONE span, so per-stage blame sums to the round makespan by
+construction (the CI leg asserts it).
+
+Offline, deterministic, import-light: pure functions over event dicts
+— no jax, no clock reads — usable from the CLI summarizer
+(``python -m byzpy_tpu.observability TRACE --critical-path``), the
+flight recorder, and the benches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: Span names that root a round tree (ordered: when several match in
+#: one trace, the outermost by timestamp wins).
+ROUND_ROOT_NAMES = (
+    "serving.sharded_round",
+    "serving.round",
+    "ps.round",
+    "p2p.round",
+    "chaos.round",
+)
+
+
+@dataclass
+class SpanNode:
+    """One complete ('X') event, linked into its causal tree."""
+
+    name: str
+    ts: float  # µs, trace epoch
+    dur: float  # µs
+    args: Dict[str, Any]
+    span_id: str
+    parent_id: Optional[str]
+    children: List["SpanNode"] = field(default_factory=list)
+
+    @property
+    def end(self) -> float:
+        """End timestamp (µs)."""
+        return self.ts + self.dur
+
+    @property
+    def shard(self) -> Optional[int]:
+        """The span's ``shard`` attribute, if stamped."""
+        s = self.args.get("shard")
+        return None if s is None else int(s)
+
+
+def build_forest(events: Sequence[dict]) -> List[SpanNode]:
+    """Link complete events into causal trees via their ``span``/
+    ``parent`` ids; returns the roots (no parent, or parent evicted
+    from the ring/export — an orphan is its own root rather than
+    silently dropped). Events recorded without trace context
+    (pre-propagation traces, disabled spans replayed from old dumps)
+    are ignored — they cannot be attributed."""
+    nodes: Dict[str, SpanNode] = {}
+    ordered: List[SpanNode] = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args", {})
+        sid = args.get("span")
+        if not sid:
+            continue
+        node = SpanNode(
+            name=str(ev.get("name", "")),
+            ts=float(ev.get("ts", 0.0)),
+            dur=float(ev.get("dur", 0.0)),
+            args=dict(args),
+            span_id=str(sid),
+            parent_id=(
+                None if args.get("parent") is None else str(args["parent"])
+            ),
+        )
+        nodes[node.span_id] = node
+        ordered.append(node)
+    roots: List[SpanNode] = []
+    for node in ordered:
+        parent = (
+            nodes.get(node.parent_id) if node.parent_id is not None else None
+        )
+        if parent is None or parent is node:
+            roots.append(node)
+        else:
+            parent.children.append(node)
+    return roots
+
+
+@dataclass(frozen=True)
+class Segment:
+    """One stretch of the critical path owned by one span."""
+
+    name: str
+    start: float  # µs
+    end: float  # µs
+    shard: Optional[int]
+
+    @property
+    def dur(self) -> float:
+        """Owned duration (µs)."""
+        return self.end - self.start
+
+
+def critical_path(root: SpanNode) -> List[Segment]:
+    """The makespan-dominating chain of ``root``'s tree, as segments
+    that partition ``[root.ts, root.end]`` exactly: walking back from
+    the root's end, the child whose end dominates the current frontier
+    owns the chain up to its end (recursively); the gaps between
+    dominating children — and the head before the first one — are the
+    parent's own time. Children overlapping in wall time (parallel
+    shard legs under one round root) resolve to whichever chain
+    actually reaches later — the definition of the critical path."""
+    segments: List[Segment] = []
+
+    def walk(node: SpanNode, start: float, end: float) -> None:
+        t = end
+        for child in sorted(
+            node.children, key=lambda c: c.end, reverse=True
+        ):
+            c_end = min(child.end, t)
+            c_start = max(child.ts, start)
+            if c_end <= c_start:
+                continue
+            if t > c_end:  # the parent's own tail after this child
+                segments.append(Segment(node.name, c_end, t, node.shard))
+            walk(child, c_start, c_end)
+            t = c_start
+            if t <= start:
+                break
+        if t > start:
+            segments.append(Segment(node.name, start, t, node.shard))
+
+    walk(root, root.ts, root.end)
+    segments.sort(key=lambda s: s.start)
+    return segments
+
+
+def round_roots(roots: Sequence[SpanNode]) -> List[SpanNode]:
+    """The round-lifecycle trees in a forest: roots named like a round
+    (:data:`ROUND_ROOT_NAMES`), plus roots that directly CONTAIN a
+    round span as their only meaningful payload are represented by
+    that round span (a driver script's wrapper span must not hide the
+    rounds inside it)."""
+    out: List[SpanNode] = []
+
+    def visit(node: SpanNode) -> None:
+        if node.name in ROUND_ROOT_NAMES:
+            out.append(node)
+            return  # nested round names (sharded_round > round) count once
+        for child in node.children:
+            visit(child)
+
+    for root in roots:
+        visit(root)
+    out.sort(key=lambda n: n.ts)
+    return out
+
+
+def blame_round(root: SpanNode) -> dict:
+    """One round tree's critical-path summary: makespan, the ordered
+    chain, and per-(stage, shard) blame with shares of the makespan
+    (blame sums to the makespan by construction)."""
+    segments = critical_path(root)
+    makespan = root.dur
+    stages: Dict[Tuple[str, Optional[int]], float] = {}
+    for seg in segments:
+        key = (seg.name, seg.shard)
+        stages[key] = stages.get(key, 0.0) + seg.dur
+    rows = [
+        {
+            "stage": name,
+            "shard": shard,
+            "blame_us": round(dur, 3),
+            "share": round(dur / makespan, 4) if makespan else 0.0,
+        }
+        for (name, shard), dur in stages.items()
+    ]
+    rows.sort(key=lambda r: -r["blame_us"])
+    return {
+        "round": root.args.get("round"),
+        "tenant": root.args.get("tenant"),
+        "root": root.name,
+        "trace": root.args.get("trace"),
+        "makespan_us": round(makespan, 3),
+        "stages": rows,
+        "path": [
+            {
+                "stage": seg.name,
+                "shard": seg.shard,
+                "start_us": round(seg.start, 3),
+                "dur_us": round(seg.dur, 3),
+            }
+            for seg in segments
+        ],
+    }
+
+
+def blame_rounds(events: Sequence[dict]) -> List[dict]:
+    """Critical-path summaries for every round tree in an event list
+    (oldest first). Rounds without trace context are skipped — they
+    cannot be attributed, only averaged, and averages are what this
+    module exists to replace."""
+    return [blame_round(r) for r in round_roots(build_forest(events))]
+
+
+def aggregate_blame(rounds: Sequence[dict]) -> List[dict]:
+    """Fold per-round blame into the committed per-stage/per-shard
+    table: total blame µs, share of total makespan, rounds touched,
+    and the mean per-round blame — sorted by total blame. The `share`
+    column is the headline: "stage X on shard Y owns Z% of the round
+    wall-clock" is the sentence the autoscaling roadmap item consumes."""
+    total_makespan = sum(r["makespan_us"] for r in rounds) or 1.0
+    acc: Dict[Tuple[str, Optional[int]], Dict[str, float]] = {}
+    for r in rounds:
+        for row in r["stages"]:
+            key = (row["stage"], row["shard"])
+            slot = acc.setdefault(key, {"blame_us": 0.0, "rounds": 0})
+            slot["blame_us"] += row["blame_us"]
+            slot["rounds"] += 1
+    out = [
+        {
+            "stage": name,
+            "shard": shard,
+            "rounds": int(slot["rounds"]),
+            "blame_us": round(slot["blame_us"], 3),
+            "mean_us": round(slot["blame_us"] / slot["rounds"], 3),
+            "share": round(slot["blame_us"] / total_makespan, 4),
+        }
+        for (name, shard), slot in acc.items()
+    ]
+    out.sort(key=lambda r: -r["blame_us"])
+    return out
+
+
+def summarize(events: Sequence[dict], *, last: Optional[int] = None) -> dict:
+    """The one-call summary (CLI/flight-recorder entry point): per-round
+    blame (optionally only the trailing ``last`` rounds) plus the
+    aggregated stage table and the blame-sums-to-makespan residual
+    (max over rounds — should be ~0; the CI leg asserts < 1e-6
+    relative)."""
+    rounds = blame_rounds(events)
+    if last is not None:
+        rounds = rounds[-last:]
+    residual = 0.0
+    for r in rounds:
+        blame = sum(row["blame_us"] for row in r["stages"])
+        if r["makespan_us"]:
+            residual = max(
+                residual, abs(blame - r["makespan_us"]) / r["makespan_us"]
+            )
+    return {
+        "rounds": rounds,
+        "stages": aggregate_blame(rounds),
+        "max_blame_residual": residual,
+    }
+
+
+__all__ = [
+    "ROUND_ROOT_NAMES",
+    "Segment",
+    "SpanNode",
+    "aggregate_blame",
+    "blame_round",
+    "blame_rounds",
+    "build_forest",
+    "critical_path",
+    "round_roots",
+    "summarize",
+]

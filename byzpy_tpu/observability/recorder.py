@@ -68,25 +68,44 @@ class FlightRecorder:
 
     def record(self, reason: str = "manual") -> Dict[str, Any]:
         """Assemble the dump object (no file IO): tail spans, metrics
-        snapshot, drop counter, the failure reason, and — when any
-        forensics plane is active — the last-N rounds' per-client
-        evidence per tenant (who was excluded/flagged going into the
-        incident; ``byzpy_tpu.forensics``)."""
+        snapshot, drop counter, the failure reason, the tail rounds'
+        critical-path blame summaries + any active SLO watchdog's
+        burn/breach state (what was slow and what was burning, going
+        into the incident), and — when any forensics plane is active —
+        the last-N rounds' per-client evidence per tenant (who was
+        excluded/flagged; ``byzpy_tpu.forensics``)."""
+        events = self._tail_events()
         dump = {
             "kind": "byzpy_tpu.flight_recorder",
             "time_unix_s": time.time(),
             "reason": reason,
             "last_rounds": self.last_rounds,
             "dropped_events": self.tracer.dropped,
-            "events": self._tail_events(),
+            "events": events,
             "metrics": self.registry.snapshot(),
         }
+        try:
+            from . import critical_path as _critical_path
+
+            cp = _critical_path.summarize(events, last=self.last_rounds)
+            if cp["rounds"]:
+                dump["critical_path"] = cp
+        except Exception:  # noqa: BLE001 — a crash dump must never fail
+            # on its optional payloads
+            pass
+        try:
+            from . import slo as _slo
+
+            slo_state = _slo.active_state()
+        except Exception:  # noqa: BLE001 — same contract
+            slo_state = []
+        if slo_state:
+            dump["slo"] = slo_state
         try:
             from ..forensics.plane import recent_evidence
 
             evidence = recent_evidence()
-        except Exception:  # noqa: BLE001 — a crash dump must never fail
-            # on its optional payloads
+        except Exception:  # noqa: BLE001 — same contract
             evidence = {}
         if evidence:
             dump["forensics"] = evidence

@@ -21,22 +21,127 @@ correlation: :func:`device_span` additionally enters a
 ``jax.profiler.TraceAnnotation`` of the same name, so when a
 ``jax.profiler`` capture is active the host span shows up on the XLA
 device timeline and the two traces correlate by name.
+
+Trace context (round causality): every enabled span carries
+``(trace_id, span_id, parent_id)`` ids, threaded through a contextvar —
+a span opened inside another becomes its child, across ``async``
+awaits, and (via :func:`carry_context`) across executor threads. The
+ids land in the exported event's ``args`` (``trace``/``span``/
+``parent``), which is what :mod:`~byzpy_tpu.observability.
+critical_path` reconstructs round trees from. Process boundaries:
+:func:`wire_context` reads the current position for stamping onto a
+wire frame (``engine.actor.wire`` does this for dict frames), and
+:func:`adopt_context`/:class:`context_scope` restore a decoded context
+on the receiving side, so a sharded round's spans stitch into ONE
+causal tree across shards and processes. The DISABLED path never
+touches the contextvar — :func:`span` stays one flag check returning
+the shared no-op singleton.
 """
 
 from __future__ import annotations
 
+import contextvars
+import itertools
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import runtime
 
 #: Synthetic tid space for named tracks (real OS thread ids stay well
 #: clear of this range on Linux/macOS).
 _TRACK_TID_BASE = 1_000_000
+
+#: Current trace position ``(trace_id, span_id)`` — the parent linkage
+#: every enabled span reads and re-sets. A contextvar so linkage is
+#: correct per-task on asyncio loops, not just per-thread.
+_CTX: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = (
+    contextvars.ContextVar("byzpy_trace_ctx", default=None)
+)
+
+#: Process-unique id prefix: span/trace ids minted by different
+#: processes (shards, the root, remote clients) must not collide when
+#: their exports are stitched into one trace.
+_ID_PREFIX = f"{os.getpid():x}{os.urandom(2).hex()}."
+_IDS = itertools.count(1)
+
+
+def _new_id() -> str:
+    return f"{_ID_PREFIX}{next(_IDS):x}"
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """The innermost open span's ``(trace_id, span_id)``, or ``None``
+    outside any span (also ``None`` whenever telemetry is disabled —
+    disabled spans never set the contextvar)."""
+    return _CTX.get()
+
+
+def wire_context() -> Optional[Tuple[str, str]]:
+    """Flag-checked front door for stamping a wire frame: the current
+    ``(trace_id, span_id)`` when telemetry is on and a span is open,
+    else ``None`` (one flag check, no contextvar read when disabled)."""
+    if not runtime.STATE.enabled:
+        return None
+    return _CTX.get()
+
+
+def adopt_context(ctx: Any) -> None:
+    """Restore a decoded wire context as the caller's current trace
+    position, so the next span opened in this task/thread becomes the
+    remote sender's child (``engine.actor.wire.decode`` calls this for
+    stamped frames). ``None`` clears the position (a fresh root);
+    anything else malformed is ignored — a forged frame must not break
+    telemetry."""
+    if ctx is None:
+        _CTX.set(None)
+        return
+    try:
+        trace_id, span_id = ctx
+        _CTX.set((str(trace_id), str(span_id)))
+    except Exception:  # noqa: BLE001 — wire-shaped input, never trusted
+        pass
+
+
+class context_scope:
+    """Scoped parent override: spans opened inside the ``with`` block
+    are children of ``ctx`` (a ``(trace_id, span_id)`` pair, e.g. a
+    :class:`PartialFold`'s carried context or a coordinator round's
+    :func:`current_context`). ``ctx=None`` starts a fresh root."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: Optional[Tuple[str, str]]) -> None:
+        self._ctx = None if ctx is None else (str(ctx[0]), str(ctx[1]))
+        self._token = None
+
+    def __enter__(self) -> "context_scope":
+        self._token = _CTX.set(self._ctx)
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        if self._token is not None:
+            _CTX.reset(self._token)
+        return False
+
+
+def carry_context(fn):
+    """Wrap a callable about to cross an executor boundary
+    (``loop.run_in_executor`` does NOT copy contextvars) so the
+    caller's trace position rides along and the spans the callable
+    opens stay linked into the caller's tree. Disabled telemetry
+    returns ``fn`` unchanged after one flag check."""
+    if not runtime.STATE.enabled:
+        return fn
+    ctx = contextvars.copy_context()
+
+    def _run(*args: Any, **kwargs: Any):
+        return ctx.run(fn, *args, **kwargs)
+
+    return _run
 
 
 class _NullSpan:
@@ -60,9 +165,16 @@ NULL_SPAN = _NullSpan()
 
 class Span:
     """One live span (context manager). Attributes set via ``set()`` (or
-    the ``span(...)`` kwargs) become chrome-trace ``args``."""
+    the ``span(...)`` kwargs) become chrome-trace ``args``. On entry the
+    span links into the current trace context (child of the innermost
+    open span, or a fresh trace root) and becomes the context for
+    anything opened inside it; its ``trace``/``span``/``parent`` ids
+    are recorded with the event."""
 
-    __slots__ = ("name", "track", "attrs", "_tracer", "_t0_ns")
+    __slots__ = (
+        "name", "track", "attrs", "trace_id", "span_id", "parent_id",
+        "_tracer", "_t0_ns", "_token",
+    )
 
     def __init__(
         self, tracer: "Tracer", name: str, track: Optional[str], attrs: Dict[str, Any]
@@ -70,22 +182,46 @@ class Span:
         self.name = name
         self.track = track
         self.attrs = attrs
+        self.trace_id = ""
+        self.span_id = ""
+        self.parent_id: Optional[str] = None
         self._tracer = tracer
         self._t0_ns = 0
+        self._token = None
 
     def set(self, **attrs: Any) -> "Span":
         """Attach/update span attributes; returns self for chaining."""
         self.attrs.update(attrs)
         return self
 
+    @property
+    def context(self) -> Tuple[str, str]:
+        """This span's ``(trace_id, span_id)`` — the parent context a
+        wire frame or an explicitly-threaded child should carry."""
+        return (self.trace_id, self.span_id)
+
     def __enter__(self) -> "Span":
+        parent = _CTX.get()
+        if parent is None:
+            self.trace_id = _new_id()
+        else:
+            self.trace_id, self.parent_id = parent
+        self.span_id = _new_id()
+        self._token = _CTX.set((self.trace_id, self.span_id))
         self._t0_ns = time.perf_counter_ns()
         return self
 
     def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
         t1 = time.perf_counter_ns()
+        if self._token is not None:
+            _CTX.reset(self._token)
+            self._token = None
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
+        self.attrs["trace"] = self.trace_id
+        self.attrs["span"] = self.span_id
+        if self.parent_id is not None:
+            self.attrs["parent"] = self.parent_id
         self._tracer._record(self.name, self.track, self._t0_ns, t1, self.attrs)
         return False
 
@@ -236,6 +372,37 @@ class Tracer:
             )
         for ev in retained:
             events.append({"pid": pid, **ev})
+        # flow events for cross-track parent/child links: a stitched
+        # round (tenant rows, shard rows, the root row) renders as one
+        # connected lane set in Perfetto instead of disjoint lanes.
+        # Same-track links are already drawn by slice nesting.
+        by_span = {
+            ev["args"]["span"]: ev
+            for ev in retained
+            if ev.get("ph") == "X" and "span" in ev.get("args", ())
+        }
+        flow_id = 0
+        for ev in retained:
+            if ev.get("ph") != "X":
+                continue
+            parent = by_span.get(ev.get("args", {}).get("parent"))
+            if parent is None or parent["tid"] == ev["tid"]:
+                continue
+            flow_id += 1
+            events.append(
+                {
+                    "name": "trace", "cat": "flow", "ph": "s",
+                    "id": flow_id, "pid": pid, "tid": parent["tid"],
+                    "ts": parent["ts"],
+                }
+            )
+            events.append(
+                {
+                    "name": "trace", "cat": "flow", "ph": "f", "bp": "e",
+                    "id": flow_id, "pid": pid, "tid": ev["tid"],
+                    "ts": ev["ts"],
+                }
+            )
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
@@ -281,8 +448,15 @@ def device_span(name: str, track: Optional[str] = None, **attrs: Any):
 
 
 def instant(name: str, track: Optional[str] = None, **attrs: Any) -> None:
-    """Record an instant event on the process tracer (flag-checked)."""
+    """Record an instant event on the process tracer (flag-checked).
+    An instant fired inside an open span links into the trace (its
+    ``trace``/``parent`` args point at the enclosing span), so e.g. an
+    SLO alarm lands inside the round tree that breached it."""
     if runtime.STATE.enabled:
+        ctx = _CTX.get()
+        if ctx is not None:
+            attrs.setdefault("trace", ctx[0])
+            attrs.setdefault("parent", ctx[1])
         _TRACER.instant(name, track, **attrs)
 
 
@@ -290,8 +464,13 @@ __all__ = [
     "NULL_SPAN",
     "Span",
     "Tracer",
+    "adopt_context",
+    "carry_context",
+    "context_scope",
+    "current_context",
     "device_span",
     "instant",
     "span",
     "tracer",
+    "wire_context",
 ]

@@ -67,6 +67,7 @@ def build_cohort(
     staleness: StalenessPolicy,
     *,
     tenant: str = "",
+    track: Optional[str] = None,
 ) -> Cohort:
     """Pad one round's submissions into the smallest bucket that holds
     them, stamping per-row staleness discounts against ``server_round``.
@@ -74,12 +75,13 @@ def build_cohort(
     m``) — the ragged door's layout, where the compiled shape lives in
     the flat batch (``serving.ragged``), not in this cohort. ``tenant``
     (optional) attributes the telemetry span to the owning tenant's
-    trace row."""
+    trace row; ``track`` overrides the row name (the sharded tier
+    passes its shard-qualified ``shard:<i>/tenant:<name>`` row)."""
     m = len(submissions)
     bucket = m if ladder is None else ladder.bucket_for(m)
     with obs_tracing.span(
         "serving.bucket_pad",
-        track=f"tenant:{tenant}" if tenant else None,
+        track=track or (f"tenant:{tenant}" if tenant else None),
         round=server_round, m=m, bucket=bucket, tenant=tenant,
     ):
         d = int(np.asarray(submissions[0].gradient).shape[0])
@@ -117,13 +119,18 @@ class CohortAggregator:
     into ``fold_init(bucket)`` as they land and closes the round with
     ``fold_finalize_masked`` — identical results, same jit cache."""
 
-    def __init__(self, aggregator: Aggregator, *, tenant: str = "") -> None:
+    def __init__(
+        self, aggregator: Aggregator, *, tenant: str = "",
+        track: Optional[str] = None,
+    ) -> None:
         self.aggregator = aggregator
         #: owning tenant (telemetry attribution); the fold runs on
         #: anonymous executor threads, so without this the expensive
-        #: stages would land on unnamed thread rows in the trace
+        #: stages would land on unnamed thread rows in the trace.
+        #: ``track`` overrides the row name (shard-qualified rows in
+        #: the sharded tier).
         self.tenant = tenant
-        self._track = f"tenant:{tenant}" if tenant else None
+        self._track = track or (f"tenant:{tenant}" if tenant else None)
 
     def aggregate(self, cohort: Cohort) -> Any:
         """Aggregate one cohort to a ``(d,)`` vector."""

@@ -277,7 +277,7 @@ class _Tenant:
         "cfg", "queue", "ledger", "ladder", "executor", "stats",
         "round_id", "ingress_bytes", "last_aggregate", "min_cohort",
         "outstanding", "round_done", "failed_rounds",
-        "last_cohort_clients", "held", "telemetry",
+        "last_cohort_clients", "held", "telemetry", "track",
         "seqs", "duplicates", "durability", "breaker", "next_wal_id",
         "quarantine_drops", "recovered", "forensics", "compile_site",
         "compile_warn_high",
@@ -288,12 +288,20 @@ class _Tenant:
         cfg: TenantConfig,
         *,
         clock: Callable[[], float] = time.monotonic,
+        track_prefix: str = "",
     ) -> None:
         self.cfg = cfg
         self.queue = AdmissionQueue(cfg.queue_capacity)
         self.ledger = CreditLedger(cfg.credit)
         self.ladder = BucketLadder(cfg.cohort_cap, min_bucket=cfg.min_bucket)
-        self.executor = CohortAggregator(cfg.aggregator, tenant=cfg.name)
+        #: telemetry track (trace row) this tenant's spans land on —
+        #: shard-qualified (``shard:<i>/tenant:<name>``) when the
+        #: frontend is one shard of the sharded tier, so a merged
+        #: multi-shard trace keeps one lane per (shard, tenant)
+        self.track = f"{track_prefix}tenant:{cfg.name}"
+        self.executor = CohortAggregator(
+            cfg.aggregator, tenant=cfg.name, track=self.track
+        )
         # effective round floor: the operator's min_cohort raised to the
         # aggregator's smallest admissible n (probed via validate_n), so
         # the out-of-the-box config can never close a cohort the crash
@@ -399,11 +407,18 @@ class ServingFrontend:
         self._shard_tag: Dict[str, Any] = (
             {} if shard is None else {"shard": int(shard)}
         )
+        # shard-qualified telemetry tracks: every tenant row of a
+        # sharded-tier frontend is named shard:<i>/tenant:<name>, so a
+        # stitched multi-shard trace renders one lane per (shard,
+        # tenant) instead of piling N shards onto one tenant row
+        track_prefix = "" if shard is None else f"shard:{int(shard)}/"
         self._tenants: Dict[str, _Tenant] = {}
         for cfg in tenants:
             if cfg.name in self._tenants:
                 raise ValueError(f"duplicate tenant {cfg.name!r}")
-            self._tenants[cfg.name] = _Tenant(cfg, clock=clock)
+            self._tenants[cfg.name] = _Tenant(
+                cfg, clock=clock, track_prefix=track_prefix
+            )
         self._clock = clock
         self._on_round = on_round
         #: the ragged dispatch plane (``serving.ragged``): grouped
@@ -939,7 +954,7 @@ class ServingFrontend:
             t.telemetry.outstanding.set(t.outstanding)
         with obs_tracing.span(
             "serving.broadcast",
-            track=f"tenant:{t.cfg.name}",
+            track=t.track,
             tenant=t.cfg.name,
             round=closed,
         ):
@@ -1102,7 +1117,7 @@ class ServingFrontend:
                 # next arrival
                 continue
             subs, held = held, []
-            track = f"tenant:{t.cfg.name}"
+            track = t.track
             with obs_tracing.span(
                 "serving.round", track=track, tenant=t.cfg.name,
                 round=t.round_id, m=len(subs), **self._shard_tag,
@@ -1117,7 +1132,7 @@ class ServingFrontend:
                     cohort = build_cohort(
                         subs, t.round_id,
                         None if ragged_served else t.ladder,
-                        t.cfg.staleness, tenant=t.cfg.name,
+                        t.cfg.staleness, tenant=t.cfg.name, track=track,
                     )
                 round_span.set(bucket=cohort.bucket)
                 assert self._device_lock is not None
@@ -1139,10 +1154,12 @@ class ServingFrontend:
                             # O(m²·d) score pass rode the kernel
                             prep = await loop.run_in_executor(
                                 None,
-                                lambda v=view, c=cohort, s=subs:
-                                self._forensics_prepare(
-                                    t, c, v.vector, s,
-                                    precomputed=v.precomputed(),
+                                obs_tracing.carry_context(
+                                    lambda v=view, c=cohort, s=subs:
+                                    self._forensics_prepare(
+                                        t, c, v.vector, s,
+                                        precomputed=v.precomputed(),
+                                    )
                                 ),
                             )
                     except Exception:  # noqa: BLE001 — poisoned
@@ -1169,8 +1186,12 @@ class ServingFrontend:
 
                 try:
                     async with self._device_lock:
+                        # context carried across the executor hop: the
+                        # fold/device-step spans stay children of this
+                        # round's span, not orphan roots
                         vec, prep = await loop.run_in_executor(
-                            None, fold_and_prepare
+                            None,
+                            obs_tracing.carry_context(fold_and_prepare),
                         )
                 except Exception:  # noqa: BLE001 — a poisoned cohort must
                     # never kill the scheduler: drop the round, keep serving
@@ -1227,7 +1248,7 @@ class ServingFrontend:
         ragged_served = (
             self._ragged is not None and self._ragged.serves(t.cfg.name)
         )
-        track = f"tenant:{t.cfg.name}"
+        track = t.track
         with obs_tracing.span(
             "serving.round", track=track, tenant=t.cfg.name,
             round=t.round_id, m=len(subs), **self._shard_tag,
@@ -1239,7 +1260,7 @@ class ServingFrontend:
                 cohort = build_cohort(
                     subs, t.round_id,
                     None if ragged_served else t.ladder,
-                    t.cfg.staleness, tenant=t.cfg.name,
+                    t.cfg.staleness, tenant=t.cfg.name, track=track,
                 )
             try:
                 view: Optional[RaggedView] = None
@@ -1336,10 +1357,22 @@ class ServingFrontend:
                     break
                 body = await reader.readexactly(length)
                 try:
+                    adopted = None
                     with obs_tracing.span(
                         "serving.ingress.decode", bytes=length
                     ):
                         request = wire.decode(body)
+                        # decode adopted any _trace_ctx stamp, but the
+                        # decode span's exit resets the contextvar to
+                        # its token — capture the adopted position and
+                        # restore it after the span closes, or the
+                        # client-submit -> admission linkage dies here
+                        # (enabled-only: the disabled path must stay a
+                        # flag check, no contextvar traffic)
+                        if obs_runtime.STATE.enabled:
+                            adopted = obs_tracing.current_context()
+                    if adopted is not None:
+                        obs_tracing.adopt_context(adopted)
                 except Exception:  # noqa: BLE001 — forged/tampered frame
                     # a frame that fails HMAC/unpickle names no trustable
                     # tenant; count it at the frontend and drop the peer
@@ -1641,16 +1674,24 @@ class ServingClient:
             self._seq += 1
         else:
             self._seq = max(self._seq, int(seq) + 1)
-        return await self._call(
-            {
-                "kind": "submit",
-                "tenant": tenant,
-                "client": client,
-                "round": int(round_submitted),
-                "gradient": np.asarray(gradient),
-                "seq": int(seq),
-            }
-        )
+        # the round-causality chain starts HERE: the submit span's
+        # context is stamped onto the frame by wire.encode, so the
+        # frontend's admission span (possibly another process) links
+        # as this span's child
+        with obs_tracing.span(
+            "serving.client.submit", track="client",
+            tenant=tenant, client=client,
+        ):
+            return await self._call(
+                {
+                    "kind": "submit",
+                    "tenant": tenant,
+                    "client": client,
+                    "round": int(round_submitted),
+                    "gradient": np.asarray(gradient),
+                    "seq": int(seq),
+                }
+            )
 
     async def stats(self, tenant: str) -> dict:
         """Fetch the tenant's stats snapshot."""

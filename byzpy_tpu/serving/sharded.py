@@ -175,6 +175,17 @@ class ShardRouter:
         return s
 
 
+def _as_trace_ctx(value: Any) -> Optional[Tuple[str, str]]:
+    """Best-effort decode of a wire frame's carried trace context —
+    telemetry metadata only, so anything malformed becomes ``None``
+    instead of rejecting the partial."""
+    try:
+        trace_id, span_id = value
+        return (str(trace_id), str(span_id))
+    except Exception:  # noqa: BLE001 — wire-shaped input, never trusted
+        return None
+
+
 @dataclass(frozen=True)
 class PartialFold:
     """One shard's per-round streaming fold contribution (wire type).
@@ -189,7 +200,11 @@ class PartialFold:
     root recomputes it from the shipped rows; a mismatch is a forged
     fold. ``clients``/``seqs``/``wal_ids`` align with ``rows`` and
     carry the identities the root's cross-shard dedup and the shard's
-    exactly-once WAL accounting need."""
+    exactly-once WAL accounting need. ``trace_ctx`` (optional) is the
+    shard's ``serving.shard_close`` span context ``(trace_id,
+    span_id)`` — telemetry-only causality metadata the root's merge
+    span records as a cross-process link (never verified, never part
+    of the digest: a forged context can at worst mis-draw a trace)."""
 
     tenant: str
     round_id: int
@@ -201,6 +216,7 @@ class PartialFold:
     extras: dict
     digest: str
     first_arrival_s: float
+    trace_ctx: Optional[Tuple[str, str]] = None
 
     @property
     def m(self) -> int:
@@ -221,6 +237,7 @@ class PartialFold:
             "extras": self.extras,
             "digest": self.digest,
             "first_arrival_s": float(self.first_arrival_s),
+            "trace_ctx": self.trace_ctx,
         }
 
     @classmethod
@@ -253,6 +270,7 @@ class PartialFold:
             extras=dict(frame.get("extras") or {}),
             digest=str(frame["digest"]),
             first_arrival_s=float(frame.get("first_arrival_s", 0.0)),
+            trace_ctx=_as_trace_ctx(frame.get("trace_ctx")),
         )
 
 
@@ -365,7 +383,7 @@ class ShardFrontend:
             track=f"shard:{self.index}",
             shard=self.index, tenant=tenant,
             round=t.round_id, m=cohort.m,
-        ):
+        ) as close_span:
             partial = t.executor.aggregator.fold_partial(
                 cohort.matrix, cohort.valid, cohort.weights
             )
@@ -381,6 +399,11 @@ class ShardFrontend:
                 extras=partial.get("extras", {}),
                 digest=evidence_digest(rows),
                 first_arrival_s=cohort.first_arrival_s,
+                # the shard_close span's identity: stamped onto the
+                # wire frame so the root's merge span can link this
+                # partial's lane into the round tree across processes
+                # (NULL_SPAN with telemetry off → no context)
+                trace_ctx=getattr(close_span, "context", None),
             )
 
     def close_partial(self, tenant: str) -> Optional[PartialFold]:
@@ -896,31 +919,39 @@ class ShardedCoordinator:
                 "scheduler (start() was called) — use one round closer"
             )
         rt = self._roots[tenant]
-        partials: List[PartialFold] = []
-        responders = 0
-        missing: List[int] = []
-        for shard in self.shards:
-            if not shard.alive:
-                missing.append(shard.index)
-                continue
-            try:
-                p = shard.close_partial(tenant)
-            except Exception:  # noqa: BLE001 — a crashing shard close is
-                # a partition, not a root outage; anything it drained
-                # before crashing returns to its held list (the async
-                # twin's contract — rows are never lost)
-                shard.requeue(tenant, rt.round_id)
-                missing.append(shard.index)
-                continue
-            responders += 1
-            if p is not None:
-                partials.append(p)
-        if responders < self.quorum:
-            for p in partials:
-                self.shards[p.shard].requeue(tenant, p.round_id)
-            rt.quorum_failures += 1
-            return None
-        return self.merge_partials(tenant, partials, missing=missing)
+        # ONE trace root per sharded round: the shard closes below run
+        # in this thread, so their serving.shard_close spans (and the
+        # merge chain under merge_partials) all link as children —
+        # the causal tree the critical-path summarizer reconstructs
+        with obs_tracing.span(
+            "serving.sharded_round", track="root",
+            tenant=tenant, round=rt.round_id,
+        ):
+            partials: List[PartialFold] = []
+            responders = 0
+            missing: List[int] = []
+            for shard in self.shards:
+                if not shard.alive:
+                    missing.append(shard.index)
+                    continue
+                try:
+                    p = shard.close_partial(tenant)
+                except Exception:  # noqa: BLE001 — a crashing shard close
+                    # is a partition, not a root outage; anything it
+                    # drained before crashing returns to its held list
+                    # (the async twin's contract — rows are never lost)
+                    shard.requeue(tenant, rt.round_id)
+                    missing.append(shard.index)
+                    continue
+                responders += 1
+                if p is not None:
+                    partials.append(p)
+            if responders < self.quorum:
+                for p in partials:
+                    self.shards[p.shard].requeue(tenant, p.round_id)
+                rt.quorum_failures += 1
+                return None
+            return self.merge_partials(tenant, partials, missing=missing)
 
     def merge_partials(
         self,
@@ -1075,6 +1106,16 @@ class ShardedCoordinator:
         with obs_tracing.span(
             "serving.fold_merge", track="root", tenant=tenant,
             round=rt.round_id, shards=len(verified), m=m_total,
+            # cross-process causality: each verified partial's carried
+            # shard_close span identity ("trace:span") — a merged
+            # multi-process export stitches the shard lanes to this
+            # merge through these links even when the shard spans live
+            # in another process's trace file
+            links=[
+                f"{p.trace_ctx[0]}:{p.trace_ctx[1]}"
+                for p, _f, _d in verified
+                if p.trace_ctx is not None
+            ],
         ):
             merged = agg.fold_merge(merge_partials)
             try:
@@ -1265,6 +1306,19 @@ class ShardedCoordinator:
         writes stay loop-confined)."""
         loop = asyncio.get_running_loop()
         rt = self._roots[tenant]
+        round_span = obs_tracing.span(
+            "serving.sharded_round", track="root",
+            tenant=tenant, round=rt.round_id,
+        )
+        with round_span:
+            return await self._close_async_traced(tenant, loop, rt)
+
+    async def _close_async_traced(
+        self, tenant: str, loop, rt: _RootTenant
+    ) -> Optional[tuple]:
+        """Body of :meth:`_close_async`, running inside the round's
+        trace-root span (executor hops carry the context explicitly —
+        ``run_in_executor`` does not copy contextvars)."""
         drained: Dict[int, tuple] = {}
         missing: List[int] = []
         responders = 0
@@ -1283,7 +1337,9 @@ class ShardedCoordinator:
             return None
         futs = {
             loop.run_in_executor(
-                None, self.shards[i].build_partial, tenant, subs, cohort
+                None,
+                obs_tracing.carry_context(self.shards[i].build_partial),
+                tenant, subs, cohort,
             ): i
             for i, (subs, cohort) in drained.items()
         }
@@ -1326,7 +1382,9 @@ class ShardedCoordinator:
         actions: List[tuple] = []
         async with self._device_lock:
             computed = await loop.run_in_executor(
-                None, self._verify_and_merge, rt, partials, actions
+                None,
+                obs_tracing.carry_context(self._verify_and_merge),
+                rt, partials, actions,
             )
         # shard-state side effects (requeues/discards/failure accounting)
         # run HERE, back on the loop — the executor half only described

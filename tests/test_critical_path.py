@@ -1,0 +1,174 @@
+"""Critical-path attribution: tree reconstruction, blame accounting.
+
+Contracts under test:
+
+* **reconstruction** — complete events link into causal trees by their
+  ``span``/``parent`` ids; orphans surface as roots, context-free
+  events are skipped, round roots are found through wrapper spans;
+* **attribution** — per-stage blame partitions the round makespan
+  EXACTLY (sums to it); overlapping children (parallel shard legs)
+  resolve to the dominating chain, so the slow shard gets the blame
+  and the fast one gets none; an injected slow stage owns the round;
+* **plumbing** — the CLI ``--critical-path`` section and the live
+  tracer round-trip (record through real spans, attribute offline).
+"""
+
+import json
+
+import pytest
+
+from byzpy_tpu import observability as obs
+from byzpy_tpu.observability import critical_path as cp
+from byzpy_tpu.observability import tracing as obs_tracing
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    obs.disable()
+    obs_tracing.tracer().clear()
+    obs_tracing.adopt_context(None)
+    yield
+    obs.disable()
+    obs_tracing.tracer().clear()
+    obs_tracing.adopt_context(None)
+
+
+def _ev(name, ts, dur, span, parent=None, **args):
+    a = {"span": span, **args}
+    if parent is not None:
+        a["parent"] = parent
+    return {"name": name, "ph": "X", "ts": ts, "dur": dur, "tid": 1, "args": a}
+
+
+class TestForest:
+    def test_links_children_and_surfaces_orphans(self):
+        events = [
+            _ev("root", 0, 100, "r"),
+            _ev("child", 10, 20, "c", parent="r"),
+            _ev("orphan", 50, 5, "o", parent="gone"),
+            {"name": "instant", "ph": "i", "ts": 1, "tid": 1, "args": {}},
+            _ev("ctxfree", 0, 1, None),  # span id None -> skipped
+        ]
+        roots = cp.build_forest(events)
+        names = sorted(r.name for r in roots)
+        assert names == ["orphan", "root"]
+        (root,) = [r for r in roots if r.name == "root"]
+        assert [c.name for c in root.children] == ["child"]
+
+    def test_round_roots_found_through_wrappers_and_nested_once(self):
+        events = [
+            _ev("bench.wrapper", 0, 100, "w"),
+            _ev("serving.sharded_round", 0, 90, "sr", parent="w", round=3),
+            _ev("serving.round", 5, 10, "r", parent="sr", round=3),
+        ]
+        rounds = cp.round_roots(cp.build_forest(events))
+        # the OUTER round root counts once; the nested serving.round
+        # inside it is part of its tree, not a second round
+        assert [r.name for r in rounds] == ["serving.sharded_round"]
+
+
+class TestCriticalPath:
+    def test_blame_partitions_makespan_exactly(self):
+        events = [
+            _ev("serving.round", 0, 100, "r", round=0, tenant="m0"),
+            _ev("serving.cohort_close", 5, 10, "a", parent="r"),
+            _ev("serving.fold", 20, 60, "b", parent="r"),
+            _ev("serving.device_step", 30, 40, "c", parent="b"),
+        ]
+        (row,) = cp.blame_rounds(events)
+        assert row["makespan_us"] == 100
+        blame = {r["stage"]: r["blame_us"] for r in row["stages"]}
+        # device_step owns its 40, fold its surrounding 20, the
+        # cohort_close its 10, the round span the gaps (5+10+15)
+        assert blame["serving.device_step"] == 40
+        assert blame["serving.fold"] == 20
+        assert blame["serving.cohort_close"] == 10
+        assert blame["serving.round"] == 30
+        assert sum(blame.values()) == pytest.approx(100)
+
+    def test_parallel_legs_blame_the_dominating_chain(self):
+        # two shard legs overlap in wall time under one round root: the
+        # slow one (ends at 80) dominates; the fast one (ends at 30)
+        # is off the critical path entirely
+        events = [
+            _ev("serving.sharded_round", 0, 100, "r", round=0),
+            _ev("serving.shard_close", 0, 30, "s0", parent="r", shard=0),
+            _ev("serving.shard_close", 0, 80, "s1", parent="r", shard=1),
+            _ev("serving.fold_merge", 80, 20, "m", parent="r"),
+        ]
+        (row,) = cp.blame_rounds(events)
+        blame = {
+            (r["stage"], r["shard"]): r["blame_us"] for r in row["stages"]
+        }
+        assert blame[("serving.shard_close", 1)] == 80
+        assert ("serving.shard_close", 0) not in blame
+        assert blame[("serving.fold_merge", None)] == 20
+        assert sum(blame.values()) == pytest.approx(100)
+
+    def test_injected_slow_stage_is_attributed(self):
+        fast = [
+            _ev("serving.round", 0, 10, "r0", round=0),
+            _ev("serving.fold", 1, 8, "f0", parent="r0"),
+        ]
+        slow = [
+            _ev("serving.round", 100, 200, "r1", round=1),
+            _ev("serving.fold", 101, 5, "f1", parent="r1"),
+            _ev("serving.bucket_pad", 110, 180, "p1", parent="r1"),
+        ]
+        summary = cp.summarize(fast + slow)
+        assert summary["max_blame_residual"] < 1e-9
+        table = {
+            (r["stage"], r["shard"]): r for r in summary["stages"]
+        }
+        # the injected slow stage dominates the aggregate blame
+        top = summary["stages"][0]
+        assert top["stage"] == "serving.bucket_pad"
+        assert top["share"] > 0.8
+        assert table[("serving.fold", None)]["rounds"] == 2
+
+    def test_summarize_last_window(self):
+        events = []
+        for r in range(6):
+            events += [
+                _ev("serving.round", r * 100, 50, f"r{r}", round=r),
+            ]
+        summary = cp.summarize(events, last=2)
+        assert [r["round"] for r in summary["rounds"]] == [4, 5]
+
+
+class TestLiveTracerRoundTrip:
+    def test_recorded_spans_attribute_offline(self, tmp_path):
+        import time
+
+        obs.enable()
+        with obs_tracing.span("serving.round", round=0, tenant="m0"):
+            with obs_tracing.span("serving.fold"):
+                time.sleep(0.002)
+        path = str(tmp_path / "t.json")
+        obs_tracing.tracer().export_chrome_trace(path)
+        with open(path) as fh:
+            events = json.load(fh)["traceEvents"]
+        (row,) = cp.blame_rounds(events)
+        blame = {r["stage"]: r["blame_us"] for r in row["stages"]}
+        assert blame["serving.fold"] >= 2000  # the slept 2 ms
+        assert sum(blame.values()) == pytest.approx(
+            row["makespan_us"], rel=1e-6
+        )
+
+    def test_cli_critical_path_section(self, tmp_path, capsys):
+        from byzpy_tpu.observability.__main__ import main
+
+        obs.enable()
+        for r in range(2):
+            with obs_tracing.span("serving.round", round=r, tenant="m0"):
+                with obs_tracing.span("serving.fold"):
+                    pass
+        path = str(tmp_path / "t.json")
+        obs_tracing.tracer().export_chrome_trace(path)
+        assert main([path, "--critical-path"]) == 0
+        out = capsys.readouterr().out
+        assert "critical-path blame" in out
+        assert main([path, "--critical-path", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["critical_path"]["max_blame_residual"] < 1e-6
+        assert len(doc["critical_path"]["rounds"]) == 2

@@ -28,9 +28,11 @@ def _telemetry_isolation():
     """Every test starts disabled with a clean tracer ring."""
     obs.disable()
     obs_tracing.tracer().clear()
+    obs_tracing.adopt_context(None)
     yield
     obs.disable()
     obs_tracing.tracer().clear()
+    obs_tracing.adopt_context(None)
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +108,179 @@ class TestSpans:
             pass
         (ev,) = obs_tracing.tracer().events()
         assert ev["name"] == "fold" and ev["args"]["m"] == 4
+
+
+# ---------------------------------------------------------------------------
+# trace context
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_nested_spans_link_parent_child(self):
+        obs.enable()
+        with obs_tracing.span("outer") as outer:
+            assert obs_tracing.current_context() == outer.context
+            with obs_tracing.span("inner"):
+                pass
+            with obs_tracing.span("inner2"):
+                pass
+        assert obs_tracing.current_context() is None
+        inner, inner2, outer_ev = obs_tracing.tracer().events()
+        assert outer_ev["args"].get("parent") is None
+        # siblings share the trace, carry distinct span ids, and both
+        # point at the outer span
+        assert inner["args"]["trace"] == outer_ev["args"]["trace"]
+        assert inner2["args"]["trace"] == outer_ev["args"]["trace"]
+        assert inner["args"]["span"] != inner2["args"]["span"]
+        assert inner["args"]["parent"] == outer_ev["args"]["span"]
+        assert inner2["args"]["parent"] == outer_ev["args"]["span"]
+
+    def test_separate_roots_get_separate_traces(self):
+        obs.enable()
+        with obs_tracing.span("a"):
+            pass
+        with obs_tracing.span("b"):
+            pass
+        a, b = obs_tracing.tracer().events()
+        assert a["args"]["trace"] != b["args"]["trace"]
+
+    def test_context_scope_reparents_and_restores(self):
+        obs.enable()
+        remote = ("trace-x", "span-x")
+        with obs_tracing.span("local") as local:
+            with obs_tracing.context_scope(remote):
+                with obs_tracing.span("child"):
+                    pass
+            with obs_tracing.span("sibling"):
+                pass
+        events = {ev["name"]: ev for ev in obs_tracing.tracer().events()}
+        assert events["child"]["args"]["trace"] == "trace-x"
+        assert events["child"]["args"]["parent"] == "span-x"
+        # the scope restored the local context on exit
+        assert events["sibling"]["args"]["parent"] == local.span_id
+
+    def test_carry_context_crosses_executor_threads(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        obs.enable()
+        with ThreadPoolExecutor(1) as pool:
+            with obs_tracing.span("round") as round_span:
+
+                def stage():
+                    with obs_tracing.span("stage"):
+                        pass
+
+                pool.submit(obs_tracing.carry_context(stage)).result()
+                # and WITHOUT carry: the stage orphans to its own trace
+                pool.submit(stage).result()
+        events = [
+            ev for ev in obs_tracing.tracer().events()
+            if ev["name"] == "stage"
+        ]
+        carried, bare = events
+        assert carried["args"]["parent"] == round_span.span_id
+        assert bare["args"].get("parent") is None
+        assert bare["args"]["trace"] != round_span.trace_id
+
+    def test_adopt_context_sets_position_and_survives_garbage(self):
+        obs.enable()
+        obs_tracing.adopt_context(("t1", "s1"))
+        assert obs_tracing.current_context() == ("t1", "s1")
+        obs_tracing.adopt_context("garbage-not-a-pair-of-two")  # ignored
+        assert obs_tracing.current_context() == ("t1", "s1")
+        obs_tracing.adopt_context(("t2", "s2"))
+        with obs_tracing.span("child"):
+            pass
+        (ev,) = obs_tracing.tracer().events()
+        assert ev["args"]["trace"] == "t2" and ev["args"]["parent"] == "s2"
+        # None clears the position (also the fixtures' hygiene hook)
+        obs_tracing.adopt_context(None)
+        assert obs_tracing.current_context() is None
+
+    def test_instant_links_into_enclosing_span(self):
+        obs.enable()
+        with obs_tracing.span("round") as r:
+            obs_tracing.instant("slo.breach", burn=2.0)
+        instant_ev = [
+            ev for ev in obs_tracing.tracer().events() if ev["ph"] == "i"
+        ][0]
+        assert instant_ev["args"]["trace"] == r.trace_id
+        assert instant_ev["args"]["parent"] == r.span_id
+
+    def test_disabled_context_is_one_flag_check(self):
+        assert obs_tracing.wire_context() is None
+        assert obs_tracing.current_context() is None
+        # disabled spans never touch the contextvar
+        with obs_tracing.span("x"):
+            assert obs_tracing.current_context() is None
+
+    def test_chrome_trace_emits_cross_track_flow_events(self):
+        obs.enable()
+        with obs_tracing.span("root", track="root"):
+            with obs_tracing.span("leg", track="shard:0"):
+                pass
+            with obs_tracing.span("same-track"):  # inherits root's? no:
+                # default track = calling thread -> different tid than
+                # the named root track, so this ALSO flows
+                pass
+        doc = obs_tracing.tracer().chrome_trace()
+        flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+        starts = [e for e in flows if e["ph"] == "s"]
+        ends = [e for e in flows if e["ph"] == "f"]
+        assert len(starts) == len(ends) == 2
+        assert {e["id"] for e in starts} == {e["id"] for e in ends}
+        # flow binds the parent's track to the child's
+        root_ev = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e["name"] == "root"
+        ][0]
+        leg_ev = [
+            e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e["name"] == "leg"
+        ][0]
+        flow_pair = [
+            (s, f) for s in starts for f in ends if s["id"] == f["id"]
+            and f["tid"] == leg_ev["tid"]
+        ]
+        assert flow_pair and flow_pair[0][0]["tid"] == root_ev["tid"]
+
+    def test_wire_frames_stamp_and_restore_context(self):
+        from byzpy_tpu.engine.actor import wire
+
+        obs.enable()
+        with obs_tracing.span("client.submit") as submit:
+            frame = wire.encode({"kind": "submit", "tenant": "m0"})
+            ctx = submit.context
+        with obs_tracing.context_scope(None):
+            decoded = wire.decode(frame[4:])
+            # the stamp is popped: consumers see what they were sent
+            assert wire.TRACE_CTX_KEY not in decoded
+            # ...and restored: the next span is the sender's child
+            assert obs_tracing.current_context() == ctx
+            with obs_tracing.span("serving.admission"):
+                pass
+        admission = obs_tracing.tracer().events()[-1]
+        assert admission["args"]["parent"] == ctx[1]
+        assert admission["args"]["trace"] == ctx[0]
+
+    def test_unstamped_frames_leave_local_context_alone(self):
+        from byzpy_tpu.engine.actor import wire
+
+        frame = wire.encode({"kind": "submit"})  # disabled: no stamp
+        obs.enable()
+        with obs_tracing.span("local") as local:
+            wire.decode(frame[4:])
+            assert obs_tracing.current_context() == local.context
+
+    def test_disabled_wire_bytes_identical(self):
+        from byzpy_tpu.engine.actor import wire
+
+        payload = {"kind": "submit", "tenant": "m0", "x": 1}
+        off = wire.encode(payload)
+        obs.enable()
+        with obs_tracing.context_scope(None):
+            on_no_span = wire.encode(payload)
+        assert off == on_no_span
 
 
 # ---------------------------------------------------------------------------

@@ -42,9 +42,11 @@ LIFECYCLE_SPANS = {
 def _telemetry_isolation():
     obs.disable()
     obs_tracing.tracer().clear()
+    obs_tracing.adopt_context(None)
     yield
     obs.disable()
     obs_tracing.tracer().clear()
+    obs_tracing.adopt_context(None)
 
 
 def _frontend(dim=32, name="m0", min_bucket=2):
@@ -202,6 +204,21 @@ class TestPrometheusIngress:
         # the TCP path adds the ingress decode span to the lifecycle
         names = {ev["name"] for ev in obs_tracing.tracer().events()}
         assert "serving.ingress.decode" in names
+        # ...and the cross-process linkage holds on the REAL socket
+        # path: every admission span is the client submit span's child
+        # (the decode span's exit must not wipe the adopted context)
+        events = obs_tracing.tracer().events()
+        submit_ids = {
+            ev["args"]["span"]
+            for ev in events
+            if ev["name"] == "serving.client.submit"
+        }
+        admissions = [
+            ev for ev in events if ev["name"] == "serving.admission"
+        ]
+        assert len(submit_ids) >= 4 and len(admissions) >= 4
+        for ev in admissions:
+            assert ev["args"].get("parent") in submit_ids, ev["args"]
 
     def test_scrape_does_not_count_as_bad_frame(self):
         async def run():
@@ -336,6 +353,165 @@ class TestChaosTelemetry:
         for ev in rounds:
             r = ev["args"]["round"]
             assert ev["ts"] <= r * s.window_s * 1e6 + s.window_s * 1e6
+
+
+class TestShardedTier:
+    def _coordinator(self, tenant="shardobs", n_shards=2, dim=16):
+        from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+        from byzpy_tpu.serving import ShardedCoordinator, TenantConfig
+
+        return ShardedCoordinator(
+            [
+                TenantConfig(
+                    name=tenant,
+                    aggregator=CoordinateWiseTrimmedMean(f=1),
+                    dim=dim,
+                    window_s=0.01,
+                    cohort_cap=16,
+                )
+            ],
+            n_shards,
+            quorum=1,
+        )
+
+    def _run_rounds(self, co, tenant="shardobs", dim=16, rounds=2):
+        rng = np.random.default_rng(3)
+        vecs = []
+        for r in range(rounds):
+            for i in range(8):
+                ok, reason = co.submit(
+                    tenant, f"c{i:02d}", r, rng.normal(size=dim).astype(np.float32),
+                    seq=r,
+                )
+                assert ok, reason
+            closed = co.close_round_nowait(tenant)
+            assert closed is not None
+            vecs.append(np.asarray(closed[2]))
+        return vecs
+
+    def test_sharded_round_stitches_into_one_tree(self):
+        from byzpy_tpu.observability import critical_path as cp
+
+        obs.enable()
+        co = self._coordinator()
+        self._run_rounds(co, rounds=2)
+        events = obs_tracing.tracer().events()
+        rounds = cp.round_roots(cp.build_forest(events))
+        assert [r.name for r in rounds] == [
+            "serving.sharded_round", "serving.sharded_round",
+        ]
+        tree = rounds[0]
+        child_names = {c.name for c in tree.children}
+        assert "serving.shard_close" in child_names
+        assert "serving.fold_merge" in child_names
+        # shard_close spans carry the shard dim; the merge span links
+        # every partial's carried context
+        shard_dims = {
+            c.shard for c in tree.children
+            if c.name == "serving.shard_close"
+        }
+        assert shard_dims == {0, 1}
+        (merge,) = [
+            c for c in tree.children if c.name == "serving.fold_merge"
+        ]
+        assert len(merge.args["links"]) == 2
+        assert {"serving.device_step"} <= {
+            c.name for c in merge.children
+        }
+        # blame partitions the round makespan
+        summary = cp.summarize(events)
+        assert summary["max_blame_residual"] < 1e-6
+        stages = {r["stage"] for r in summary["stages"]}
+        assert "serving.fold_merge" in stages
+
+    def test_partial_fold_wire_carries_context_and_links_remote_root(self):
+        from byzpy_tpu.serving.sharded import (
+            decode_partial_fold, encode_partial_fold,
+        )
+
+        obs.enable()
+        co = self._coordinator(tenant="shardwire")
+        rng = np.random.default_rng(4)
+        for i in range(8):
+            co.submit(
+                "shardwire", f"c{i:02d}", 0,
+                rng.normal(size=16).astype(np.float32), seq=0,
+            )
+        partials = [
+            s.close_partial("shardwire") for s in co.shards
+        ]
+        partials = [p for p in partials if p is not None]
+        assert partials and all(p.trace_ctx is not None for p in partials)
+        # the wire round-trip preserves the context (and the frame dict
+        # exposes no telemetry key to the consumer)
+        p = partials[0]
+        q = decode_partial_fold(encode_partial_fold(p)[4:])
+        assert q.trace_ctx == p.trace_ctx
+        res = co.merge_partials("shardwire", partials)
+        assert res is not None
+        merges = [
+            ev for ev in obs_tracing.tracer().events()
+            if ev["name"] == "serving.fold_merge"
+        ]
+        assert merges[-1]["args"]["links"] == [
+            f"{p.trace_ctx[0]}:{p.trace_ctx[1]}" for p in partials
+        ]
+
+    def test_aggregates_bit_identical_propagation_on_off(self):
+        # the acceptance pin: trace-context propagation must never
+        # perturb round arithmetic
+        co_off = self._coordinator(tenant="paroff")
+        off = self._run_rounds(co_off, tenant="paroff", rounds=2)
+        obs.enable()
+        co_on = self._coordinator(tenant="paron")
+        on = self._run_rounds(co_on, tenant="paron", rounds=2)
+        for a, b in zip(off, on, strict=True):
+            np.testing.assert_array_equal(a, b)
+
+    @pytest.mark.slow
+    def test_scrape_serves_shard_tenant_and_slo_families_together(self):
+        from byzpy_tpu.observability.slo import SLOWatchdog, TenantSLO
+
+        async def run():
+            obs.enable()
+            co = self._coordinator(tenant="shardslo")
+            self._run_rounds(co, tenant="shardslo", rounds=2)
+            watchdog = SLOWatchdog(
+                [
+                    TenantSLO(
+                        tenant="shardslo", accepted_p99_s=5.0,
+                        failed_round_rate=0.5,
+                    )
+                ]
+            )
+            watchdog.evaluate()
+            # the ROOT ingress: shard 0's inner frontend's TCP port
+            # (the registry is process-wide — one scrape sees the
+            # whole tier)
+            host, port = await co.shards[0].frontend.serve()
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read(-1)
+            writer.close()
+            await co.shards[0].frontend.close()
+            watchdog.close()
+            return raw
+
+        raw = asyncio.run(run())
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.0 200 OK")
+        text = body.decode()
+        # the three families the sharded tier's operators dashboard on,
+        # in ONE scrape: per-shard, per-tenant, and SLO
+        for needle in (
+            'byzpy_shard_rounds_total{tenant="shardslo"}',
+            'byzpy_shard_accepted_total{shard="0",tenant="shardslo"}',
+            'byzpy_serving_submissions_total{outcome="accepted",tenant="shardslo"}',
+            'byzpy_slo_burn_rate{objective="accepted_p99",tenant="shardslo"}',
+            "# TYPE byzpy_slo_breaches_total counter",
+        ):
+            assert needle in text, f"scrape missing {needle!r}"
 
 
 class TestOverheadBudget:
