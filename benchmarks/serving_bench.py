@@ -904,7 +904,11 @@ def _run_scale(args) -> dict:
         "round_submissions": per_round,
         "rounds": args.scale_rounds,
         "aggregator": f"cge-f{args.byzantine}",
-        "timing_model": (
+        # machine-readable model tag (ISSUE 14 honesty gap): this lane
+        # MODELS the makespan on one core — never compare it silently
+        # with the runner lane's timing_model == "measured" rows
+        "timing_model": "modeled:max(legs)+merge",
+        "timing_model_note": (
             "per-shard ingress legs (frame decode + full admission) "
             "measured in isolation — shards share no state, so the "
             "serial leg equals a dedicated shard process's; round "
@@ -925,6 +929,211 @@ def _run_scale(args) -> dict:
     }
     if not telemetry_was_on:
         obs.disable()
+    return row
+
+
+# ---------------------------------------------------------------------------
+# process runner lane (ISSUE 14: measured multi-process makespans)
+# ---------------------------------------------------------------------------
+
+
+def _runner_tenant(args, agg) -> "TenantConfig":
+    from byzpy_tpu.serving.credits import CreditPolicy
+
+    return TenantConfig(
+        name="scale",
+        aggregator=agg,
+        dim=args.runner_dim,
+        cohort_cap=args.runner_round_submissions,
+        queue_capacity=args.runner_round_submissions + 16,
+        credit=CreditPolicy(
+            rate_per_s=0.0,
+            burst=1e9,
+            max_tracked_clients=max(65536, args.runner_clients + 1),
+        ),
+        staleness=StalenessPolicy(kind="exponential", gamma=0.5, cutoff=16),
+    )
+
+
+def _drive_runner_rounds(
+    args, n_shards: int, fanout, rng, identity
+) -> dict:
+    """One deployment's measured rounds: spawn the real process fleet
+    (N shard processes + merge nodes + root, all over TCP), stream each
+    round's pre-encoded frames through windowed-pipelined shard
+    connections, close at the root, and assert bit parity vs the
+    unsharded aggregate of the same merged cohort — every number here
+    is WALL CLOCK across real processes, no makespan model."""
+    import gc
+
+    from byzpy_tpu.aggregators import ComparativeGradientElimination
+    from byzpy_tpu.serving.runner import Runner, RunnerClient, RunnerSpec
+
+    d = args.runner_dim
+    per_round = args.runner_round_submissions
+    agg = ComparativeGradientElimination(f=args.byzantine)
+    ref_agg = ComparativeGradientElimination(f=args.byzantine)
+    spec = RunnerSpec(
+        tenants=[_runner_tenant(args, agg)],
+        n_shards=n_shards,
+        fanout=fanout,
+        quorum=1,
+        telemetry=True,
+        shard_timeout_s=120.0,
+    )
+    grads = [rng.normal(size=d).astype(np.float32) for _ in range(64)]
+    ingest_s: list = []
+    close_s: list = []
+    total_accepted = 0
+    with Runner(spec) as runner:
+        client = RunnerClient("127.0.0.1", runner.shard_ports)
+        try:
+            for r in range(args.runner_rounds + 1):
+                warmup = r == 0
+                lo = (r * per_round) % max(
+                    1, args.runner_clients - per_round + 1
+                )
+                window = identity[lo: lo + per_round]
+                # frame encoding is the CLIENT's cost: build the round's
+                # traffic outside the timed region
+                frames: dict = {s: [] for s in range(n_shards)}
+                for i, c in enumerate(window):
+                    s, frame = client.encode_submit(
+                        "scale", c, r, grads[i % len(grads)], seq=r
+                    )
+                    frames[s].append(frame)
+                gc.collect()
+                t0 = time.monotonic()
+                accepted, rejected = client.submit_many(frames)
+                t1 = time.monotonic()
+                reply = runner.close_round("scale", return_rows=warmup)
+                t2 = time.monotonic()
+                assert reply["closed"] == r, (n_shards, r, reply)
+                assert rejected == 0, (n_shards, r, rejected)
+                if warmup:
+                    # warmup round compiles the merged masked program
+                    # AND pins bit parity: the hierarchical fold vs the
+                    # exact unsharded aggregate of the same merged rows
+                    rows = np.asarray(reply["rows"])
+                    ref = np.asarray(
+                        ref_agg.aggregate(
+                            [rows[i] for i in range(rows.shape[0])]
+                        )
+                    )
+                    assert np.array_equal(
+                        np.asarray(reply["aggregate"]), ref
+                    ), f"runner fold diverged at {n_shards} shards"
+                    continue
+                total_accepted += accepted
+                ingest_s.append(t1 - t0)
+                close_s.append(t2 - t1)
+        finally:
+            client.close()
+        st = runner.stats()["root"]["scale"]
+    makespans = [i + c for i, c in zip(ingest_s, close_s, strict=True)]
+    makespan_median = float(np.median(makespans))
+    return {
+        "accepted": total_accepted,
+        "makespan_median_ms": round(1e3 * makespan_median, 2),
+        "makespan_p99_ms": round(
+            1e3 * float(np.percentile(makespans, 99)), 2
+        ),
+        "accepted_per_sec": round(
+            total_accepted / max(1, len(makespans)) / makespan_median, 1
+        ),
+        "mean_ingest_ms": round(1e3 * float(np.mean(ingest_s)), 2),
+        "mean_close_ms": round(1e3 * float(np.mean(close_s)), 2),
+        "rounds": len(makespans),
+        "depth": spec.topology.depth,
+        "merge_nodes": sum(
+            len(level) for level in spec.topology.levels
+        ),
+        "failed_rounds": st["failed_rounds"],
+        "forged_partials": st["forged_partials"],
+        "quorum_failures": st["quorum_failures"],
+    }
+
+
+def _run_runner(args) -> dict:
+    """MEASURED multi-process scaling (the lane ISSUE 14 adds): the
+    same per-round submission load through 1/2/4 REAL shard processes
+    — every shard an OS process with its own TCP ingress, the root
+    coordinator a process driving the barrier + hierarchical merge
+    over sockets — plus a depth-2 vs depth-3 merge-tree A/B at the
+    largest shard count. ``timing_model`` is ``"measured"``: the
+    numbers are wall clock across the process fleet, never the modeled
+    ``max(legs)+merge`` combination, and the row records
+    ``host_cores`` so a single-core host's flat scaling reads as what
+    it is (the lane measures the tier; the tier needs cores to
+    scale)."""
+    rng = np.random.default_rng(11)
+    identity = [f"c{i:06d}" for i in range(args.runner_clients)]
+    results = {}
+    for n_shards in args.runner_shards:
+        results[n_shards] = _drive_runner_rounds(
+            args, n_shards, None, rng, identity
+        )
+    base = results[args.runner_shards[0]]["accepted_per_sec"]
+    speedups = {
+        n: round(results[n]["accepted_per_sec"] / base, 2)
+        for n in args.runner_shards
+    }
+    depth_ab = None
+    ab_shards = max(args.runner_shards)
+    if ab_shards >= 4:
+        deep = _drive_runner_rounds(
+            args, ab_shards, 2, rng, identity
+        )
+        flat = results[ab_shards]
+        depth_ab = {
+            "shards": ab_shards,
+            "depth2": {
+                "makespan_median_ms": flat["makespan_median_ms"],
+                "mean_close_ms": flat["mean_close_ms"],
+                "accepted_per_sec": flat["accepted_per_sec"],
+            },
+            "depth3": {
+                "makespan_median_ms": deep["makespan_median_ms"],
+                "mean_close_ms": deep["mean_close_ms"],
+                "accepted_per_sec": deep["accepted_per_sec"],
+                "merge_nodes": deep["merge_nodes"],
+            },
+            "close_ratio_depth3_vs_depth2": round(
+                deep["mean_close_ms"] / max(flat["mean_close_ms"], 1e-9),
+                3,
+            ),
+        }
+    host_cores = os.cpu_count() or 1
+    row = {
+        "lane": "runner",
+        "clients": args.runner_clients,
+        "dim": args.runner_dim,
+        "round_submissions": args.runner_round_submissions,
+        "rounds": args.runner_rounds,
+        "aggregator": f"cge-f{args.byzantine}",
+        "timing_model": "measured",
+        "timing_model_note": (
+            "real process-per-shard deployment: N shard processes + "
+            "merge nodes + root coordinator over TCP; makespan = "
+            "pipelined ingest wall + root close wall, measured end to "
+            "end — NOT the modeled max(legs)+merge combination the "
+            "scale lane uses (never compare the two silently)"
+        ),
+        "host_cores": host_cores,
+        "shards": results,
+        "speedup_vs_1shard": speedups,
+        "depth_ab": depth_ab,
+        "parity": "bit-identical",
+        "telemetry": "on (cross-process trace propagation active)",
+    }
+    if host_cores < max(args.runner_shards):
+        row["scaling_caveat"] = (
+            f"host has {host_cores} core(s) for "
+            f"{max(args.runner_shards)} shard processes — the measured "
+            "curve shows process overhead, not the tier's multi-core "
+            "scaling; rerun on a host with >= shard-count cores for "
+            "the acceptance trend"
+        )
     return row
 
 
@@ -1106,6 +1315,21 @@ def _run_wire(args) -> dict:
     }
 
 
+def _assert_runner_smoke(args, runner_row: dict) -> None:
+    """The runner lane's CI contract: real processes closed every
+    round at bit parity, nothing failed/forged, and the lane is
+    honestly tagged as measured."""
+    assert runner_row["timing_model"] == "measured", runner_row
+    assert runner_row["parity"] == "bit-identical"
+    for n in args.runner_shards:
+        res = runner_row["shards"][n]
+        assert res["rounds"] == args.runner_rounds, res
+        assert res["failed_rounds"] == 0, res
+        assert res["forged_partials"] == 0, res
+        assert res["quorum_failures"] == 0, res
+        assert res["accepted_per_sec"] > 0, res
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=10_000)
@@ -1125,12 +1349,27 @@ def main() -> None:
     ap.add_argument("--scale-rounds", type=int, default=6)
     ap.add_argument("--scale-dim", type=int, default=256)
     ap.add_argument("--failover-seeds", type=int, default=10)
+    ap.add_argument("--processes", action="store_true",
+                    help="run the process-per-shard runner lane "
+                         "(real OS processes + sockets; measured, "
+                         "not modeled, makespans)")
+    ap.add_argument("--processes-only", action="store_true",
+                    help="run ONLY the runner lane (implies "
+                         "--processes)")
+    ap.add_argument("--runner-clients", type=int, default=100_000,
+                    help="distinct identities in the runner lane")
+    ap.add_argument("--runner-round-submissions", type=int, default=8000)
+    ap.add_argument("--runner-rounds", type=int, default=4)
+    ap.add_argument("--runner-dim", type=int, default=256)
     ap.add_argument("--out", type=str, default=None)
     ap.add_argument("--smoke", action="store_true",
                     help="small CI run with contract assertions")
     args = ap.parse_args()
 
     args.scale_shards = (1, 2, 4)
+    args.runner_shards = (1, 2, 4)
+    if args.processes_only:
+        args.processes = True
     if args.smoke:
         args.clients = 300
         args.dim = 512
@@ -1144,14 +1383,28 @@ def main() -> None:
         args.scale_dim = 64
         args.scale_shards = (1, 2)
         args.failover_seeds = 3
+        args.runner_clients = 2000
+        args.runner_round_submissions = 400
+        args.runner_rounds = 3
+        args.runner_dim = 64
+        args.runner_shards = (1, 2)
 
     meta = {
         "lane": "meta",
         "backend": jax.default_backend(),
         "devices": len(jax.devices()),
+        "host_cores": os.cpu_count() or 1,
         "smoke": bool(args.smoke),
     }
     _emit(meta, args.out)
+
+    if args.processes_only:
+        runner_row = _run_runner(args)
+        _emit(runner_row, args.out)
+        if args.smoke:
+            _assert_runner_smoke(args, runner_row)
+            print("serving runner smoke OK")
+        return
 
     # the classic 10k-client swarm (headline continuity; single tenant,
     # default door), then the cross-tenant batching pair on the
@@ -1219,6 +1472,11 @@ def main() -> None:
 
     scale = _run_scale(args)
     _emit(scale, args.out)
+
+    runner_row = None
+    if args.processes:
+        runner_row = _run_runner(args)
+        _emit(runner_row, args.out)
 
     failover = _run_failover(args)
     _emit(failover, args.out)
@@ -1306,6 +1564,8 @@ def main() -> None:
         assert failover["invariant_violations"] == 0, failover
         assert failover["quorum_closes"] >= args.failover_seeds, failover
         assert failover["root_duplicates_dropped"] > 0, failover
+        if runner_row is not None:
+            _assert_runner_smoke(args, runner_row)
         print("serving smoke OK")
 
 

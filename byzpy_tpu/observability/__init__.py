@@ -56,6 +56,7 @@ __all__ = [
     "FlightRecorder",
     "MetricsLogger",
     "MetricsRegistry",
+    "BurnRatePolicy",
     "SLOWatchdog",
     "StepTimer",
     "TenantSLO",
@@ -81,6 +82,7 @@ _LAZY = {
     "FlightRecorder": ("recorder", "FlightRecorder"),
     "MetricsLogger": ("compat", "MetricsLogger"),
     "StepTimer": ("compat", "StepTimer"),
+    "BurnRatePolicy": ("slo", "BurnRatePolicy"),
     "SLOWatchdog": ("slo", "SLOWatchdog"),
     "TenantSLO": ("slo", "TenantSLO"),
 }

@@ -43,6 +43,76 @@ _ACTIVE: "weakref.WeakSet[SLOWatchdog]" = weakref.WeakSet()
 
 
 @dataclass(frozen=True)
+class BurnRatePolicy:
+    """Multiwindow burn-rate alerting pair (the SRE-workbook
+    convention ROUND13_NOTES.md queued): an objective breaches only
+    when the burn exceeds ``burn_threshold`` over BOTH the short and
+    the long window — the long window proves the budget spend is
+    significant, the short window proves it is still happening (no
+    paging on a spike that already ended, no paging hours late on a
+    slow leak). Two presets carry the conventional thresholds:
+
+    * :meth:`page` — fast burn, ~14× budget over (5 min, 1 h): at that
+      rate a 30-day budget dies in ~2 days, someone should wake up;
+    * :meth:`ticket` — slow burn, ~3× (1–6× family) over (30 min,
+      6 h): worth a ticket, not a page.
+
+    The single-window fields on :class:`TenantSLO` (``window_s`` +
+    ``burn_threshold``) stay the default and are byte-for-byte
+    unchanged when no policy is attached; the autoscaler keeps reading
+    ``byzpy_slo_burn_rate`` either way (it carries the LONG-window
+    burn under a policy — the budget-significant signal — with the
+    short window published alongside as
+    ``byzpy_slo_short_burn_rate``)."""
+
+    short_window_s: float
+    long_window_s: float
+    burn_threshold: float
+    severity: str = "page"
+
+    def __post_init__(self) -> None:
+        if not 0 < self.short_window_s <= self.long_window_s:
+            raise ValueError(
+                "need 0 < short_window_s <= long_window_s "
+                f"(got {self.short_window_s}/{self.long_window_s})"
+            )
+        if self.burn_threshold <= 0:
+            raise ValueError("burn_threshold must be > 0")
+
+    @classmethod
+    def page(
+        cls,
+        *,
+        short_window_s: float = 300.0,
+        long_window_s: float = 3600.0,
+        burn_threshold: float = 14.0,
+    ) -> "BurnRatePolicy":
+        """Page-severity preset: ~14× burn over (5 min, 1 h)."""
+        return cls(
+            short_window_s=short_window_s,
+            long_window_s=long_window_s,
+            burn_threshold=burn_threshold,
+            severity="page",
+        )
+
+    @classmethod
+    def ticket(
+        cls,
+        *,
+        short_window_s: float = 1800.0,
+        long_window_s: float = 21600.0,
+        burn_threshold: float = 3.0,
+    ) -> "BurnRatePolicy":
+        """Ticket-severity preset: ~3× burn over (30 min, 6 h)."""
+        return cls(
+            short_window_s=short_window_s,
+            long_window_s=long_window_s,
+            burn_threshold=burn_threshold,
+            severity="ticket",
+        )
+
+
+@dataclass(frozen=True)
 class TenantSLO:
     """Declarative objectives for one serving tenant.
 
@@ -54,8 +124,10 @@ class TenantSLO:
     verdicts that are quarantine/trust rejections. ``None`` disables
     an objective. ``window_s`` is the rolling evaluation window;
     ``burn_threshold`` the burn rate that counts as a breach (1.0 =
-    alarm exactly at budget; page-style alerting uses ~14, ticket-
-    style ~1-6 — Google SRE workbook conventions)."""
+    alarm exactly at budget). Attach a :class:`BurnRatePolicy` as
+    ``burn`` for multiwindow page/ticket alerting — the single-window
+    fields are then ignored in favor of the policy's (short, long)
+    pair."""
 
     tenant: str
     accepted_p99_s: Optional[float] = None
@@ -63,6 +135,7 @@ class TenantSLO:
     quarantine_rate: Optional[float] = None
     window_s: float = 60.0
     burn_threshold: float = 1.0
+    burn: Optional[BurnRatePolicy] = None
 
     def objectives(self) -> List[str]:
         """The objective names this SLO activates."""
@@ -127,6 +200,8 @@ class _ObjectiveState:
     burn: float = 0.0
     bad: int = 0
     total: int = 0
+    #: short-window burn (multiwindow policies only; 0.0 otherwise)
+    short_burn: float = 0.0
 
 
 class SLOWatchdog:
@@ -203,6 +278,18 @@ class SLOWatchdog:
                     help="declared objective target (seconds or fraction)",
                     labels=labels,
                 )
+                if slo.burn is not None:
+                    self._gauges[(slo.tenant, obj, "short_burn")] = (
+                        reg.gauge(
+                            "byzpy_slo_short_burn_rate",
+                            help=(
+                                "short-window burn of a multiwindow "
+                                "policy (byzpy_slo_burn_rate carries "
+                                "the long window)"
+                            ),
+                            labels=labels,
+                        )
+                    )
             t = self._gauges
             if slo.accepted_p99_s is not None:
                 t[(slo.tenant, "accepted_p99", "target")].set(
@@ -253,13 +340,25 @@ class SLOWatchdog:
         snap.quarantined = quarantined
         return snap
 
-    def _window_base(self, tenant: str, slo: TenantSLO, now: float) -> _Snapshot:
-        """The snapshot at the far edge of the rolling window (or the
-        oldest retained — a young watchdog evaluates over what it has)."""
+    def _window_base(
+        self, tenant: str, window_s: float, now: float, *, prune: bool
+    ) -> _Snapshot:
+        """The snapshot at the far edge of a rolling window (or the
+        oldest retained — a young watchdog evaluates over what it
+        has). ``prune=True`` drops history older than the window; a
+        multiwindow pass prunes only for its LONG window and reads the
+        short edge non-destructively."""
         hist = self._history[tenant]
-        while len(hist) > 1 and hist[1].t <= now - slo.window_s:
-            hist.popleft()
-        return hist[0]
+        if prune:
+            while len(hist) > 1 and hist[1].t <= now - window_s:
+                hist.popleft()
+        base = hist[0]
+        for snap in hist:
+            if snap.t <= now - window_s:
+                base = snap
+            else:
+                break
+        return base
 
     # -- evaluation --------------------------------------------------------
 
@@ -276,49 +375,82 @@ class SLOWatchdog:
             tenant = slo.tenant
             now = self.clock()
             cur = self._snapshot(tenant)
-            base = self._window_base(tenant, slo, now)
-            if slo.accepted_p99_s is not None:
-                counts = [
-                    int(c - b)
-                    for c, b in zip(
-                        cur.latency_counts, base.latency_counts, strict=True
-                    )
-                ]
-                buckets = self.registry.histogram(
-                    "byzpy_serving_round_latency_seconds",
-                    labels={"tenant": tenant},
-                ).buckets
-                over, total = _hist_over(
-                    buckets, counts, slo.accepted_p99_s
+            if slo.burn is None:
+                base = self._window_base(
+                    tenant, slo.window_s, now, prune=True
                 )
-                rows.append(
-                    self._score(
-                        slo, "accepted_p99", over, total, _LATENCY_BUDGET,
-                        newly_breached,
+                for obj, bad, total, budget in self._objective_counts(
+                    slo, cur, base
+                ):
+                    rows.append(
+                        self._score(
+                            slo, obj, bad, total, budget, newly_breached
+                        )
                     )
+            else:
+                long_base = self._window_base(
+                    tenant, slo.burn.long_window_s, now, prune=True
                 )
-            if slo.failed_round_rate is not None:
-                failed = cur.failed - base.failed
-                closes = (cur.rounds - base.rounds) + failed
-                rows.append(
-                    self._score(
-                        slo, "failed_rounds", int(failed), int(closes),
-                        slo.failed_round_rate, newly_breached,
+                short_base = self._window_base(
+                    tenant, slo.burn.short_window_s, now, prune=False
+                )
+                short = {
+                    obj: (bad, total, budget)
+                    for obj, bad, total, budget in self._objective_counts(
+                        slo, cur, short_base
                     )
-                )
-            if slo.quarantine_rate is not None:
-                bad = cur.quarantined - base.quarantined
-                total_v = cur.verdicts_total - base.verdicts_total
-                rows.append(
-                    self._score(
-                        slo, "quarantine", int(bad), int(total_v),
-                        slo.quarantine_rate, newly_breached,
+                }
+                for obj, bad, total, budget in self._objective_counts(
+                    slo, cur, long_base
+                ):
+                    s_bad, s_total, _b = short[obj]
+                    rows.append(
+                        self._score_multiwindow(
+                            slo, obj, bad, total, s_bad, s_total,
+                            budget, newly_breached,
+                        )
                     )
-                )
             self._history[tenant].append(cur)
         if newly_breached:
             self._flight_dump(newly_breached)
         return rows
+
+    def _objective_counts(
+        self, slo: TenantSLO, cur: _Snapshot, base: _Snapshot
+    ) -> List[Tuple[str, int, int, float]]:
+        """Per-objective ``(name, bad, total, budget)`` counts over one
+        window's delta — the shared middle of the single-window and
+        multiwindow scorers."""
+        out: List[Tuple[str, int, int, float]] = []
+        if slo.accepted_p99_s is not None:
+            counts = [
+                int(c - b)
+                for c, b in zip(
+                    cur.latency_counts, base.latency_counts, strict=True
+                )
+            ]
+            buckets = self.registry.histogram(
+                "byzpy_serving_round_latency_seconds",
+                labels={"tenant": slo.tenant},
+            ).buckets
+            over, total = _hist_over(buckets, counts, slo.accepted_p99_s)
+            out.append(("accepted_p99", over, total, _LATENCY_BUDGET))
+        if slo.failed_round_rate is not None:
+            failed = cur.failed - base.failed
+            closes = (cur.rounds - base.rounds) + failed
+            out.append(
+                (
+                    "failed_rounds", int(failed), int(closes),
+                    slo.failed_round_rate,
+                )
+            )
+        if slo.quarantine_rate is not None:
+            bad = cur.quarantined - base.quarantined
+            total_v = cur.verdicts_total - base.verdicts_total
+            out.append(
+                ("quarantine", int(bad), int(total_v), slo.quarantine_rate)
+            )
+        return out
 
     def _score(
         self,
@@ -371,6 +503,79 @@ class SLOWatchdog:
         state.breached = breached
         return row
 
+    def _score_multiwindow(
+        self,
+        slo: TenantSLO,
+        objective: str,
+        bad: int,
+        total: int,
+        short_bad: int,
+        short_total: int,
+        budget: float,
+        newly_breached: List[dict],
+    ) -> dict:
+        """Multiwindow fold: burn over the long AND the short window,
+        breach only when both exceed the policy threshold. The long
+        window's burn is what ``byzpy_slo_burn_rate`` publishes (the
+        budget-significant number the autoscaler reads); the short
+        window rides ``byzpy_slo_short_burn_rate``."""
+        policy = slo.burn
+        assert policy is not None
+        state = self._state[(slo.tenant, objective)]
+        bad_frac = (bad / total) if total > 0 else 0.0
+        burn = bad_frac / budget if budget > 0 else 0.0
+        s_frac = (short_bad / short_total) if short_total > 0 else 0.0
+        short_burn = s_frac / budget if budget > 0 else 0.0
+        breached = (
+            total > 0
+            and short_total > 0
+            and burn > policy.burn_threshold
+            and short_burn > policy.burn_threshold
+        )
+        state.burn, state.bad, state.total = burn, bad, total
+        state.short_burn = short_burn
+        self._gauges[(slo.tenant, objective, "burn")].set(burn)
+        self._gauges[(slo.tenant, objective, "short_burn")].set(short_burn)
+        self._gauges[(slo.tenant, objective, "breached")].set(
+            1.0 if breached else 0.0
+        )
+        row = {
+            "tenant": slo.tenant,
+            "objective": objective,
+            "bad": bad,
+            "total": total,
+            "burn": round(burn, 4),
+            "short_bad": short_bad,
+            "short_total": short_total,
+            "short_burn": round(short_burn, 4),
+            "threshold": policy.burn_threshold,
+            "severity": policy.severity,
+            "breached": breached,
+        }
+        if breached and not state.breached:
+            state.breaches += 1
+            self._gauges[(slo.tenant, objective, "breaches")].inc()
+            _tracing.instant(
+                "slo.breach",
+                track="slo",
+                tenant=slo.tenant,
+                objective=objective,
+                severity=policy.severity,
+                burn=round(burn, 4),
+                short_burn=round(short_burn, 4),
+                bad=bad,
+                total=total,
+            )
+            newly_breached.append(row)
+            if self._on_breach is not None:
+                try:
+                    self._on_breach(slo.tenant, objective, row)
+                except Exception:  # noqa: BLE001 — observer bug, never
+                    # the watchdog's outage
+                    pass
+        state.breached = breached
+        return row
+
     def _flight_dump(self, breaches: List[dict]) -> None:
         """Dump the flight recorder on a fresh breach: the trailing
         rounds + critical-path + SLO state artifact an operator (or
@@ -405,6 +610,7 @@ class SLOWatchdog:
                     "tenant": tenant,
                     "objective": objective,
                     "burn": round(st.burn, 4),
+                    "short_burn": round(st.short_burn, 4),
                     "breached": st.breached,
                     "breaches": st.breaches,
                     "bad": st.bad,
@@ -427,4 +633,4 @@ def active_state() -> List[dict]:
     return [w.state() for w in list(_ACTIVE)]
 
 
-__all__ = ["SLOWatchdog", "TenantSLO", "active_state"]
+__all__ = ["BurnRatePolicy", "SLOWatchdog", "TenantSLO", "active_state"]

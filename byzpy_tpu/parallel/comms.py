@@ -446,6 +446,63 @@ def sharded_round_wire_bytes(
     return submits + partials + broadcast
 
 
+#: Measured per-segment pickle framing of a combined PartialFold's
+#: ``segments`` list (one ``[shard, m]`` pair ≈ two small ints + list
+#: envelope). Pinned alongside the partial-fold law.
+_MERGE_SEGMENT_BYTES = 10
+
+
+def merge_tree_wire_bytes(
+    n_shards: int,
+    fanout: Optional[int],
+    n_clients_round: int,
+    n_params: int,
+    *,
+    signed: bool = False,
+    extras_bytes_per_row: float = 0.0,
+    client_id_bytes: int = 6,
+    dtype_bytes: int = 4,
+) -> float:
+    """Closed-form per-round bytes of the depth-N merge tree's FOLD
+    hops (``serving.runner`` / ``MergeTopology``): at every tree level
+    the partial-fold row payload crosses a wire once more — level 0
+    ships ``n_shards`` flat frames (the PR-12 shard→root hop), each
+    internal level re-ships the combined rows up in fewer, larger
+    frames (plus per-segment framing). ``fanout=None`` degenerates to
+    the flat single-hop law, so
+    ``sharded_round_wire_bytes(...) - flat fold hop + this`` prices a
+    deep deployment. The per-row identity and extras costs repeat per
+    level too (a combined frame carries its leaves' client ids and the
+    family's recomputed accumulators).
+
+    The structural point the law makes explicit: depth multiplies FOLD
+    wire bytes by the level count while dividing the per-node frame
+    COUNT — the trade pays when the root's verify+merge CPU (the PR-13
+    blame table's 37.5% at 4 shards), not the fabric, is the
+    bottleneck. Measured side:
+    ``benchmarks/serving_bench.py --processes`` (depth A/B lane)."""
+    from ..serving.sharded import MergeTopology
+
+    topo = MergeTopology(n_shards, fanout)
+    per_shard_m = n_clients_round / max(n_shards, 1)
+
+    def frame(m_rows: float, segments: int) -> float:
+        return partial_fold_bytes(
+            m_rows,
+            n_params,
+            signed=signed,
+            extras_bytes=extras_bytes_per_row * m_rows,
+            client_id_bytes=client_id_bytes,
+            dtype_bytes=dtype_bytes,
+        ) + segments * _MERGE_SEGMENT_BYTES
+
+    total = n_shards * frame(per_shard_m, 1)
+    for level in topo.levels:
+        for group in level:
+            total += frame(per_shard_m * len(group), len(group))
+    return total
+
+
 def scaling_model(
     *,
     flops_per_chip: float,
@@ -484,6 +541,7 @@ __all__ = [
     "ScalingPoint",
     "compression_factor",
     "measured_opt_state_bytes",
+    "merge_tree_wire_bytes",
     "opt_state_bytes",
     "partial_fold_bytes",
     "ps_round_wire_bytes",

@@ -74,13 +74,33 @@ from .ragged import (
     ragged_enabled,
 )
 from .sharded import (
+    MergeTopology,
     PartialFold,
     ShardFrontend,
     ShardRouter,
     ShardedCoordinator,
     audit_sharded_exactly_once,
+    combine_partials,
 )
 from .staleness import StalenessPolicy
+
+#: process-per-shard runner symbols resolve lazily: the runner module
+#: is also the child-process entrypoint (``python -m
+#: byzpy_tpu.serving.runner``), and an eager package import of the
+#: same module runpy is about to execute trips the double-import
+#: warning in every spawned shard
+_LAZY_RUNNER = {"Runner", "RunnerClient", "RunnerSpec"}
+
+
+def __getattr__(name: str):
+    if name in _LAZY_RUNNER:
+        from . import runner
+
+        return getattr(runner, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
 
 __all__ = [
     "AdmissionQueue",
@@ -92,12 +112,16 @@ __all__ = [
     "CreditPolicy",
     "DurabilityConfig",
     "ForensicsConfig",
+    "MergeTopology",
     "PartialFold",
     "RaggedBatcher",
     "RaggedExecutor",
     "RaggedRuntime",
     "RaggedView",
     "RetryPolicy",
+    "Runner",
+    "RunnerClient",
+    "RunnerSpec",
     "ragged_enabled",
     "ServingClient",
     "ServingFrontend",
@@ -109,5 +133,6 @@ __all__ = [
     "TenantConfig",
     "TokenBucket",
     "audit_sharded_exactly_once",
+    "combine_partials",
     "serve_frame",
 ]

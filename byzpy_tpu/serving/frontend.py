@@ -121,6 +121,12 @@ _agg_digest = evidence_digest
 #: First 4 bytes of an HTTP GET — the ingress sniffs them where the
 #: wire length prefix would sit and serves a Prometheus scrape instead.
 _HTTP_GET_PREFIX = b"GET "
+
+#: Pop-key a ``request_hook`` response sets truthy to force its reply
+#: frame LOSSLESS (``wire.encode(..., precision="off")``) — replies
+#: whose float bits are load-bearing (a shard's ``PartialFold`` rows)
+#: must not ride a lossy ``BYZPY_TPU_WIRE_PRECISION`` fabric.
+LOSSLESS_REPLY = "_lossless"
 _HTTP_MAX_REQUEST = 8192
 
 
@@ -434,6 +440,10 @@ class ServingFrontend:
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set = set()
         self._running = False
+        #: optional first-look request handler (see
+        #: :meth:`handle_request`) — the process-per-shard runner
+        #: mounts its shard control plane here
+        self.request_hook: Optional[Callable[[dict], Optional[dict]]] = None
         self._durability = durability
         #: per-tenant recovery provenance (RecoveredTenant or None) —
         #: populated when a DurabilityConfig points at a directory with
@@ -758,9 +768,34 @@ class ServingFrontend:
         a non-numeric round, an unhashable tenant — is a buggy client,
         not a forged peer: it gets a ``rejected_malformed`` ack and the
         connection stays up, rather than an exception tearing down the
-        handler with no accounting."""
+        handler with no accounting.
+
+        ``request_hook`` (when set) sees every dict request FIRST and
+        may claim it by returning a response dict (``None`` falls
+        through to the built-in kinds) — the process-per-shard runner
+        mounts its coordinator control plane (``shard_close``/
+        ``confirm``/``requeue``/…) on the existing ingress this way,
+        one port per shard for submissions and round control both. A
+        hook response carrying ``LOSSLESS_REPLY: True`` is encoded with
+        ``precision="off"`` (partial-fold rows must not ride a lossy
+        ``BYZPY_TPU_WIRE_PRECISION`` fabric)."""
         if not isinstance(request, dict):
             return {"kind": "ack", "accepted": False, "reason": "bad_frame"}
+        if self.request_hook is not None:
+            try:
+                hooked = self.request_hook(request)
+            except Exception:  # noqa: BLE001 — a hook bug is a
+                # malformed-op ack, never a torn-down connection
+                self.malformed_requests += 1
+                if obs_runtime.STATE.enabled:
+                    self._m_malformed.inc()
+                return {
+                    "kind": "ack",
+                    "accepted": False,
+                    "reason": REJECTED_MALFORMED,
+                }
+            if hooked is not None:
+                return hooked
         kind = request.get("kind")
         if kind == "submit":
             tenant = request.get("tenant", "")
@@ -1396,7 +1431,8 @@ class ServingFrontend:
                     if obs_runtime.STATE.enabled:
                         t.telemetry.ingress_bytes.inc(wire._HEADER.size + length)
                         t.telemetry.submit_frames.inc()
-                await wire.send_obj(writer, self.handle_request(request))
+                writer.write(encode_reply(self.handle_request(request)))
+                await writer.drain()
         finally:
             self._conns.discard(writer)
             writer.close()
@@ -1535,13 +1571,23 @@ class ServingFrontend:
         }
 
 
+def encode_reply(reply: dict) -> bytes:
+    """Encode one ``handle_request`` reply, honoring (and stripping)
+    the ``LOSSLESS_REPLY`` pop-key — the ONE place the rule lives, so
+    the TCP read loop and the in-process :func:`serve_frame` path
+    cannot drift (a hook reply's partial rows must never ride a lossy
+    ``BYZPY_TPU_WIRE_PRECISION`` fabric, on either path)."""
+    if isinstance(reply, dict) and reply.pop(LOSSLESS_REPLY, False):
+        return wire.encode(reply, precision="off")
+    return wire.encode(reply)
+
+
 def serve_frame(frontend: ServingFrontend, frame_body: bytes) -> bytes:
     """In-process wire path: decode one frame body, serve it, encode the
     reply — the exact codec/HMAC round the TCP ingress runs, minus the
     socket (the bench's 10k-client swarm exercises the wire cost this
     way without 10k TCP connections)."""
-    reply = frontend.handle_request(wire.decode(frame_body))
-    return wire.encode(reply)
+    return encode_reply(frontend.handle_request(wire.decode(frame_body)))
 
 
 class ServingClient:

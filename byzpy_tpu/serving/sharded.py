@@ -204,7 +204,15 @@ class PartialFold:
     shard's ``serving.shard_close`` span context ``(trace_id,
     span_id)`` — telemetry-only causality metadata the root's merge
     span records as a cross-process link (never verified, never part
-    of the digest: a forged context can at worst mis-draw a trace)."""
+    of the digest: a forged context can at worst mis-draw a trace).
+
+    ``segments`` (optional) makes the frame a COMBINED partial on the
+    depth-N merge tree (:func:`combine_partials`): ``((shard, m), …)``
+    names, in row order, which leaf shard owns each contiguous row
+    block — ``None`` means the flat single-shard frame ``((shard,
+    m),)``. The parent's cross-checks (home-shard ownership, per-shard
+    row cap, dedup) run per segment, so a rack/pod-level combiner
+    changes WHERE verification work happens, never what it checks."""
 
     tenant: str
     round_id: int
@@ -217,11 +225,32 @@ class PartialFold:
     digest: str
     first_arrival_s: float
     trace_ctx: Optional[Tuple[str, str]] = None
+    segments: Optional[Tuple[Tuple[int, int], ...]] = None
 
     @property
     def m(self) -> int:
         """Row count of this partial."""
         return int(self.rows.shape[0])
+
+    @property
+    def covered(self) -> Tuple[int, ...]:
+        """Leaf shard indices this partial carries rows for (one index
+        for a flat partial, the combined group for a tree partial)."""
+        if self.segments is None:
+            return (self.shard,)
+        return tuple(int(s) for s, _m in self.segments)
+
+    def segment_spans(self) -> Tuple[Tuple[int, int, int], ...]:
+        """``(shard, row_lo, row_hi)`` spans in row order — a flat
+        partial degenerates to one span covering every row."""
+        if self.segments is None:
+            return ((self.shard, 0, self.m),)
+        spans = []
+        lo = 0
+        for s, m in self.segments:
+            spans.append((int(s), lo, lo + int(m)))
+            lo += int(m)
+        return tuple(spans)
 
     def to_wire(self) -> dict:
         """Frame body for the HMAC actor wire (``wire.encode``)."""
@@ -238,6 +267,11 @@ class PartialFold:
             "digest": self.digest,
             "first_arrival_s": float(self.first_arrival_s),
             "trace_ctx": self.trace_ctx,
+            "segments": (
+                None
+                if self.segments is None
+                else [[int(s), int(m)] for s, m in self.segments]
+            ),
         }
 
     @classmethod
@@ -259,6 +293,24 @@ class PartialFold:
         )
         if not (len(clients) == len(seqs) == len(wal_ids) == rows.shape[0]):
             raise ValueError("partial_fold field lengths disagree")
+        segments = frame.get("segments")
+        if segments is not None:
+            segments = tuple(
+                (int(s), int(m)) for s, m in segments
+            )
+            # an EMPTY segment list would make `covered` empty and the
+            # root's verification loop degenerate — a combined frame
+            # must name at least one leaf; a DUPLICATE leaf would let
+            # one shard appear in several segments, each under the
+            # per-shard cohort cap while their sum is not (and would
+            # double-confirm the shard at _finish)
+            if (
+                not segments
+                or any(m < 0 for _s, m in segments)
+                or sum(m for _s, m in segments) != rows.shape[0]
+                or len({s for s, _m in segments}) != len(segments)
+            ):
+                raise ValueError("partial_fold segments disagree with rows")
         return cls(
             tenant=str(frame["tenant"]),
             round_id=int(frame["round"]),
@@ -271,6 +323,7 @@ class PartialFold:
             digest=str(frame["digest"]),
             first_arrival_s=float(frame.get("first_arrival_s", 0.0)),
             trace_ctx=_as_trace_ctx(frame.get("trace_ctx")),
+            segments=segments,
         )
 
 
@@ -289,6 +342,153 @@ def decode_partial_fold(body: bytes) -> "PartialFold":
     """Inverse of :func:`encode_partial_fold` (HMAC verified by
     ``wire.decode`` when signing is configured)."""
     return PartialFold.from_wire(wire.decode(body))
+
+
+def combine_partials(
+    aggregator, partials: Sequence[PartialFold]
+) -> PartialFold:
+    """Combine sibling partials into ONE up-stream partial — the
+    depth-N merge tree's internal node (rack/pod combiner).
+
+    ``fold_merge`` composes, and this function is the composition made
+    wire-shaped: the children's rows concatenate in shard order (the
+    canonical sharded cohort order, so a root that merges combined
+    partials sees EXACTLY the row sequence the flat shard→root merge
+    would have produced — the bit-parity contract is preserved by
+    construction at any tree depth), identities concatenate alongside,
+    ``segments`` records which leaf shard owns each row block, and the
+    family extras are RECOMPUTED from the combined rows
+    (``Aggregator._partial_extras`` is a deterministic function of the
+    rows, so the combined frame is indistinguishable from a single
+    larger shard's — the parent's ``extras_policy="verify"``
+    cross-check holds unchanged, where forwarding ``_merge_extras``
+    output would not: e.g. the assembled Multi-Krum cross-Gram blocks
+    reproduce the direct recompute only to matmul tolerance). The
+    digest is refreshed over the combined row bits; ``shard`` is the
+    lowest covered leaf (stable sort key at the parent)."""
+    if not partials:
+        raise ValueError("combine_partials needs at least one partial")
+    ordered = sorted(partials, key=lambda p: p.shard)
+    tenants = {p.tenant for p in ordered}
+    rounds = {p.round_id for p in ordered}
+    if len(tenants) > 1 or len(rounds) > 1:
+        raise ValueError(
+            "combine_partials across tenants/rounds: "
+            f"{sorted(tenants)} / {sorted(rounds)}"
+        )
+    covered: List[int] = []
+    for p in ordered:
+        covered.extend(p.covered)
+    if len(set(covered)) != len(covered):
+        raise ValueError(f"combine_partials shard overlap: {covered}")
+    rows = np.ascontiguousarray(
+        np.concatenate([p.rows for p in ordered], axis=0)
+    )
+    segments: List[Tuple[int, int]] = []
+    for p in ordered:
+        for s, lo, hi in p.segment_spans():
+            segments.append((s, hi - lo))
+    with obs_tracing.span(
+        "serving.merge_combine",
+        track="merge",
+        tenant=ordered[0].tenant,
+        round=ordered[0].round_id,
+        children=len(ordered),
+        m=int(rows.shape[0]),
+        links=[
+            f"{p.trace_ctx[0]}:{p.trace_ctx[1]}"
+            for p in ordered
+            if p.trace_ctx is not None
+        ],
+    ) as combine_span:
+        extras = aggregator._partial_extras(rows) if any(
+            p.extras for p in ordered
+        ) else {}
+        return PartialFold(
+            tenant=ordered[0].tenant,
+            round_id=ordered[0].round_id,
+            shard=min(covered),
+            rows=rows,
+            clients=tuple(c for p in ordered for c in p.clients),
+            seqs=tuple(q for p in ordered for q in p.seqs),
+            wal_ids=tuple(w for p in ordered for w in p.wal_ids),
+            extras=extras,
+            digest=evidence_digest(rows),
+            first_arrival_s=min(p.first_arrival_s for p in ordered),
+            trace_ctx=getattr(combine_span, "context", None),
+            segments=tuple(segments),
+        )
+
+
+class MergeTopology:
+    """Depth-N merge-tree shape over ``n_shards`` leaf shards.
+
+    ``fanout=None`` is the flat two-level tier (every shard's partial
+    merges directly at the root — PR 12's shape). With a fanout,
+    contiguous runs of ``fanout`` children combine at each internal
+    level (:func:`combine_partials`) until at most ``fanout`` nodes
+    face the root: 4 shards at fanout 2 is the rack→pod→root depth-3
+    tree. Contiguity is load-bearing — concatenating groups in group
+    order must reproduce concatenation in shard order, the canonical
+    row order of the bit-parity contract."""
+
+    __slots__ = ("n_shards", "fanout", "levels")
+
+    def __init__(self, n_shards: int, fanout: Optional[int] = None) -> None:
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        if fanout is not None and fanout < 2:
+            raise ValueError("fanout must be >= 2 (or None for flat)")
+        self.n_shards = int(n_shards)
+        self.fanout = None if fanout is None else int(fanout)
+        #: internal combine levels, leaf-most first: each level is a
+        #: tuple of groups, each group the tuple of LEAF shard indices
+        #: its combined partial covers
+        levels: List[Tuple[Tuple[int, ...], ...]] = []
+        if self.fanout is not None:
+            nodes: List[Tuple[int, ...]] = [
+                (i,) for i in range(self.n_shards)
+            ]
+            while len(nodes) > self.fanout:
+                grouped = [
+                    tuple(
+                        leaf
+                        for node in nodes[i: i + self.fanout]
+                        for leaf in node
+                    )
+                    for i in range(0, len(nodes), self.fanout)
+                ]
+                levels.append(tuple(grouped))
+                nodes = grouped
+        self.levels: Tuple[Tuple[Tuple[int, ...], ...], ...] = tuple(levels)
+
+    @property
+    def depth(self) -> int:
+        """Tiers of the tree: 2 = shard→root (flat), 3 = shard→pod→
+        root, …"""
+        return 2 + len(self.levels)
+
+    def combine(self, aggregator, partials: Sequence[PartialFold]):
+        """Run every internal level's combines over ``partials`` (leaf
+        partials in, root-facing partials out). Groups with no
+        responding member vanish; a group with a single member passes
+        through un-recombined (nothing to combine — its frame already
+        carries the right segments)."""
+        current = list(partials)
+        for level in self.levels:
+            nxt: List[PartialFold] = []
+            for group in level:
+                members = [
+                    p for p in current if p.covered[0] in group
+                ]
+                if not members:
+                    continue
+                if len(members) == 1:
+                    nxt.append(members[0])
+                else:
+                    nxt.append(combine_partials(aggregator, members))
+            current = nxt
+        return current
 
 
 class ShardFrontend:
@@ -691,6 +891,8 @@ class ShardedCoordinator:
         on_round: Optional[Callable[[str, int, Any, Any], None]] = None,
         extras_policy: str = "trust",
         max_tracked_clients: int = 1 << 16,
+        topology: Optional[MergeTopology] = None,
+        shards: Optional[Sequence[Any]] = None,
     ) -> None:
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
@@ -701,22 +903,42 @@ class ShardedCoordinator:
                 "extras_policy must be 'trust', 'verify' or 'recompute' "
                 f"(got {extras_policy!r})"
             )
+        if topology is not None and topology.n_shards != n_shards:
+            raise ValueError(
+                f"topology covers {topology.n_shards} shards, "
+                f"coordinator has {n_shards}"
+            )
         self.router = ShardRouter(n_shards)
         self._clock = clock
         self.shard_timeout_s = float(shard_timeout_s)
         #: shards required for a close; default = majority
         self.quorum = quorum if quorum is not None else n_shards // 2 + 1
         self.extras_policy = extras_policy
+        #: merge-tree shape driving the round close (None = flat
+        #: two-level; the process runner passes the same object so the
+        #: in-process and process-per-shard tiers share one topology)
+        self.topology = topology
         self._on_round = on_round
         self.callback_errors = 0
         self._durability = durability
-        self.shards: List[ShardFrontend] = [
-            ShardFrontend(
-                i, tenants, clock=clock,
-                durability=self._shard_durability(i),
-            )
-            for i in range(n_shards)
-        ]
+        if shards is not None:
+            # injected shard objects (the process runner's root passes
+            # wire-RPC proxies): anything answering the ShardFrontend
+            # coordinator surface — alive/index/confirm/requeue/
+            # discard_inflight/account_failed/sync_round
+            if len(shards) != n_shards:
+                raise ValueError(
+                    f"{len(shards)} shard objects for {n_shards} shards"
+                )
+            self.shards = list(shards)
+        else:
+            self.shards = [
+                ShardFrontend(
+                    i, tenants, clock=clock,
+                    durability=self._shard_durability(i),
+                )
+                for i in range(n_shards)
+            ]
         self._roots: Dict[str, _RootTenant] = {}
         for cfg in tenants:
             root_dur = None
@@ -852,13 +1074,17 @@ class ShardedCoordinator:
         as forged (digest mismatch, field nonsense, row-cap abuse,
         extras inconsistency under ``extras_policy='verify'``). The
         measured digest rides back so the evidence event does not hash
-        the same rows a second time."""
+        the same rows a second time. Combined partials from the depth-N
+        merge tree run the same checks PER SEGMENT (ownership against
+        the segment's leaf shard, the row cap per leaf)."""
         rows = p.rows
         agg = rt.cfg.aggregator
+        spans = p.segment_spans()
         if (
             rows.ndim != 2
             or rows.shape[0] != len(p.clients)
-            or rows.shape[0] > rt.cfg.cohort_cap
+            or (spans and spans[-1][2] != rows.shape[0])
+            or any(hi - lo > rt.cfg.cohort_cap for _s, lo, hi in spans)
             or (rows.shape[0] and rows.shape[1] != rt.cfg.dim)
         ):
             return None, ""
@@ -882,13 +1108,18 @@ class ShardedCoordinator:
                     return None, measured
         folded: List[int] = []
         dups: List[int] = []
+        span_iter = iter(spans)
+        owner, span_lo, span_hi = next(span_iter)
         for j, (client, seq) in enumerate(
             zip(p.clients, p.seqs, strict=True)
         ):
-            if self.router.shard_for(client) != p.shard:
-                # a client this shard does not own: sticky routing makes
-                # the claim a protocol violation — the whole partial is
-                # untrustworthy (the replay-another-shard attack)
+            while j >= span_hi:
+                owner, span_lo, span_hi = next(span_iter)
+            if self.router.shard_for(client) != owner:
+                # a client this segment's shard does not own: sticky
+                # routing makes the claim a protocol violation — the
+                # whole partial is untrustworthy (the replay-another-
+                # shard attack)
                 return None, measured
             if rt.is_folded(client, seq):
                 dups.append(j)
@@ -900,6 +1131,50 @@ class ShardedCoordinator:
         self.shard_events.append(event)
         if len(self.shard_events) > 1024:
             del self.shard_events[:512]
+
+    def note_forged(
+        self,
+        tenant: str,
+        shards,
+        *,
+        claimed_digest: str = "",
+        measured_digest: str = "",
+        m: int = 0,
+        discard: bool = True,
+    ) -> None:
+        """Account ONE forged partial detected UPSTREAM of the root —
+        a merge-tree node that excluded a child's frame reports it
+        here so the counters, evidence trail and inflight accounting
+        stay identical to a root-detected forgery: the FRAME counts
+        once (``forged_partials``, one evidence event) however many
+        leaves it covered, while the per-leaf side effects (forged
+        metric, inflight discard — the rows are untrustworthy) fan out
+        over ``shards`` (an int or a sequence of leaf indices)."""
+        if isinstance(shards, int):
+            shards = (shards,)
+        shards = [int(s) for s in shards]
+        rt = self._roots[tenant]
+        rt.forged += 1
+        event = {
+            "event": "shard_forged",
+            "tenant": tenant,
+            "round": rt.round_id,
+            "shard": shards[0] if len(shards) == 1 else None,
+            "shards": shards,
+            "claimed_digest": claimed_digest,
+            "measured_digest": measured_digest,
+            "m": int(m),
+        }
+        self._note_event(event)
+        if rt.durability is not None:
+            rt.durability.record_evidence(rt.round_id, event)
+        for shard in shards:
+            if obs_runtime.STATE.enabled and (
+                (tenant, shard) in self._m_forged
+            ):
+                self._m_forged[(tenant, shard)].inc()
+            if discard and 0 <= shard < len(self.shards):
+                self.shards[shard].discard_inflight(tenant, rt.round_id)
 
     # -- round close (sync door) ------------------------------------------
 
@@ -951,6 +1226,14 @@ class ShardedCoordinator:
                     self.shards[p.shard].requeue(tenant, p.round_id)
                 rt.quorum_failures += 1
                 return None
+            if self.topology is not None and partials:
+                # run the internal merge-tree levels (rack→pod combines)
+                # before the root merge — in-process this is the same
+                # thread; the process runner distributes each level to
+                # its own merge-node process
+                partials = self.topology.combine(
+                    rt.cfg.aggregator, partials
+                )
             return self.merge_partials(tenant, partials, missing=missing)
 
     def merge_partials(
@@ -986,17 +1269,23 @@ class ShardedCoordinator:
         ``round_done``) that the admission path touches concurrently,
         so the executor half must only describe them. Shard indices
         are bounds-checked here: a forged frame on the remote-root door
-        may claim any index."""
-        for kind, idx, round_id in actions:
-            if not 0 <= idx < len(self.shards):
-                continue
-            shard = self.shards[idx]
-            if kind == "requeue":
-                shard.requeue(tenant, round_id)
-            elif kind == "discard":
-                shard.discard_inflight(tenant, round_id)
-            elif kind == "fail":
-                shard.account_failed(tenant, round_id)
+        may claim any index. Each action names the covered LEAF shards
+        (one for a flat partial, the whole group for a merge-tree
+        partial) — the side effect fans out to every leaf whose rows
+        rode the frame."""
+        for kind, indices, round_id in actions:
+            if isinstance(indices, int):
+                indices = (indices,)
+            for idx in indices:
+                if not 0 <= idx < len(self.shards):
+                    continue
+                shard = self.shards[idx]
+                if kind == "requeue":
+                    shard.requeue(tenant, round_id)
+                elif kind == "discard":
+                    shard.discard_inflight(tenant, round_id)
+                elif kind == "fail":
+                    shard.account_failed(tenant, round_id)
 
     def _verify_and_merge(
         self,
@@ -1021,14 +1310,28 @@ class ShardedCoordinator:
         verified: List[Tuple[PartialFold, List[int], List[int]]] = []
         seen_shards: set = set()
         for p in sorted(partials, key=lambda p: p.shard):
-            known = 0 <= p.shard < len(self.shards)
+            covered = p.covered
+            # bool(covered) + the uniqueness check guard hand-built
+            # PartialFolds with empty or duplicate-leaf segments
+            # (from_wire already rejects both wire forms): an empty
+            # cover must read as forged, never index-error the close
+            # mid-verify with honest partials unapplied; a repeated
+            # leaf must not ride several under-cap segments past the
+            # per-shard row cap
+            known = (
+                bool(covered)
+                and len(set(covered)) == len(covered)
+                and p.shard == covered[0]
+                and all(0 <= s < len(self.shards) for s in covered)
+            )
+            overlap = known and any(s in seen_shards for s in covered)
             if (
                 not known
-                or p.shard in seen_shards
+                or overlap
                 or p.tenant != tenant
                 or p.round_id != rt.round_id
             ):
-                if not known or p.shard in seen_shards:
+                if not known or overlap:
                     # an unknown shard index, or a second partial
                     # claiming a shard this close already heard from —
                     # only possible on the remote-root door (in-process
@@ -1052,18 +1355,20 @@ class ShardedCoordinator:
                     continue
                 # stale or misaddressed partial: the shard's rows go
                 # back to its held list (a partition, not a forgery)
-                actions.append(("requeue", p.shard, p.round_id))
-                rt.partitions += 1
+                actions.append(("requeue", covered, p.round_id))
+                rt.partitions += len(covered)
                 if obs_runtime.STATE.enabled:
-                    self._m_partitions[(tenant, p.shard)].inc()
+                    for s in covered:
+                        self._m_partitions[(tenant, s)].inc()
                 continue
-            seen_shards.add(p.shard)
+            seen_shards.update(covered)
             checks, measured = self._verify_partial(rt, p)
             if checks is None:
                 rt.forged += 1
-                actions.append(("discard", p.shard, p.round_id))
+                actions.append(("discard", covered, p.round_id))
                 if obs_runtime.STATE.enabled:
-                    self._m_forged[(tenant, p.shard)].inc()
+                    for s in covered:
+                        self._m_forged[(tenant, s)].inc()
                 event = {
                     "event": "shard_forged",
                     "tenant": tenant,
@@ -1085,7 +1390,7 @@ class ShardedCoordinator:
             # the duplicate rows are NOT counted: they will be
             # re-verified when the window finally closes)
             for p, _f, _d in verified:
-                actions.append(("requeue", p.shard, p.round_id))
+                actions.append(("requeue", p.covered, p.round_id))
             return None
         rt.root_duplicates += sum(len(d) for _, _, d in verified)
         merge_partials = []
@@ -1133,7 +1438,7 @@ class ShardedCoordinator:
                 # accounting, serving continues
                 rt.failed_rounds += 1
                 for p, _f, _d in verified:
-                    actions.append(("fail", p.shard, rt.round_id))
+                    actions.append(("fail", p.covered, rt.round_id))
                 return None
         return verified, merged, vec, t0
 
@@ -1165,26 +1470,38 @@ class ShardedCoordinator:
         for idx, (p, folded, dups) in enumerate(verified):
             for j in folded:
                 rt.note_folded(p.clients[j], p.seqs[j])
-            pre = None
-            if view is not None and not dups and idx < len(offsets):
-                start = offsets[idx]
-                stop = start + len(folded)
-                pre = {
-                    "kind": view["kind"],
-                    "scores": (
-                        None
-                        if view.get("scores") is None
-                        else np.asarray(view["scores"])[start:stop]
-                    ),
-                    "keep": (
-                        None
-                        if view.get("keep") is None
-                        else np.asarray(view["keep"])[start:stop]
-                    ),
-                }
-            self.shards[p.shard].confirm(
-                tenant, closed, folded, dups, digest, vec, pre
-            )
+            # confirmation (WAL round record, forensics fan-out, stats)
+            # goes to each LEAF shard whose rows rode this frame — a
+            # merge-tree partial fans back per segment, with the row
+            # indices re-localized to the leaf's own inflight order
+            start = offsets[idx] if idx < len(offsets) else None
+            for owner, lo, hi in p.segment_spans():
+                if not 0 <= owner < len(self.shards):
+                    continue
+                loc_folded = [j - lo for j in folded if lo <= j < hi]
+                loc_dups = [j - lo for j in dups if lo <= j < hi]
+                pre = None
+                if view is not None and not dups and start is not None:
+                    pre = {
+                        "kind": view["kind"],
+                        "scores": (
+                            None
+                            if view.get("scores") is None
+                            else np.asarray(view["scores"])[
+                                start + lo: start + hi
+                            ]
+                        ),
+                        "keep": (
+                            None
+                            if view.get("keep") is None
+                            else np.asarray(view["keep"])[
+                                start + lo: start + hi
+                            ]
+                        ),
+                    }
+                self.shards[owner].confirm(
+                    tenant, closed, loc_folded, loc_dups, digest, vec, pre
+                )
         if rt.durability is not None:
             rt.durability.record_evidence(
                 closed,
@@ -1378,6 +1695,15 @@ class ShardedCoordinator:
                 return None
         if not partials:
             return None
+        if self.topology is not None:
+            # internal merge-tree levels off the loop (pure numpy
+            # concatenation + extras recompute — the work a pod-level
+            # merge process owns in the runner deployment)
+            partials = await loop.run_in_executor(
+                None,
+                obs_tracing.carry_context(self.topology.combine),
+                rt.cfg.aggregator, partials,
+            )
         assert self._device_lock is not None
         actions: List[tuple] = []
         async with self._device_lock:
@@ -1574,11 +1900,13 @@ __all__ = [
     "PARTIAL_FOLD",
     "REJECTED_SHARD_DOWN",
     "ROOT_DUPLICATE",
+    "MergeTopology",
     "PartialFold",
     "ShardFrontend",
     "ShardRouter",
     "ShardedCoordinator",
     "audit_sharded_exactly_once",
+    "combine_partials",
     "decode_partial_fold",
     "encode_partial_fold",
     "shard_for",

@@ -312,3 +312,294 @@ def test_merged_score_view_without_extras_falls_back_to_evidence():
     view = agg.merged_score_view(merged, aggregate=vec)
     assert view is not None and view["kind"] == "geomed_distance"
     assert np.isfinite(view["scores"]).all()
+
+
+# ---------------------------------------------------------------------------
+# depth-N merge tree (ISSUE 14): fold_merge composes — a rack/pod-level
+# combine between the shards and the root must not move a single bit
+# ---------------------------------------------------------------------------
+
+
+def _ragged_bounds(m, k, seed):
+    """k contiguous shard slices with RAGGED sizes (seeded; some may
+    be empty at small m — an empty shard is a neutral participant)."""
+    rng = np.random.default_rng(1000 + seed)
+    cuts = np.sort(rng.integers(0, m + 1, size=k - 1))
+    bounds = np.concatenate([[0], cuts, [m]])
+    return [
+        slice(int(bounds[i]), int(bounds[i + 1])) for i in range(k)
+    ]
+
+
+def _leaf_partials(agg, rows, slices, weights=None):
+    """Wire-shaped leaf PartialFolds (one per shard slice)."""
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.serving.sharded import PartialFold
+
+    out = []
+    for s, sl in enumerate(slices):
+        shard_rows = np.ascontiguousarray(rows[sl], np.float32)
+        if weights is not None and shard_rows.shape[0]:
+            w = np.asarray(weights[sl], np.float32)
+            if bool((w != 1.0).any()):
+                shard_rows = shard_rows * w[:, None]
+        out.append(
+            PartialFold(
+                tenant="m0",
+                round_id=0,
+                shard=s,
+                rows=shard_rows,
+                clients=tuple(
+                    f"c{j}" for j in range(sl.start, sl.stop)
+                ),
+                seqs=tuple(range(sl.start, sl.stop)),
+                wal_ids=tuple(range(sl.start, sl.stop)),
+                extras=agg._partial_extras(shard_rows),
+                digest=evidence_digest(shard_rows),
+                first_arrival_s=0.0,
+            )
+        )
+    return out
+
+
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+@pytest.mark.parametrize("depth", [2, 3])
+def test_merge_tree_depth_parity_ragged_shards(make_agg, depth):
+    """Every family × depth ∈ {2, 3} × ragged shard sizes: the tree's
+    finalize is bit-identical to the single fold — combining a level
+    (combine_partials) then merging is the same merge."""
+    from byzpy_tpu.serving.sharded import MergeTopology
+
+    agg = make_agg()
+    m, k = N, 4
+    if not _admissible(agg, m):
+        pytest.skip("inadmissible m for this aggregator")
+    for seed in (0, 1):
+        rows = _rows(m, seed=31 + seed)
+        ref = np.asarray(agg.aggregate([rows[i] for i in range(m)]))
+        slices = _ragged_bounds(m, k, seed)
+        partials = [
+            p
+            for p in _leaf_partials(agg, rows, slices)
+            if p.m or True  # empty shards participate (neutral)
+        ]
+        topo = MergeTopology(k, fanout=2 if depth == 3 else None)
+        assert topo.depth == depth
+        top = topo.combine(agg, partials)
+        if depth == 3:
+            assert len(top) <= 2
+        merged = agg.fold_merge(
+            [{"rows": p.rows, "m": p.m, "extras": p.extras} for p in top]
+        )
+        out = np.asarray(agg.fold_merge_finalize(merged))
+        np.testing.assert_array_equal(
+            out, ref, err_msg=f"{agg.name} depth={depth} seed={seed}"
+        )
+
+
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+def test_merge_tree_depth3_staleness_parity(make_agg):
+    """Depth-3 with per-shard staleness discounts == the single fold
+    of the hand-discounted rows (discounts apply at the leaves; the
+    combine must not re-touch them)."""
+    from byzpy_tpu.serving.sharded import MergeTopology
+
+    agg = make_agg()
+    m = N
+    if not _admissible(agg, m):
+        pytest.skip("inadmissible m for this aggregator")
+    rows = _rows(m, seed=41)
+    pol = StalenessPolicy(kind="exponential", gamma=0.5)
+    weights = np.asarray(
+        [pol.discount(i % 3) for i in range(m)], np.float32
+    )
+    scaled = rows * weights[:, None]
+    ref = np.asarray(agg.aggregate([scaled[i] for i in range(m)]))
+    slices = _ragged_bounds(m, 4, 7)
+    partials = _leaf_partials(agg, rows, slices, weights=weights)
+    top = MergeTopology(4, fanout=2).combine(agg, partials)
+    merged = agg.fold_merge(
+        [{"rows": p.rows, "m": p.m, "extras": p.extras} for p in top]
+    )
+    out = np.asarray(agg.fold_merge_finalize(merged))
+    np.testing.assert_array_equal(out, ref, err_msg=agg.name)
+
+
+def test_combine_partials_segments_digest_and_extras():
+    """The combined frame is indistinguishable from a single larger
+    shard's: segments name each leaf's row block in shard order, the
+    digest covers the combined bits, and the extras are the
+    DETERMINISTIC recompute over the combined rows (so a parent's
+    extras_policy='verify' recompute agrees exactly)."""
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.serving.sharded import combine_partials
+
+    agg = CoordinateWiseTrimmedMean(f=1)
+    rows = _rows(7, seed=43)
+    slices = [slice(0, 3), slice(3, 3), slice(3, 7)]
+    partials = _leaf_partials(agg, rows, slices)
+    combined = combine_partials(agg, list(reversed(partials)))
+    assert combined.shard == 0
+    assert combined.segments == ((0, 3), (1, 0), (2, 4))
+    assert combined.covered == (0, 1, 2)
+    assert combined.segment_spans() == (
+        (0, 0, 3), (1, 3, 3), (2, 3, 7),
+    )
+    np.testing.assert_array_equal(combined.rows, rows)
+    assert combined.clients == tuple(f"c{j}" for j in range(7))
+    assert combined.digest == evidence_digest(rows)
+    want = agg._partial_extras(rows)
+    for key, val in want.items():
+        np.testing.assert_array_equal(
+            np.asarray(combined.extras[key]), np.asarray(val)
+        )
+    # wire round-trip carries the segments
+    from byzpy_tpu.serving.sharded import PartialFold
+
+    again = PartialFold.from_wire(combined.to_wire())
+    assert again.segments == combined.segments
+
+
+def test_partial_fold_rejects_empty_segments_frame():
+    """A forged frame with ``segments: []`` and zero rows must be an
+    explicit wire rejection — an empty cover reaching the root's
+    verification loop would abort the close mid-verify instead of
+    discarding the frame as forged (review finding, round 14)."""
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.serving.sharded import PartialFold
+
+    rows = np.zeros((0, 8), np.float32)
+    frame = {
+        "kind": "partial_fold", "tenant": "m0", "round": 0,
+        "shard": 0, "rows": rows, "clients": [], "seqs": [],
+        "wal_ids": [], "extras": {}, "digest": evidence_digest(rows),
+        "first_arrival_s": 0.0, "segments": [],
+    }
+    with pytest.raises(ValueError):
+        PartialFold.from_wire(frame)
+    # and a hand-built empty cover reads as forged, not a crash
+    from byzpy_tpu.serving.sharded import ShardedCoordinator
+    from byzpy_tpu.serving import TenantConfig
+
+    co = ShardedCoordinator(
+        [
+            TenantConfig(
+                name="m0", aggregator=CoordinateWiseMedian(), dim=8,
+                cohort_cap=8,
+            )
+        ],
+        2,
+        quorum=1,
+    )
+    ghost = PartialFold(
+        tenant="m0", round_id=0, shard=0, rows=rows, clients=(),
+        seqs=(), wal_ids=(), extras={},
+        digest=evidence_digest(rows), first_arrival_s=0.0,
+        segments=(),
+    )
+    assert co.merge_partials("m0", [ghost]) is None
+    assert co.stats()["root"]["m0"]["forged_partials"] == 1
+
+
+def test_partial_fold_rejects_duplicate_leaf_segments():
+    """One shard claimed by SEVERAL segments of one frame must be
+    rejected: each segment alone sits under the per-shard cohort cap
+    while their sum does not (cap bypass), and the confirm fan-out
+    would hit the same shard twice (review finding, round 14)."""
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.serving import TenantConfig
+    from byzpy_tpu.serving.sharded import PartialFold, ShardedCoordinator
+
+    rows = _rows(6, seed=53)[:, :8]
+    frame = {
+        "kind": "partial_fold", "tenant": "m0", "round": 0,
+        "shard": 1, "rows": rows,
+        "clients": [f"c{j}" for j in range(6)],
+        "seqs": list(range(6)), "wal_ids": list(range(6)),
+        "extras": {}, "digest": evidence_digest(rows),
+        "first_arrival_s": 0.0, "segments": [[1, 3], [1, 3]],
+    }
+    with pytest.raises(ValueError):
+        PartialFold.from_wire(frame)
+    co = ShardedCoordinator(
+        [
+            TenantConfig(
+                name="m0", aggregator=CoordinateWiseMedian(), dim=8,
+                cohort_cap=4,
+            )
+        ],
+        2,
+        quorum=1,
+    )
+    dup = PartialFold(
+        tenant="m0", round_id=0, shard=1, rows=rows,
+        clients=tuple(f"c{j}" for j in range(6)),
+        seqs=tuple(range(6)), wal_ids=tuple(range(6)), extras={},
+        digest=evidence_digest(rows), first_arrival_s=0.0,
+        segments=((1, 3), (1, 3)),
+    )
+    assert co.merge_partials("m0", [dup]) is None
+    assert co.stats()["root"]["m0"]["forged_partials"] == 1
+
+
+def test_note_forged_counts_one_frame_however_many_leaves():
+    """An upstream-detected forged frame covering several leaves
+    accounts ONCE (forged_partials, one evidence event) with the
+    per-leaf side effects fanned out — identical to a root-detected
+    forgery, so flat and deep topologies agree on the same attack."""
+    from byzpy_tpu.serving import TenantConfig
+    from byzpy_tpu.serving.sharded import ShardedCoordinator
+
+    co = ShardedCoordinator(
+        [
+            TenantConfig(
+                name="m0", aggregator=CoordinateWiseMedian(), dim=8,
+                cohort_cap=8,
+            )
+        ],
+        4,
+        quorum=1,
+    )
+    co.note_forged("m0", [0, 1, 2], claimed_digest="x", m=6)
+    assert co.stats()["root"]["m0"]["forged_partials"] == 1
+    events = [
+        e for e in co.shard_events if e["event"] == "shard_forged"
+    ]
+    assert len(events) == 1 and events[0]["shards"] == [0, 1, 2]
+    # the int form still works (single-leaf callers)
+    co.note_forged("m0", 3, claimed_digest="y", m=1)
+    assert co.stats()["root"]["m0"]["forged_partials"] == 2
+
+
+def test_combine_partials_rejects_overlap_and_mixed_rounds():
+    import dataclasses
+
+    from byzpy_tpu.serving.sharded import combine_partials
+
+    agg = CoordinateWiseMedian()
+    rows = _rows(6, seed=47)
+    a, b = _leaf_partials(agg, rows, [slice(0, 3), slice(3, 6)])
+    with pytest.raises(ValueError):
+        combine_partials(agg, [a, dataclasses.replace(b, shard=0)])
+    with pytest.raises(ValueError):
+        combine_partials(agg, [a, dataclasses.replace(b, round_id=1)])
+    with pytest.raises(ValueError):
+        combine_partials(agg, [])
+
+
+def test_merge_topology_shapes():
+    from byzpy_tpu.serving.sharded import MergeTopology
+
+    flat = MergeTopology(4)
+    assert flat.depth == 2 and flat.levels == ()
+    deep = MergeTopology(4, fanout=2)
+    assert deep.depth == 3
+    assert deep.levels == (((0, 1), (2, 3)),)
+    deeper = MergeTopology(8, fanout=2)
+    assert deeper.depth == 4
+    assert deeper.levels[0] == ((0, 1), (2, 3), (4, 5), (6, 7))
+    assert deeper.levels[1] == ((0, 1, 2, 3), (4, 5, 6, 7))
+    with pytest.raises(ValueError):
+        MergeTopology(4, fanout=1)
+    with pytest.raises(ValueError):
+        MergeTopology(0)

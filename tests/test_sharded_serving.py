@@ -823,3 +823,122 @@ def test_ghost_mode_requires_n_shards():
     co = ShardedCoordinator(_tenants(), 2, quorum=1)
     with pytest.raises(ValueError):
         CompromisedShard(co.shards[1], mode="ghost_clients")
+
+
+# ---------------------------------------------------------------------------
+# in-process depth-N topology (ISSUE 14): the coordinator's closers run
+# the merge-tree combine levels before the root merge
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_topology_depth3_parity_and_confirm_fanout():
+    """A topology-bearing coordinator closes rounds bit-identical to a
+    flat one AND to the single frontend; confirmations fan back to
+    every leaf shard (per-segment), so dedup/WAL/stat accounting is
+    indistinguishable from the flat tier."""
+    from byzpy_tpu.serving import MergeTopology
+
+    grads = _grads(CLIENTS, seed=61)
+    results = {}
+    for fanout in (None, 2):
+        co = ShardedCoordinator(
+            _tenants(), 4, quorum=1,
+            topology=MergeTopology(4, fanout=fanout),
+        )
+        seqs = dict.fromkeys(CLIENTS, 0)
+        aggs = []
+        for r in range(2):
+            _drive_round(co, r, grads, seqs)
+            res = co.close_round_nowait("m0")
+            assert res is not None
+            aggs.append(np.asarray(res[2]))
+            # every leaf shard retired its inflight (confirm fan-out)
+            for sh in co.shards:
+                assert not sh._inflight, (fanout, r, sh.index)
+                assert (
+                    sh.frontend._tenants["m0"].outstanding == 0
+                ), (fanout, r)
+        results[fanout] = aggs
+        st = co.stats()["root"]["m0"]
+        assert st["rounds"] == 2 and st["forged_partials"] == 0
+    for a, b in zip(results[None], results[2], strict=True):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_coordinator_topology_async_scheduler_parity():
+    """The async root scheduler runs the combine levels on the
+    executor — same bits as the sync closer."""
+    from byzpy_tpu.serving import MergeTopology
+
+    grads = _grads(CLIENTS, seed=67)
+
+    def run_sync():
+        co = ShardedCoordinator(
+            _tenants(), 4, quorum=1,
+            topology=MergeTopology(4, fanout=2),
+        )
+        seqs = dict.fromkeys(CLIENTS, 0)
+        _drive_round(co, 0, grads, seqs)
+        res = co.close_round_nowait("m0")
+        return np.asarray(res[2])
+
+    async def run_async():
+        co = ShardedCoordinator(
+            _tenants(window_s=0.02), 4, quorum=1,
+            topology=MergeTopology(4, fanout=2),
+        )
+        seqs = dict.fromkeys(CLIENTS, 0)
+        _drive_round(co, 0, grads, seqs)
+        await co.start()
+        try:
+            for _ in range(200):
+                await asyncio.sleep(0.02)
+                if co.last_aggregate("m0") is not None:
+                    break
+        finally:
+            await co.close()
+        assert co.last_aggregate("m0") is not None
+        return np.asarray(co.last_aggregate("m0"))
+
+    np.testing.assert_array_equal(run_sync(), asyncio.run(run_async()))
+
+
+def test_merge_tree_wire_law_matches_measured_frames():
+    """The depth-N fold-hop law vs real combined frames: flat degrades
+    to the single-hop law; the depth-3 total prices every level's
+    re-shipped rows within tolerance."""
+    from byzpy_tpu.parallel.comms import merge_tree_wire_bytes
+    from byzpy_tpu.serving import MergeTopology
+    from byzpy_tpu.serving.sharded import combine_partials
+
+    agg = CoordinateWiseTrimmedMean(f=0)  # no extras: law's 0-extra case
+    n_shards, per_shard, d = 4, 32, 256
+    rng = np.random.default_rng(5)
+    partials = []
+    for s in range(n_shards):
+        rows = rng.normal(size=(per_shard, d)).astype(np.float32)
+        partials.append(
+            PartialFold(
+                tenant="m0", round_id=0, shard=s, rows=rows,
+                clients=tuple(
+                    f"c{s:02d}{j:03d}" for j in range(per_shard)
+                ),
+                seqs=tuple(range(per_shard)),
+                wal_ids=tuple(range(per_shard)),
+                extras={}, digest=evidence_digest(rows),
+                first_arrival_s=0.0,
+            )
+        )
+    measured = sum(len(encode_partial_fold(p)) for p in partials)
+    top = MergeTopology(n_shards, fanout=2).combine(agg, partials)
+    measured += sum(len(encode_partial_fold(p)) for p in top)
+    law = merge_tree_wire_bytes(
+        n_shards, 2, n_shards * per_shard, d, client_id_bytes=6
+    )
+    assert abs(measured - law) / measured < 0.02, (measured, law)
+    # fanout=None == the flat fold hop, exactly
+    flat_law = merge_tree_wire_bytes(
+        n_shards, None, n_shards * per_shard, d, client_id_bytes=6
+    )
+    flat_measured = sum(len(encode_partial_fold(p)) for p in partials)
+    assert abs(flat_measured - flat_law) / flat_measured < 0.02

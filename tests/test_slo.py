@@ -323,3 +323,113 @@ class TestChaosVirtualClock:
 
         s = self._scenario(SLOSpec(failed_round_rate=0.1, window_s=2.0))
         assert Scenario.from_dict(json.loads(s.to_json())) == s
+
+
+class TestMultiwindowBurn:
+    """ISSUE-14 satellite: the ROUND13_NOTES.md multiwindow convention
+    — short/long-window burn pairs with page (~14×) / ticket (~1–6×)
+    presets; breach requires BOTH windows over threshold; the
+    single-window path stays byte-identical when no policy is set."""
+
+    def test_presets_carry_the_convention(self):
+        from byzpy_tpu.observability.slo import BurnRatePolicy
+
+        page = BurnRatePolicy.page()
+        assert page.severity == "page"
+        assert page.burn_threshold == pytest.approx(14.0)
+        assert page.short_window_s < page.long_window_s
+        ticket = BurnRatePolicy.ticket()
+        assert ticket.severity == "ticket"
+        assert 1.0 <= ticket.burn_threshold <= 6.0
+        assert ticket.long_window_s > page.long_window_s
+        with pytest.raises(ValueError):
+            BurnRatePolicy(short_window_s=10.0, long_window_s=5.0,
+                           burn_threshold=14.0)
+        with pytest.raises(ValueError):
+            BurnRatePolicy(short_window_s=1.0, long_window_s=5.0,
+                           burn_threshold=0.0)
+
+    def _watchdog(self, reg, clock, *, threshold=2.0):
+        from byzpy_tpu.observability.slo import BurnRatePolicy
+
+        return SLOWatchdog(
+            [
+                TenantSLO(
+                    tenant="m0",
+                    failed_round_rate=0.1,
+                    burn=BurnRatePolicy(
+                        short_window_s=5.0,
+                        long_window_s=50.0,
+                        burn_threshold=threshold,
+                    ),
+                )
+            ],
+            registry=reg,
+            clock=lambda: clock[0],
+        )
+
+    def test_sustained_burn_breaches_both_windows(self):
+        reg = _registry()
+        clock = [0.0]
+        w = self._watchdog(reg, clock)
+        failed = reg.counter(
+            "byzpy_serving_failed_rounds_total", labels={"tenant": "m0"}
+        )
+        rounds = reg.counter(
+            "byzpy_serving_rounds_total", labels={"tenant": "m0"}
+        )
+        # sustained 50% failure rate (5x the 10% budget > 2x threshold)
+        for t in (1.0, 2.0, 3.0, 4.0):
+            failed.inc(2)
+            rounds.inc(2)
+            clock[0] = t
+            (row,) = w.evaluate()
+        assert row["burn"] == pytest.approx(5.0)
+        assert row["short_burn"] == pytest.approx(5.0)
+        assert row["severity"] == "page"
+        assert row["breached"]
+        # both series on the scrape: long on byzpy_slo_burn_rate, short
+        # on byzpy_slo_short_burn_rate
+        text = reg.prometheus_text()
+        assert "byzpy_slo_burn_rate" in text
+        assert "byzpy_slo_short_burn_rate" in text
+
+    def test_ended_spike_does_not_page(self):
+        """A burst that already stopped: the LONG window still carries
+        the badness but the SHORT window is clean — no page (the
+        whole point of the multiwindow AND)."""
+        reg = _registry()
+        clock = [0.0]
+        w = self._watchdog(reg, clock)
+        failed = reg.counter(
+            "byzpy_serving_failed_rounds_total", labels={"tenant": "m0"}
+        )
+        rounds = reg.counter(
+            "byzpy_serving_rounds_total", labels={"tenant": "m0"}
+        )
+        failed.inc(8)
+        rounds.inc(8)
+        clock[0] = 1.0
+        (row,) = w.evaluate()
+        assert row["breached"]  # burst in both windows: page
+        # clean traffic for longer than the short window
+        for t in (3.0, 6.0, 9.0, 12.0):
+            rounds.inc(3)
+            clock[0] = t
+            (row,) = w.evaluate()
+        # long window still remembers (burn > threshold) but the short
+        # window is clean -> breach clears
+        assert row["burn"] > 2.0
+        assert row["short_burn"] == 0.0
+        assert not row["breached"]
+
+    def test_single_window_rows_unchanged_shape(self):
+        """No policy attached: rows keep the single-window shape (no
+        severity/short keys) — existing configs unchanged."""
+        reg = _registry()
+        w = SLOWatchdog(
+            [TenantSLO(tenant="m0", failed_round_rate=0.1)],
+            registry=reg,
+        )
+        (row,) = w.evaluate()
+        assert "severity" not in row and "short_burn" not in row
