@@ -50,6 +50,18 @@ Four lanes, each emitting JSON rows (stdout + ``--out`` JSONL):
   raises ``staleness_inflation`` flags within ``DETECT_BUDGET``
   rounds at a pinned honest false-positive rate.
 
+* ``subint8`` — the adversarial-residual lane (round 15): the
+  residual-shaping attacker (an encoder-controlling client inflating
+  its per-block scales by κ and steering the coarse grid's rounding
+  error through error feedback) through the REAL serving admission
+  path per aggregator × sub-int8 fabric ({fp8, s4}), measured for
+  influence vs its unshaped influence-ascent twin and screened by the
+  forensics ``residual_shaping`` detector (pre-decode per-block
+  inflation ratio — honest encoders sit at exactly 1.0) with the
+  honest false-positive rate pinned under ``FP_BOUND``; plus the
+  per-aggregator × attack precision-floor table (Byzantine tolerance
+  over wire-quantization error, int8 → fp8 → fp8_e5m2 → s4).
+
 ``--smoke`` shrinks everything for CI and asserts the contracts (zero
 harness-crashed cells, cell replay determinism, swarm liveness, zero
 recovery-invariant violations). ``--lanes`` selects a subset (e.g.
@@ -63,6 +75,7 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 import numpy as np
 
@@ -941,6 +954,230 @@ def run_swarm(args, out) -> dict:
     return row
 
 
+#: Sub-int8 fabric precisions the adversarial-residual lane drives
+#: (ISSUE 15); the attack shapes the matching integer grid (s4 on the
+#: s4 fabric, the 8-bit grid on fp8 — fp8 shaping is the same
+#: scale-inflation signature).
+SUBINT8_PRECISIONS = ("fp8", "s4")
+SUBINT8_FLOOR_MODES = ("int8", "fp8", "fp8_e5m2", "s4")
+
+
+def _subint8_floor_rows(args, out) -> list:
+    """Precision floor per aggregator x attack: how far each wire mode's
+    quantization error sits below the Byzantine perturbation the
+    aggregator already tolerates (the PR-3 robustness-study rule,
+    extended down the precision ladder). ``margin`` = tolerance / wire
+    error; the floor DIES where margin < 1 — that boundary is the lane's
+    deliverable, not an assertion."""
+    import jax
+    import jax.numpy as jnp
+
+    from byzpy_tpu.ops import attack_ops, robust
+    from byzpy_tpu.parallel import quantization as qz
+
+    n, f = args.clients_grid * 2, args.byzantine
+    d = 2048 if not args.smoke else 512
+    aggs = {
+        "trimmed_mean": partial(robust.trimmed_mean, f=f),
+        "multi_krum": partial(robust.multi_krum, f=f, q=n - f - 2),
+        "cge": partial(robust.cge, f=f),
+    }
+    key = jax.random.PRNGKey(args.seed)
+    k1, k2, kg = jax.random.split(key, 3)
+    signal = jax.random.normal(kg, (1, d), jnp.float32)
+    x_clean = signal + jax.random.normal(k1, (n, d), jnp.float32)
+    x_clean2 = signal + jax.random.normal(k2, (n, d), jnp.float32)
+
+    def attacked(kind):
+        honest = x_clean[: n - f]
+        if kind == "empire":
+            vec = attack_ops.empire(honest, scale=-1.1)
+        elif kind == "little":
+            vec = attack_ops.little(honest, f=f, n_total=n)
+        else:
+            vec = attack_ops.sign_flip(jnp.mean(honest, axis=0), scale=-4.0)
+        return jnp.concatenate(
+            [honest, jnp.broadcast_to(vec, (f, d)).astype(honest.dtype)],
+            axis=0,
+        )
+
+    rows = []
+    for agg_name, agg in aggs.items():
+        agg_j = jax.jit(agg)
+        base_clean = agg_j(x_clean)
+        resample = float(jnp.linalg.norm(agg_j(x_clean2) - base_clean))
+        for att in ("sign_flip", "little", "empire"):
+            x_att = attacked(att)
+            base_att = agg_j(x_att)
+            tolerance = max(
+                float(jnp.linalg.norm(base_att - base_clean)), resample
+            )
+            margins = {}
+            floor = None
+            floor_open = True
+            for mode in SUBINT8_FLOOR_MODES:
+                wire = qz.dequantize_blockwise(qz.encode_blockwise(x_att, mode))
+                err = float(jnp.linalg.norm(agg_j(wire) - base_att))
+                margin = tolerance / err if err > 0 else float("inf")
+                margins[mode] = round(margin, 3)
+                # the floor is the coarsest rung reachable WITHOUT
+                # crossing a failed finer rung (the ladder's error
+                # bounds overlap — e5m2 and s4 share absmax/14 — so a
+                # non-monotone pass past a failure must not relabel
+                # the failed rung as safe); boundary rule margin >= 1
+                # == the robustness study's err/tolerance <= 1
+                if floor_open and margin >= 1.0:
+                    floor = mode
+                else:
+                    floor_open = False
+            row = {
+                "lane": "subint8_floor",
+                "aggregator": agg_name,
+                "attack": att,
+                "n": n, "d": d, "f": f,
+                "tolerance": round(tolerance, 6),
+                "margin_by_mode": margins,
+                "floor": floor,
+            }
+            rows.append(row)
+            _emit(row, out)
+    return rows
+
+
+def run_subint8(args, out) -> dict:
+    """Adversarial-residual lane (ISSUE 15): the residual-shaping
+    attacker — an encoder-controlling client steering its own sub-int8
+    quantization error through error feedback — driven through the REAL
+    serving admission path per aggregator x fabric precision, measured
+    for influence against its unshaped (influence-ascent) twin, and
+    screened by the forensics ``residual_shaping`` detector with the
+    honest false-positive rate pinned; plus the per-aggregator
+    precision-floor table."""
+    fc = _forensics_config()
+    rows = []
+    for agg_name, agg_params in args.aggregators:
+        for prec in SUBINT8_PRECISIONS:
+            shape_mode = "s4" if prec == "s4" else "int8"
+            common = dict(
+                seed=args.seed,
+                n_clients=args.clients_grid,
+                dim=args.dim,
+                rounds=args.rounds,
+                aggregator=agg_name,
+                aggregator_params=agg_params,
+                engine="serving",
+                precision=prec,
+            )
+            baseline = ChaosHarness(
+                Scenario(name=f"subint8-baseline/{agg_name}/{prec}", **common)
+            ).run()
+            cell = Scenario(
+                name=f"subint8/{agg_name}/{prec}",
+                n_byzantine=args.byzantine,
+                attack=AttackSpec(
+                    name="residual_shaping",
+                    params={"mode": shape_mode, "kappa": 4.0,
+                            "scale0": 0.05},
+                ),
+                **common,
+            )
+            report = ChaosHarness(cell, forensics=fc).run()
+            s = report.forensics_summary()
+            plain = ChaosHarness(
+                Scenario(
+                    name=f"subint8-plain/{agg_name}/{prec}",
+                    n_byzantine=args.byzantine,
+                    attack=AttackSpec(
+                        name="influence_ascent", params={"scale0": 0.05}
+                    ),
+                    **common,
+                )
+            ).run()
+            row = {
+                "lane": "subint8",
+                "aggregator": agg_name,
+                "precision": prec,
+                "attack": "residual_shaping",
+                "shape_mode": shape_mode,
+                "kappa": 4.0,
+                "rounds": report.rounds_completed,
+                "mean_influence": round(report.influence_mean, 6),
+                "max_influence": round(report.influence_max, 6),
+                "plain_mean_influence": round(plain.influence_mean, 6),
+                "shaping_vs_plain": round(
+                    report.influence_mean / max(plain.influence_mean, 1e-9), 3
+                ),
+                "final_error": round(report.final_error, 6),
+                "baseline_error": round(baseline.final_error, 6),
+                "verdict": _verdict(report.final_error, baseline.final_error),
+                "byz_present": s["byz_present"],
+                "byz_flagged": s["byz_flagged"],
+                "recall": s["recall"],
+                "first_byz_flag_round": s["first_byz_flag_round"],
+                "honest_fp_rate": round(s["honest_fp_rate"], 4),
+                "flags_by_detector": s["flags_by_detector"],
+                "within_budget": (
+                    s["first_byz_flag_round"] is not None
+                    and s["first_byz_flag_round"] <= DETECT_BUDGET
+                ),
+                "trace_digest": report.trace.digest(),
+            }
+            rows.append(row)
+            _emit(row, out)
+    # honest-only FP pin on the sub-int8 fabrics (every honest frame's
+    # pre-decode inflation is exactly 1.0 — the detector must be silent)
+    worst_fp = 0.0
+    honest_runs = 0
+    for i in range(min(args.forensics_honest_seeds, 3)):
+        for prec in SUBINT8_PRECISIONS:
+            cell = Scenario(
+                name=f"subint8-honest/{prec}",
+                seed=args.seed + i,
+                n_clients=args.clients_grid,
+                dim=args.dim,
+                rounds=args.rounds,
+                aggregator="trimmed_mean",
+                aggregator_params={"f": args.byzantine},
+                engine="serving",
+                precision=prec,
+            )
+            s = ChaosHarness(cell, forensics=fc).run().forensics_summary()
+            worst_fp = max(worst_fp, s["honest_fp_rate"])
+            honest_runs += 1
+    floor_rows = _subint8_floor_rows(args, out)
+    summary = {
+        "lane": "subint8_summary",
+        "cells": len(rows),
+        "shaping_all_flagged": all(
+            r["byz_flagged"] == r["byz_present"] for r in rows
+        ),
+        "shaping_within_budget": all(r["within_budget"] for r in rows),
+        "residual_shaping_fired": all(
+            r["flags_by_detector"].get("residual_shaping", 0) > 0
+            for r in rows
+        ),
+        "honest_runs": honest_runs,
+        "honest_worst_fp_rate": round(worst_fp, 4),
+        "fp_within_bound": worst_fp <= FP_BOUND,
+        "floor_cells": len(floor_rows),
+        "int8_floor_clean": all(
+            r["margin_by_mode"]["int8"] >= 1.0 for r in floor_rows
+        ),
+        "floor_by_aggregator": {
+            a: sorted(
+                {
+                    r["floor"]
+                    for r in floor_rows
+                    if r["aggregator"] == a and r["floor"] is not None
+                }
+            )
+            for a in {r["aggregator"] for r in floor_rows}
+        },
+    }
+    _emit(summary, out)
+    return summary
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=20260804)
@@ -958,7 +1195,10 @@ def main() -> None:
     )
     ap.add_argument(
         "--lanes", type=str,
-        default="grid,adaptive,serving,swarm,recovery,forensics,ragged,shard",
+        default=(
+            "grid,adaptive,serving,swarm,recovery,forensics,ragged,shard,"
+            "subint8"
+        ),
         help="comma-separated lane subset",
     )
     ap.add_argument("--out", type=str, default=None)
@@ -1005,6 +1245,7 @@ def main() -> None:
     forensics = run_forensics(args, args.out) if "forensics" in lanes else None
     ragged = run_ragged(args, args.out) if "ragged" in lanes else None
     shard = run_shard(args, args.out) if "shard" in lanes else None
+    subint8 = run_subint8(args, args.out) if "subint8" in lanes else None
 
     crashed = [r for r in grid if r.get("harness_crashed")]
     headline = {
@@ -1042,6 +1283,15 @@ def main() -> None:
             {k: v["forged_detected"] for k, v in shard["forgery"].items()}
             if shard
             else None
+        ),
+        "subint8_shaping_flagged": (
+            subint8["shaping_all_flagged"] if subint8 else None
+        ),
+        "subint8_honest_worst_fp": (
+            subint8["honest_worst_fp_rate"] if subint8 else None
+        ),
+        "subint8_floor_by_aggregator": (
+            subint8["floor_by_aggregator"] if subint8 else None
         ),
     }
     _emit(headline, args.out)
@@ -1082,6 +1332,11 @@ def main() -> None:
             v["forged_detected"] == v["rounds"]
             for v in shard["forgery"].values()
         ), shard
+    if args.smoke and subint8 is not None:
+        assert subint8["shaping_all_flagged"], subint8
+        assert subint8["residual_shaping_fired"], subint8
+        assert subint8["fp_within_bound"], subint8
+        assert subint8["int8_floor_clean"], subint8
     if args.smoke and forensics is not None:
         assert forensics["adaptive_all_flagged"], forensics
         assert forensics["adaptive_within_budget"], forensics

@@ -135,22 +135,44 @@ def main() -> int:
             byz_shift = float(jnp.linalg.norm(base_att - base_clean))
             tolerance = max(byz_shift, resample_shift)
             errs = {}
-            for mode in ("int8", "bf16"):
-                if mode == "int8":
-                    wire = qz.quantize_blockwise(x_att).dequantize()
-                else:
+            for mode in ("int8", "bf16", "fp8", "fp8_e5m2", "s4"):
+                if mode == "bf16":
                     wire = x_att.astype(jnp.bfloat16).astype(jnp.float32)
+                else:
+                    wire = qz.dequantize_blockwise(
+                        qz.encode_blockwise(x_att, mode)
+                    )
                 errs[mode] = float(jnp.linalg.norm(agg_j(wire) - base_att))
             ratio = errs["int8"] / tolerance if tolerance else float("inf")
+            # the sub-int8 precision floor: the coarsest mode (down the
+            # int8 -> fp8 -> fp8_e5m2 -> s4 ladder) reachable without
+            # crossing a failed finer rung (boundary err/tol <= 1,
+            # same rule as the chaos subint8_floor lane)
+            floor = None
+            for mode in ("int8", "fp8", "fp8_e5m2", "s4"):
+                if not tolerance or errs[mode] / tolerance > 1.0:
+                    break
+                floor = mode
             rows.append({
                 "aggregator": agg_name, "attack": att,
                 "byz_shift": byz_shift, "resample_shift": resample_shift,
                 "tolerance": tolerance,
                 "int8_err": errs["int8"], "bf16_err": errs["bf16"],
-                "int8_over_tolerance": ratio, **provenance,
+                "fp8_err": errs["fp8"], "fp8_e5m2_err": errs["fp8_e5m2"],
+                "s4_err": errs["s4"],
+                "int8_over_tolerance": ratio,
+                "fp8_over_tolerance": (
+                    errs["fp8"] / tolerance if tolerance else float("inf")
+                ),
+                "s4_over_tolerance": (
+                    errs["s4"] / tolerance if tolerance else float("inf")
+                ),
+                "precision_floor": floor, **provenance,
             })
             print(f"{agg_name:18s} {att:9s} {tolerance:11.4f} "
-                  f"{errs['int8']:11.4f} {errs['bf16']:11.4f} {ratio:9.4f}")
+                  f"{errs['int8']:11.4f} {errs['bf16']:11.4f} {ratio:9.4f} "
+                  f"fp8={errs['fp8']:.4f} s4={errs['s4']:.4f} "
+                  f"floor={floor}")
             if ratio >= 1.0:
                 failures.append((agg_name, att, ratio))
 

@@ -49,5 +49,19 @@ timeout 1800 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
   python benchmarks/quant_robustness_study.py \
   --out benchmarks/results/quant_robustness_tpu.jsonl \
   >> "$OUT" 2>/tmp/r5_quantrob.err
+# 8. ISSUE 15 (sub-int8 fabric): on-chip fp8/s4 sweep — wire bytes +
+#    steps/sec down the whole precision ladder (quantized_comm_bench
+#    covers fp8/s4 since round 15), the sub-int8 Pallas kernels'
+#    Mosaic bit-parity gate (BYZPY_TPU_SUBINT8_PALLAS=1 flips only
+#    with this evidence), the EF convergence study on-chip, and the
+#    fp8/s4 autotune families (swept in step 4's --force run)
+timeout 1800 env BYZPY_TPU_TUNE_CACHE=benchmarks/results/autotune_tpu.json \
+  BYZPY_TPU_SUBINT8_PALLAS=1 \
+  python benchmarks/quantized_comm_bench.py \
+  --out benchmarks/results/subint8_comm_tpu.jsonl \
+  >> "$OUT" 2>/tmp/r5_subint8.err
+timeout 1800 python benchmarks/ef_convergence_study.py \
+  --out benchmarks/results/round15_subint8_tpu.jsonl \
+  >> "$OUT" 2>/tmp/r5_ef.err
 echo "# bundle end $(date -u)" >> "$OUT"
 echo "bundle complete: $OUT (+ roofline_tpu.jsonl, autotune_tpu.json, grid_tpu.jsonl, quantized_comm_tpu.jsonl, quant_robustness_tpu.jsonl)"

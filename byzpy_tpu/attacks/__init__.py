@@ -3,6 +3,7 @@ from .adaptive import (
     InfluenceAscentAttack,
     KrumEvasionAttack,
     PublicRoundState,
+    ResidualShapingAttack,
     StalenessAbuseAttack,
 )
 from .base import Attack
@@ -27,5 +28,6 @@ __all__ = [
     "InfluenceAscentAttack",
     "KrumEvasionAttack",
     "PublicRoundState",
+    "ResidualShapingAttack",
     "StalenessAbuseAttack",
 ]

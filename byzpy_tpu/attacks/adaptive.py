@@ -375,10 +375,155 @@ class StalenessAbuseAttack(AdaptiveAttack):
         return (self.inflation * payload).astype(np.float32)
 
 
+#: The integer-grid wire modes an encoder-controlling client can shape
+#: (fp8 shaping is the same scale-inflation signature on the format
+#: grid; the code maxima themselves come from the wire codec's own
+#: table at call time so the two can never drift).
+_SHAPE_MODES = ("int8", "s4")
+
+
+def _shaped_wire_roundtrip(
+    payload: np.ndarray, mode: str, block: int, kappa: float
+) -> tuple:
+    """What a residual-shaping client's self-controlled encoder emits:
+    blockwise codes on a ``kappa``-inflated scale grid (each block's
+    scale is ``kappa * absmax / qmax`` instead of the honest
+    ``absmax / qmax``), plus the resulting decode and the PRE-decode
+    inflation ratio an ingress would measure. The grid constant comes
+    from ``engine.actor.wire._WIRE_QMAX`` and the ratio is computed by
+    the REAL ``wire.frame_inflation`` over the shaped frame's actual
+    code layout — the attack and the countermeasure read one rulebook.
+    Returns ``(decoded, inflation)``."""
+    from ..engine.actor import wire as _wire
+
+    qmax = _wire._WIRE_QMAX[mode]
+    flat = np.ascontiguousarray(payload, np.float32).ravel()
+    n = flat.size
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    xb = flat.reshape(nb, block)
+    absmax = np.max(np.abs(xb), axis=1)
+    scales = np.where(
+        absmax > 0, absmax / qmax * np.float32(kappa), 1.0
+    ).astype(np.float32)
+    codes = np.clip(np.rint(xb / scales[:, None]), -qmax, qmax)
+    decoded = (codes * scales[:, None]).ravel()[:n].astype(np.float32)
+    if mode == "s4":
+        nib = (codes + 8.0).astype(np.uint8).ravel()
+        wire_codes = nib[0::2] | (nib[1::2] << 4)  # packed, block-padded
+    else:
+        wire_codes = codes.astype(np.int8).ravel()[:n]
+    inflation = _wire.frame_inflation(
+        _wire.QuantizedWireArray(
+            mode, wire_codes, scales, block, payload.shape, "float32"
+        )
+    )
+    return decoded.reshape(payload.shape), float(inflation)
+
+
+class ResidualShapingAttack(InfluenceAscentAttack):
+    """Error-feedback residual shaping on the sub-int8 wire fabric.
+
+    Error feedback makes the compressed uplink *stateful*: an honest
+    client carries the residual its encoder lost and folds it into the
+    next frame. A Byzantine client CONTROLS its encoder, so it can
+    shape both halves of that loop:
+
+    * it inflates its per-block scales by ``kappa`` (> 1) — a grid
+      ``kappa``x coarser than its content needs. Post-decode the row
+      still lands near the honest consensus (the coarse rounding is
+      absorbed exactly like quantization noise), so magnitude/z-score
+      screens see nothing;
+    * the rounding error of that self-chosen coarse grid — up to
+      ``kappa/2`` code steps per coordinate — is not noise to the
+      attacker: it is *budget*. The attack carries it as its EF
+      residual and re-injects it every round, so directional pushes
+      far below one honest grid step accumulate across rounds and
+      eventually cross the grid — influence a single shaped frame
+      could never deliver, riding the same line search as
+      :class:`InfluenceAscentAttack` (which this class extends: the
+      magnitude knob stays adaptive).
+
+    The countermeasure is PRE-decode: an honest blockwise encoder maps
+    each block's absmax to exactly the code maximum, so its per-block
+    inflation ratio ``qmax / max|code|`` is 1.0; this attack's frames
+    sit at ~``kappa``. The serving ingress measures that ratio on the
+    still-compressed frame (``wire.decode_with_stats``) and the
+    forensics ``residual_shaping`` detector flags it — measured (recall
+    + honest FP) by the ``subint8`` lane of
+    ``benchmarks/chaos_bench.py``. ``apply`` returns the DECODED row
+    (the wire view the frontend folds); ``wire_inflation`` exposes the
+    pre-decode tell the in-process engines thread into
+    ``ServingFrontend.submit(wire_inflation=...)``, exactly what the
+    TCP ingress would have measured from the frame."""
+
+    name = "residual-shaping"
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        mode: str = "s4",
+        block: int = 256,
+        kappa: float = 4.0,
+        direction: Any = None,
+        scale0: float = 0.05,
+        grow: float = 1.6,
+        shrink: float = 0.5,
+        max_scale: float = 1e3,
+        seed: int = 0,
+        client_id: str = "byz",
+    ) -> None:
+        super().__init__(
+            dim, direction=direction, scale0=scale0, grow=grow,
+            shrink=shrink, max_scale=max_scale, seed=seed,
+            client_id=client_id,
+        )
+        if mode not in _SHAPE_MODES:
+            raise ValueError(
+                f"mode must be one of {sorted(_SHAPE_MODES)}, got {mode!r}"
+            )
+        if kappa < 1.0:
+            raise ValueError(f"kappa must be >= 1 (got {kappa})")
+        if block <= 0:
+            raise ValueError(f"block must be positive, got {block}")
+        self.mode = mode
+        self.block = int(block)
+        self.kappa = float(kappa)
+        #: the attacker's EF residual: everything its shaped grid has
+        #: "lost" so far and will re-inject (attacker-controlled state —
+        #: the reason sub-int8 EF needs its own detector)
+        self.residual = np.zeros((dim,), np.float32)
+        #: pre-decode inflation ratio of the LAST emitted frame (what
+        #: the ingress would measure; ~kappa while shaping)
+        self.wire_inflation: float = 1.0
+
+    def apply(self, *, model=None, x=None, y=None,
+              honest_grads=None, base_grad=None) -> np.ndarray:
+        """Next submission: consensus estimate + line-searched push +
+        carried residual, round-tripped through the attacker's own
+        kappa-shaped encoder. The decoded row is what lands in the
+        cohort; the residual update is exactly EF's."""
+        self.submissions += 1
+        target = (
+            self._aggregate_estimate()
+            + self.scale * self.direction
+            + self.residual
+        ).astype(np.float32)
+        decoded, self.wire_inflation = _shaped_wire_roundtrip(
+            target, self.mode, self.block, self.kappa
+        )
+        self.residual = target - decoded
+        return decoded
+
+
 __all__ = [
     "AdaptiveAttack",
     "InfluenceAscentAttack",
     "KrumEvasionAttack",
     "PublicRoundState",
+    "ResidualShapingAttack",
     "StalenessAbuseAttack",
 ]

@@ -397,11 +397,13 @@ class ChaosHarness:
             )
         from ..parallel.quantization import (
             dequantize_blockwise,
-            quantize_blockwise,
+            encode_blockwise,
         )
 
         return np.asarray(
-            dequantize_blockwise(quantize_blockwise(jnp.asarray(matrix)))
+            dequantize_blockwise(
+                encode_blockwise(jnp.asarray(matrix), self.s.precision)
+            )
         )
 
     # -- public API --------------------------------------------------------
@@ -783,12 +785,29 @@ class ChaosHarness:
             matrix = self._apply_precision(matrix)
             server_round = fe.round_of("chaos")
             round_acks: Dict[str, str] = {}
+            blockwise = s.precision not in ("off", "bf16")
             for i, owner in enumerate(owners):
                 stamp = server_round
                 attack = owner.attack
                 if attack is not None and hasattr(attack, "next_round_stamp"):
                     stamp = attack.next_round_stamp(server_round)
-                ok, reason = fe.submit("chaos", owner.cid, stamp, matrix[i])
+                # pre-decode wire forensics, as the TCP ingress would
+                # measure it — which means NOTHING off the blockwise
+                # fabrics: an off/bf16 frame carries no per-block
+                # scales, so even a shaping attack's ratio is
+                # unobservable there (the real ingress would stamp
+                # None). On a coded fabric the attack exposes its
+                # shaped ratio; every other client's honest encode
+                # sits at exactly 1.0.
+                if blockwise:
+                    wi = getattr(attack, "wire_inflation", None)
+                    if wi is None:
+                        wi = 1.0
+                else:
+                    wi = None
+                ok, reason = fe.submit(
+                    "chaos", owner.cid, stamp, matrix[i], wire_inflation=wi
+                )
                 # a client with several arrivals keeps its ACCEPTED ack:
                 # the submission that folded defines the round's outcome
                 # for the adversary (a partial rate-rejection must not
@@ -821,6 +840,11 @@ class ChaosHarness:
                         cohort.clients, agg,
                         aggregator=aggregator,
                         weights=cohort.weights, bucket=cohort.bucket,
+                        wire_inflations=(
+                            cohort.wire_inflations
+                            if cohort.wire_inflations
+                            else None
+                        ),
                     )
                 )
             w = (w - np.float32(s.learning_rate) * agg).astype(np.float32)

@@ -25,7 +25,7 @@ from typing import Any, Dict, Mapping, Optional, Tuple
 _ARRIVAL_KINDS = ("every_round", "bernoulli", "poisson")
 _STRAGGLER_KINDS = ("none", "lognormal", "bimodal")
 _ENGINES = ("direct", "spmd", "actor", "serving")
-_PRECISIONS = ("off", "bf16", "int8")
+_PRECISIONS = ("off", "bf16", "int8", "fp8", "fp8_e5m2", "s4")
 
 
 @dataclass(frozen=True)
@@ -408,6 +408,24 @@ def _a_krum_evasion(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
     )
 
 
+def _a_residual_shaping(
+    dim: int, p: Mapping[str, Any], seed: int, client_id: str
+):
+    from ..attacks.adaptive import ResidualShapingAttack
+
+    return ResidualShapingAttack(
+        dim,
+        mode=str(p.get("mode", "s4")),
+        block=int(p.get("block", 256)),
+        kappa=float(p.get("kappa", 4.0)),
+        scale0=float(p.get("scale0", 0.05)),
+        grow=float(p.get("grow", 1.6)),
+        shrink=float(p.get("shrink", 0.5)),
+        seed=seed,
+        client_id=client_id,
+    )
+
+
 def _a_staleness(dim: int, p: Mapping[str, Any], seed: int, client_id: str):
     from ..attacks.adaptive import StalenessAbuseAttack
     from ..serving.staleness import StalenessPolicy
@@ -435,6 +453,7 @@ ATTACKS = {
     "outlier": _a_outlier,
     "influence_ascent": _a_influence,
     "krum_evasion": _a_krum_evasion,
+    "residual_shaping": _a_residual_shaping,
     "staleness_abuse": _a_staleness,
 }
 

@@ -41,15 +41,36 @@ MAX_FRAME = 1 << 31
 _SIG_LEN = hashlib.sha256().digest_size
 
 #: Env opt-in for compressed tensor frames: "off" (default, lossless
-#: cloudpickle), "bf16", or "int8" (blockwise symmetric, per-block f32
-#: scales carried in the frame). Lossy — remote-TCP PS rounds ship ~4x
-#: fewer payload bytes at int8; see docs/performance.md §quantized comms.
+#: cloudpickle), "bf16", "int8" (blockwise symmetric, per-block f32
+#: scales carried in the frame), or the sub-int8 tier "fp8"/"fp8_e5m2"
+#: (blockwise-scaled float8 — one byte per value, format-relative
+#: accuracy) and "s4" (two 4-bit codes packed per byte, ~7.9x fewer
+#: payload bytes). Lossy — see docs/performance.md §quantized comms and
+#: §sub-int8 fabric.
 _WIRE_PRECISION_ENV = "BYZPY_TPU_WIRE_PRECISION"
 _WIRE_BLOCK_ENV = "BYZPY_TPU_WIRE_BLOCK"
+#: Every lossy wire mode, and the blockwise subset carrying per-block
+#: scale headers (pre-decode forensics — the residual-shaping detector
+#: — applies to these).
+WIRE_MODES = ("bf16", "int8", "fp8", "fp8_e5m2", "s4")
+BLOCKWISE_WIRE_MODES = ("int8", "fp8", "fp8_e5m2", "s4")
+#: Per-mode code maximum in the scaled domain: an honest blockwise
+#: encoder maps each block's absmax to EXACTLY this code magnitude, so
+#: the pre-decode inflation ratio qmax/max|code| of every nonzero block
+#: is 1.0 — the invariant the residual-shaping detector leans on.
+_WIRE_QMAX = {"int8": 127.0, "s4": 7.0, "fp8": 448.0, "fp8_e5m2": 57344.0}
 #: Arrays below this element count always travel lossless (the scale
 #: header would rival the payload).
 WIRE_QUANT_MIN_SIZE = 1024
 _WIRE_DEFAULT_BLOCK = 256
+
+
+def _ml_f8_dtype(mode: str):
+    import ml_dtypes
+
+    return (
+        ml_dtypes.float8_e4m3fn if mode == "fp8" else ml_dtypes.float8_e5m2
+    )
 
 
 def _wire_key() -> bytes | None:
@@ -79,11 +100,12 @@ def warn_untrusted_bind(host: str, component: str) -> None:
 
 
 def wire_precision() -> str:
-    """Resolved ``BYZPY_TPU_WIRE_PRECISION`` policy: ``"off"`` (default),
-    ``"bf16"``, or ``"int8"``. Unknown values degrade to ``"off"`` —
-    the wire must never fail on a typo'd env var."""
+    """Resolved ``BYZPY_TPU_WIRE_PRECISION`` policy: ``"off"``
+    (default), ``"bf16"``, ``"int8"``, ``"fp8"``, ``"fp8_e5m2"``, or
+    ``"s4"``. Unknown values degrade to ``"off"`` — the wire must never
+    fail on a typo'd env var."""
     mode = os.environ.get(_WIRE_PRECISION_ENV, "off").lower()
-    return mode if mode in ("bf16", "int8") else "off"
+    return mode if mode in WIRE_MODES else "off"
 
 
 def _wire_block() -> int:
@@ -97,11 +119,13 @@ def _wire_block() -> int:
 @dataclasses.dataclass(frozen=True)
 class QuantizedWireArray:
     """One compressed tensor inside a wire frame: ``codes`` (int8 for
-    ``int8`` mode, uint16 bf16 bit patterns for ``bf16``), the per-block
-    f32 ``scales`` header (``None`` for bf16), and enough metadata to
-    reconstruct shape/dtype. Pickles alongside the rest of the payload,
-    so the frame HMAC covers codes AND scales — a tampered scale block
-    fails :func:`decode` before any dequantization runs."""
+    ``int8`` mode, uint16 bf16 bit patterns for ``bf16``, uint8 float8
+    bit patterns for ``fp8``/``fp8_e5m2``, block-padded packed nibbles
+    for ``s4``), the per-block f32 ``scales`` header (``None`` for
+    bf16), and enough metadata to reconstruct shape/dtype. Pickles
+    alongside the rest of the payload, so the frame HMAC covers codes
+    AND scales — a tampered scale block fails :func:`decode` before any
+    dequantization runs."""
 
     mode: str
     codes: np.ndarray
@@ -145,6 +169,66 @@ def _np_dequantize(
     flat = codes.astype(np.float32)
     if pad:
         flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    out = (flat.reshape(nb, block) * scales[:, None]).ravel()[:n]
+    return out.astype(dtype).reshape(shape)
+
+
+def _np_blockwise_encode(
+    arr: np.ndarray, block: int, mode: str
+) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Mode-generic blockwise encode over the flattened array (numpy
+    mirror of ``parallel.quantization.encode_blockwise``; parity pinned
+    by ``tests/test_quantized_wire.py``). Returns ``(codes, scales,
+    finite)`` — ``finite=False`` means a block's absmax is non-finite
+    and the frame must travel lossless (same contract as the int8
+    codec). Codes are int8 for ``int8``, uint8 float8 bit patterns for
+    ``fp8``/``fp8_e5m2``, and block-padded packed nibbles (uint8, two
+    codes per byte) for ``s4``."""
+    if mode == "int8":
+        return _np_quantize(arr, block)
+    flat = np.ascontiguousarray(arr, dtype=np.float32).ravel()
+    n = flat.size
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    xb = flat.reshape(nb, block)
+    absmax = np.max(np.abs(xb), axis=1)  # propagates inf AND NaN
+    finite = bool(np.isfinite(absmax).all())
+    qmax = _WIRE_QMAX[mode]
+    scales = np.where(absmax > 0, absmax / qmax, 1.0).astype(np.float32)
+    with np.errstate(invalid="ignore", over="ignore"):
+        y = xb / scales[:, None]
+        if mode == "s4":
+            q = np.clip(np.rint(y), -7, 7).astype(np.int8)
+            nib = (q + np.int8(8)).astype(np.uint8).reshape(-1)
+            codes = nib[0::2] | (nib[1::2] << 4)  # padded: nb*block//2 bytes
+        else:
+            y = np.clip(y, -qmax, qmax)
+            codes = y.astype(_ml_f8_dtype(mode)).view(np.uint8).ravel()[:n]
+    return codes, scales, finite
+
+
+def _np_blockwise_decode(
+    codes: np.ndarray, scales: np.ndarray, block: int, shape, dtype, mode: str
+) -> np.ndarray:
+    """Inverse of :func:`_np_blockwise_encode` (lossy)."""
+    if mode == "int8":
+        return _np_dequantize(codes, scales, block, shape, dtype)
+    nb = scales.size
+    n = 1
+    for s in shape:
+        n *= s
+    if mode == "s4":
+        nib = np.empty(codes.size * 2, np.uint8)
+        nib[0::2] = codes & np.uint8(0xF)
+        nib[1::2] = codes >> 4
+        flat = nib.astype(np.float32) - 8.0
+    else:
+        flat = codes.view(_ml_f8_dtype(mode)).astype(np.float32)
+        pad = nb * block - flat.size
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
     out = (flat.reshape(nb, block) * scales[:, None]).ravel()[:n]
     return out.astype(dtype).reshape(shape)
 
@@ -240,12 +324,12 @@ def compress_payload(
     min_size: int = WIRE_QUANT_MIN_SIZE,
 ) -> Any:
     """Swap large finite float arrays in a payload pytree for
-    :class:`QuantizedWireArray` frames (``mode`` ``"int8"``/``"bf16"``;
-    anything else returns ``obj`` unchanged). Non-float, object-dtype,
-    small, and non-finite arrays pass through lossless (attack vectors
-    arrive verbatim, the reference's semantics). Untouched subtrees are
-    returned as-is."""
-    if mode not in ("int8", "bf16"):
+    :class:`QuantizedWireArray` frames (``mode`` one of
+    :data:`WIRE_MODES`; anything else returns ``obj`` unchanged).
+    Non-float, object-dtype, small, and non-finite arrays pass through
+    lossless (attack vectors arrive verbatim, the reference's
+    semantics). Untouched subtrees are returned as-is."""
+    if mode not in WIRE_MODES:
         return obj
     block = block or _wire_block()
 
@@ -260,13 +344,13 @@ def compress_payload(
                 return QuantizedWireArray(
                     "bf16", codes, None, block, x.shape, str(x.dtype)
                 )
-            codes, scales, finite = _np_quantize(x, block)
+            codes, scales, finite = _np_blockwise_encode(x, block, mode)
             # cheap post-hoc non-finite detection from the codec's own
             # per-block absmax reduction (no extra full-array pass)
             if not finite:
                 return x
             return QuantizedWireArray(
-                "int8", codes, scales, block, x.shape, str(x.dtype)
+                mode, codes, scales, block, x.shape, str(x.dtype)
             )
         return x
 
@@ -283,10 +367,121 @@ def decompress_payload(obj: Any) -> Any:
         if isinstance(x, QuantizedWireArray):
             if x.mode == "bf16":
                 return _np_from_bf16(x.codes, x.shape, x.dtype)
-            return _np_dequantize(x.codes, x.scales, x.block, x.shape, x.dtype)
+            return _np_blockwise_decode(
+                x.codes, x.scales, x.block, x.shape, x.dtype, x.mode
+            )
         return x
 
     return _map_payload_leaves(leaf, obj)
+
+
+def frame_inflation(qwa: QuantizedWireArray) -> Optional[float]:
+    """PRE-decode per-block inflation ratio of one blockwise frame:
+    ``max over nonzero blocks of qmax / max|code|``.
+
+    An honest blockwise encoder maps each block's absmax to exactly the
+    code maximum (127 / 7 / the fp8 format max), so every nonzero
+    block's ratio is 1.0 (stochastic rounding can dip one code step).
+    A residual-shaping client inflates its per-block SCALES relative to
+    the content it encodes — buying itself a coarser grid whose
+    "quantization error" it steers via error feedback — which is
+    invisible post-decode but shows pre-decode as max|code| well under
+    qmax. Computed from the codes alone (no dequantization, no scale
+    trust); ``None`` for non-blockwise frames (bf16 carries no scale
+    header to shape). All-zero payloads report 1.0."""
+    if qwa.mode not in BLOCKWISE_WIRE_MODES or qwa.scales is None:
+        return None
+    qmax = _WIRE_QMAX[qwa.mode]
+    block = qwa.block
+    if qwa.mode == "s4":
+        nib = np.empty(qwa.codes.size * 2, np.uint8)
+        nib[0::2] = qwa.codes & np.uint8(0xF)
+        nib[1::2] = qwa.codes >> 4
+        # nibble 0 is outside the honest encoder's [-7, 7] codomain;
+        # clamp so a hostile -8 cannot fake EXTRA magnitude
+        mags = np.minimum(np.abs(nib.astype(np.float32) - 8.0), qmax)
+    elif qwa.mode == "int8":
+        mags = np.abs(qwa.codes.astype(np.float32))
+    else:
+        vals = qwa.codes.view(_ml_f8_dtype(qwa.mode)).astype(np.float32)
+        mags = np.minimum(np.abs(np.where(np.isfinite(vals), vals, qmax)), qmax)
+    n = mags.size
+    nb = qwa.scales.size
+    pad = nb * block - n
+    if pad > 0:
+        mags = np.concatenate([mags, np.zeros(pad, np.float32)])
+    blockmax = mags[: nb * block].reshape(nb, block).max(axis=1)
+    nonzero = blockmax > 0
+    if not nonzero.any():
+        return 1.0
+    return float(qmax / blockmax[nonzero].min())
+
+
+def payload_block_stats(obj: Any) -> Optional[dict]:
+    """Pre-decode wire forensics over a still-compressed payload: the
+    worst :func:`frame_inflation` across every blockwise
+    :class:`QuantizedWireArray` in the pytree (``None`` when the
+    payload carries none — lossless and bf16 frames have no per-block
+    scale header to shape). The serving ingress computes this BEFORE
+    :func:`decompress_payload` runs and threads it into the forensics
+    plane as the submission's ``wire_inflation`` feature."""
+    worst: Optional[float] = None
+    frames = 0
+
+    def leaf(x: Any) -> Any:
+        nonlocal worst, frames
+        if isinstance(x, QuantizedWireArray):
+            infl = frame_inflation(x)
+            if infl is not None:
+                frames += 1
+                worst = infl if worst is None else max(worst, infl)
+        return x
+
+    _map_payload_leaves(leaf, obj)
+    if worst is None:
+        return None
+    return {"max_inflation": worst, "frames": frames}
+
+
+def ef_precompensate(
+    arr: np.ndarray,
+    residual: Optional[np.ndarray],
+    mode: Optional[str] = None,
+    *,
+    block: Optional[int] = None,
+    min_size: int = WIRE_QUANT_MIN_SIZE,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Client-side error feedback for the lossy wire fabric: fold the
+    previous frame's quantization residual into ``arr`` and return
+    ``(compensated, new_residual)``.
+
+    ``compensated`` is what the caller hands to :func:`encode` — the
+    wire's own (deterministic) blockwise encode then reproduces exactly
+    the encoding this function measured, so ``new_residual`` is
+    precisely the error the receiver's decode will see this round and
+    the transmitted stream telescopes across frames (the numpy mirror
+    of ``parallel.quantization.ef_encode``). Frames the wire would ship
+    LOSSLESS (small/non-finite payloads, ``mode`` off/bf16-less-stateful)
+    deliver the compensation exactly, so the residual returns to zero.
+    ``mode=None`` resolves ``BYZPY_TPU_WIRE_PRECISION``."""
+    mode = wire_precision() if mode is None else mode
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    comp = arr if residual is None else arr + residual.astype(np.float32)
+    zero = np.zeros_like(comp)
+    if mode not in BLOCKWISE_WIRE_MODES:
+        # bf16/off: no blockwise codec on the wire. bf16's cast error
+        # is below the EF signal; carrying state for it buys nothing.
+        return comp, zero
+    if not _quantizable(comp, min_size):
+        return comp, zero  # travels lossless: fully delivered
+    block = block or _wire_block()
+    codes, scales, finite = _np_blockwise_encode(comp, block, mode)
+    if not finite:
+        return comp, zero  # lossless fallback path delivers exactly
+    dec = _np_blockwise_decode(
+        codes, scales, block, comp.shape, np.float32, mode
+    )
+    return comp, comp - dec
 
 
 #: (frames, bytes) counter pairs per direction, resolved ONCE on the
@@ -346,7 +541,7 @@ def encode(obj: Any, *, precision: Optional[str] = None) -> bytes:
     (:func:`decode` pops it). Telemetry disabled: one flag check, the
     frame bytes are byte-identical to the pre-propagation wire."""
     mode = wire_precision() if precision is None else (
-        precision if precision in ("bf16", "int8") else "off"
+        precision if precision in WIRE_MODES else "off"
     )
     if _obs_runtime.STATE.enabled and type(obj) is dict:
         ctx = _obs_tracing.wire_context()
@@ -373,6 +568,21 @@ def decode(body: bytes) -> Any:
     the remote sender's child. Frames without a stamp leave the local
     context untouched (a decode inside an open local span must not
     orphan it)."""
+    return _decode_impl(body, want_stats=False)[0]
+
+
+def decode_with_stats(body: bytes) -> Tuple[Any, Optional[dict]]:
+    """:func:`decode` plus the PRE-decode :func:`payload_block_stats` of
+    the frame's compressed payload, captured between unpickle and
+    dequantization (after HMAC verification — stats from a forged frame
+    would be attacker-free ink). The serving ingress uses this so the
+    forensics plane sees each submission's wire-side block-inflation
+    ratio; stats are ``None`` for frames carrying no blockwise
+    payload."""
+    return _decode_impl(body, want_stats=True)
+
+
+def _decode_impl(body: bytes, *, want_stats: bool) -> Tuple[Any, Optional[dict]]:
     if _obs_runtime.STATE.enabled:
         _frame_counters("rx", _HEADER.size + len(body))
     key = _wire_key()
@@ -385,12 +595,14 @@ def decode(body: bytes) -> Any:
                 "frame HMAC verification failed: wrong BYZPY_TPU_WIRE_KEY "
                 "or tampered/unsigned frame"
             )
-    obj = decompress_payload(cloudpickle.loads(body))
+    raw = cloudpickle.loads(body)
+    stats = payload_block_stats(raw) if want_stats else None
+    obj = decompress_payload(raw)
     if type(obj) is dict and TRACE_CTX_KEY in obj:
         ctx = obj.pop(TRACE_CTX_KEY)
         if _obs_runtime.STATE.enabled:
             _obs_tracing.adopt_context(ctx)
-    return obj
+    return obj, stats
 
 
 def host_view(obj: Any) -> Any:
@@ -444,12 +656,18 @@ async def recv_obj(reader: asyncio.StreamReader) -> Any:
 
 
 __all__ = [
+    "BLOCKWISE_WIRE_MODES",
     "TRACE_CTX_KEY",
+    "WIRE_MODES",
     "send_obj",
     "recv_obj",
     "encode",
     "decode",
+    "decode_with_stats",
+    "ef_precompensate",
+    "frame_inflation",
     "host_view",
+    "payload_block_stats",
     "warn_untrusted_bind",
     "wire_precision",
     "compress_payload",

@@ -60,7 +60,9 @@ def wal_timeline(tenant_directory: str) -> dict:
     for rec in records:
         kind = rec[0]
         if kind == durable.ACCEPT:
-            _, wal_id, client, _seq, _round_sub, _arrived, _grad = rec
+            # round-15 accepts carry an 8th field (the ingress-measured
+            # wire inflation); older segments carry 7 — read both
+            _, wal_id, client = rec[:3]
             accepts[int(wal_id)] = str(client)
         elif kind == durable.ROUND:
             _, round_id, wal_ids, digest, m = rec

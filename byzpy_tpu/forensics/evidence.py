@@ -41,6 +41,7 @@ DETECTORS = (
     "sign_anomaly",
     "echo",
     "low_trust",
+    "residual_shaping",
 )
 
 
@@ -75,7 +76,16 @@ class DetectorConfig:
     round to buy inflation headroom, while an honest client's lag
     varies. A genuinely always-slow honest client also trips this; in
     a deployment that is still worth operator attention (raise the
-    threshold to tolerate it)."""
+    threshold to tolerate it). ``wire_inflation_threshold``: a
+    submission whose PRE-decode per-block wire inflation ratio
+    (``engine.actor.wire.frame_inflation`` — qmax over the largest
+    code magnitude among nonzero blocks) exceeds this is shaping its
+    quantization grid: an honest blockwise encoder maps each block's
+    absmax to exactly the code maximum (ratio 1.0; stochastic
+    rounding dips one code step), while a residual-shaping client
+    inflates its scales to buy a coarse grid whose "error" it steers
+    through error feedback — invisible post-decode, unmistakable
+    pre-decode."""
 
     norm_z_threshold: float = 12.0
     inflation_threshold: float = 3.0
@@ -85,6 +95,7 @@ class DetectorConfig:
     echo_ratio: float = 0.05
     echo_rounds: int = 2
     pinned_rounds: int = 4
+    wire_inflation_threshold: float = 2.0
 
     def __post_init__(self) -> None:
         if self.norm_z_threshold <= 0:
@@ -97,6 +108,11 @@ class DetectorConfig:
             raise ValueError("echo_rounds must be >= 1")
         if self.pinned_rounds < 1:
             raise ValueError("pinned_rounds must be >= 1")
+        if self.wire_inflation_threshold <= 1.0:
+            raise ValueError(
+                "wire_inflation_threshold must be > 1 (an honest "
+                "blockwise encoder sits at exactly 1.0)"
+            )
 
 
 @dataclass(frozen=True)
@@ -129,6 +145,9 @@ class SubmissionEvidence:
     selected: Optional[bool]
     flags: Tuple[str, ...] = ()
     trust: Optional[float] = None
+    #: pre-decode wire block-inflation ratio (None when the submission
+    #: arrived lossless/in-process — the residual-shaping feature)
+    wire_inflation: Optional[float] = None
 
     def to_wire(self) -> dict:
         """Compact dict for WAL/flight-recorder serialization."""
@@ -143,6 +162,10 @@ class SubmissionEvidence:
             "sel": self.selected,
             "f": list(self.flags),
             "t": None if self.trust is None else round(self.trust, 4),
+            "wi": (
+                None if self.wire_inflation is None
+                else round(self.wire_inflation, 4)
+            ),
         }
 
     @classmethod
@@ -159,6 +182,9 @@ class SubmissionEvidence:
             selected=d.get("sel"),
             flags=tuple(d.get("f", ())),
             trust=None if d.get("t") is None else float(d["t"]),
+            wire_inflation=(
+                None if d.get("wi") is None else float(d["wi"])
+            ),
         )
 
 

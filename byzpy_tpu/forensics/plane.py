@@ -225,6 +225,7 @@ class ForensicsPlane:
         deltas: Optional[Sequence[int]] = None,
         bucket: Optional[int] = None,
         precomputed: Optional[Mapping[str, Any]] = None,
+        wire_inflations: Optional[Sequence[Optional[float]]] = None,
     ) -> dict:
         """The HEAVY half of :meth:`observe_round`: features + the
         aggregator's score view (the O(m²·d) Krum distances / O(m·d)
@@ -305,6 +306,13 @@ class ForensicsPlane:
                 else None
             ),
             "deltas": None if deltas is None else [int(d) for d in deltas],
+            "wire_inflations": (
+                None
+                if wire_inflations is None
+                else [
+                    None if w is None else float(w) for w in wire_inflations
+                ]
+            ),
             "bucket": bucket,
             "aggregate": aggregate,
         }
@@ -321,6 +329,7 @@ class ForensicsPlane:
         flags = prep["flags"]
         scores, keep = prep["scores"], prep["keep"]
         weights, deltas = prep["weights"], prep["deltas"]
+        wire_inflations = prep.get("wire_inflations")
         clients = prep["clients"]
         aggregate = prep["aggregate"]
         m = int(idx.size)
@@ -345,6 +354,20 @@ class ForensicsPlane:
             )
             if stale_streak >= self.cfg.detectors.pinned_rounds:
                 row_flags.append("staleness_pinned")
+            wi = (
+                wire_inflations[i]
+                if wire_inflations is not None and i < len(wire_inflations)
+                else None
+            )
+            if (
+                wi is not None
+                and wi > self.cfg.detectors.wire_inflation_threshold
+            ):
+                # pre-decode grid shaping: the frame's per-block scales
+                # claim far more magnitude than its codes use — the
+                # residual-shaping signature (an honest encoder's
+                # ratio is exactly 1.0)
+                row_flags.append("residual_shaping")
             selected = None if keep is None else bool(keep[slot])
             trust = self.ledger.observe(
                 client, round_id, selected=selected, flags=row_flags,
@@ -381,6 +404,7 @@ class ForensicsPlane:
                     selected=selected,
                     flags=tuple(row_flags),
                     trust=float(trust),
+                    wire_inflation=wi,
                 )
             )
         quarantined_now = self.ledger.quarantined()
@@ -421,6 +445,7 @@ class ForensicsPlane:
         weights: Any = None,
         deltas: Optional[Sequence[int]] = None,
         bucket: Optional[int] = None,
+        wire_inflations: Optional[Sequence[Optional[float]]] = None,
     ) -> RoundEvidence:
         """Digest one closed round: :meth:`prepare` + :meth:`apply` in
         one synchronous call (the chaos harness and the sync round
@@ -431,6 +456,7 @@ class ForensicsPlane:
                 round_id, matrix, valid, clients, aggregate,
                 aggregator=aggregator, weights=weights,
                 deltas=deltas, bucket=bucket,
+                wire_inflations=wire_inflations,
             )
         )
 

@@ -199,7 +199,9 @@ def compression_factor(
 ) -> float:
     """Wire-byte multiplier of a compressed fabric relative to its
     full-precision baseline: 1.0 for ``"off"``, ``2/dtype_bytes`` for
-    ``"bf16"``, and ``(1 + 4/block)/dtype_bytes`` for ``"int8"``. The
+    ``"bf16"``, ``(1 + 4/block)/dtype_bytes`` for ``"int8"`` and the
+    fp8 formats (one byte per value is one byte per value), and
+    ``(0.5 + 4/block)/dtype_bytes`` for packed ``"s4"``. The
     law itself lives on
     :meth:`~byzpy_tpu.parallel.quantization.CommPrecision.wire_bytes_per_value`
     (single source of truth for the blockwise wire layout); this wrapper
@@ -304,7 +306,12 @@ def ps_round_wire_bytes(
 #: precision: compressed frames carry a ``QuantizedWireArray`` header
 #: (mode/block/shape/dtype + the scales array's own pickle framing).
 #: Pinned within tolerance by ``tests/test_serving.py``.
-_SERVING_ENVELOPE_BYTES = {"off": 224, "bf16": 368, "int8": 432}
+_SERVING_ENVELOPE_BYTES = {
+    "off": 224, "bf16": 368, "int8": 432,
+    # sub-int8 frames carry the same QuantizedWireArray header as int8
+    # (mode string length and scale-array framing shift it a few bytes)
+    "fp8": 431, "fp8_e5m2": 436, "s4": 430,
+}
 
 
 def serving_ingress_bytes(
@@ -322,7 +329,7 @@ def serving_ingress_bytes(
     cloudpickle envelope, and the gradient payload —
     ``n_params · dtype_bytes`` scaled by :func:`compression_factor` for
     the ``BYZPY_TPU_WIRE_PRECISION`` fabric the frame rides
-    (``off``/``bf16``/``int8``). Multiply by sustained submissions/sec
+    (``off``/``bf16``/``int8``/``fp8``/``fp8_e5m2``/``s4``). Multiply by sustained submissions/sec
     for the tier's ingress-bandwidth law; the measured side is the
     frontend's per-tenant ``ingress_bytes`` counter and
     ``benchmarks/serving_bench.py``'s accounting lane.

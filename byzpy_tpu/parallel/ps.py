@@ -47,7 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.bundle import ModelBundle
 from ..utils.trees import ravel_pytree_fn
-from .collectives import reshard_q
+from .collectives import reshard_q, reshard_q_ef
 from .mesh import node_axis
 from .quantization import (
     CommPrecision,
@@ -99,15 +99,22 @@ class ShardedUpdateConfig:
     * ``"auto"`` (default) — ``"on"`` whenever the mesh's feature grid
       spans more than one chip, else ``"off"``.
 
-    ``param_gather_precision`` (``None``/``"off"``/``"bf16"``/``"int8"``
-    or a :class:`~byzpy_tpu.parallel.quantization.CommPrecision`)
+    ``param_gather_precision`` (``None``/``"off"``/``"bf16"``/``"int8"``/
+    ``"fp8"``/``"fp8_e5m2"``/``"s4"`` or a
+    :class:`~byzpy_tpu.parallel.quantization.CommPrecision`)
     compresses the params all-gather wire payload. The carried state
     always leads with each chip's authoritative EXACT flat param shard;
     the (possibly lossy) gathered replica only feeds the next round's
     forward/backward, so compression error is bounded per round and
     never compounds into the optimizer state. ``off`` (default) keeps
     the gather f32 and the sharded round bit-identical (coordinate-wise
-    aggregators; elementwise optimizers) to the replicated one.
+    aggregators; elementwise optimizers) to the replicated one. A
+    precision with ``error_feedback=True`` additionally carries the
+    gather's quantization residual BESIDE the optimizer state
+    (feature-sharded over the same grid, donated with it): each round's
+    encode folds the previous round's residual in, so the gathered
+    replica's error dithers around zero instead of re-rounding the same
+    way every round — the sub-int8 modes (fp8/s4) lean on this.
 
     Trajectory contract: with an elementwise optimizer (SGD, momentum,
     Adam — anything whose update is a per-coordinate function of
@@ -175,15 +182,28 @@ def build_ps_train_step(
     sharding before aggregation; without a mesh it is the same program on
     one device.
 
-    ``comm_precision`` (``"off"``/``"bf16"``/``"int8"`` or a
+    ``comm_precision`` (``"off"``/``"bf16"``/``"int8"``/``"fp8"``/
+    ``"fp8_e5m2"``/``"s4"`` or a
     :class:`~byzpy_tpu.parallel.quantization.CommPrecision`) compresses
     the gradient-transpose wire traffic — the round's dominant collective
     at ``d >= 1e5``: the stacked gradient matrix is encoded *before* the
     node->feature resharding constraint, so the all-to-all XLA inserts
-    moves int8 codes (+ per-block f32 scales) or bf16 instead of f32, and
+    moves coded bytes (int8/fp8 codes + per-block f32 scales, packed s4
+    nibbles at half a byte per value, or bf16) instead of f32, and
     every device decodes after the transpose. Aggregation always runs on
     the decoded full-precision matrix. The default ``"off"`` produces a
-    program bit-identical to the uncompressed fabric.
+    program bit-identical to the uncompressed fabric. With
+    ``error_feedback=True`` on the precision, each node's ``(n, d)``
+    residual rides the carried state (node-sharded, donated): round
+    ``t`` transmits ``g_t + e_{t-1}`` and carries
+    ``e_t = (g_t + e_{t-1}) - decode(encode(g_t + e_{t-1}))``, so the
+    per-node transmitted stream telescopes to the true gradient stream
+    plus one round's bounded error — sub-int8 compression stops
+    compounding (the EF convergence study in
+    ``benchmarks/ef_convergence_study.py`` measures exactly this).
+    Error feedback changes the carried-state STRUCTURE: ``opt_state0``
+    becomes ``(base_opt_state, ef_state)`` and the step returns the
+    updated residuals in the same slot — callers thread it opaquely.
 
     ``sharded_update`` (:class:`ShardedUpdateConfig`, a mode string, a
     bool, or ``None`` = auto) controls the weight update's layout. When
@@ -271,8 +291,11 @@ def build_ps_train_step(
         # block (scales shard alongside the codes)
         pad_grid = 1
         if mesh is not None and feat_shards > 1:
+            # blockwise gathers (int8/fp8/s4) pad to the quantization
+            # block too, so no shard ever splits a block (and the packed
+            # s4 payload's half-length stays grid-divisible)
             pad_grid = feat_shards * (
-                gather_p.block if gather_p.mode == "int8" else 1
+                gather_p.block if gather_p.blockwise else 1
             )
         d_pad = -(-d // pad_grid) * pad_grid
         flat_padded0 = jnp.pad(flat0, (0, d_pad - d))
@@ -292,6 +315,32 @@ def build_ps_train_step(
         opt_state0 = (flat_padded0, opt.init(flat_padded0))
     else:
         opt_state0 = opt.init(bundle.params)
+
+    # -- error-feedback residual state ------------------------------------
+    # The EF residuals are ROUND STATE: they live beside the optimizer
+    # state (donated with it, feature-/node-sharded like the tensors
+    # they compensate) and change the carried-state structure only when
+    # EF is actually on — the default round's opt_state is untouched.
+    grad_res_dtype = grad_dtype if grad_dtype is not None else param_dtype
+    ef_transpose = mesh is not None and comm.enabled and comm.error_feedback
+    ef_gather = (
+        su_on
+        and flat_sharding is not None
+        and gather_p.enabled
+        and gather_p.error_feedback
+    )
+    ef0 = {}
+    if ef_transpose:
+        ef0["transpose"] = jax.device_put(
+            jnp.zeros((cfg.n_nodes, d), grad_res_dtype), row_spec
+        )
+    if ef_gather:
+        ef0["gather"] = jax.device_put(
+            jnp.zeros((d_pad,), param_dtype), flat_sharding
+        )
+    has_ef = bool(ef0)
+    if has_ef:
+        opt_state0 = (opt_state0, ef0)
 
     def build_matrix(grads_n, key):
         """Honest rows + byzantine rows from the (n, d) per-node gradient
@@ -314,27 +363,38 @@ def build_ps_train_step(
     def transpose_compressed(grads_n):
         """Encoded gradient transpose: pin the encoded payload to the node
         layout, re-pin it to the feature layout (the reshard between the
-        two constraints IS the wire hop — so the all-to-all moves
-        int8/bf16), and decode feature-sharded. The decoded matrix is
+        two constraints IS the wire hop — so the all-to-all moves coded
+        bytes), and decode feature-sharded. The decoded matrix is
         constrained too, else the partitioner replicates the aggregation
         input with an (n, d) f32 all-reduce that dwarfs the transpose.
         (One call into :func:`~byzpy_tpu.parallel.collectives.reshard_q`,
         the fabric-wide compressed-reshard primitive.)"""
         return reshard_q(grads_n, row_spec, feat_spec, precision=comm)
 
-    def gather_flat_params(new_flat):
+    def gather_flat_params(new_flat, ef_state):
         """The sharded round's ONE parameter collective: all-gather the
         refreshed flat params from the feature shards back to every chip
-        (optionally bf16/int8 on the wire — the exact shard each chip
+        (optionally compressed on the wire — the exact shard each chip
         owns stays in the carried opt state, so gather loss never
-        compounds across rounds)."""
+        compounds across rounds; with EF the gather residual rides
+        ``ef_state`` and dithers the replica error around zero)."""
         if flat_sharding is None:
-            return new_flat
-        return reshard_q(
-            new_flat, flat_sharding, repl_sharding, precision=gather_p
+            return new_flat, ef_state
+        if ef_gather:
+            gathered, new_r = reshard_q_ef(
+                new_flat, ef_state["gather"], flat_sharding, repl_sharding,
+                precision=gather_p,
+            )
+            return gathered, {**ef_state, "gather": new_r}
+        return (
+            reshard_q(new_flat, flat_sharding, repl_sharding, precision=gather_p),
+            ef_state,
         )
 
     def train_step(params, opt_state, xs, ys, key):
+        ef_state = {}
+        if has_ef:
+            opt_state, ef_state = opt_state
         if node_spec is not None:
             xs = jax.lax.with_sharding_constraint(xs, node_spec)
             ys = jax.lax.with_sharding_constraint(ys, node_spec)
@@ -347,8 +407,18 @@ def build_ps_train_step(
             # nodes transmit too), and the attack/masking runs on the
             # decoded, feature-sharded rows: the omniscient adversary sees
             # the wire view of the honest gradients.
+            if ef_transpose:
+                # EF: the wire carries g + e, the new residual stays
+                # node-sharded beside the optimizer state
+                decoded, new_tr = reshard_q_ef(
+                    grads, ef_state["transpose"], row_spec, feat_spec,
+                    precision=comm,
+                )
+                ef_state = {**ef_state, "transpose": new_tr}
+            else:
+                decoded = transpose_compressed(grads)
             matrix = jax.lax.with_sharding_constraint(
-                build_matrix(transpose_compressed(grads), key), feat_spec
+                build_matrix(decoded, key), feat_spec
             )
         else:
             matrix = build_matrix(grads, key)
@@ -402,7 +472,8 @@ def build_ps_train_step(
                     else leaf,
                     inner,
                 )
-            params = unravel(gather_flat_params(new_flat)[:d])
+            gathered, ef_state = gather_flat_params(new_flat, ef_state)
+            params = unravel(gathered[:d])
             opt_state = (new_flat, inner)
         else:
             update = unravel(agg_flat)
@@ -412,6 +483,19 @@ def build_ps_train_step(
             "honest_loss": jnp.mean(losses[:h]),
             "agg_grad_norm": agg_norm,
         }
+        if has_ef:
+            # shard-local residual-energy metrics (the convergence study
+            # watches these stay bounded — a drifting residual is the
+            # "EF compounding" failure mode)
+            if ef_transpose:
+                metrics["ef_transpose_norm"] = jnp.sqrt(
+                    jnp.sum(jnp.square(ef_state["transpose"].astype(jnp.float32)))
+                )
+            if ef_gather:
+                metrics["ef_gather_norm"] = jnp.sqrt(
+                    jnp.sum(jnp.square(ef_state["gather"].astype(jnp.float32)))
+                )
+            opt_state = (opt_state, ef_state)
         return params, opt_state, metrics
 
     return train_step, opt_state0

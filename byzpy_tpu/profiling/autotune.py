@@ -11,6 +11,10 @@ the roofline profiler showed dominating the round loop —
 * ``quant`` — ``parallel.quantization.quantize_blockwise`` (the
   compressed-fabric encode; candidates stay multiples of the
   quantization block so scales never straddle a grid step)
+* ``quant_fp8`` / ``quant_s4`` — the sub-int8 encodes
+  (``parallel.quantization.encode_blockwise``; same block-multiple
+  rule, separate cache keys because the f8 cast and nibble packing
+  change the kernels' arithmetic intensity)
 
 — and persists each winner in the shape-keyed on-disk cache
 (:mod:`.tilecache`) that ``_auto_tile`` / ``_auto_selection_tile`` /
@@ -45,6 +49,8 @@ CANDIDATES: Dict[str, Tuple[int, ...]] = {
     "sorted_reduce": (512, 1024, 2048, 4096),
     "meamed": (256, 512, 1024, 2048),
     "quant": (1024, 2048, 4096, 8192, 16384),
+    "quant_fp8": (1024, 2048, 4096, 8192, 16384),
+    "quant_s4": (1024, 2048, 4096, 8192, 16384),
     "ragged": (512, 1024, 2048, 4096, 8192),
 }
 
@@ -76,6 +82,13 @@ def _kernel_runner(family: str) -> Callable:
 
         return lambda x, tile: quantize_blockwise(
             x, tile=tile, use_pallas=True
+        ).values
+    if family in ("quant_fp8", "quant_s4"):
+        from ..parallel.quantization import encode_blockwise
+
+        mode = "fp8" if family == "quant_fp8" else "s4"
+        return lambda x, tile: encode_blockwise(
+            x, mode, tile=tile, use_pallas=True
         ).values
     if family == "ragged":
         import jax.numpy as jnp

@@ -49,7 +49,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-DIM = 32
+#: Above the wire codec's WIRE_QUANT_MIN_SIZE floor, so the EF-residual
+#: leg's s4 downlink actually quantizes (a smaller dim would ride the
+#: lossless small-array path and the residual invariants would be
+#: vacuously zero).
+DIM = 2048
 TENANT = "drill"
 
 
@@ -87,12 +91,36 @@ def _durability(directory: str):
 
 async def _serve(directory: str) -> None:
     from .. import observability
+    from ..engine.actor import wire
     from ..serving import ServingFrontend
+    from ..serving.frontend import LOSSLESS_REPLY
 
     observability.enable()
     fe = ServingFrontend(
         [_tenant_config()], durability=_durability(directory)
     )
+
+    def hook(request):
+        # downlink door for the EF-residual leg: the client pulls the
+        # tenant's compressed (s4 + error-feedback) model broadcast —
+        # the encode mutates the residual the snapshot must cover. The
+        # reply re-ships the DECODED downlink lossless, which is
+        # exactly the array a real client holds after decoding.
+        if request.get("kind") == "model":
+            try:
+                frame = fe.broadcast_frame(TENANT, precision="s4")
+            except RuntimeError:
+                return {"kind": "model", "aggregate": None}
+            payload = wire.decode(frame[4:])
+            return {
+                "kind": "model",
+                "aggregate": payload["aggregate"],
+                "round": payload["round"],
+                LOSSLESS_REPLY: True,
+            }
+        return None
+
+    fe.request_hook = hook
     host, port = await fe.serve("127.0.0.1", 0)
     rec = fe.recovered.get(TENANT)
     print(f"PORT {port}", flush=True)
@@ -176,12 +204,31 @@ async def _drive_kill_recover(seed: int, directory: str) -> dict:
             r = await c.close_round(TENANT)
             assert r["closed"] == 0, r
             live_digests[0] = r["digest"]
+            # EF-residual leg, phase A: pull the compressed (s4 + error
+            # feedback) model broadcast so the tenant carries a residual,
+            # then close a second round — the snapshot_every=2 cadence
+            # snapshots AT that close, capturing the residual
+            model = await c._call({"kind": "model", "tenant": TENANT})  # noqa: SLF001
+            assert model["aggregate"] is not None
+            for i in range(6):
+                ack = await c.submit(TENANT, f"c{i}", 1, _grad(rng))
+                assert ack["accepted"], ack
+                acked.append((f"c{i}", ack_seq(c)))
+            r = await c.close_round(TENANT)
+            assert r["closed"] == 1, r
+            live_digests[1] = r["digest"]
+            # the residual the snapshot should have captured (recorded
+            # BEFORE the next pull mutates it past the snapshot)
+            ef_at_snapshot = (await c.stats(TENANT))["stats"][
+                "ef_residual_norm"
+            ]
+            model = await c._call({"kind": "model", "tenant": TENANT})  # noqa: SLF001
             # phase 2: accepted-but-unfolded submissions, then the kill.
             # The client records these as AMBIGUOUS (it will replay them).
             ambiguous: List[Tuple[str, int, np.ndarray]] = []
             for i in range(5):
                 g = _grad(rng)
-                ack = await c.submit(TENANT, f"c{i}", 1, g)
+                ack = await c.submit(TENANT, f"c{i}", 2, g)
                 assert ack["accepted"], ack
                 seq = ack_seq(c)
                 acked.append((f"c{i}", seq))
@@ -197,7 +244,7 @@ async def _drive_kill_recover(seed: int, directory: str) -> dict:
                 # the dedup layer must absorb them (accepted, duplicate)
                 dup = 0
                 for client, seq, g in ambiguous:
-                    ack = await c.submit(TENANT, client, 1, g, seq=seq)
+                    ack = await c.submit(TENANT, client, 2, g, seq=seq)
                     assert ack["accepted"], ack
                     dup += ack["reason"] == "duplicate"
                 # fresh post-recovery traffic across several rounds (at
@@ -213,12 +260,43 @@ async def _drive_kill_recover(seed: int, directory: str) -> dict:
                         closed_rounds.append(r["closed"])
                         live_digests[r["closed"]] = r["digest"]
 
+                # EF-residual leg, phase B: the recovered residual is
+                # either the snapshot's BIT-EXACT capture (same norm to
+                # the last float) or None (WAL-tail-only recovery /
+                # snapshot save lost to the kill) — the documented
+                # safe-to-reset branch
+                ef_recovered = (await c.stats(TENANT))["stats"][
+                    "ef_residual_norm"
+                ]
+                if ef_recovered is not None:
+                    ef_branch = "snapshot_bitexact"
+                    ef_ok = ef_recovered == ef_at_snapshot
+                else:
+                    ef_branch = "reset_safe"
+                    ef_ok = True  # non-divergence asserted below
+                ef_norms_post = []
                 for phase in range(3):
                     for i in range(4):
-                        ack = await c.submit(TENANT, f"c{i}", 1, _grad(rng))
+                        ack = await c.submit(TENANT, f"c{i}", 2, _grad(rng))
                         assert ack["accepted"], ack
                         acked.append((f"c{i}", ack_seq(c)))
                     await close_all()
+                    # keep the downlink EF stream alive across recovery:
+                    # every pull must stay a bounded, non-divergent
+                    # residual (no silent divergence after recover)
+                    model = await c._call(  # noqa: SLF001
+                        {"kind": "model", "tenant": TENANT}
+                    )
+                    agg = np.asarray(model["aggregate"], np.float32)
+                    stats_now = (await c.stats(TENANT))["stats"]
+                    ef_norms_post.append(stats_now["ef_residual_norm"])
+                    # residual bound: one round's s4 quantization error,
+                    # generously slacked (absmax/14 per coordinate x 4)
+                    bound = 4 * float(np.abs(agg).max()) / 14 * np.sqrt(DIM)
+                    ef_ok = ef_ok and (
+                        ef_norms_post[-1] is not None
+                        and ef_norms_post[-1] <= bound
+                    )
                 stats = (await c.stats(TENANT))["stats"]
                 metrics_text = await _scrape(server2.port)
         finally:
@@ -235,6 +313,9 @@ async def _drive_kill_recover(seed: int, directory: str) -> dict:
             "duplicates_absorbed": dup,
             "outstanding_after_drain": stats["outstanding"],
             "recovered_from": stats["recovered_from"],
+            "ef_branch": ef_branch,
+            "ef_residual_ok": bool(ef_ok),
+            "ef_norms_post_recovery": ef_norms_post,
             "recovery_metric_exported": "byzpy_recoveries_total" in metrics_text,
             "retry_metric_exported": "byzpy_retry_total" in metrics_text,
             "checkpoint_metric_exported": (
@@ -245,6 +326,7 @@ async def _drive_kill_recover(seed: int, directory: str) -> dict:
     inv["violations"] += int(stats["outstanding"] != 0)
     inv["violations"] += int(stats["recovered_from"] is None)
     inv["violations"] += int(dup != len(ambiguous))
+    inv["violations"] += int(not ef_ok)
     return inv
 
 

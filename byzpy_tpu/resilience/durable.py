@@ -175,6 +175,12 @@ class RecoveredTenant:
     failed_rounds: int = 0
     ingress_bytes: int = 0
     stats_rounds: int = 0
+    #: downlink error-feedback residual captured by the snapshot (the
+    #: sub-int8 broadcast fabric's carried state); None when the tenant
+    #: never broadcast compressed or recovery had no snapshot — the
+    #: frontend resets to zero then (documented safe: EF self-corrects
+    #: within one round's quantization bound)
+    ef_residual: Any = None
     from_snapshot: Optional[int] = None
     skipped_corrupt: List[int] = field(default_factory=list)
     torn_segments: int = 0
@@ -246,10 +252,18 @@ class TenantDurability:
         round_submitted: int,
         arrived_s: float,
         gradient: Any,
+        wire_inflation: Optional[float] = None,
     ) -> None:
-        """WRITE-AHEAD: called before the accept ack is returned."""
+        """WRITE-AHEAD: called before the accept ack is returned.
+        ``wire_inflation`` (the ingress-measured pre-decode block
+        ratio) persists WITH the accept: a shaped frame admitted just
+        before a crash must still reach the forensics detector when
+        its replayed row folds after recovery."""
         self._append(
-            (ACCEPT, wal_id, client, seq, round_submitted, arrived_s, gradient)
+            (
+                ACCEPT, wal_id, client, seq, round_submitted, arrived_s,
+                gradient, wire_inflation,
+            )
         )
 
     def record_round(
@@ -352,6 +366,7 @@ class TenantDurability:
             rec.failed_rounds = int(state.get("failed_rounds", 0))
             rec.ingress_bytes = int(state.get("ingress_bytes", 0))
             rec.stats_rounds = int(state.get("stats_rounds", 0))
+            rec.ef_residual = state.get("ef_residual")
             if "segment_index" in state:
                 self._snap_segments[step] = int(state["segment_index"])
             pending: Dict[int, dict] = {
@@ -375,7 +390,11 @@ class TenantDurability:
             for r in records:
                 kind = r[0]
                 if kind == ACCEPT:
-                    _, wal_id, client, seq, round_sub, arrived_s, grad = r
+                    # pre-round-15 segments carry 7 fields (no wire
+                    # inflation); read both shapes so an upgrade can
+                    # recover an old directory
+                    _, wal_id, client, seq, round_sub, arrived_s, grad = r[:7]
+                    wi = r[7] if len(r) > 7 else None
                     if wal_id < rec.next_wal_id and wal_id not in pending:
                         # predates the snapshot: already folded, dropped,
                         # or carried in the snapshot's pending set
@@ -383,6 +402,7 @@ class TenantDurability:
                     pending[wal_id] = {
                         "w": wal_id, "c": client, "q": seq,
                         "r": round_sub, "t": arrived_s, "g": grad,
+                        "wi": wi,
                     }
                     rec.next_wal_id = max(rec.next_wal_id, wal_id + 1)
                     if seq is not None:

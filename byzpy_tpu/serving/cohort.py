@@ -48,6 +48,11 @@ class Cohort:
     weights: np.ndarray
     clients: Tuple[str, ...]
     first_arrival_s: float
+    #: per-valid-row pre-decode wire block-inflation ratios, aligned
+    #: with ``clients`` (None entries for lossless/in-process rows) —
+    #: the forensics residual-shaping feature, carried so sync round
+    #: closers and the chaos harness see what the ingress measured
+    wire_inflations: Tuple[Optional[float], ...] = ()
 
     @property
     def bucket(self) -> int:
@@ -98,6 +103,9 @@ def build_cohort(
             weights=weights,
             clients=tuple(s.client for s in submissions),
             first_arrival_s=min(s.arrived_s for s in submissions),
+            wire_inflations=tuple(
+                getattr(s, "wire_inflation", None) for s in submissions
+            ),
         )
 
 

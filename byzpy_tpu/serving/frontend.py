@@ -286,7 +286,7 @@ class _Tenant:
         "last_cohort_clients", "held", "telemetry", "track",
         "seqs", "duplicates", "durability", "breaker", "next_wal_id",
         "quarantine_drops", "recovered", "forensics", "compile_site",
-        "compile_warn_high",
+        "compile_warn_high", "ef_residual",
     )
 
     def __init__(
@@ -376,6 +376,15 @@ class _Tenant:
         #: warned about (each NEW excess size warns once)
         self.compile_site = f"serving.masked_aggregate:{cfg.name}"
         self.compile_warn_high = 0
+        #: downlink error-feedback residual (``(dim,)`` f32, lazily
+        #: zeros on the first compressed broadcast): what the sub-int8
+        #: broadcast fabric lost last round and re-injects this round
+        #: (:meth:`ServingFrontend.broadcast_frame`). ROUND STATE —
+        #: captured in durable snapshots; a WAL-tail recovery resets it
+        #: to None, which is SAFE: any residual start point only shifts
+        #: the telescoped stream by one round's bounded quantization
+        #: error (pinned by the extended SIGKILL drill)
+        self.ef_residual: Optional[np.ndarray] = None
         self.telemetry = _TenantTelemetry(cfg.name, cfg.dim)
 
     def note_seq(self, client: str, seq: int) -> None:
@@ -528,6 +537,11 @@ class ServingFrontend:
         t.failed_rounds = rec.failed_rounds
         t.ingress_bytes = rec.ingress_bytes
         t.stats.rounds = rec.stats_rounds
+        # downlink EF residual: bit-exact from the snapshot; rounds the
+        # WAL replayed PAST the snapshot make it stale, which error
+        # feedback self-corrects within one round's quantization bound
+        # (safe-to-reset contract — see _Tenant.ef_residual)
+        t.ef_residual = rec.ef_residual
         # accepted-before-death, never folded: back into the queue (the
         # arrival stamp is re-issued on THIS process's clock — monotonic
         # time does not survive a process boundary)
@@ -537,6 +551,11 @@ class ServingFrontend:
                 client=p["c"], round_submitted=int(p["r"]),
                 gradient=p["g"], arrived_s=now,
                 seq=p["q"], wal_id=int(p["w"]),
+                # the ingress-measured pre-decode block ratio survives
+                # the crash with its accept record: a shaped frame
+                # admitted just before the kill still reaches the
+                # residual_shaping detector when its replay folds
+                wire_inflation=p.get("wi"),
             )
             for p in rec.pending
         ]
@@ -556,6 +575,7 @@ class ServingFrontend:
         t.durability.record_accept(
             sub.wal_id, sub.client, sub.seq, sub.round_submitted,
             sub.arrived_s, sub.gradient,
+            wire_inflation=sub.wire_inflation,
         )
 
     def _maybe_snapshot(self, t: _Tenant) -> None:
@@ -580,11 +600,14 @@ class ServingFrontend:
             "failed_rounds": t.failed_rounds,
             "ingress_bytes": t.ingress_bytes,
             "stats_rounds": t.stats.rounds,
+            "ef_residual": (
+                None if t.ef_residual is None else np.asarray(t.ef_residual)
+            ),
             "pending": [
                 {
                     "w": s.wal_id, "c": s.client, "q": s.seq,
                     "r": s.round_submitted, "t": s.arrived_s,
-                    "g": s.gradient,
+                    "g": s.gradient, "wi": s.wire_inflation,
                 }
                 for s in (*t.queue.snapshot_items(), *t.held)
             ],
@@ -647,6 +670,7 @@ class ServingFrontend:
         gradient: Any,
         *,
         seq: Optional[int] = None,
+        wire_inflation: Optional[float] = None,
     ) -> Tuple[bool, str]:
         """Admit one submission: ``(accepted, reason)``.
 
@@ -662,7 +686,10 @@ class ServingFrontend:
         write-ahead log before this returns — the ack is a durable
         promise. ``seq`` keys must be per-client monotonic (the
         :class:`ServingClient` auto-assigns them); only definitively
-        un-acked submissions should be retried under the same key."""
+        un-acked submissions should be retried under the same key.
+        ``wire_inflation`` (stamped by the TCP ingress from the
+        still-compressed frame) is the pre-decode block-inflation ratio
+        the forensics plane's residual-shaping detector screens."""
         t = self._tenants.get(tenant)
         if t is None:
             if obs_runtime.STATE.enabled:
@@ -718,6 +745,9 @@ class ServingFrontend:
             arrived_s=now,
             seq=None if seq is None else int(seq),
             wal_id=(t.next_wal_id if t.durability is not None else None),
+            wire_inflation=(
+                None if wire_inflation is None else float(wire_inflation)
+            ),
         )
         if t.durability is not None:
             # capacity gate BEFORE the write-ahead append, so a row is
@@ -801,6 +831,7 @@ class ServingFrontend:
             tenant = request.get("tenant", "")
             try:
                 seq = request.get("seq")
+                wi = request.get("_wire_inflation")
                 with obs_tracing.span(
                     "serving.admission",
                     tenant=tenant if isinstance(tenant, str) else "?",
@@ -812,6 +843,7 @@ class ServingFrontend:
                         int(request.get("round", 0)),
                         request.get("gradient"),
                         seq=None if seq is None else int(seq),
+                        wire_inflation=None if wi is None else float(wi),
                     )
             except Exception:  # noqa: BLE001 — client bug, not ours
                 self.malformed_requests += 1
@@ -1027,6 +1059,11 @@ class ServingFrontend:
                 if len(subs) == cohort.m
                 else None
             )
+            wire_inflations = (
+                [s.wire_inflation for s in subs]
+                if len(subs) == cohort.m
+                else None
+            )
             return t.forensics.prepare(
                 t.round_id,
                 cohort.matrix,
@@ -1038,6 +1075,7 @@ class ServingFrontend:
                 deltas=deltas,
                 bucket=cohort.bucket,
                 precomputed=precomputed,
+                wire_inflations=wire_inflations,
             )
         except Exception:  # noqa: BLE001 — attribution is an observer,
             # not a round participant
@@ -1396,7 +1434,27 @@ class ServingFrontend:
                     with obs_tracing.span(
                         "serving.ingress.decode", bytes=length
                     ):
-                        request = wire.decode(body)
+                        # stats come from the STILL-COMPRESSED payload
+                        # (post-HMAC): the per-block inflation ratio a
+                        # residual-shaping client cannot scrub after
+                        # the fact rides into admission alongside the
+                        # decoded gradient
+                        request, wire_stats = wire.decode_with_stats(body)
+                        if isinstance(request, dict):
+                            # the ingress is the ONLY author of this
+                            # key: a client-stamped value (e.g. a
+                            # shaping attacker whitewashing itself
+                            # with 1.0) is discarded, then the
+                            # measured ratio — when the frame carried
+                            # a blockwise payload — is stamped fresh
+                            request.pop("_wire_inflation", None)
+                            if (
+                                wire_stats is not None
+                                and request.get("kind") == "submit"
+                            ):
+                                request["_wire_inflation"] = wire_stats[
+                                    "max_inflation"
+                                ]
                         # decode adopted any _trace_ctx stamp, but the
                         # decode span's exit resets the contextvar to
                         # its token — capture the adopted position and
@@ -1477,6 +1535,50 @@ class ServingFrontend:
         """Current server round of ``tenant``."""
         return self._tenants[tenant].round_id
 
+    def broadcast_frame(
+        self, tenant: str, *, precision: Optional[str] = None
+    ) -> bytes:
+        """Encode the tenant's latest broadcast aggregate as a model
+        frame for the client downlink — the frontend→client half of the
+        million-client wire, compressed per ``precision`` (default: the
+        ``BYZPY_TPU_WIRE_PRECISION`` fabric) with per-round **error
+        feedback** on the blockwise modes: the residual the compressed
+        broadcast lost last round is folded into this round's payload
+        before encoding, so a client integrating the stream sees the
+        true aggregate trajectory plus ONE round's bounded error. The
+        residual is tenant round state: durable snapshots capture it
+        bit-exact; a WAL-tail recovery restarts it at zero (safe —
+        documented at ``_Tenant.ef_residual``, drilled by
+        ``resilience.drill``). Raises ``ValueError`` for an unknown
+        tenant, ``RuntimeError`` before the first closed round."""
+        t = self._tenants.get(tenant)
+        if t is None:
+            raise ValueError(f"unknown tenant {tenant!r}")
+        if t.last_aggregate is None:
+            raise RuntimeError(
+                f"tenant {tenant!r} has not closed a round yet — no "
+                "aggregate to broadcast"
+            )
+        mode = wire.wire_precision() if precision is None else (
+            precision if precision in wire.WIRE_MODES else "off"
+        )
+        agg = np.asarray(t.last_aggregate, np.float32).reshape(-1)
+        if mode in wire.BLOCKWISE_WIRE_MODES:
+            payload, t.ef_residual = wire.ef_precompensate(
+                agg, t.ef_residual, mode
+            )
+        else:
+            payload = agg
+        return wire.encode(
+            {
+                "kind": "model",
+                "tenant": tenant,
+                "round": t.round_id - 1,
+                "aggregate": payload,
+            },
+            precision=mode,
+        )
+
     def reset_round_stats(self) -> None:
         """Zero every tenant's round-latency/cohort statistics window —
         the warmup→measure boundary for benchmarks (compile-round
@@ -1539,6 +1641,16 @@ class ServingFrontend:
                 if t.recovered is not None
                 else None
             ),
+            # downlink error-feedback residual energy (None = no
+            # compressed broadcast yet / reset on WAL-tail recovery) —
+            # the SIGKILL drill reads this to prove the residual was
+            # either restored bit-exact from the snapshot or safely
+            # reset (bounded, non-divergent) after recovery
+            "ef_residual_norm": (
+                None
+                if t.ef_residual is None
+                else float(np.linalg.norm(np.asarray(t.ef_residual)))
+            ),
             # which door serves this tenant's rounds (False = bucket
             # ladder: ragged disabled, or no masked program)
             "ragged_served": (
@@ -1586,8 +1698,17 @@ def serve_frame(frontend: ServingFrontend, frame_body: bytes) -> bytes:
     """In-process wire path: decode one frame body, serve it, encode the
     reply — the exact codec/HMAC round the TCP ingress runs, minus the
     socket (the bench's 10k-client swarm exercises the wire cost this
-    way without 10k TCP connections)."""
-    return encode_reply(frontend.handle_request(wire.decode(frame_body)))
+    way without 10k TCP connections). Pre-decode block stats are
+    threaded exactly like ``_handle_conn``: the ingress is the only
+    author of ``_wire_inflation`` (a client-stamped value is
+    discarded), and the measured ratio rides into admission when the
+    frame carried a blockwise payload."""
+    request, wire_stats = wire.decode_with_stats(frame_body)
+    if isinstance(request, dict):
+        request.pop("_wire_inflation", None)
+        if wire_stats is not None and request.get("kind") == "submit":
+            request["_wire_inflation"] = wire_stats["max_inflation"]
+    return encode_reply(frontend.handle_request(request))
 
 
 class ServingClient:
@@ -1614,6 +1735,7 @@ class ServingClient:
         *,
         retry: Optional[RetryPolicy] = None,
         rng: Optional[random.Random] = None,
+        error_feedback: bool = False,
     ) -> None:
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
@@ -1623,6 +1745,16 @@ class ServingClient:
         self._seq = 0
         #: reconnects performed by the retry driver (introspection)
         self.reconnects = 0
+        #: uplink error feedback over the lossy submit fabric: with a
+        #: blockwise ``BYZPY_TPU_WIRE_PRECISION`` active, each (tenant,
+        #: client) keeps the residual its last frame's quantization
+        #: lost and folds it into the next submission BEFORE the wire
+        #: encode (``wire.ef_precompensate``) — the client-side half of
+        #: the sub-int8 fabric. Off by default: an EF client's payload
+        #: deliberately differs from its raw gradient, which a
+        #: bit-parity test must opt into.
+        self.error_feedback = bool(error_feedback)
+        self._ef_residuals: Dict[Tuple[str, str], np.ndarray] = {}
 
     async def __aenter__(self) -> "ServingClient":
         return self
@@ -1720,6 +1852,15 @@ class ServingClient:
             self._seq += 1
         else:
             self._seq = max(self._seq, int(seq) + 1)
+        gradient = np.asarray(gradient)
+        if self.error_feedback and wire.wire_precision() in (
+            wire.BLOCKWISE_WIRE_MODES
+        ):
+            gradient, self._ef_residuals[(tenant, client)] = (
+                wire.ef_precompensate(
+                    gradient, self._ef_residuals.get((tenant, client))
+                )
+            )
         # the round-causality chain starts HERE: the submit span's
         # context is stamped onto the frame by wire.encode, so the
         # frontend's admission span (possibly another process) links

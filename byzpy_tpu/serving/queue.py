@@ -40,6 +40,11 @@ class Submission:
     arrived_s: float
     seq: Optional[int] = None
     wal_id: Optional[int] = None
+    #: PRE-decode per-block inflation ratio of the submission's
+    #: compressed wire frame (``engine.actor.wire.frame_inflation``;
+    #: ``None`` for lossless/in-process submissions) — the forensics
+    #: plane's residual-shaping feature
+    wire_inflation: Optional[float] = None
 
 
 class AdmissionQueue:
