@@ -1315,6 +1315,94 @@ def _run_wire(args) -> dict:
     }
 
 
+def _run_batched_door(args) -> dict:
+    """The wire-rate batched front door over REAL TCP: one connection
+    writes a burst of frames in a single send, so the server's read
+    loop drains several complete frames per event-loop wakeup and
+    serves them through ONE vectorized decode + admission pass. The row
+    proves three contracts: (a) the door actually batches
+    (``max_batch > 1``), (b) the acks are identical to serving the same
+    bodies through the per-frame door, and (c) telemetry stays exact —
+    ``byzpy_wire_frames_total{direction=rx}`` advances by exactly the
+    number of frames despite the amortized decode."""
+    from byzpy_tpu import observability as obs
+    from byzpy_tpu.observability import metrics as obs_metrics
+    from byzpy_tpu.serving.frontend import serve_frame
+
+    n = 64 if args.smoke else 256
+    d = max(args.dim, 4096)
+    os.environ["BYZPY_TPU_WIRE_PRECISION"] = "s4"
+    rng = np.random.default_rng(9)
+    bodies = [
+        wire.encode({
+            "kind": "submit", "tenant": "door", "client": f"c{i}",
+            "round": 0,
+            "gradient": rng.normal(size=d).astype(np.float32),
+            "seq": 0,
+        })[4:]
+        for i in range(n)
+    ]
+    os.environ.pop("BYZPY_TPU_WIRE_PRECISION", None)
+
+    def mk_fe():
+        # window far beyond the burst so no round closes mid-stream and
+        # ack round ids are deterministic on both doors
+        return ServingFrontend([TenantConfig(
+            name="door", dim=d,
+            aggregator=CoordinateWiseTrimmedMean(f=1),
+            cohort_cap=n, window_s=60.0, queue_capacity=2 * n,
+        )])
+
+    obs.enable()
+    reg = obs_metrics.registry()
+    rx = reg.counter("byzpy_wire_frames_total", labels={"direction": "rx"})
+    rx0 = rx.value
+    hist = reg.histogram("byzpy_ingress_batch_size")
+    hist0 = hist.count
+
+    async def run():
+        fe = mk_fe()
+        await fe.start()
+        host, port = await fe.serve()
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(
+            b"".join(wire._HEADER.pack(len(b)) + b for b in bodies)
+        )
+        writer.write_eof()
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        await fe.close()
+        return data, fe
+
+    data, fe = asyncio.run(run())
+    rx_delta = rx.value - rx0
+    batches_observed = hist.count - hist0
+    acks = []
+    while data:
+        (ln,) = wire._HEADER.unpack(data[:4])
+        acks.append(wire.decode(data[4:4 + ln]))
+        data = data[4 + ln:]
+    obs.disable()
+
+    fe_p = mk_fe()
+    acks_p = [wire.decode(serve_frame(fe_p, b)[4:]) for b in bodies]
+    return {
+        "lane": "batched_door",
+        "dim": d,
+        "frames": n,
+        "batches": fe.ingress_batches,
+        "max_batch": fe.ingress_max_batch,
+        "frames_per_wakeup": round(
+            fe.ingress_frames_batched / max(fe.ingress_batches, 1), 2
+        ),
+        "batch_size_histogram_count": batches_observed,
+        "rx_frames_counted": rx_delta,
+        "bad_frames": fe.bad_frames,
+        "parity": "acks-identical" if acks == acks_p else "DIVERGED",
+    }
+
+
 def _assert_runner_smoke(args, runner_row: dict) -> None:
     """The runner lane's CI contract: real processes closed every
     round at bit parity, nothing failed/forged, and the lane is
@@ -1470,6 +1558,9 @@ def main() -> None:
     wire_row = _run_wire(args)
     _emit(wire_row, args.out)
 
+    door = _run_batched_door(args)
+    _emit(door, args.out)
+
     scale = _run_scale(args)
     _emit(scale, args.out)
 
@@ -1528,6 +1619,8 @@ def main() -> None:
             for n in args.scale_shards
         },
         "failover_invariant_violations": failover["invariant_violations"],
+        "ingress_frames_per_wakeup": door["frames_per_wakeup"],
+        "ingress_max_batch": door["max_batch"],
     }
     _emit(headline, args.out)
 
@@ -1564,6 +1657,13 @@ def main() -> None:
         assert failover["invariant_violations"] == 0, failover
         assert failover["quorum_closes"] >= args.failover_seeds, failover
         assert failover["root_duplicates_dropped"] > 0, failover
+        # batched front door: >1 frame per wakeup over real TCP, acks
+        # at parity with the per-frame door, rx frame counter exact
+        assert door["max_batch"] > 1, door
+        assert door["parity"] == "acks-identical", door
+        assert door["rx_frames_counted"] == door["frames"], door
+        assert door["batch_size_histogram_count"] == door["batches"], door
+        assert door["bad_frames"] == 0, door
         if runner_row is not None:
             _assert_runner_smoke(args, runner_row)
         print("serving smoke OK")

@@ -25,7 +25,7 @@ import hmac
 import os
 import struct
 import warnings
-from typing import Any, Optional, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import asyncio
 
@@ -78,8 +78,28 @@ def _wire_key() -> bytes | None:
     return key.encode() if key else None
 
 
-def _sign(body: bytes, key: bytes) -> bytes:
-    return hmac.new(key, body, hashlib.sha256).digest()
+#: Keyed HMAC bases, one per wire key ever seen (in practice: one).
+#: ``hmac.new(key, ...)`` pays two SHA-256 block compressions just to
+#: absorb the padded key; cloning a cached keyed base skips that setup,
+#: which matters once ingress verifies whole batches of frames per
+#: event-loop wakeup. Keys rotate via env restarts, so the cache is
+#: bounded by construction; cleared defensively if it ever grows.
+_HMAC_BASE: dict = {}
+
+
+def _hmac_base(key: bytes) -> "hmac.HMAC":
+    base = _HMAC_BASE.get(key)
+    if base is None:
+        if len(_HMAC_BASE) > 8:
+            _HMAC_BASE.clear()
+        base = _HMAC_BASE[key] = hmac.new(key, b"", hashlib.sha256)
+    return base
+
+
+def _sign(body, key: bytes) -> bytes:
+    mac = _hmac_base(key).copy()
+    mac.update(body)
+    return mac.digest()
 
 _LOOPBACK = {"127.0.0.1", "::1", "localhost"}  # "" binds ALL interfaces — warn
 
@@ -331,6 +351,12 @@ def compress_payload(
     semantics). Untouched subtrees are returned as-is."""
     if mode not in WIRE_MODES:
         return obj
+    if type(obj) is dict and not any(
+        isinstance(v, (np.ndarray, QuantizedWireArray, dict, list, tuple))
+        or dataclasses.is_dataclass(v)
+        for v in obj.values()
+    ):
+        return obj  # scalar-only frame (acks, control) — nothing to swap
     block = block or _wire_block()
 
     def leaf(x: Any) -> Any:
@@ -441,6 +467,107 @@ def payload_block_stats(obj: Any) -> Optional[dict]:
     if worst is None:
         return None
     return {"max_inflation": worst, "frames": frames}
+
+
+_MAG_LUT: dict = {}
+
+
+def _byte_mag_lut(mode: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Rank-compressed forensics table: ``(rank, mag_of_rank)`` where
+    ``rank`` is a ``(256,)`` uint8 mapping each code byte to the RANK of
+    the clamped magnitude the per-frame :func:`frame_inflation` assigns
+    it (for s4, the max of the byte's two nibble magnitudes — valid per
+    block whenever blocks hold whole bytes), and ``mag_of_rank`` maps
+    ranks back to the exact f32 magnitudes. Block maxima run over uint8
+    ranks (SIMD-max over a quarter the bytes of an f32 expansion); the
+    rank order is magnitude-isomorphic, so mapping the winning rank
+    back yields bit-for-bit the per-frame path's block maximum. Rank 0
+    is always magnitude 0.0 (bytes 0x00 / 0x88 decode to zero), so
+    zero-padding ragged tails in rank space is exact too."""
+    ent = _MAG_LUT.get(mode)
+    if ent is None:
+        b = np.arange(256, dtype=np.uint8)
+        qmax = _WIRE_QMAX[mode]
+        if mode == "s4":
+            lo = np.abs((b & np.uint8(0xF)).astype(np.float32) - 8.0)
+            hi = np.abs((b >> 4).astype(np.float32) - 8.0)
+            lut = np.minimum(np.maximum(lo, hi), qmax).astype(np.float32)
+        elif mode == "int8":
+            lut = np.abs(b.view(np.int8).astype(np.float32))
+        else:
+            vals = b.view(_ml_f8_dtype(mode)).astype(np.float32)
+            lut = np.minimum(
+                np.abs(np.where(np.isfinite(vals), vals, qmax)), qmax
+            ).astype(np.float32)
+        mag_of_rank = np.unique(lut)  # sorted ascending, <= 256 entries
+        rank = np.searchsorted(mag_of_rank, lut).astype(np.uint8)
+        _MAG_LUT[mode] = ent = (rank, mag_of_rank.astype(np.float32))
+    return ent
+
+
+def _rows_code_values(codes: np.ndarray, mode: str) -> np.ndarray:
+    """Row-batched code -> f32 value expansion shared by the batched
+    dequantizer and the batched forensics pass: ``codes`` is ``(R,
+    ncodes)`` stacked wire codes, the result ``(R, nvals)`` f32 code
+    values BEFORE scaling (s4 nibbles unpacked and recentred, fp8 bit
+    patterns reinterpreted — non-finite patterns propagate, exactly as
+    the per-frame codec's)."""
+    if mode == "s4":
+        nib = np.empty((codes.shape[0], codes.shape[1] * 2), np.uint8)
+        nib[:, 0::2] = codes & np.uint8(0xF)
+        nib[:, 1::2] = codes >> 4
+        return nib.astype(np.float32) - 8.0
+    if mode == "int8":
+        return codes.astype(np.float32)
+    return codes.view(_ml_f8_dtype(mode)).astype(np.float32)
+
+
+def decode_rows_np(
+    codes: np.ndarray, scales: np.ndarray, *, mode: str, block: int,
+    d: int, dtype=np.float32,
+) -> np.ndarray:
+    """Row-batched numpy mirror of :func:`_np_blockwise_decode` over
+    ``R`` stacked ``(d,)`` frames: ``codes`` is ``(R, ncodes)`` (``d``
+    codes per row for int8/fp8, ``nb*block//2`` packed nibble bytes for
+    s4), ``scales`` ``(R, nb)`` f32. Every arithmetic step is the
+    per-frame codec's, applied elementwise across the row axis, so each
+    output row is bit-identical to decoding its frame alone — the
+    invariant the batched-vs-per-frame parity tests pin. This is also
+    the host reference the in-jit ``parallel.quantization
+    .dequantize_rows`` mirrors."""
+    codes = np.asarray(codes)
+    scales = np.asarray(scales)
+    rows, nb = scales.shape
+    flat = _rows_code_values(codes, mode)
+    pad = nb * block - flat.shape[1]
+    if pad > 0:
+        flat = np.concatenate(
+            [flat, np.zeros((rows, pad), np.float32)], axis=1
+        )
+    out = (flat.reshape(rows, nb, block) * scales[:, :, None]).reshape(
+        rows, -1
+    )[:, :d]
+    return np.ascontiguousarray(out).astype(dtype, copy=False)
+
+
+def rows_code_absmax(
+    codes: np.ndarray, *, mode: str, block: int, nb: int
+) -> np.ndarray:
+    """Row-batched per-block max |code value| — ``(R, nb)`` f32 from
+    ``(R, ncodes)`` stacked codes, UNclamped (a hostile s4 ``-8``
+    nibble reports 8, a non-finite fp8 pattern propagates), so
+    ``isfinite(absmax * scales)`` decides finiteness of the dequantized
+    rows without materializing them: IEEE multiply is magnitude-
+    monotone, hence the max-magnitude code's product is finite iff
+    every code's product in that block is."""
+    mags = np.abs(_rows_code_values(np.asarray(codes), mode))
+    rows = mags.shape[0]
+    pad = nb * block - mags.shape[1]
+    if pad > 0:
+        mags = np.concatenate(
+            [mags, np.zeros((rows, pad), np.float32)], axis=1
+        )
+    return mags.reshape(rows, nb, block).max(axis=2)
 
 
 def ef_precompensate(
@@ -605,6 +732,322 @@ def _decode_impl(body: bytes, *, want_stats: bool) -> Tuple[Any, Optional[dict]]
     return obj, stats
 
 
+@dataclasses.dataclass
+class DecodedFrame:
+    """One :func:`decode_batch` result slot: the decoded payload and its
+    pre-decode forensics stats (:func:`payload_block_stats` semantics),
+    or the exception the frame's verify/decode raised. A batch result
+    is truncated at the first error slot — exactly the frames the
+    per-frame path would have served before dropping the peer."""
+
+    obj: Any = None
+    stats: Optional[dict] = None
+    error: Optional[BaseException] = None
+    #: the frame's popped ``_trace_ctx`` stamp (None when unstamped) —
+    #: a batched ingress adopts it per frame so each admission span
+    #: stays the SENDING client's child, exactly like the per-frame
+    #: door's decode-time adoption
+    trace_ctx: Optional[Any] = None
+
+
+def _qwa_group_key(q: QuantizedWireArray):
+    codes = q.codes
+    scales = q.scales
+    return (
+        q.mode, q.block, getattr(codes, "size", -1),
+        str(getattr(codes, "dtype", "?")),
+        -1 if scales is None else getattr(scales, "size", -1),
+    )
+
+
+def _qwa_honest_layout(q: QuantizedWireArray) -> bool:
+    """True when the frame has exactly the layout the honest encoder
+    emits — the precondition for the row-batched decode. Anything else
+    (hand-crafted pickles with inconsistent code/scale sizes) takes the
+    per-frame codec verbatim, so hostile frames fail — or pass — with
+    exactly the per-frame path's semantics."""
+    try:
+        n = 1
+        for s in q.shape:
+            n *= int(s)
+        codes = q.codes
+        if not isinstance(codes, np.ndarray):
+            return False
+        if q.mode == "bf16":
+            return q.scales is None and codes.size == n
+        scales = q.scales
+        if not isinstance(scales, np.ndarray) or q.block <= 0:
+            return False
+        nb = -(-n // q.block)
+        if scales.size != nb:
+            return False
+        if q.mode == "s4":
+            return codes.size * 2 == nb * q.block
+        return codes.size == n
+    except Exception:
+        return False
+
+
+def _batch_inflations(group: list) -> list:
+    """:func:`frame_inflation` over a group of same-layout blockwise
+    frames in one vectorized pass (bit-identical per frame: every step
+    is the per-frame codec's, applied along a stacked row axis; the
+    final division is done per frame with the same scalar types)."""
+    q0 = group[0]
+    qmax = _WIRE_QMAX[q0.mode]
+    block = q0.block
+    nb = group[0].scales.size
+    codes = np.stack([q.codes.ravel() for q in group])
+    canonical = codes.dtype == (
+        np.dtype(np.int8) if q0.mode == "int8" else np.dtype(np.uint8)
+    )
+    if canonical and (q0.mode != "s4" or block % 2 == 0):
+        # rank-LUT gather per code byte, block maxima in uint8 rank
+        # space, winners mapped back to exact f32 magnitudes (for s4
+        # the byte-level maxima equal nibble-level ones because blocks
+        # hold whole bytes)
+        rank_lut, mag_of_rank = _byte_mag_lut(q0.mode)
+        ranks = np.take(rank_lut, codes.view(np.uint8))
+        per_block = block // 2 if q0.mode == "s4" else block
+        pad = nb * per_block - ranks.shape[1]
+        if pad > 0:
+            ranks = np.concatenate(
+                [ranks, np.zeros((len(group), pad), np.uint8)], axis=1
+            )
+        blockmax = mag_of_rank[
+            ranks[:, : nb * per_block]
+            .reshape(len(group), nb, per_block)
+            .max(axis=2)
+        ]
+    else:
+        vals = _rows_code_values(codes, q0.mode)
+        if q0.mode == "s4":
+            mags = np.minimum(np.abs(vals), qmax)
+        elif q0.mode == "int8":
+            mags = np.abs(vals)
+        else:
+            mags = np.minimum(
+                np.abs(np.where(np.isfinite(vals), vals, qmax)), qmax
+            )
+        pad = nb * block - mags.shape[1]
+        if pad > 0:
+            mags = np.concatenate(
+                [mags, np.zeros((len(group), pad), np.float32)], axis=1
+            )
+        blockmax = mags[:, : nb * block].reshape(
+            len(group), nb, block
+        ).max(axis=2)
+    masked = np.where(blockmax > 0, blockmax, np.float32(np.inf))
+    mins = masked.min(axis=1)
+    return [
+        1.0 if not np.isfinite(mn) else float(qmax / mn) for mn in mins
+    ]
+
+
+def _batch_decode_group(group: list) -> list:
+    """Vectorized :func:`_np_blockwise_decode` / :func:`_np_from_bf16`
+    over a group of same-layout frames (honest layout pre-checked)."""
+    q0 = group[0]
+    codes = np.stack([q.codes.ravel() for q in group])
+    if q0.mode == "bf16":
+        flat = (codes.astype(np.uint32) << 16).view(np.float32)
+        return [
+            flat[i].astype(q.dtype).reshape(q.shape)
+            for i, q in enumerate(group)
+        ]
+    scales = np.stack([q.scales.ravel() for q in group])
+    rows = decode_rows_np(
+        codes, scales, mode=q0.mode, block=q0.block,
+        d=flat_size(q0.shape),
+    )
+    return [
+        rows[i].astype(q.dtype, copy=False).reshape(q.shape)
+        for i, q in enumerate(group)
+    ]
+
+
+def flat_size(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def decode_batch(
+    bodies: Sequence, *, keep_quantized: bool = False
+) -> list:
+    """Batched :func:`decode_with_stats` over many frame bodies (bytes
+    or memoryviews, length prefixes stripped): HMAC verification rides
+    a cloned keyed base (the per-frame key schedule is amortized away),
+    and the numpy codec mirrors + pre-decode block-inflation forensics
+    run vectorized across every same-layout compressed tensor in the
+    batch — one pass over the stacked codes instead of one per frame.
+    Results are bit-identical to calling :func:`decode_with_stats` per
+    frame (pinned by the ingress parity tests); frames whose payloads
+    don't group (lossless, object, odd layouts) fall back to the
+    per-frame codec inside the same call.
+
+    ``keep_quantized=True`` leaves a dict frame's top-level
+    ``"gradient"`` :class:`QuantizedWireArray` COMPRESSED when it is a
+    well-formed 1-D blockwise float frame — the serving ingress admits
+    codes+scales and dequantization happens inside the ragged fold's
+    jitted program (device-side), not here. Stats are still computed
+    for kept frames; ill-formed frames are decoded (and fail) exactly
+    as the per-frame path would.
+
+    Returns a list of :class:`DecodedFrame`, truncated after the first
+    error slot: the per-frame TCP door drops a peer at the first bad
+    frame, so later frames in the batch must not be served either.
+    Trace context: the first stamped frame's ``_trace_ctx`` is adopted
+    for the batch (the batch's admission span links to that sender);
+    every frame's stamp is popped regardless."""
+    telemetry = _obs_runtime.STATE.enabled
+    key = _wire_key()
+    base = _hmac_base(key) if key is not None else None
+    out: list = []
+    raws: list = []
+    for body in bodies:
+        if telemetry:
+            _frame_counters("rx", _HEADER.size + len(body))
+        try:
+            payload = body
+            if key is not None:
+                if len(body) < _SIG_LEN:
+                    raise ValueError(
+                        "frame too short to carry an HMAC signature"
+                    )
+                sig, payload = body[:_SIG_LEN], body[_SIG_LEN:]
+                mac = base.copy()
+                mac.update(payload)
+                if not hmac.compare_digest(bytes(sig), mac.digest()):
+                    raise ValueError(
+                        "frame HMAC verification failed: wrong "
+                        "BYZPY_TPU_WIRE_KEY or tampered/unsigned frame"
+                    )
+            raw = cloudpickle.loads(payload)
+        except Exception as exc:  # noqa: BLE001 — per-frame error slot
+            out.append(DecodedFrame(error=exc))
+            return out
+        raws.append(raw)
+        out.append(DecodedFrame(obj=raw))
+
+    # one walk per frame collects its compressed tensors; same-layout
+    # tensors across the whole batch then share one vectorized pass
+    # (flat dicts — every honest submit frame — skip the generic
+    # recursive walk for one shallow scan over the values)
+    per_frame: list = []
+    groups: dict = {}
+    for raw in raws:
+        qwas: list = []
+        flat = type(raw) is dict
+        if flat:
+            for v in raw.values():
+                if isinstance(v, QuantizedWireArray):
+                    qwas.append(v)
+                elif isinstance(v, (dict, list, tuple)) or (
+                    dataclasses.is_dataclass(v) and not isinstance(v, type)
+                ):
+                    flat = False
+                    qwas.clear()
+                    break
+        if not flat:
+
+            def leaf(x, _q=qwas):
+                if isinstance(x, QuantizedWireArray):
+                    _q.append(x)
+                return x
+
+            _map_payload_leaves(leaf, raw)
+        per_frame.append(qwas)
+        for q in qwas:
+            if _qwa_honest_layout(q):
+                groups.setdefault(_qwa_group_key(q), []).append(q)
+
+    infl: dict = {}
+    dec: dict = {}
+    keep: set = set()
+    if keep_quantized:
+        for raw in raws:
+            if type(raw) is not dict:
+                continue
+            g = raw.get("gradient")
+            if (
+                isinstance(g, QuantizedWireArray)
+                and g.mode in BLOCKWISE_WIRE_MODES
+                and len(g.shape) == 1
+                and _qwa_honest_layout(g)
+            ):
+                try:
+                    if np.dtype(g.dtype).kind == "f":
+                        keep.add(id(g))
+                except TypeError:
+                    pass
+    for gkey, group in groups.items():
+        mode = gkey[0]
+        if mode in BLOCKWISE_WIRE_MODES:
+            try:
+                for q, r in zip(group, _batch_inflations(group)):
+                    infl[id(q)] = r
+            except Exception:  # noqa: BLE001 — per-frame fallback below
+                pass
+        to_decode = [q for q in group if id(q) not in keep]
+        if not to_decode:
+            continue
+        try:
+            for q, row in zip(to_decode, _batch_decode_group(to_decode)):
+                dec[id(q)] = row
+        except Exception:  # noqa: BLE001 — per-frame fallback below
+            pass
+
+    adopted = False
+    for i, raw in enumerate(raws):
+        qwas = per_frame[i]
+        worst = None
+        frames = 0
+        try:
+            for q in qwas:
+                r = infl.get(id(q))
+                if r is None:
+                    r = frame_inflation(q)
+                if r is not None:
+                    frames += 1
+                    worst = r if worst is None else max(worst, r)
+            stats = (
+                None if worst is None
+                else {"max_inflation": worst, "frames": frames}
+            )
+
+            def leaf(x):
+                if isinstance(x, QuantizedWireArray):
+                    if id(x) in keep:
+                        return x
+                    row = dec.get(id(x))
+                    if row is not None:
+                        return row
+                    if x.mode == "bf16":
+                        return _np_from_bf16(x.codes, x.shape, x.dtype)
+                    return _np_blockwise_decode(
+                        x.codes, x.scales, x.block, x.shape, x.dtype,
+                        x.mode,
+                    )
+                return x
+
+            needs_map = any(id(q) not in keep for q in qwas)
+            obj = _map_payload_leaves(leaf, raw) if needs_map else raw
+        except Exception as exc:  # noqa: BLE001 — per-frame error slot
+            del out[i:]
+            out.append(DecodedFrame(error=exc))
+            return out
+        ctx = None
+        if type(obj) is dict and TRACE_CTX_KEY in obj:
+            ctx = obj.pop(TRACE_CTX_KEY)
+            if telemetry and not adopted:
+                adopted = True
+                _obs_tracing.adopt_context(ctx)
+        out[i] = DecodedFrame(obj=obj, stats=stats, trace_ctx=ctx)
+    return out
+
+
 def host_view(obj: Any) -> Any:
     """Convert any jax.Arrays in a payload pytree to numpy before it crosses
     a process or network boundary (device buffers don't pickle portably and
@@ -663,7 +1106,11 @@ __all__ = [
     "recv_obj",
     "encode",
     "decode",
+    "decode_batch",
+    "decode_rows_np",
     "decode_with_stats",
+    "DecodedFrame",
+    "rows_code_absmax",
     "ef_precompensate",
     "frame_inflation",
     "host_view",

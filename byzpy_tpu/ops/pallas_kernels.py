@@ -22,6 +22,7 @@ the same code paths (``tests/test_pallas_kernels.py``).
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -1969,6 +1970,193 @@ def _ragged_segment_sum_call(
     return out[:n_cohorts, :d].astype(x.dtype)
 
 
+def _ragged_segment_sum_dequant_kernel(
+    fill_ref, w_ref, c_ref, s_ref, out_ref, *,
+    rows_tile: int, block: int, blocks_per_tile: int, mode: str, fp_dtype,
+):
+    """Fused-dequant twin of :func:`_ragged_segment_sum_kernel`: the row
+    tile arrives as WIRE codes (int8 codes / fp8 bit patterns / packed
+    s4 nibbles) plus its ``(rows_tile, blocks_per_tile)`` f32 scale
+    block, expands to f32 inside the tile (cast + blockwise scale
+    multiply — both IEEE-exact, matching the host codec bit-for-bit),
+    and feeds the same transposed-weights MXU contraction. Quantized
+    rows thus reach the accumulate at wire width: a feature tile moves
+    tile bytes (int8/fp8) or tile/2 bytes (s4) plus tile/block scale
+    floats instead of 4·tile f32 bytes."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(i * rows_tile < fill_ref[0])
+    def _():
+        codes = c_ref[:]
+        if mode == "s4":
+            lo = codes & jnp.uint8(0xF)
+            hi = codes >> 4
+            vals = jnp.stack([lo, hi], axis=-1).reshape(
+                rows_tile, blocks_per_tile * block
+            ).astype(jnp.float32) - 8.0
+        elif mode == "int8":
+            vals = codes.astype(jnp.float32)
+        else:
+            vals = lax.bitcast_convert_type(codes, fp_dtype).astype(
+                jnp.float32
+            )
+        x = (
+            vals.reshape(rows_tile, blocks_per_tile, block)
+            * s_ref[:][:, :, None]
+        ).reshape(rows_tile, blocks_per_tile * block)
+        out_ref[:] += jax.lax.dot_general(
+            w_ref[:], x,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def ragged_segment_sum_dequant_pallas(
+    codes: Array,
+    scales: Array,
+    weights: Array,
+    *,
+    mode: str,
+    block: int,
+    d: int,
+    fill: Optional[Array] = None,
+    rows_tile: Optional[int] = None,
+    tile: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """:func:`ragged_segment_sum_pallas` consuming still-compressed
+    wire rows: ``out[c] = Σ_r weights[c, r] · dequant(codes[r],
+    scales[r])[:d]`` without ever materializing the ``(R, d)`` f32
+    matrix — dequantization happens per (row-tile × feature-tile)
+    inside the kernel, next to the MXU accumulate (the EQuARX stance:
+    codes travel, f32 exists only tile-local). ``codes`` is ``(R,
+    ncodes)`` wire layout (``d`` int8 codes / fp8 bit patterns, or
+    ``nb·block/2`` packed s4 nibble bytes), ``scales`` ``(R, nb)`` f32;
+    ``fill`` is the batch's occupied-row count, scalar-prefetched so
+    capacity row tiles skip both the dequant and the MXU work. The
+    feature tile is rounded up to a whole number of codec blocks so a
+    scale block never straddles tiles. The XLA mirror
+    (``ops.ragged.flat_dequantize`` + the einsum contraction) is
+    authoritative for the serving tier's bit-parity contract; this
+    kernel is the same explicit opt-in as the dense ragged kernel
+    (``BYZPY_TPU_RAGGED_PALLAS=1``), interpret-exact on CPU, with
+    on-chip validation riding the queued rerun bundle."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    n, ncodes = codes.shape
+    nb = scales.shape[1]
+    n_cohorts = weights.shape[0]
+    if mode == "s4" and block % 2:
+        raise ValueError("s4 fused dequant requires an even block")
+    if tile is None:
+        tuned = _tuned_tile(
+            "ragged", max(_SUBLANES, _round_up(n, _SUBLANES)), d
+        )
+        tile = tuned if tuned is not None else max(
+            _LANES, min(4096, _round_up(d, _LANES))
+        )
+    # a feature tile must hold whole codec blocks (the scale block
+    # boundary) AND whole lanes; round up to the lcm of both
+    lcm = block * _LANES // math.gcd(block, _LANES)
+    tile = _round_up(int(tile), lcm)
+    if rows_tile is None:
+        rows_tile = max(_SUBLANES, min(256, _round_up(n, _SUBLANES)))
+    if fill is None:
+        fill = jnp.asarray([n], jnp.int32)
+    else:
+        fill = jnp.asarray(fill, jnp.int32).reshape((1,))
+    return _ragged_segment_sum_dequant_call(
+        codes, scales, weights, fill, mode=mode, block=int(block),
+        d=int(d), n_cohorts=int(n_cohorts), rows_tile=int(rows_tile),
+        tile=int(tile), interpret=bool(interpret),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mode", "block", "d", "n_cohorts", "rows_tile", "tile", "interpret"
+    ),
+)
+def _ragged_segment_sum_dequant_call(
+    codes: Array,
+    scales: Array,
+    weights: Array,
+    fill: Array,
+    *,
+    mode: str,
+    block: int,
+    d: int,
+    n_cohorts: int,
+    rows_tile: int,
+    tile: int,
+    interpret: bool,
+) -> Array:
+    n, ncodes = codes.shape
+    nb = scales.shape[1]
+    n_pad = _round_up(max(n, 1), rows_tile)
+    d_pad = _round_up(max(d, 1), tile)
+    c_pad = max(_SUBLANES, _round_up(n_cohorts, _SUBLANES))
+    codes_per_tile = tile // 2 if mode == "s4" else tile
+    cw_pad = (d_pad // tile) * codes_per_tile
+    nb_pad = d_pad // block
+    cp = jnp.zeros((n_pad, cw_pad), codes.dtype).at[:n, :ncodes].set(codes)
+    sp = jnp.zeros((n_pad, nb_pad), jnp.float32).at[:n, :nb].set(
+        scales.astype(jnp.float32)
+    )
+    ohp = jnp.zeros((n_pad, c_pad), jnp.float32).at[:n, :n_cohorts].set(
+        weights.T.astype(jnp.float32)
+    )
+    if mode == "s4":
+        fp_dtype = None
+    elif mode == "int8":
+        fp_dtype = None
+    else:
+        import ml_dtypes
+
+        fp_dtype = (
+            ml_dtypes.float8_e4m3fn if mode == "fp8"
+            else ml_dtypes.float8_e5m2
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pad // rows_tile, d_pad // tile),
+        in_specs=[
+            pl.BlockSpec(
+                (rows_tile, c_pad), lambda i, j, fill: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rows_tile, codes_per_tile), lambda i, j, fill: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (rows_tile, tile // block), lambda i, j, fill: (i, j),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (c_pad, tile), lambda i, j, fill: (0, j), memory_space=pltpu.VMEM
+        ),
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_segment_sum_dequant_kernel,
+            rows_tile=rows_tile, block=block,
+            blocks_per_tile=tile // block, mode=mode, fp_dtype=fp_dtype,
+        ),
+        out_shape=jax.ShapeDtypeStruct((c_pad, d_pad), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(fill, ohp, cp, sp)
+    return out[:n_cohorts, :d]
+
+
 # ---------------------------------------------------------------------------
 # Dispatch policy
 # ---------------------------------------------------------------------------
@@ -2083,6 +2271,7 @@ __all__ = [
     "nnm_pallas",
     "nnm_stream_pallas",
     "nnm_selection_mean_stream_pallas",
+    "ragged_segment_sum_dequant_pallas",
     "ragged_segment_sum_pallas",
     "selection_mean_from_gram_pallas",
     "selection_mean_pallas",

@@ -359,6 +359,26 @@ def ragged_via_masked(
     )
 
 
+def flat_dequantize(
+    codes: Array, scales: Array, *, mode: str, block: int, d: int
+) -> Array:
+    """Expand a flat-rows batch of still-compressed wire rows into the
+    ``(R, d)`` f32 ``flat`` matrix every program here consumes — the
+    batched-ingress entry of the ragged ABI (PR 16): the serving
+    executor feeds admitted codes + scales straight into its jitted
+    program and this is the first traced op, so quantized submissions
+    never materialize as f32 rows on host. Capacity rows (zero codes,
+    zero scales) expand to exact-zero rows for int8/fp8 and to
+    ``-0.0`` rows for s4 (nibble 0 decodes to ``-8 * 0.0``) — both are
+    exact zeros under the masked einsum contractions, so the bit-parity
+    contract above is unaffected. Delegates to
+    ``parallel.quantization.dequantize_rows`` (bit-identical to the
+    host wire codec on CPU/TPU)."""
+    from ..parallel.quantization import dequantize_rows
+
+    return dequantize_rows(codes, scales, mode=mode, block=block, d=d)
+
+
 def ragged_evidence(
     flat: Array, seg: Array, aggregates: Array, *, n_cohorts: int
 ) -> Tuple[Array, Array]:
@@ -382,6 +402,7 @@ def ragged_evidence(
 
 
 __all__ = [
+    "flat_dequantize",
     "ragged_cge",
     "ragged_evidence",
     "ragged_krum_scores",

@@ -950,6 +950,34 @@ def _dequantize_xla(values: Array, scales: Array, *, block: int, dtype) -> Array
     return out[:, :d].astype(dtype)
 
 
+def dequantize_rows(
+    codes: Array, scales: Array, *, mode: str, block: int, d: int,
+    dtype=jnp.float32,
+) -> Array:
+    """Trace-safe row-batched dequantization of WIRE-layout codes — the
+    in-jit twin of ``engine.actor.wire.decode_rows_np`` and the entry
+    point the ragged fold's jitted program uses to consume admitted
+    submissions that are still compressed (PR 16's batched ingress
+    hands codes + scales through admission untouched).
+
+    ``codes`` is ``(rows, ncodes)`` exactly as the wire carries them:
+    int8 codes for ``int8``, uint8 fp8 bit patterns for
+    ``fp8``/``fp8_e5m2``, packed offset-binary nibbles (``nb*block//2``
+    bytes) for ``s4``; ``scales`` is ``(rows, nb)`` f32. On CPU/TPU the
+    result is bit-identical to the host mirror (cast + f32 multiply,
+    both IEEE-exact), which is what keeps the fused device-side path at
+    bit parity with the per-frame ingress decode."""
+    if mode == "s4":
+        return _dequantize_s4_xla(codes, scales, block=block, d=d, dtype=dtype)
+    if mode in _FP8_FORMATS:
+        fp_dtype, _ = _fp8_dtype(mode)
+        values = jax.lax.bitcast_convert_type(codes, fp_dtype)
+        return _dequantize_fp8_xla(values, scales, block=block, dtype=dtype)
+    if mode == "int8":
+        return _dequantize_xla(codes, scales, block=block, dtype=dtype)
+    raise ValueError(f"no wire row codec for mode {mode!r}")
+
+
 def quantization_error_bound(
     x: Array, *, block: int = DEFAULT_BLOCK, mode: str = "int8"
 ) -> Array:
@@ -987,6 +1015,7 @@ __all__ = [
     "QuantizedBlocks",
     "as_comm_precision",
     "dequantize_blockwise",
+    "dequantize_rows",
     "ef_encode",
     "encode_blockwise",
     "quantization_error_bound",

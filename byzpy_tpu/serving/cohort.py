@@ -16,6 +16,15 @@ Two consumers share the :class:`Cohort` layout:
 Staleness folds in here: a round-``k`` gradient landing in server round
 ``k + δ`` is scaled by ``StalenessPolicy.discount(δ)`` before it enters
 the aggregate; ``δ = 0`` rows are bit-identical (weight exactly 1.0).
+
+Quantized cohorts (PR 16): when every submission in a ragged round
+arrived as the same blockwise :class:`~byzpy_tpu.engine.actor.wire
+.QuantizedWireArray` spec, the cohort carries the stacked CODES and
+SCALES instead of f32 rows — the ragged executor feeds them straight
+into its jitted program and dequantization happens device-side.
+``cohort.matrix`` stays available to every legacy consumer (forensics,
+chaos harness, dense fallbacks) as a lazy property that materializes —
+bit-identically to the wire codec — on first touch and caches.
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ from typing import Any, Optional, Sequence, Tuple
 import numpy as np
 
 from ..aggregators.base import Aggregator
+from ..engine.actor import wire
 from ..observability import tracing as obs_tracing
 from .buckets import BucketLadder
 from .queue import Submission
@@ -36,33 +46,107 @@ from .staleness import StalenessPolicy
 class Cohort:
     """One closed round's padded cohort.
 
-    ``matrix``: ``(bucket, d)`` float32 rows — valid rows first (slot
-    order = admission order), zero rows after; ``valid``: ``(bucket,)``
-    bool; ``weights``: ``(bucket,)`` float32 staleness discounts (1.0
-    for fresh rows, 0.0 padding); ``clients``: the valid rows' client
-    ids; ``first_arrival_s``: the earliest admission timestamp (round
-    latency is measured from here)."""
+    ``valid``: ``(bucket,)`` bool; ``weights``: ``(bucket,)`` float32
+    staleness discounts (1.0 for fresh rows, 0.0 padding);
+    ``clients``: the valid rows' client ids; ``first_arrival_s``: the
+    earliest admission timestamp (round latency is measured from here).
 
-    matrix: np.ndarray
+    Row storage is one of two layouts:
+
+    * dense — ``dense`` holds the ``(bucket, d)`` float32 matrix (valid
+      rows first, slot order = admission order, zero rows after);
+    * quantized — ``qcodes`` ``(bucket, ncodes)`` + ``qscales``
+      ``(bucket, nb)`` hold every row's still-compressed wire codes and
+      per-block scales (``qmode``/``qblock``/``qdim`` the shared codec
+      spec), and ``dense`` starts ``None``.
+
+    ``matrix`` serves both: for a quantized cohort it dequantizes
+    through the wire codec's own numpy mirror on first access
+    (bit-identical to decoding each frame at ingress) and caches — so
+    the hot batched path never pays it unless a consumer actually asks
+    for host f32 rows."""
+
     valid: np.ndarray
     weights: np.ndarray
     clients: Tuple[str, ...]
     first_arrival_s: float
+    dense: Optional[np.ndarray] = None
     #: per-valid-row pre-decode wire block-inflation ratios, aligned
     #: with ``clients`` (None entries for lossless/in-process rows) —
     #: the forensics residual-shaping feature, carried so sync round
     #: closers and the chaos harness see what the ingress measured
     wire_inflations: Tuple[Optional[float], ...] = ()
+    qcodes: Optional[np.ndarray] = None
+    qscales: Optional[np.ndarray] = None
+    qmode: Optional[str] = None
+    qblock: int = 0
+    qdim: int = 0
+
+    @property
+    def matrix(self) -> np.ndarray:
+        """``(bucket, d)`` float32 rows — lazily dequantized (and
+        cached) for quantized cohorts, free for dense ones."""
+        if self.dense is None:
+            mat = wire.decode_rows_np(
+                self.qcodes, self.qscales,
+                mode=self.qmode, block=self.qblock, d=self.qdim,
+            )
+            # codec padding decodes a zero-scaled row to ±0.0; dense
+            # cohorts pad with exact +0.0 rows — keep that invariant
+            mat[~self.valid] = 0.0
+            object.__setattr__(self, "dense", mat)
+        return self.dense
+
+    @property
+    def quantized(self) -> bool:
+        """True when the rows are still wire codes (no f32 host copy
+        has been materialized yet)."""
+        return self.qmode is not None
 
     @property
     def bucket(self) -> int:
         """Padded row count (the compiled shape)."""
-        return int(self.matrix.shape[0])
+        return int(self.valid.shape[0])
 
     @property
     def m(self) -> int:
         """Actual cohort size (valid rows)."""
         return int(self.valid.sum())
+
+    def finite(self) -> bool:
+        """Exactly ``np.isfinite(self.matrix).all()`` — the round
+        closers' poison gate — WITHOUT materializing a quantized
+        cohort: per-block max |code| times the block scale is finite
+        iff every dequantized element is (IEEE multiply is magnitude-
+        monotone; non-finite fp8 codes and scales propagate through
+        the product)."""
+        if self.dense is not None or self.qmode is None:
+            return bool(np.isfinite(self.matrix).all())
+        absmax = wire.rows_code_absmax(
+            self.qcodes, mode=self.qmode, block=self.qblock,
+            nb=int(self.qscales.shape[1]),
+        )
+        with np.errstate(invalid="ignore", over="ignore"):
+            return bool(np.isfinite(absmax * self.qscales).all())
+
+
+def _row_dense(gradient: Any) -> np.ndarray:
+    """One submission row as host f32: admitted still-compressed rows
+    dequantize through the wire codec (bit-identical to an ingress-time
+    decode), plain arrays pass through."""
+    if isinstance(gradient, wire.QuantizedWireArray):
+        return wire.decode_rows_np(
+            gradient.codes[None], gradient.scales[None],
+            mode=gradient.mode, block=gradient.block,
+            d=int(gradient.shape[0]),
+        )[0]
+    return np.asarray(gradient)
+
+
+def _row_dim(gradient: Any) -> int:
+    if isinstance(gradient, wire.QuantizedWireArray):
+        return int(gradient.shape[0])
+    return int(np.asarray(gradient).shape[0])
 
 
 def build_cohort(
@@ -73,6 +157,7 @@ def build_cohort(
     *,
     tenant: str = "",
     track: Optional[str] = None,
+    quantized: bool = False,
 ) -> Cohort:
     """Pad one round's submissions into the smallest bucket that holds
     them, stamping per-row staleness discounts against ``server_round``.
@@ -81,7 +166,14 @@ def build_cohort(
     the flat batch (``serving.ragged``), not in this cohort. ``tenant``
     (optional) attributes the telemetry span to the owning tenant's
     trace row; ``track`` overrides the row name (the sharded tier
-    passes its shard-qualified ``shard:<i>/tenant:<name>`` row)."""
+    passes its shard-qualified ``shard:<i>/tenant:<name>`` row).
+
+    ``quantized=True`` (the batched-ingress ragged path) keeps the
+    round compressed when EVERY submission carries the same blockwise
+    wire spec: the cohort stacks codes + scales and the fold
+    dequantizes device-side. Mixed or dense rounds fall back to the
+    dense layout, dequantizing admitted wire rows bit-identically to
+    a per-frame ingress decode."""
     m = len(submissions)
     bucket = m if ladder is None else ladder.bucket_for(m)
     with obs_tracing.span(
@@ -89,16 +181,16 @@ def build_cohort(
         track=track or (f"tenant:{tenant}" if tenant else None),
         round=server_round, m=m, bucket=bucket, tenant=tenant,
     ):
-        d = int(np.asarray(submissions[0].gradient).shape[0])
-        matrix = np.zeros((bucket, d), np.float32)
+        g0 = submissions[0].gradient
+        d = _row_dim(g0)
         weights = np.zeros((bucket,), np.float32)
         valid = np.zeros((bucket,), bool)
         for slot, sub in enumerate(submissions):
-            matrix[slot] = sub.gradient
-            weights[slot] = staleness.discount(server_round - sub.round_submitted)
+            weights[slot] = staleness.discount(
+                server_round - sub.round_submitted
+            )
             valid[slot] = True
-        return Cohort(
-            matrix=matrix,
+        common = dict(
             valid=valid,
             weights=weights,
             clients=tuple(s.client for s in submissions),
@@ -107,6 +199,30 @@ def build_cohort(
                 getattr(s, "wire_inflation", None) for s in submissions
             ),
         )
+        if quantized and isinstance(g0, wire.QuantizedWireArray):
+            spec = (g0.mode, g0.block, g0.codes.size, g0.scales.size, d)
+            if all(
+                isinstance(s.gradient, wire.QuantizedWireArray)
+                and (
+                    s.gradient.mode, s.gradient.block,
+                    s.gradient.codes.size, s.gradient.scales.size,
+                    _row_dim(s.gradient),
+                ) == spec
+                for s in submissions
+            ):
+                qcodes = np.zeros((bucket, g0.codes.size), g0.codes.dtype)
+                qscales = np.zeros((bucket, g0.scales.size), np.float32)
+                for slot, sub in enumerate(submissions):
+                    qcodes[slot] = sub.gradient.codes
+                    qscales[slot] = sub.gradient.scales
+                return Cohort(
+                    qcodes=qcodes, qscales=qscales, qmode=g0.mode,
+                    qblock=g0.block, qdim=d, **common,
+                )
+        matrix = np.zeros((bucket, d), np.float32)
+        for slot, sub in enumerate(submissions):
+            matrix[slot] = _row_dense(sub.gradient)
+        return Cohort(dense=matrix, **common)
 
 
 class CohortAggregator:

@@ -129,6 +129,11 @@ _HTTP_GET_PREFIX = b"GET "
 LOSSLESS_REPLY = "_lossless"
 _HTTP_MAX_REQUEST = 8192
 
+#: Socket read size of the batched ingress loop — large enough that one
+#: event-loop wakeup drains many queued frames into one decode batch,
+#: small enough to keep per-connection memory bounded.
+_INGRESS_READ_CHUNK = 1 << 18
+
 
 def _publish_wire_info() -> None:
     """Refresh the ``byzpy_wire_info`` marker gauge (wire precision +
@@ -453,6 +458,13 @@ class ServingFrontend:
         #: :meth:`handle_request`) — the process-per-shard runner
         #: mounts its shard control plane here
         self.request_hook: Optional[Callable[[dict], Optional[dict]]] = None
+        #: request kinds the mounted hook PROMISES to pass through
+        #: (return ``None`` for, with no side effects). The batched
+        #: ingress only admits a run of submit frames in one pass when
+        #: ``"submit"`` is declared here (the shard runner's control
+        #: hook qualifies); otherwise every frame still routes through
+        #: :meth:`handle_request` so the hook sees it first.
+        self.request_hook_passthrough: frozenset = frozenset()
         self._durability = durability
         #: per-tenant recovery provenance (RecoveredTenant or None) —
         #: populated when a DurabilityConfig points at a directory with
@@ -491,6 +503,18 @@ class ServingFrontend:
         self._m_unknown_tenant = reg.counter(
             "byzpy_serving_unknown_tenant_total",
             help="submissions naming no configured tenant",
+        )
+        #: batched-door accounting: every :meth:`serve_frames` call is
+        #: one batch (the TCP ingress passes everything a wakeup
+        #: drained); ``ingress_max_batch > 1`` is the smoke test's
+        #: proof that the door actually amortizes
+        self.ingress_batches = 0
+        self.ingress_frames_batched = 0
+        self.ingress_max_batch = 0
+        self._m_batch_size = reg.histogram(
+            "byzpy_ingress_batch_size",
+            help="frames decoded per ingress batch (serve_frames call)",
+            buckets=obs_metrics.SIZE_BUCKETS,
         )
 
     # -- durability / recovery -------------------------------------------
@@ -671,6 +695,7 @@ class ServingFrontend:
         *,
         seq: Optional[int] = None,
         wire_inflation: Optional[float] = None,
+        _now: Optional[float] = None,
     ) -> Tuple[bool, str]:
         """Admit one submission: ``(accepted, reason)``.
 
@@ -689,14 +714,24 @@ class ServingFrontend:
         un-acked submissions should be retried under the same key.
         ``wire_inflation`` (stamped by the TCP ingress from the
         still-compressed frame) is the pre-decode block-inflation ratio
-        the forensics plane's residual-shaping detector screens."""
+        the forensics plane's residual-shaping detector screens.
+
+        ``gradient`` may arrive STILL COMPRESSED (a blockwise
+        :class:`~byzpy_tpu.engine.actor.wire.QuantizedWireArray` kept
+        by the batched ingress): the shape gate reads the codec's
+        declared ``(dim,)`` float shape and the row stays codes+scales
+        through the queue — dequantization happens in the fold (device-
+        side on the ragged door, bit-identical host decode otherwise).
+        ``_now`` lets the batched admission stamp one clock read across
+        a drained batch (arrival order is preserved; the rows were all
+        on the socket at the same wakeup)."""
         t = self._tenants.get(tenant)
         if t is None:
             if obs_runtime.STATE.enabled:
                 self._m_unknown_tenant.inc()
             return False, REJECTED_TENANT
         telemetry = obs_runtime.STATE.enabled
-        now = self._clock()
+        now = self._clock() if _now is None else _now
         if seq is not None and t.is_duplicate(client, seq):
             t.duplicates += 1
             t.ledger.record(DUPLICATE, client)
@@ -718,12 +753,32 @@ class ServingFrontend:
             if telemetry:
                 t.telemetry.outcome(REJECTED_UNTRUSTED)
             return False, REJECTED_UNTRUSTED
-        row = np.asarray(gradient)
-        if row.ndim != 1 or row.shape[0] != t.cfg.dim or row.dtype.kind != "f":
-            t.ledger.record(REJECTED_SHAPE, client)
-            if telemetry:
-                t.telemetry.outcome(REJECTED_SHAPE)
-            return False, REJECTED_SHAPE
+        if isinstance(gradient, wire.QuantizedWireArray):
+            # still-compressed row: the codec's declared shape/dtype is
+            # what the gate judges (the codes were already validated
+            # against the honest-encoder layout at decode_batch time)
+            row: Any = gradient
+            if not (
+                gradient.mode in wire.BLOCKWISE_WIRE_MODES
+                and len(gradient.shape) == 1
+                and int(gradient.shape[0]) == t.cfg.dim
+                and np.dtype(gradient.dtype).kind == "f"
+            ):
+                t.ledger.record(REJECTED_SHAPE, client)
+                if telemetry:
+                    t.telemetry.outcome(REJECTED_SHAPE)
+                return False, REJECTED_SHAPE
+        else:
+            row = np.asarray(gradient)
+            if (
+                row.ndim != 1
+                or row.shape[0] != t.cfg.dim
+                or row.dtype.kind != "f"
+            ):
+                t.ledger.record(REJECTED_SHAPE, client)
+                if telemetry:
+                    t.telemetry.outcome(REJECTED_SHAPE)
+                return False, REJECTED_SHAPE
         delta = t.round_id - int(round_submitted)
         if not t.cfg.staleness.admits(delta):
             t.ledger.record(REJECTED_STALE, client)
@@ -902,6 +957,188 @@ class ServingFrontend:
                 "round": t.round_id,
             }
         return {"kind": "ack", "accepted": False, "reason": "bad_frame"}
+
+    # -- batched ingress -------------------------------------------------
+
+    def serve_frames(
+        self, bodies: Sequence[Any]
+    ) -> Tuple[List[bytes], int, Optional[BaseException]]:
+        """Serve a BATCH of wire frame bodies (bytes or memoryviews,
+        length prefixes stripped) through one decode pass — the batched
+        front door shared by the TCP ingress (everything one wakeup
+        drained) and :func:`serve_frame` (a batch of one).
+
+        HMAC verification, codec decode, and the pre-decode block-
+        inflation forensics run vectorized across the whole batch
+        (:func:`wire.decode_batch`); quantized gradient rows stay
+        codes+scales through admission (``keep_quantized``). Admission
+        itself still walks every frame IN ARRIVAL ORDER — consecutive
+        submit frames ride one clock read and one span through
+        :meth:`_handle_submit_batch`, anything else (stats polls, hook
+        control frames, close_round) flushes the run and routes through
+        :meth:`handle_request` exactly as before — so acks, ledger
+        outcomes, and WAL-before-ack semantics are bit-identical to
+        serving the frames one at a time.
+
+        Returns ``(replies, served, error)``: encoded reply frames for
+        the ``served`` leading bodies, and the decode/HMAC failure that
+        stopped the batch (``None`` when every frame served). Frames
+        past a failure are NOT decoded or served — the TCP ingress
+        drops the peer there, exactly like the per-frame path."""
+        nb = len(bodies)
+        self.ingress_batches += 1
+        self.ingress_frames_batched += nb
+        if nb > self.ingress_max_batch:
+            self.ingress_max_batch = nb
+        if obs_runtime.STATE.enabled:
+            self._m_batch_size.observe(float(nb))
+        # same span name as the historical per-frame door — dashboards
+        # and the observability smoke key on it; `frames` says how much
+        # one decode pass amortized
+        with obs_tracing.span(
+            "serving.ingress.decode",
+            bytes=sum(len(b) for b in bodies), frames=nb,
+        ):
+            recs = wire.decode_batch(bodies, keep_quantized=True)
+        batch_submits = (
+            self.request_hook is None
+            or "submit" in self.request_hook_passthrough
+        )
+        replies: List[bytes] = []
+        error: Optional[BaseException] = None
+        pending: List[Tuple[dict, int, Any]] = []
+        telemetry = obs_runtime.STATE.enabled
+
+        def flush() -> None:
+            if pending:
+                replies.extend(self._handle_submit_batch(pending))
+                pending.clear()
+
+        for i, rec in enumerate(recs):
+            if rec.error is not None:
+                # a frame that fails HMAC/unpickle names no trustable
+                # tenant: counted HERE (shared by the TCP and in-process
+                # doors), frames behind it not served
+                self._count_bad_frame()
+                error = rec.error
+                break
+            request = rec.obj
+            if isinstance(request, dict):
+                # the ingress is the ONLY author of this key: a client-
+                # stamped value is discarded, then the measured pre-
+                # decode ratio — when the frame carried a blockwise
+                # payload — is stamped fresh (same rule as per-frame)
+                request.pop("_wire_inflation", None)
+                if rec.stats is not None and request.get("kind") == "submit":
+                    request["_wire_inflation"] = rec.stats["max_inflation"]
+                if batch_submits and request.get("kind") == "submit":
+                    pending.append((request, len(bodies[i]), rec.trace_ctx))
+                    continue
+            flush()
+            # non-submit (or hook-owned) frames keep the per-frame
+            # contract exactly: hook first, built-in kinds after —
+            # with the frame's own trace context adopted and ingress-
+            # bytes accounting mirroring the per-frame read loop
+            if telemetry and rec.trace_ctx is not None:
+                obs_tracing.adopt_context(rec.trace_ctx)
+            if (
+                isinstance(request, dict)
+                and request.get("kind") == "submit"
+            ):
+                self._account_submit_bytes(request, len(bodies[i]))
+            replies.append(encode_reply(self.handle_request(request)))
+        flush()
+        return replies, len(replies), error
+
+    def _account_submit_bytes(self, request: dict, length: int) -> None:
+        """Ingress accounting for ONE submit frame — mirrors the
+        serving_ingress_bytes law (submission frames only; stats polls
+        would skew the measured side)."""
+        name = request.get("tenant")
+        t = self._tenants.get(name) if isinstance(name, str) else None
+        if t is None:
+            return
+        t.ingress_bytes += wire._HEADER.size + length
+        if obs_runtime.STATE.enabled:
+            t.telemetry.ingress_bytes.inc(wire._HEADER.size + length)
+            t.telemetry.submit_frames.inc()
+
+    def _handle_submit_batch(
+        self, items: Sequence[Tuple[dict, int, Any]]
+    ) -> List[bytes]:
+        """Admit a run of consecutive decoded submit frames in one
+        pass: one clock read across the run (the frames were all on
+        the socket at the same wakeup) and per-tenant ingress-byte
+        counters bumped once per run instead of once per frame. Every
+        frame still walks the FULL per-frame gate order (dedup →
+        breaker → trust → shape → staleness → credit → WAL-before-ack
+        → enqueue) in arrival order under its own ``serving.admission``
+        span (child of the sending client's stamped context), with the
+        same malformed-field guard as :meth:`handle_request` — acks
+        are bit-identical to the per-frame door."""
+        telemetry = obs_runtime.STATE.enabled
+        now = self._clock()
+        # bytes first (the per-frame loop counts a frame's bytes before
+        # computing its ack), summed per tenant in one pass
+        per_tenant: Dict[str, Tuple[int, int]] = {}
+        for request, length, _ctx in items:
+            name = request.get("tenant")
+            if isinstance(name, str) and name in self._tenants:
+                nbytes, frames = per_tenant.get(name, (0, 0))
+                per_tenant[name] = (
+                    nbytes + wire._HEADER.size + length, frames + 1
+                )
+        for name, (nbytes, frames) in per_tenant.items():
+            t = self._tenants[name]
+            t.ingress_bytes += nbytes
+            if telemetry:
+                t.telemetry.ingress_bytes.inc(nbytes)
+                t.telemetry.submit_frames.inc(frames)
+        replies: List[bytes] = []
+        for request, _length, ctx in items:
+            tenant = request.get("tenant", "")
+            if telemetry and ctx is not None:
+                obs_tracing.adopt_context(ctx)
+            try:
+                seq = request.get("seq")
+                wi = request.get("_wire_inflation")
+                with obs_tracing.span(
+                    "serving.admission",
+                    tenant=tenant if isinstance(tenant, str) else "?",
+                    **self._shard_tag,
+                ):
+                    accepted, reason = self.submit(
+                        tenant if isinstance(tenant, str) else "",
+                        str(request.get("client", "")),
+                        int(request.get("round", 0)),
+                        request.get("gradient"),
+                        seq=None if seq is None else int(seq),
+                        wire_inflation=None if wi is None else float(wi),
+                        _now=now,
+                    )
+            except Exception:  # noqa: BLE001 — client bug, not ours
+                self.malformed_requests += 1
+                if telemetry:
+                    self._m_malformed.inc()
+                replies.append(encode_reply({
+                    "kind": "ack",
+                    "accepted": False,
+                    "reason": REJECTED_MALFORMED,
+                    "round": -1,
+                }))
+                continue
+            t = (
+                self._tenants.get(tenant)
+                if isinstance(tenant, str)
+                else None
+            )
+            replies.append(encode_reply({
+                "kind": "ack",
+                "accepted": accepted,
+                "reason": reason,
+                "round": t.round_id if t is not None else -1,
+            }))
+        return replies
 
     # -- scheduling ------------------------------------------------------
 
@@ -1202,10 +1439,14 @@ class ServingFrontend:
                     # ragged tenants pack at the EXACT cohort size (the
                     # compiled shape lives in the flat batch); ladder
                     # tenants pad to their bucket as before
+                    # ragged rounds keep wire-quantized rows compressed
+                    # (codes+scales) all the way into the fold — the
+                    # executor dequantizes device-side
                     cohort = build_cohort(
                         subs, t.round_id,
                         None if ragged_served else t.ladder,
                         t.cfg.staleness, tenant=t.cfg.name, track=track,
+                        quantized=ragged_served,
                     )
                 round_span.set(bucket=cohort.bucket)
                 assert self._device_lock is not None
@@ -1334,10 +1575,14 @@ class ServingFrontend:
                     subs, t.round_id,
                     None if ragged_served else t.ladder,
                     t.cfg.staleness, tenant=t.cfg.name, track=track,
+                    quantized=ragged_served,
                 )
             try:
                 view: Optional[RaggedView] = None
-                if ragged_served and bool(np.isfinite(cohort.matrix).all()):
+                # cohort.finite() judges a quantized cohort from its
+                # codes+scales without materializing host f32 rows —
+                # exactly np.isfinite(cohort.matrix).all()
+                if ragged_served and cohort.finite():
                     assert self._ragged is not None
                     view = self._ragged.aggregate_sync(t.cfg.name, cohort)
                 if view is not None:
@@ -1403,94 +1648,102 @@ class ServingFrontend:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        """Batched TCP read loop: each wakeup drains EVERY complete
+        frame queued on the socket into zero-copy memoryview slices
+        over one growable receive buffer and serves them as ONE
+        :meth:`serve_frames` batch — no per-frame ``readexactly``
+        round-trips, no per-frame ``bytes`` copies, one reply write +
+        drain per wakeup. Replies stay in arrival order.
+
+        Framing faults resynchronize instead of tearing down the
+        queue: an oversized length prefix counts a bad frame and the
+        parser discards exactly the declared payload (streaming — the
+        buffer never grows past the declared bytes) before resuming at
+        the next length prefix, so frames queued behind it still
+        serve; a frame torn by EOF (partial header or payload) counts
+        a bad frame at close. Only a frame that FAILS decode (forged
+        HMAC / tampered pickle) still drops the peer — it names no
+        trustable tenant."""
         self._conns.add(writer)
+        hdr = wire._HEADER.size
+        buf = bytearray()
+        skip = 0  # bytes of an oversized frame's payload still to discard
         try:
             while True:
+                chunk = await reader.read(_INGRESS_READ_CHUNK)
+                at_eof = not chunk
+                if chunk and skip:
+                    if len(chunk) <= skip:
+                        skip -= len(chunk)
+                        continue
+                    chunk = chunk[skip:]
+                    skip = 0
+                if chunk:
+                    buf += chunk
+                pos = 0
+                http = False
+                drop = False
+                mv = memoryview(buf)
                 try:
-                    header = await reader.readexactly(wire._HEADER.size)
-                except asyncio.IncompleteReadError:
+                    bodies: List[Any] = []
+                    while len(buf) - pos >= hdr:
+                        if bytes(mv[pos:pos + hdr]) == _HTTP_GET_PREFIX:
+                            # the same TCP ingress doubles as the
+                            # Prometheus scrape endpoint: a peer whose
+                            # next frame opens with "GET " is an HTTP
+                            # scraper, not a wire client (as a length
+                            # prefix those 4 bytes would name a ~1.2 GB
+                            # frame no serving client sends)
+                            http = True
+                            break
+                        (length,) = wire._HEADER.unpack(mv[pos:pos + hdr])
+                        if length > wire.MAX_FRAME:
+                            # oversized prefix: as hostile as a tampered
+                            # frame — count it, discard exactly the
+                            # declared payload, resync at the next
+                            # length prefix (frames queued behind it
+                            # still serve)
+                            self._count_bad_frame()
+                            avail = len(buf) - pos - hdr
+                            if avail >= length:
+                                pos += hdr + int(length)
+                                continue
+                            skip = int(length) - avail
+                            pos = len(buf)
+                            break
+                        if len(buf) - pos - hdr < length:
+                            break  # incomplete frame: wait for more bytes
+                        bodies.append(mv[pos + hdr: pos + hdr + length])
+                        pos += hdr + length
+                    if bodies:
+                        replies, _served, err = self.serve_frames(bodies)
+                        if replies:
+                            writer.write(b"".join(replies))
+                            await writer.drain()
+                        if err is not None:
+                            drop = True
+                finally:
+                    # the memoryview slices must die before the buffer
+                    # compaction below — bytearray refuses to resize
+                    # while exports are live
+                    del bodies
+                    mv.release()
+                del buf[:pos]
+                if drop:
                     break
-                if header == _HTTP_GET_PREFIX:
-                    # the same TCP ingress doubles as the Prometheus
-                    # scrape endpoint: a peer opening with "GET " is an
-                    # HTTP scraper, not a wire client. As a length
-                    # prefix those 4 bytes would name a ~1.2 GB frame —
-                    # technically under MAX_FRAME, so this sniff does
-                    # shadow that one exact frame size, but no serving
-                    # client sends GB-scale control frames and before
-                    # this branch such a peer just hung for 1.2 GB and
-                    # was dropped as a bad frame
-                    await self._serve_http_metrics(reader, writer)
+                if http:
+                    await self._serve_http_metrics(
+                        reader, writer, initial=bytes(buf)
+                    )
                     break
-                (length,) = wire._HEADER.unpack(header)
-                if length > wire.MAX_FRAME:
-                    # an oversized prefix is as hostile as a tampered
-                    # frame — count it, never a silent drop
-                    self._count_bad_frame()
+                if at_eof:
+                    if buf:
+                        # torn frame: a partial header or payload cut
+                        # off by the close — count it, never silent
+                        # (an oversized frame torn mid-discard was
+                        # already counted at its header)
+                        self._count_bad_frame()
                     break
-                body = await reader.readexactly(length)
-                try:
-                    adopted = None
-                    with obs_tracing.span(
-                        "serving.ingress.decode", bytes=length
-                    ):
-                        # stats come from the STILL-COMPRESSED payload
-                        # (post-HMAC): the per-block inflation ratio a
-                        # residual-shaping client cannot scrub after
-                        # the fact rides into admission alongside the
-                        # decoded gradient
-                        request, wire_stats = wire.decode_with_stats(body)
-                        if isinstance(request, dict):
-                            # the ingress is the ONLY author of this
-                            # key: a client-stamped value (e.g. a
-                            # shaping attacker whitewashing itself
-                            # with 1.0) is discarded, then the
-                            # measured ratio — when the frame carried
-                            # a blockwise payload — is stamped fresh
-                            request.pop("_wire_inflation", None)
-                            if (
-                                wire_stats is not None
-                                and request.get("kind") == "submit"
-                            ):
-                                request["_wire_inflation"] = wire_stats[
-                                    "max_inflation"
-                                ]
-                        # decode adopted any _trace_ctx stamp, but the
-                        # decode span's exit resets the contextvar to
-                        # its token — capture the adopted position and
-                        # restore it after the span closes, or the
-                        # client-submit -> admission linkage dies here
-                        # (enabled-only: the disabled path must stay a
-                        # flag check, no contextvar traffic)
-                        if obs_runtime.STATE.enabled:
-                            adopted = obs_tracing.current_context()
-                    if adopted is not None:
-                        obs_tracing.adopt_context(adopted)
-                except Exception:  # noqa: BLE001 — forged/tampered frame
-                    # a frame that fails HMAC/unpickle names no trustable
-                    # tenant; count it at the frontend and drop the peer
-                    self._count_bad_frame()
-                    break
-                name = (
-                    request.get("tenant")
-                    if isinstance(request, dict)
-                    else None
-                )
-                t = (
-                    self._tenants.get(name)
-                    if isinstance(name, str)
-                    else None
-                )
-                # ingress accounting mirrors the serving_ingress_bytes
-                # law, which prices SUBMISSION frames — stats polls
-                # would skew the measured side
-                if t is not None and request.get("kind") == "submit":
-                    t.ingress_bytes += wire._HEADER.size + length
-                    if obs_runtime.STATE.enabled:
-                        t.telemetry.ingress_bytes.inc(wire._HEADER.size + length)
-                        t.telemetry.submit_frames.inc()
-                writer.write(encode_reply(self.handle_request(request)))
-                await writer.drain()
         finally:
             self._conns.discard(writer)
             writer.close()
@@ -1505,14 +1758,20 @@ class ServingFrontend:
             self._m_bad_frames.inc()
 
     async def _serve_http_metrics(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        *,
+        initial: bytes = b"",
     ) -> None:
         """Answer one HTTP GET on the wire ingress with the process
         metrics registry in Prometheus text exposition format (0.0.4).
         The request is drained up to its blank line (bounded) so the
         scraper sees a clean close; rendering is an in-memory string
-        build, safe on the admission loop."""
-        data = b""
+        build, safe on the admission loop. ``initial`` is whatever the
+        batched read loop already pulled off the socket past the "GET "
+        sniff (the request may have arrived whole in one chunk)."""
+        data = initial
         while b"\r\n\r\n" not in data and len(data) < _HTTP_MAX_REQUEST:
             chunk = await reader.read(1024)
             if not chunk:
@@ -1664,6 +1923,12 @@ class ServingFrontend:
                 "bad_frames": self.bad_frames,
                 "malformed_requests": self.malformed_requests,
                 "callback_errors": self.callback_errors,
+                # batched-door accounting: serve_frames calls, frames
+                # they carried, and the largest single batch (the
+                # smoke's proof the ingress actually amortizes)
+                "ingress_batches": self.ingress_batches,
+                "ingress_frames": self.ingress_frames_batched,
+                "ingress_max_batch": self.ingress_max_batch,
                 # ragged dispatch accounting (None = escape hatch on):
                 # groups/executors, device calls, batch coalescing
                 "ragged": (
@@ -1698,17 +1963,16 @@ def serve_frame(frontend: ServingFrontend, frame_body: bytes) -> bytes:
     """In-process wire path: decode one frame body, serve it, encode the
     reply — the exact codec/HMAC round the TCP ingress runs, minus the
     socket (the bench's 10k-client swarm exercises the wire cost this
-    way without 10k TCP connections). Pre-decode block stats are
-    threaded exactly like ``_handle_conn``: the ingress is the only
-    author of ``_wire_inflation`` (a client-stamped value is
-    discarded), and the measured ratio rides into admission when the
-    frame carried a blockwise payload."""
-    request, wire_stats = wire.decode_with_stats(frame_body)
-    if isinstance(request, dict):
-        request.pop("_wire_inflation", None)
-        if wire_stats is not None and request.get("kind") == "submit":
-            request["_wire_inflation"] = wire_stats["max_inflation"]
-    return encode_reply(frontend.handle_request(request))
+    way without 10k TCP connections). Routed through the SAME batched
+    door as the TCP read loop (:meth:`ServingFrontend.serve_frames`,
+    batch of one), so inflation-stamp ownership, quantized-row
+    admission, and accounting cannot drift between the two paths; a
+    frame that fails HMAC/decode counts in ``bad_frames`` and
+    re-raises, mirroring the dropped-peer contract."""
+    replies, _served, err = frontend.serve_frames([frame_body])
+    if err is not None:
+        raise err
+    return replies[0]
 
 
 class ServingClient:

@@ -103,6 +103,27 @@ def ragged_segment_sum_fn(
     return segment_sum
 
 
+def ragged_segment_dequant_fn(mode: str, block: int) -> Optional[Callable]:
+    """Pre-trace dispatch for the FUSED-dequant contraction kernel
+    (``ops.pallas_kernels.ragged_segment_sum_dequant_pallas``): same
+    explicit opt-in as :func:`ragged_segment_sum_fn`, additionally
+    keyed by the batch's wire codec spec. ``None`` keeps the XLA
+    mirror (``flat_dequantize`` at program entry + einsum contraction
+    — the authoritative bit-parity path)."""
+    if os.environ.get("BYZPY_TPU_RAGGED_PALLAS", "0") != "1":
+        return None
+    if mode == "s4" and block % 2:
+        return None
+    from ..ops.pallas_kernels import ragged_segment_sum_dequant_pallas
+
+    def seg_dequant(codes, scales, weights, *, d):
+        return ragged_segment_sum_dequant_pallas(
+            codes, scales, weights, mode=mode, block=block, d=d
+        )
+
+    return seg_dequant
+
+
 @dataclass(frozen=True)
 class RaggedView:
     """One cohort's slice of a ragged dispatch: the aggregate vector
@@ -164,7 +185,18 @@ class RaggedExecutor:
         self.cohorts_dispatched = 0
         #: largest number of cohorts one device call carried
         self.max_batch = 0
-        segment_sum = ragged_segment_sum_fn(self.rows, self.dim)
+        #: device dispatches whose rows entered the program as wire
+        #: codes (no host f32 materialization of the batch)
+        self.quantized_dispatches = 0
+        self._fn = fn
+        self._with_evidence = bool(with_evidence)
+        self._segment_sum = ragged_segment_sum_fn(self.rows, self.dim)
+        #: one lazily-built jitted program per wire codec spec the
+        #: batched ingress actually admits ((mode, block) keys; in
+        #: practice a deployment pins ONE wire precision, so this adds
+        #: a single extra compile-cache entry, accounted like the rest)
+        self._jitted_q: Dict[tuple, Any] = {}
+        segment_sum = self._segment_sum
         n_cohorts = self.max_cohorts
 
         def program(flat, seg, offsets, lengths, weights):
@@ -190,11 +222,101 @@ class RaggedExecutor:
 
         self._jitted = jax.jit(program)
 
+    def _jitted_quant(self, mode: str, block: int):
+        """The quantized-entry twin of the dense program, per wire
+        codec spec: consumes the flat batch as stacked codes + scales,
+        dequantizes as the FIRST traced op
+        (``ops.ragged.flat_dequantize`` — bit-identical to the host
+        wire codec), and runs the identical aggregation body, so a
+        quantized round's aggregate is bit-for-bit the dense program's
+        on the ingress-decoded rows. Under ``BYZPY_TPU_RAGGED_PALLAS=1``
+        the trailing segment-sum contraction additionally fuses the
+        dequant INTO the kernel (codes travel to the MXU tile), with
+        staleness weights folded into the per-cohort weight rows —
+        the Pallas path's documented ulp-level contract."""
+        key = (mode, block)
+        jitted = self._jitted_q.get(key)
+        if jitted is not None:
+            return jitted
+        fn = self._fn
+        n_cohorts = self.max_cohorts
+        dim = self.dim
+        with_evidence = self._with_evidence
+        base_segment_sum = self._segment_sum
+        fused = (
+            ragged_segment_dequant_fn(mode, block)
+            if base_segment_sum is not None else None
+        )
+
+        def program_q(codes, scales_q, seg, offsets, lengths, weights):
+            with jax.named_scope("serving.ragged_dequant"):
+                flat = ragged_ops.flat_dequantize(
+                    codes, scales_q, mode=mode, block=block, d=dim
+                )
+            with jax.named_scope("serving.ragged_scale"):
+                scaled = flat * weights[:, None].astype(flat.dtype)
+            segment_sum = base_segment_sum
+            if fused is not None:
+                def segment_sum(x, w):
+                    # `x is scaled` resolves at TRACE time: only the
+                    # contraction over the scaled flat rows may take
+                    # the fused kernel (sorted/derived operands keep
+                    # the dense kernel — their bits are not wire codes)
+                    if x is scaled:
+                        return fused(
+                            codes, scales_q,
+                            w * weights[None, :].astype(w.dtype), d=dim,
+                        )
+                    return base_segment_sum(x, w)
+            with jax.named_scope("serving.ragged_aggregate"):
+                aggs, score, keep = fn(
+                    scaled, seg, offsets, lengths,
+                    n_cohorts=n_cohorts, segment_sum=segment_sum,
+                )
+            if not with_evidence:
+                return aggs, score, keep, None, None
+            with jax.named_scope("serving.ragged_evidence"):
+                norm, cos = ragged_ops.ragged_evidence(
+                    scaled, seg, aggs, n_cohorts=n_cohorts
+                )
+            return aggs, score, keep, norm, cos
+
+        jitted = self._jitted_q[key] = jax.jit(program_q)
+        return jitted
+
+    @staticmethod
+    def _quant_spec(cohorts: Sequence[Cohort]) -> Optional[tuple]:
+        """The shared wire codec spec when EVERY cohort in the batch is
+        still quantized with identical layout — the precondition for
+        the quantized-entry program; mixed batches densify (lazily,
+        bit-identically) and take the dense program."""
+        c0 = cohorts[0]
+        if not c0.quantized:
+            return None
+        spec = (
+            c0.qmode, c0.qblock,
+            int(c0.qcodes.shape[1]), int(c0.qscales.shape[1]),
+        )
+        for c in cohorts[1:]:
+            if not c.quantized or (
+                c.qmode, c.qblock,
+                int(c.qcodes.shape[1]), int(c.qscales.shape[1]),
+            ) != spec:
+                return None
+        return spec
+
     def cache_size(self) -> Optional[int]:
         try:
-            return int(self._jitted._cache_size())
+            return int(self._jitted._cache_size()) + sum(
+                int(j._cache_size()) for j in self._jitted_q.values()
+            )
         except Exception:  # noqa: BLE001 — introspection API drift
             return None
+
+    def expected_compiles(self) -> int:
+        """Compile-cache entries this executor legitimately owns: the
+        dense program plus one per wire codec spec seen."""
+        return 1 + len(self._jitted_q)
 
     def aggregate(
         self, cohorts: Sequence[Cohort], tenants: Sequence[str]
@@ -214,7 +336,6 @@ class RaggedExecutor:
             raise ValueError(
                 f"batch of {fill} rows exceeds row capacity {self.rows}"
             )
-        flat = np.zeros((self.rows, self.dim), np.float32)
         seg = np.full((self.rows,), self.max_cohorts, np.int32)
         weights = np.zeros((self.rows,), np.float32)
         offsets = np.full((self.max_cohorts,), fill, np.int32)
@@ -222,24 +343,48 @@ class RaggedExecutor:
         off = 0
         for c, cohort in enumerate(cohorts):
             m = sizes[c]
-            flat[off:off + m] = cohort.matrix[:m]
             weights[off:off + m] = cohort.weights[:m]
             seg[off:off + m] = c
             offsets[c] = off
             lengths[c] = m
             off += m
+        qspec = self._quant_spec(cohorts)
+        if qspec is not None:
+            # batched-ingress hot path: the flat batch stays WIRE codes
+            # on host; f32 rows first exist inside the jitted program
+            mode, block, ncodes, nb = qspec
+            codes = np.zeros((self.rows, ncodes), cohorts[0].qcodes.dtype)
+            scales = np.zeros((self.rows, nb), np.float32)
+            off = 0
+            for c, cohort in enumerate(cohorts):
+                m = sizes[c]
+                codes[off:off + m] = cohort.qcodes[:m]
+                scales[off:off + m] = cohort.qscales[:m]
+                off += m
+            jitted = self._jitted_quant(mode, block)
+            rows_args = (jnp.asarray(codes), jnp.asarray(scales))
+            self.quantized_dispatches += 1
+        else:
+            flat = np.zeros((self.rows, self.dim), np.float32)
+            off = 0
+            for c, cohort in enumerate(cohorts):
+                m = sizes[c]
+                flat[off:off + m] = cohort.matrix[:m]
+                off += m
+            jitted = self._jitted
+            rows_args = (jnp.asarray(flat),)
         label = tenants[0] if len(tenants) == 1 else ",".join(tenants)
         track = f"tenant:{tenants[0]}" if len(tenants) == 1 else None
         with obs_tracing.span(
             "serving.fold", track=track, tenant=label,
-            cohorts=n, rows=fill,
+            cohorts=n, rows=fill, quantized=qspec is not None,
         ):
             with obs_tracing.device_span(
                 "serving.device_step", track=track, tenant=label,
                 cohorts=n, rows=fill, ragged=True,
             ):
-                aggs, score, keep, norm, cos = self._jitted(
-                    jnp.asarray(flat), jnp.asarray(seg),
+                aggs, score, keep, norm, cos = jitted(
+                    *rows_args, jnp.asarray(seg),
                     jnp.asarray(offsets), jnp.asarray(lengths),
                     jnp.asarray(weights),
                 )
@@ -354,6 +499,11 @@ class RaggedRuntime:
             "tenants": sorted(self._by_tenant),
             "dispatches": sum(e.dispatches for e in execs),
             "cohorts_dispatched": sum(e.cohorts_dispatched for e in execs),
+            # dispatches whose rows entered the program as wire codes
+            # (device-side dequant; no host f32 batch was built)
+            "quantized_dispatches": sum(
+                e.quantized_dispatches for e in execs
+            ),
             "compile_entries": sum(
                 e.cache_size() or 0 for e in execs
             ),
@@ -385,7 +535,7 @@ class RaggedRuntime:
             return
         total = sum(sizes)
         obs_jitstats.note_cache_size(RAGGED_SITE, total)
-        expected = len(execs)
+        expected = sum(e.expected_compiles() for e in execs)
         if total > expected and total > self._warn_high:
             self._warn_high = total
             obs_metrics.registry().counter(
@@ -450,7 +600,10 @@ def _dispatch_group(
     finite_items: List[Tuple[int, str, Cohort]] = []
     results: List[Any] = [None] * len(items)
     for i, (tenant, cohort, fallback) in enumerate(items):
-        if bool(np.isfinite(cohort.matrix).all()):
+        # Cohort.finite() == isfinite(matrix).all(), but decided from
+        # codes × scales for quantized cohorts — the gate must not be
+        # the thing that forces a host dequant of the batched path
+        if cohort.finite():
             finite_items.append((i, tenant, cohort))
         else:
             try:
@@ -609,5 +762,6 @@ __all__ = [
     "RaggedRuntime",
     "RaggedView",
     "ragged_enabled",
+    "ragged_segment_dequant_fn",
     "ragged_segment_sum_fn",
 ]

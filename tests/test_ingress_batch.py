@@ -1,0 +1,306 @@
+"""Batched ingress bit-parity: the PR-16 wire-rate front door.
+
+Pins the tentpole contract: draining a connection's queued frames into
+ONE ``serve_frames`` batch (vectorized decode, amortized HMAC,
+quantized rows kept compressed into the ragged fold) is bit-identical
+to serving the same frames one at a time — identical acks, identical
+round aggregates, identical pre-decode inflation forensics — across
+every wire precision, with hostile frames (tampered / oversized /
+duplicate-seq) interleaved mid-batch."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+from byzpy_tpu.engine.actor import wire
+from byzpy_tpu.serving import ServingFrontend, TenantConfig
+from byzpy_tpu.serving.frontend import serve_frame
+
+D = 4096  # above WIRE_QUANT_MIN_SIZE so blockwise modes engage
+
+PRECISIONS = ("off", "bf16", "int8", "fp8", "s4")
+
+
+def _frontend(**kw):
+    cfg = dict(
+        name="m0", dim=D, aggregator=CoordinateWiseTrimmedMean(f=1),
+        cohort_cap=16, window_s=0.01,
+    )
+    cfg.update(kw)
+    return ServingFrontend([TenantConfig(**cfg)])
+
+
+def _frames(n=6, *, dup_at=None, seed=0):
+    """n submit frame bodies (length prefixes stripped); ``dup_at``
+    re-sends frame 0's (client, seq) key mid-batch."""
+    rng = np.random.default_rng(seed)
+    bodies = []
+    for i in range(n):
+        client, seq = f"c{i}", 0
+        if dup_at is not None and i == dup_at:
+            client, seq = "c0", 0  # replayed idempotency key
+        bodies.append(wire.encode({
+            "kind": "submit", "tenant": "m0", "client": client,
+            "round": 0, "gradient": rng.normal(size=D).astype(np.float32),
+            "seq": seq,
+        })[4:])
+    return bodies
+
+
+@pytest.mark.parametrize("precision", PRECISIONS)
+def test_batched_matches_per_frame_bitwise(precision, monkeypatch):
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", precision)
+    bodies = _frames(dup_at=3)
+
+    fe_b = _frontend()
+    replies, served, err = fe_b.serve_frames(bodies)
+    assert err is None and served == len(bodies)
+    acks_b = [wire.decode(r[4:]) for r in replies]
+
+    fe_p = _frontend()
+    acks_p = [wire.decode(serve_frame(fe_p, b)[4:]) for b in bodies]
+
+    assert acks_b == acks_p
+    assert acks_b[3]["reason"] == "duplicate"  # mid-batch dedup held
+    assert [a["accepted"] for a in acks_b] == [True] * len(bodies)
+
+    closed_b = fe_b.close_round_nowait("m0")
+    closed_p = fe_p.close_round_nowait("m0")
+    assert closed_b is not None and closed_p is not None
+    vb, vp = np.asarray(closed_b[2]), np.asarray(closed_p[2])
+    assert vb.tobytes() == vp.tobytes()  # aggregates byte-identical
+    # pre-decode inflation forensics identical, frame for frame
+    assert closed_b[1].wire_inflations == closed_p[1].wire_inflations
+    if precision in wire.BLOCKWISE_WIRE_MODES:
+        assert all(r is not None for r in closed_b[1].wire_inflations)
+    assert (
+        fe_b.stats()["m0"]["ledger"]["totals"]
+        == fe_p.stats()["m0"]["ledger"]["totals"]
+    )
+    assert fe_b.ingress_max_batch == len(bodies)
+    assert (
+        fe_b._tenants["m0"].ingress_bytes
+        == fe_p._tenants["m0"].ingress_bytes
+    )
+
+
+@pytest.mark.parametrize("precision", ("off", "s4"))
+def test_tampered_frame_mid_batch_truncates_at_parity(
+    precision, monkeypatch
+):
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", precision)
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "batch-parity-key")
+    bodies = _frames(5)
+    bad = bytearray(bodies[2])
+    bad[-1] ^= 0xFF  # flip a payload byte under the HMAC
+    bodies[2] = bytes(bad)
+
+    fe_b = _frontend()
+    replies, served, err = fe_b.serve_frames(bodies)
+    # frames BEFORE the tampered one served; it and everything after
+    # did not — exactly where the per-frame door dropped the peer
+    assert served == 2 and err is not None
+    assert fe_b.bad_frames == 1
+    acks_b = [wire.decode(r[4:]) for r in replies]
+
+    fe_p = _frontend()
+    acks_p = []
+    for i, b in enumerate(bodies):
+        if i == 2:
+            with pytest.raises(Exception):
+                serve_frame(fe_p, b)
+            break
+        acks_p.append(wire.decode(serve_frame(fe_p, b)[4:]))
+    assert acks_b == acks_p
+    assert fe_p.bad_frames == 1
+    assert (
+        fe_b.stats()["m0"]["ledger"]["totals"]
+        == fe_p.stats()["m0"]["ledger"]["totals"]
+    )
+
+
+def test_hostile_interleave_over_tcp(monkeypatch):
+    """One connection, one write: [good, dup-seq, oversized junk,
+    good, tampered, good]. The batched read loop serves every frame up
+    to the tampered one (resyncing past the oversized frame), then
+    drops the peer — acks in arrival order, both framing faults
+    counted."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "s4")
+    monkeypatch.setenv("BYZPY_TPU_WIRE_KEY", "batch-parity-key")
+    monkeypatch.setattr(wire, "MAX_FRAME", 1 << 16)
+    bodies = _frames(4, dup_at=1)
+    tampered = bytearray(bodies[3])
+    tampered[-1] ^= 0xFF
+    junk_len = wire.MAX_FRAME + 64
+
+    async def run():
+        fe = _frontend()
+        await fe.start()
+        host, port = await fe.serve()
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(
+            wire._HEADER.pack(len(bodies[0])) + bodies[0]
+            + wire._HEADER.pack(len(bodies[1])) + bodies[1]
+            + wire._HEADER.pack(junk_len) + b"\xee" * junk_len
+            + wire._HEADER.pack(len(bodies[2])) + bodies[2]
+            + wire._HEADER.pack(len(tampered)) + bytes(tampered)
+        )
+        writer.write_eof()
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        await fe.close()
+        return data, fe
+
+    data, fe = asyncio.run(run())
+    acks = []
+    while data:
+        (ln,) = wire._HEADER.unpack(data[:4])
+        acks.append(wire.decode(data[4:4 + ln]))
+        data = data[4 + ln:]
+    assert [a["reason"] for a in acks] == [
+        "accepted", "duplicate", "accepted"
+    ]
+    assert fe.bad_frames == 2  # oversized + tampered
+    assert fe.stats()["m0"]["ledger"]["totals"]["accepted"] == 2
+
+
+def test_torn_frame_at_eof_counts_bad_frame():
+    async def run():
+        fe = _frontend()
+        await fe.start()
+        host, port = await fe.serve()
+        reader, writer = await asyncio.open_connection(host, port)
+        body = _frames(1)[0]
+        writer.write(wire._HEADER.pack(len(body)) + body[: len(body) // 2])
+        writer.write_eof()
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        await fe.close()
+        return data, fe.bad_frames
+
+    data, bad = asyncio.run(run())
+    assert data == b"" and bad == 1
+
+
+# ---------------------------------------------------------------------------
+# device-side dequantization fused into the ragged fold
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ("int8", "fp8", "s4"))
+def test_fused_dequant_kernel_matches_xla_fallback(mode):
+    from byzpy_tpu.ops.pallas_kernels import ragged_segment_sum_dequant_pallas
+    from byzpy_tpu.ops.ragged import flat_dequantize
+
+    rng = np.random.default_rng(5)
+    n, d, block = 12, 1024, 256
+    rows = [rng.normal(size=d).astype(np.float32) for _ in range(n)]
+    enc = [wire._np_blockwise_encode(r, block, mode) for r in rows]
+    codes = np.stack([e[0] for e in enc])
+    scales = np.stack([e[1] for e in enc])
+    seg = np.asarray(
+        [0] * 5 + [1] * 4 + [2] * 3, np.int32
+    )
+    weights = np.zeros((3, n), np.float32)
+    for i, s in enumerate(seg):
+        weights[s, i] = 1.0 if i % 3 else 0.5
+
+    fused = np.asarray(ragged_segment_sum_dequant_pallas(
+        codes, scales, weights, mode=mode, block=block, d=d
+    ))
+    flat = np.asarray(flat_dequantize(
+        codes, scales, mode=mode, block=block, d=d
+    ))
+    ref = np.einsum("cn,nd->cd", weights, flat)
+    np.testing.assert_allclose(fused, ref, rtol=1e-6, atol=1e-6)
+    # XLA dequant mirror is bit-identical to the wire codec's numpy one
+    host = wire.decode_rows_np(
+        codes, scales, mode=mode, block=block, d=d
+    )
+    assert flat.tobytes() == host.tobytes()
+
+
+def test_quantized_round_keeps_rows_compressed(monkeypatch):
+    """The batched quantized path never materializes host f32 rows:
+    the cohort reaches the fold (and leaves it) as codes + scales, the
+    executor records a quantized dispatch, and the lowered program's
+    parameters show the codes entering the device AS wire bytes."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "int8")
+    import jax
+
+    fe = _frontend()
+    replies, served, err = fe.serve_frames(_frames(6))
+    assert err is None and served == 6
+    closed = fe.close_round_nowait("m0")
+    assert closed is not None
+    cohort = closed[1]
+    assert cohort.quantized
+    assert cohort.dense is None  # no consumer forced a host decode
+    ex = fe._ragged.executor_for("m0")
+    assert ex is not None and ex.quantized_dispatches == 1
+    jitted = ex._jitted_q[("int8", cohort.qblock)]
+    ncodes = cohort.qcodes.shape[1]
+    nb = cohort.qscales.shape[1]
+    hlo = jitted.lower(
+        jax.ShapeDtypeStruct((ex.rows, ncodes), np.int8),
+        jax.ShapeDtypeStruct((ex.rows, nb), np.float32),
+        jax.ShapeDtypeStruct((ex.rows,), np.int32),
+        jax.ShapeDtypeStruct((ex.max_cohorts,), np.int32),
+        jax.ShapeDtypeStruct((ex.max_cohorts,), np.int32),
+        jax.ShapeDtypeStruct((ex.rows,), np.float32),
+    ).as_text()
+    main = next(
+        line for line in hlo.splitlines() if "func.func public @main" in line
+    )
+    # int8 wire codes are a program INPUT...
+    assert f"tensor<{ex.rows}x{ncodes}xi8>" in main
+    # ...and the f32 flat batch exists only INSIDE the program (on
+    # device), never as a host-side argument
+    assert f"tensor<{ex.rows}x{D}xf32>" not in main
+
+
+@pytest.mark.parametrize("precision", ("int8", "s4"))
+def test_pallas_fused_round_matches_xla_round(precision, monkeypatch):
+    """With the Pallas ragged fold enabled, the fused dequant kernel
+    (codes travel into the MXU tile) produces the same round aggregate
+    as the XLA dequant-then-fold program — interpret mode on CPU is
+    the same contraction the TPU kernel runs."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", precision)
+    bodies = _frames(6)
+
+    monkeypatch.setenv("BYZPY_TPU_RAGGED_PALLAS", "1")
+    fe_k = _frontend()
+    _, served, err = fe_k.serve_frames(bodies)
+    assert err is None and served == 6
+    vec_k = np.asarray(fe_k.close_round_nowait("m0")[2])
+
+    monkeypatch.delenv("BYZPY_TPU_RAGGED_PALLAS")
+    fe_x = _frontend()
+    fe_x.serve_frames(bodies)
+    vec_x = np.asarray(fe_x.close_round_nowait("m0")[2])
+    np.testing.assert_allclose(vec_k, vec_x, rtol=1e-6, atol=1e-6)
+
+
+def test_mixed_spec_round_falls_back_dense(monkeypatch):
+    """A round mixing wire-quantized and in-process dense submissions
+    cannot stack codes — it falls back to the dense cohort layout,
+    decoding admitted rows bit-identically to a per-frame ingress."""
+    monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", "int8")
+    fe = _frontend()
+    replies, served, err = fe.serve_frames(_frames(4))
+    assert err is None and served == 4
+    rng = np.random.default_rng(9)
+    for i in range(2):
+        ok, reason = fe.submit(
+            "m0", f"p{i}", 0, rng.normal(size=D).astype(np.float32)
+        )
+        assert ok, reason
+    closed = fe.close_round_nowait("m0")
+    assert closed is not None
+    cohort = closed[1]
+    assert not cohort.quantized and cohort.dense is not None
+    assert np.isfinite(np.asarray(closed[2])).all()

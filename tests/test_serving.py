@@ -612,21 +612,71 @@ def test_bench_ragged_sizes_respect_aggregator_floor():
         assert max(sizes) <= cap
 
 
-def test_oversized_frame_counted_and_peer_dropped():
+def test_oversized_frame_counted_and_connection_resyncs():
     # a length prefix beyond MAX_FRAME is as hostile as a tampered
-    # frame: the peer is dropped AND the event is visible in bad_frames
+    # frame: it counts in bad_frames — but the batched ingress discards
+    # exactly the declared payload and RESYNCS at the next length
+    # prefix instead of tearing down the connection's queued frames
     async def run():
         fe = ServingFrontend([_tenant()])
         await fe.start()
         host, port = await fe.serve()
         reader, writer = await asyncio.open_connection(host, port)
+        # torn oversized frame: header only, then EOF — counted once,
+        # clean close, no reply bytes
         writer.write(wire._HEADER.pack(wire.MAX_FRAME + 1))
+        writer.write_eof()
         await writer.drain()
         data = await reader.read()
         writer.close()
         await fe.close()
         assert data == b""
         assert fe.bad_frames == 1
+        assert fe.stats()["m0"]["ledger"]["totals"].get("accepted", 0) == 0
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_oversized_frame_mid_batch_resyncs_to_queued_frames(monkeypatch):
+    # frames queued BEHIND an oversized frame on the same connection
+    # must still serve: the parser skips the declared payload and picks
+    # up at the next length prefix (MAX_FRAME shrunk so the test can
+    # actually send the declared junk)
+    monkeypatch.setattr(wire, "MAX_FRAME", 4096)
+
+    async def run():
+        fe = ServingFrontend([_tenant()])
+        await fe.start()
+        host, port = await fe.serve()
+        reader, writer = await asyncio.open_connection(host, port)
+        good = wire.encode({
+            "kind": "submit", "tenant": "m0", "client": "c0",
+            "round": 0, "gradient": _grad(),
+        })
+        junk_len = wire.MAX_FRAME + 100
+        writer.write(
+            good
+            + wire._HEADER.pack(junk_len) + b"\xee" * junk_len
+            + wire.encode({
+                "kind": "submit", "tenant": "m0", "client": "c1",
+                "round": 0, "gradient": _grad(1),
+            })
+        )
+        writer.write_eof()
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        await fe.close()
+        acks = []
+        while data:
+            (ln,) = wire._HEADER.unpack(data[:4])
+            acks.append(wire.decode(data[4:4 + ln]))
+            data = data[4 + ln:]
+        # both real frames answered, in order, around the discarded one
+        assert [a["accepted"] for a in acks] == [True, True]
+        assert fe.bad_frames == 1
+        assert fe.stats()["m0"]["ledger"]["totals"]["accepted"] == 2
         return True
 
     assert asyncio.run(run())

@@ -546,8 +546,12 @@ def test_serving_client_uplink_error_feedback(monkeypatch):
             assert reply["accepted"], reply
 
     asyncio.run(drive())
+    from byzpy_tpu.serving.cohort import _row_dense
+
+    # the batched ingress admits blockwise rows STILL COMPRESSED —
+    # decode each queued row exactly as the fold would
     subs = fe._tenants["m0"].queue.snapshot_items()
-    sent = np.sum([s.gradient for s in subs], axis=0)
+    sent = np.sum([_row_dense(s.gradient) for s in subs], axis=0)
     for g in grads:
         true += g
     assert np.abs(sent - true).max() <= 4 * np.abs(true).max() / 14
