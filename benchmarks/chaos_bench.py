@@ -860,6 +860,167 @@ def run_shard(args, out) -> dict:
     return row
 
 
+def run_speculative(args, out) -> dict:
+    """Speculative quorum close + late-arrival repair (ISSUE 17): the
+    always-on round door must be FORENSICALLY equivalent to the barrier
+    it replaces.  Cells, asserted unconditionally:
+
+    (1) repair BIT PARITY across seeds — a 3-shard coordinator with the
+        repair horizon armed closes every round degraded (one straggler
+        past the barrier), then folds the straggler's late partial
+        through :meth:`ShardedCoordinator.repair_round`; the repaired
+        aggregate must be bit-identical to a barrier twin that waited
+        for all three shards, every round, every seed (late arrival
+        must not change a single aggregate bit — same shard-order
+        merge, same staleness discounts the rows were stamped with at
+        their ORIGINAL round);
+    (2) staleness abuse — replaying the already-repaired partial (the
+        double-fold inflation an abuser would smuggle through the
+        repair window) is rejected as a protocol violation without
+        touching the aggregate;
+    (3) forged late arrival — a compromised straggler's tampered
+        partial is excluded by the same digest cross-check the barrier
+        runs (the repair horizon is not a forensics bypass), with an
+        evidence event and the degraded close left standing."""
+    from byzpy_tpu.aggregators import MultiKrum
+    from byzpy_tpu.chaos.shards import CompromisedShard
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.serving import ShardedCoordinator, TenantConfig
+    from byzpy_tpu.serving.staleness import StalenessPolicy
+
+    dim = args.dim
+    rounds = max(4, args.rounds // 4)
+    n_clients = max(12, args.clients_grid)
+    n_shards, straggler = 3, 2
+    clients = [f"c{i:04d}" for i in range(n_clients)]
+
+    def mk_tenants():
+        return [
+            TenantConfig(
+                name="m0",
+                aggregator=MultiKrum(f=args.byzantine, q=args.byzantine + 1),
+                dim=dim,
+                cohort_cap=max(n_clients, 8),
+                staleness=StalenessPolicy(
+                    kind="exponential", gamma=0.5, cutoff=8
+                ),
+            )
+        ]
+
+    seeds = [args.seed + k for k in range(3)]
+    parity_rounds = 0
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        grads = {
+            c: rng.normal(size=dim).astype(np.float32) for c in clients
+        }
+        co = ShardedCoordinator(
+            mk_tenants(), n_shards, quorum=2, repair_horizon_rounds=2
+        )
+        twin = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
+        for r in range(rounds):
+            for c in clients:
+                ok, reason = co.submit("m0", c, r, grads[c], seq=r)
+                assert ok, (c, reason)
+                ok, reason = twin.submit("m0", c, r, grads[c], seq=r)
+                assert ok, (c, reason)
+            ref = twin.close_round_nowait("m0")
+            assert ref is not None
+            # the straggler DRAINED at the barrier (its cohort is round
+            # r's), but its reply is late: the root closes degraded at
+            # quorum with the horizon armed...
+            late = co.shards[straggler].close_partial("m0")
+            assert late is not None
+            present = [
+                co.shards[s].close_partial("m0")
+                for s in range(n_shards)
+                if s != straggler
+            ]
+            res = co.merge_partials(
+                "m0", [p for p in present if p is not None],
+                missing=[straggler],
+            )
+            assert res is not None, (seed, r)
+            # ...and the late arrival folds as a WAL-recorded repair
+            # delta, bit-identical to the barrier twin's full close
+            rep = co.repair_round("m0", late)
+            assert rep is not None, (seed, r)
+            assert rep[0] == r and ref[0] == r, (rep[0], ref[0])
+            assert np.array_equal(rep[2], ref[2]), (
+                f"repair diverged from barrier twin at seed {seed} "
+                f"round {r}: {evidence_digest(rep[2])} != "
+                f"{evidence_digest(ref[2])}"
+            )
+            parity_rounds += 1
+            # staleness-abuse: replaying the repaired partial (double-
+            # fold inflation) is a protocol violation — rejected, and
+            # the aggregate does not move
+            replay = co.repair_round("m0", late)
+            assert replay is None, (seed, r)
+        st = co.stats()["root"]["m0"]
+        assert st["speculative_closes"] == rounds, st
+        assert st["repairs"] == rounds, st
+        assert st["open_repairs"] == 0, st
+
+    # forged late arrival: the compromised straggler tampers its rows
+    # after the digest — repair_round must exclude it with evidence,
+    # and the degraded close's broadcast stands
+    rng = np.random.default_rng(args.seed)
+    grads = {c: rng.normal(size=dim).astype(np.float32) for c in clients}
+    co = ShardedCoordinator(
+        mk_tenants(), n_shards, quorum=2, repair_horizon_rounds=2
+    )
+    co.shards[straggler] = CompromisedShard(
+        co.shards[straggler], mode="bitflip", seed=args.seed,
+        n_shards=n_shards,
+    )
+    forged_rejected = 0
+    for r in range(rounds):
+        for c in clients:
+            ok, _ = co.submit("m0", c, r, grads[c], seq=r)
+            assert ok
+        late = co.shards[straggler].close_partial("m0")
+        assert late is not None
+        present = [
+            co.shards[s].close_partial("m0")
+            for s in range(n_shards)
+            if s != straggler
+        ]
+        res = co.merge_partials(
+            "m0", [p for p in present if p is not None],
+            missing=[straggler],
+        )
+        assert res is not None, r
+        before = np.asarray(res[2]).copy()
+        rep = co.repair_round("m0", late)
+        assert rep is None, f"forged late partial folded at round {r}"
+        forged_rejected += 1
+        rt_last = co._roots["m0"].last_aggregate
+        assert np.array_equal(np.asarray(rt_last), before), r
+    events = [
+        e for e in co.shard_events if e["event"] == "shard_forged"
+    ]
+    assert len(events) == rounds and all(
+        e["shard"] == straggler for e in events
+    ), events
+
+    row = {
+        "lane": "speculative",
+        "aggregator": "multi-krum",
+        "clients": n_clients,
+        "shards": n_shards,
+        "rounds": rounds,
+        "seeds": len(seeds),
+        "repair_parity_rounds": parity_rounds,
+        "repair_parity": "bit-identical",
+        "replay_rejected": "all",
+        "forged_late_rejected": forged_rejected,
+        "evidence_events": len(events),
+    }
+    _emit(row, out)
+    return row
+
+
 def run_swarm(args, out) -> dict:
     scenario = Scenario(
         name="swarm",
@@ -1197,7 +1358,7 @@ def main() -> None:
         "--lanes", type=str,
         default=(
             "grid,adaptive,serving,swarm,recovery,forensics,ragged,shard,"
-            "subint8"
+            "speculative,subint8"
         ),
         help="comma-separated lane subset",
     )
@@ -1245,6 +1406,9 @@ def main() -> None:
     forensics = run_forensics(args, args.out) if "forensics" in lanes else None
     ragged = run_ragged(args, args.out) if "ragged" in lanes else None
     shard = run_shard(args, args.out) if "shard" in lanes else None
+    speculative = (
+        run_speculative(args, args.out) if "speculative" in lanes else None
+    )
     subint8 = run_subint8(args, args.out) if "subint8" in lanes else None
 
     crashed = [r for r in grid if r.get("harness_crashed")]
@@ -1283,6 +1447,9 @@ def main() -> None:
             {k: v["forged_detected"] for k, v in shard["forgery"].items()}
             if shard
             else None
+        ),
+        "speculative_repair_parity": (
+            speculative["repair_parity"] if speculative else None
         ),
         "subint8_shaping_flagged": (
             subint8["shaping_all_flagged"] if subint8 else None
@@ -1332,6 +1499,14 @@ def main() -> None:
             v["forged_detected"] == v["rounds"]
             for v in shard["forgery"].values()
         ), shard
+    if args.smoke and speculative is not None:
+        # run_speculative asserts repair parity + replay/forgery
+        # rejection internally; pin the headline shape here too
+        assert speculative["repair_parity"] == "bit-identical", speculative
+        assert speculative["repair_parity_rounds"] > 0, speculative
+        assert (
+            speculative["forged_late_rejected"] == speculative["rounds"]
+        ), speculative
     if args.smoke and subint8 is not None:
         assert subint8["shaping_all_flagged"], subint8
         assert subint8["residual_shaping_fired"], subint8

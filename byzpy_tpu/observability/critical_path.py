@@ -273,15 +273,188 @@ def summarize(events: Sequence[dict], *, last: Optional[int] = None) -> dict:
     }
 
 
+def _clip_to_uncovered(
+    lo: float, hi: float, covered: Sequence[Tuple[float, float]]
+) -> Tuple[List[Tuple[float, float]], float]:
+    """Split ``[lo, hi)`` against a sorted, disjoint interval list:
+    returns the VISIBLE parts (outside every covered interval) and the
+    total HIDDEN duration (inside one). Pure interval arithmetic — the
+    heart of the overlap attribution."""
+    visible: List[Tuple[float, float]] = []
+    hidden = 0.0
+    t = lo
+    for c_lo, c_hi in covered:
+        if c_hi <= t:
+            continue
+        if c_lo >= hi:
+            break
+        if c_lo > t:
+            visible.append((t, min(c_lo, hi)))
+        overlap_hi = min(c_hi, hi)
+        if overlap_hi > max(c_lo, t):
+            hidden += overlap_hi - max(c_lo, t)
+        t = max(t, overlap_hi)
+        if t >= hi:
+            break
+    if t < hi:
+        visible.append((t, hi))
+    return visible, hidden
+
+
+def _add_interval(
+    covered: List[Tuple[float, float]], lo: float, hi: float
+) -> None:
+    """Insert ``[lo, hi)`` into a sorted disjoint interval list,
+    merging neighbours in place."""
+    if hi <= lo:
+        return
+    merged: List[Tuple[float, float]] = []
+    placed = False
+    for c_lo, c_hi in covered:
+        if c_hi < lo or c_lo > hi:
+            if not placed and c_lo > hi:
+                merged.append((lo, hi))
+                placed = True
+            merged.append((c_lo, c_hi))
+        else:
+            lo = min(lo, c_lo)
+            hi = max(hi, c_hi)
+    if not placed:
+        merged.append((lo, hi))
+    merged.sort(key=lambda iv: iv[0])
+    covered[:] = merged
+
+
+def blame_round_overlapped(
+    root: SpanNode, covered: List[Tuple[float, float]]
+) -> dict:
+    """One round tree's blame under CROSS-ROUND OVERLAP: the round's
+    critical-path segments are clipped against the wall-clock region
+    already claimed by EARLIER rounds (``covered``, which this call
+    extends with the round's own interval). A segment's clipped-away
+    time is ``overlap_hidden_us`` — work the pipeline hid behind a
+    previous round's tail — and the remainder is its EXCLUSIVE blame.
+    Exclusive blame over all rounds sums exactly to the UNION makespan
+    of the round intervals (each round's segments partition its
+    interval; the uncovered part of that interval is precisely the new
+    wall-clock area the round adds to the union)."""
+    segments = critical_path(root)
+    stages: Dict[Tuple[str, Optional[int]], Dict[str, float]] = {}
+    exclusive_total = 0.0
+    hidden_total = 0.0
+    for seg in segments:
+        visible, hidden = _clip_to_uncovered(seg.start, seg.end, covered)
+        excl = sum(hi - lo for lo, hi in visible)
+        slot = stages.setdefault(
+            (seg.name, seg.shard), {"blame_us": 0.0, "overlap_hidden_us": 0.0}
+        )
+        slot["blame_us"] += excl
+        slot["overlap_hidden_us"] += hidden
+        exclusive_total += excl
+        hidden_total += hidden
+    _add_interval(covered, root.ts, root.end)
+    rows = [
+        {
+            "stage": name,
+            "shard": shard,
+            "blame_us": round(slot["blame_us"], 3),
+            "overlap_hidden_us": round(slot["overlap_hidden_us"], 3),
+        }
+        for (name, shard), slot in stages.items()
+    ]
+    rows.sort(key=lambda r: -r["blame_us"])
+    return {
+        "round": root.args.get("round"),
+        "tenant": root.args.get("tenant"),
+        "root": root.name,
+        "trace": root.args.get("trace"),
+        "makespan_us": round(root.dur, 3),
+        "exclusive_us": round(exclusive_total, 3),
+        "overlap_hidden_us": round(hidden_total, 3),
+        "stages": rows,
+    }
+
+
+def summarize_overlapped(
+    events: Sequence[dict], *, last: Optional[int] = None
+) -> dict:
+    """Overlap-aware variant of :func:`summarize` for PIPELINED traces,
+    where round N+1's ingest runs while round N's merge/device tail is
+    still closing and the sequential attribution would double-count the
+    overlapped wall-clock. Rounds are processed in start order; each
+    round's critical-path segments are clipped to the region no earlier
+    round claimed, yielding per-(stage, shard) EXCLUSIVE blame plus an
+    explicit ``overlap_hidden_us`` column (critical-path time the
+    pipeline hid behind an earlier round — the measured win). Exclusive
+    blame sums exactly to the UNION makespan of the round intervals
+    (``max_blame_residual`` asserts it, same contract as the sequential
+    summarizer); on a non-overlapped trace the numbers reduce to
+    :func:`summarize`'s with a zero hidden column."""
+    roots = round_roots(build_forest(events))
+    if last is not None:
+        roots = roots[-last:]
+    covered: List[Tuple[float, float]] = []
+    rounds = [blame_round_overlapped(r, covered) for r in roots]
+    makespan_union = sum(hi - lo for lo, hi in covered)
+    acc: Dict[Tuple[str, Optional[int]], Dict[str, float]] = {}
+    for r in rounds:
+        for row in r["stages"]:
+            key = (row["stage"], row["shard"])
+            slot = acc.setdefault(
+                key,
+                {"blame_us": 0.0, "overlap_hidden_us": 0.0, "rounds": 0},
+            )
+            slot["blame_us"] += row["blame_us"]
+            slot["overlap_hidden_us"] += row["overlap_hidden_us"]
+            slot["rounds"] += 1
+    stages = [
+        {
+            "stage": name,
+            "shard": shard,
+            "rounds": int(slot["rounds"]),
+            "blame_us": round(slot["blame_us"], 3),
+            "overlap_hidden_us": round(slot["overlap_hidden_us"], 3),
+            "share": (
+                round(slot["blame_us"] / makespan_union, 4)
+                if makespan_union
+                else 0.0
+            ),
+        }
+        for (name, shard), slot in acc.items()
+    ]
+    stages.sort(key=lambda r: -r["blame_us"])
+    exclusive = sum(r["exclusive_us"] for r in rounds)
+    residual = (
+        abs(exclusive - makespan_union) / makespan_union
+        if makespan_union
+        else 0.0
+    )
+    wall = sum(r["makespan_us"] for r in rounds)
+    return {
+        "rounds": rounds,
+        "stages": stages,
+        "makespan_us": round(makespan_union, 3),
+        "overlap_hidden_us": round(
+            sum(r["overlap_hidden_us"] for r in rounds), 3
+        ),
+        "overlap_ratio": (
+            round(1.0 - makespan_union / wall, 4) if wall else 0.0
+        ),
+        "max_blame_residual": residual,
+    }
+
+
 __all__ = [
     "ROUND_ROOT_NAMES",
     "Segment",
     "SpanNode",
     "aggregate_blame",
     "blame_round",
+    "blame_round_overlapped",
     "blame_rounds",
     "build_forest",
     "critical_path",
     "round_roots",
     "summarize",
+    "summarize_overlapped",
 ]

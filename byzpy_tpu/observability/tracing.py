@@ -447,6 +447,39 @@ def device_span(name: str, track: Optional[str] = None, **attrs: Any):
     return _DeviceSpan(Span(_TRACER, name, track, attrs))
 
 
+def begin_span(name: str, track: Optional[str] = None, **attrs: Any):
+    """Open a span whose END will be reported from a DIFFERENT call
+    stack — possibly a different thread — via :func:`end_span`. The
+    cross-round pipelined closers need this shape: a round's root span
+    opens when the barrier fires but only closes when the deferred
+    verify+merge+device step settles, on the finish thread, while the
+    opening thread has long since moved on to round N+1.
+
+    The span links into the caller's current trace position exactly
+    like ``with span(...)``, but the caller's contextvar is restored
+    immediately (children opened later must nest EXPLICITLY via
+    ``context_scope(sp.context)`` — an implicitly-inherited context
+    would leak the round parent into unrelated work on this thread).
+    Telemetry off returns :data:`NULL_SPAN`; ``end_span`` accepts it."""
+    if not runtime.STATE.enabled:
+        return NULL_SPAN
+    sp = Span(_TRACER, name, track, attrs)
+    sp.__enter__()
+    if sp._token is not None:
+        # restore the opener's context NOW; disarm the token so the
+        # deferred __exit__ (any thread) never resets a contextvar
+        # token that belongs to this thread's context
+        _CTX.reset(sp._token)
+        sp._token = None
+    return sp
+
+
+def end_span(sp) -> None:
+    """Close a :func:`begin_span` span (records the complete event);
+    safe from any thread and a no-op for :data:`NULL_SPAN`."""
+    sp.__exit__(None, None, None)
+
+
 def instant(name: str, track: Optional[str] = None, **attrs: Any) -> None:
     """Record an instant event on the process tracer (flag-checked).
     An instant fired inside an open span links into the trace (its
@@ -465,10 +498,12 @@ __all__ = [
     "Span",
     "Tracer",
     "adopt_context",
+    "begin_span",
     "carry_context",
     "context_scope",
     "current_context",
     "device_span",
+    "end_span",
     "instant",
     "span",
     "tracer",

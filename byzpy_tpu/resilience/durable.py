@@ -66,6 +66,16 @@ DROP = "f"
 #: Recovery replay IGNORES them (they carry no round state) — they are
 #: the auditable who-was-excluded-when trail the forensics CLI reads.
 EVIDENCE = "e"
+#: Speculative-close repair records (``byzpy_tpu.serving.sharded``):
+#: appended when a late partial folds into an ALREADY-CLOSED round
+#: within the repair horizon. The payload carries the repaired round,
+#: the covered shards, the folded ``(client, seq)`` pairs, and the
+#: pre-repair / post-repair / delta aggregate digests — the
+#: bit-auditable trail ``audit_sharded_exactly_once`` joins against
+#: merge evidence so a row can never fold in both. Recovery replay
+#: IGNORES them (the shard-side confirm writes the authoritative
+#: per-shard round record, exactly like a barrier close).
+REPAIR = "p"
 
 
 @dataclass(frozen=True)
@@ -286,6 +296,14 @@ class TenantDurability:
         Ignored by recovery replay; read back by
         ``python -m byzpy_tpu.forensics report``."""
         self._append((EVIDENCE, int(round_id), payload))
+
+    def record_repair(self, round_id: int, payload: dict) -> None:
+        """Append one speculative-close repair record: a late partial
+        folded into closed round ``round_id`` within the repair
+        horizon. ``payload`` carries the shards covered, the folded
+        ``(client, seq)`` pairs, and the old/new/delta aggregate
+        digests (the bit-audit trail). Ignored by recovery replay."""
+        self._append((REPAIR, int(round_id), payload))
 
     def snapshot_due(self) -> bool:
         """Whether the periodic snapshot cadence has come round."""

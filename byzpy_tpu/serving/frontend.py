@@ -210,7 +210,7 @@ class _TenantTelemetry:
     __slots__ = (
         "labels", "outcomes", "rounds", "failed", "ingress_bytes",
         "submit_frames", "queue_depth", "outstanding", "latency_s",
-        "cohort_m",
+        "cohort_m", "overlap_ratio",
     )
 
     def __init__(self, name: str, dim: int) -> None:
@@ -261,6 +261,13 @@ class _TenantTelemetry:
             "byzpy_serving_cohort_size",
             help="closed-round cohort sizes", labels=self.labels,
             buckets=obs_metrics.SIZE_BUCKETS,
+        )
+        self.overlap_ratio = reg.gauge(
+            "byzpy_round_overlap_ratio",
+            help="fraction of the previous round's fold+device time that "
+                 "ran hidden under the next window's admission "
+                 "(cross-round pipelining; 0 = fully serial)",
+            labels=self.labels,
         )
         reg.gauge(
             "byzpy_serving_tenant_dim",
@@ -415,9 +422,20 @@ class ServingFrontend:
         on_round: Optional[RoundCallback] = None,
         durability: Optional[DurabilityConfig] = None,
         shard: Optional[int] = None,
+        pipeline_depth: int = 1,
     ) -> None:
         if not tenants:
             raise ValueError("at least one tenant is required")
+        if pipeline_depth not in (0, 1):
+            raise ValueError("pipeline_depth must be 0 or 1")
+        #: cross-round pipelining depth for the async scheduler: 1
+        #: (default) lets round N's fold + device step run on the
+        #: executor while the NEXT window collects — the settle happens
+        #: before the next cohort is built, so round ids, staleness
+        #: judgments and aggregate bits are identical to the barrier
+        #: path (depth 0). Ragged tenants always run barrier (their
+        #: dispatch plane batches across tenants already).
+        self.pipeline_depth = int(pipeline_depth)
         #: ingress-shard index when this frontend is one shard of a
         #: sharded tier (``serving.sharded``): stamps a ``shard`` dim
         #: onto the serving spans so a merged trace attributes
@@ -1409,17 +1427,64 @@ class ServingFrontend:
         ragged_served = (
             self._ragged is not None and self._ragged.serves(t.cfg.name)
         )
+        # cross-round pipelining only applies to the in-process fold
+        # path: ragged tenants hand their rounds to the shared dispatch
+        # thread (which already overlaps tenants against each other), so
+        # they stay on the barrier path regardless of pipeline_depth
+        pipelined = self.pipeline_depth > 0 and not ragged_served
         # adopt anything a prior synchronous round closer parked in
         # t.held (sequential sync -> async handover): those rows were
         # admitted and count in `outstanding`, so abandoning them would
         # lose submissions and deadlock drain()
         held: list = list(t.held)
         t.held.clear()
+        # the one in-flight (dispatched, unsettled) round when
+        # pipelining: settled after the NEXT window's collect returns and
+        # BEFORE its cohort is built, so round ids, staleness judgments
+        # and aggregate bits are identical to the barrier path — only
+        # the admission window overlaps the fold + device step
+        pending: Optional[dict] = None
+
+        async def settle() -> None:
+            nonlocal pending
+            if pending is None:
+                return
+            p, pending = pending, None
+            wait_start = self._clock()
+            try:
+                vec, prep = await p["fut"]
+            except Exception:  # noqa: BLE001 — poisoned cohort: drop
+                # the round, keep serving (same contract as the barrier
+                # path's crash guard)
+                self._fail_round(t, p["cohort"], p["subs"])
+                obs_tracing.end_span(p["span"])
+                return
+            # finish under the round's context so the broadcast span
+            # stays a child of the (still-open) round span
+            with obs_tracing.context_scope(
+                getattr(p["span"], "context", None)
+            ):
+                self._finish_round(t, p["cohort"], vec, p["subs"], prep)
+            obs_tracing.end_span(p["span"])
+            done_s = p["done_s"] or wait_start
+            span_s = done_s - p["kicked"]
+            if obs_runtime.STATE.enabled and span_s > 0:
+                hidden = max(0.0, min(done_s, wait_start) - p["kicked"])
+                t.telemetry.overlap_ratio.set(
+                    max(0.0, min(1.0, hidden / span_s))
+                )
+
         while self._running:
             more = await t.queue.collect(
                 t.cfg.cohort_cap - len(held), t.cfg.window_s
             )
             held.extend(more)
+            # settle the overlapped round FIRST: its _finish_round must
+            # advance round_id and release outstanding rows before the
+            # next cohort is built (bit-identity with the barrier path),
+            # and it must settle even on an under-strength window so
+            # drain() cannot hang on an already-folded round
+            await settle()
             if len(held) < t.min_cohort:
                 # under-strength window: hold the round open until the
                 # cohort reaches the tenant's floor (the aggregator's
@@ -1428,6 +1493,62 @@ class ServingFrontend:
                 continue
             subs, held = held, []
             track = t.track
+            if pipelined:
+                sp = obs_tracing.begin_span(
+                    "serving.round", track=track, tenant=t.cfg.name,
+                    round=t.round_id, m=len(subs), pipelined=True,
+                    **self._shard_tag,
+                )
+                with obs_tracing.context_scope(
+                    getattr(sp, "context", None)
+                ):
+                    with obs_tracing.span(
+                        "serving.cohort_close", track=track,
+                        round=t.round_id, m=len(subs),
+                    ):
+                        cohort = build_cohort(
+                            subs, t.round_id, t.ladder,
+                            t.cfg.staleness, tenant=t.cfg.name,
+                            track=track,
+                        )
+                    sp.set(bucket=cohort.bucket)
+                    assert self._device_lock is not None
+                    # hold the device lock across the dispatch: other
+                    # tenants' rounds queue behind this fold exactly as
+                    # on the barrier path; released by the future's done
+                    # callback (which runs on this loop)
+                    await self._device_lock.acquire()
+                    entry: dict = {
+                        "subs": subs, "cohort": cohort, "span": sp,
+                        "kicked": self._clock(), "done_s": None,
+                    }
+
+                    def fold_and_prepare(
+                        subs=subs, cohort=cohort, entry=entry
+                    ):
+                        try:
+                            v = t.executor.aggregate(cohort)
+                            p = (
+                                self._forensics_prepare(t, cohort, v, subs)
+                                if t.forensics is not None
+                                else None
+                            )
+                            return v, p
+                        finally:
+                            # fold-complete timestamp feeds the
+                            # overlap-ratio gauge at settle
+                            entry["done_s"] = self._clock()
+
+                    fut = loop.run_in_executor(
+                        None,
+                        obs_tracing.carry_context(fold_and_prepare),
+                    )
+                    fut.add_done_callback(
+                        lambda _f: self._device_lock.release()
+                    )
+                    entry["fut"] = fut
+                    pending = entry
+                continue
             with obs_tracing.span(
                 "serving.round", track=track, tenant=t.cfg.name,
                 round=t.round_id, m=len(subs), **self._shard_tag,
@@ -1512,6 +1633,9 @@ class ServingFrontend:
                     self._fail_round(t, cohort, subs)
                     continue
                 self._finish_round(t, cohort, vec, subs, prep)
+        # graceful stop (close() flips _running before cancelling): an
+        # already-folded in-flight round is published, not lost
+        await settle()
 
     async def drain(self, tenant: str) -> int:
         """Wait until every ADMISSIBLE submission of ``tenant`` has been

@@ -93,6 +93,7 @@ import numpy as np
 
 from ..engine.actor import wire
 from ..engine.actor.transports.tcp import dial_policy
+from ..observability import metrics as obs_metrics
 from ..observability import runtime as obs_runtime
 from ..observability import tracing as obs_tracing
 from ..resilience.durable import DurabilityConfig
@@ -139,6 +140,10 @@ class RunnerSpec:
     quorum: Optional[int] = None
     extras_policy: str = "trust"
     telemetry: bool = False
+    #: speculative-close repair horizon (rounds) passed through to the
+    #: root's :class:`ShardedCoordinator` — 0 keeps the classic
+    #: degraded close (stragglers requeue at the barrier)
+    repair_horizon_rounds: int = 0
 
     @property
     def topology(self) -> MergeTopology:
@@ -646,6 +651,10 @@ class _ShardProxy:
         self.alive = True
         self._sock: Optional[socket.socket] = None
         self.failed_ops = 0
+        # pipelined closes run round N's confirm fan-out on the finish
+        # thread while the control thread syncs/polls the same shard —
+        # the socket carries one op at a time or frames interleave
+        self._op_lock = Lock()
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
@@ -671,15 +680,16 @@ class _ShardProxy:
         the shard is unreachable (the op is lost, accounted)."""
         if not self.alive:
             return None
-        for _attempt in (0, 1):
-            try:
-                sock = self._ensure()
-                sock.settimeout(timeout)
-                return rpc(sock, frame)
-            except (OSError, ValueError, ConnectionError):
-                self.reset()
-        self.failed_ops += 1
-        return None
+        with self._op_lock:
+            for _attempt in (0, 1):
+                try:
+                    sock = self._ensure()
+                    sock.settimeout(timeout)
+                    return rpc(sock, frame)
+                except (OSError, ValueError, ConnectionError):
+                    self.reset()
+            self.failed_ops += 1
+            return None
 
     # -- the coordinator-facing surface -----------------------------------
 
@@ -757,6 +767,7 @@ class _RootServer:
             durability=spec.durability,
             extras_policy=spec.extras_policy,
             shards=self.proxies,
+            repair_horizon_rounds=spec.repair_horizon_rounds,
         )
         #: (kind, host, port, covered leaves) per top-tier child
         self.top = list(top_children)
@@ -771,6 +782,29 @@ class _RootServer:
         )
         self._lock = Lock()
         self._stop = False
+        # cross-round pipelining: depth-1 in-flight window per tenant.
+        # A pipelined close barriers round N on the control thread,
+        # then hands verify+merge+device-step to this 1-worker pool and
+        # returns — the shard processes ingest round N+1 while the
+        # finish runs. The NEXT close settles the pending finish before
+        # barriering, so finishes serialize (WAL round records stay
+        # monotonic) and backpressure still reaches the door.
+        self._finish_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="root-finish"
+        )
+        self._pending: Dict[str, dict] = {}
+        reg = obs_metrics.registry()
+        self._m_overlap = {
+            cfg.name: reg.gauge(
+                "byzpy_round_overlap_ratio",
+                help=(
+                    "fraction of the deferred round finish that ran "
+                    "hidden behind next-round ingest"
+                ),
+                labels={"tenant": cfg.name},
+            )
+            for cfg in spec.tenants
+        }
 
     # -- barrier close -----------------------------------------------------
 
@@ -786,113 +820,297 @@ class _RootServer:
         if sock is not None:
             sock.close()
 
+    def _barrier(
+        self, tenant: str, round_id: int
+    ) -> Tuple[List[PartialFold], List[int]]:
+        """Fan one round's close to the top tier and collect the
+        replies: returns ``(partials, missing_set)``. No shard-state
+        side effects — requeue/merge policy belongs to the callers
+        (the classic door requeues stragglers immediately; a
+        speculative close leaves them in flight for the repair
+        horizon)."""
+        missing: List[int] = [
+            p.index for p in self.proxies if not p.alive
+        ]
+        live_top = [
+            i
+            for i, (_k, _h, _p, cover) in enumerate(self.top)
+            if any(self.proxies[s].alive for s in cover)
+        ]
+        # encode on THIS thread: the frames carry the round span's
+        # trace context into every child process
+        frames = {}
+        for i in live_top:
+            kind = self.top[i][0]
+            op = SHARD_CLOSE if kind == "shard" else MERGE_CLOSE
+            frames[i] = wire.encode(
+                {"kind": op, "tenant": tenant, "round": round_id},
+                precision="off",
+            )
+
+        def barrier(i: int) -> dict:
+            sock = self._top_sock(i)
+            sock.settimeout(self._close_timeout)
+            sock.sendall(frames[i])
+            return recv_frame(sock)
+
+        futures = {
+            self._pool.submit(barrier, i): i for i in live_top
+        }
+        partials: List[PartialFold] = []
+        for fut, i in futures.items():
+            cover = self.top[i][3]
+            try:
+                reply = fut.result(timeout=self._close_timeout + 5.0)
+            except Exception:  # noqa: BLE001 — timeout / dead child:
+                # the whole subtree missed the barrier; its socket
+                # may be mid-frame, reset it
+                self._reset_top(i)
+                missing.extend(
+                    s for s in cover if self.proxies[s].alive
+                )
+                continue
+            missing.extend(int(s) for s in reply.get("missing", ()))
+            for ev in reply.get("forged", ()):
+                # one forged FRAME = one count + one evidence
+                # event, however many leaves it covered (the
+                # flat-root accounting; discard fans per leaf)
+                shards = [
+                    int(s)
+                    for s in ev.get("shards", (ev.get("shard"),))
+                    if s is not None
+                ]
+                if not shards:
+                    continue
+                self.co.note_forged(
+                    tenant,
+                    shards,
+                    claimed_digest=str(
+                        ev.get("claimed_digest", "")
+                    ),
+                    measured_digest=str(
+                        ev.get("measured_digest", "")
+                    ),
+                    m=int(ev.get("m", 0)),
+                )
+            raw = reply.get("partial")
+            if raw is not None:
+                try:
+                    partials.append(PartialFold.from_wire(raw))
+                except (ValueError, KeyError, TypeError):
+                    missing.extend(
+                        s for s in cover if self.proxies[s].alive
+                    )
+        return partials, sorted(set(missing))
+
+    def _requeue_missing(
+        self, tenant: str, missing: Sequence[int], round_id: int
+    ) -> None:
+        """Return missing-but-ALIVE leaves' drained cohorts to their
+        held lists. A leaf may have drained for a close whose reply
+        never reached us (straggler past the barrier, merge-node
+        timeout): requeue it explicitly or its inflight rows strand
+        forever — the shard's event loop serializes the frames, so the
+        requeue lands AFTER any still-running close finishes
+        (idempotent when the leaf drained nothing)."""
+        for s in missing:
+            if self.proxies[s].alive:
+                self.proxies[s].requeue(tenant, round_id)
+
     def close_round(self, tenant: str) -> Optional[tuple]:
         """One root-driven barrier round over real sockets: fan the
         close to the top tier, decode + account replies, quorum-gate,
         then run the coordinator's verify + hierarchical merge +
         finalize + confirm protocol through the shard proxies. Returns
-        ``(closed_round_id, merged_rows, aggregate)`` or ``None``."""
+        ``(closed_round_id, merged_rows, aggregate)`` or ``None``.
+        With the repair horizon armed, stragglers are NOT requeued at
+        the barrier — the coordinator retains the speculative close's
+        repair context and the horizon expiry recycles them."""
+        self._settle(tenant)
         rt = self.co._roots[tenant]
         with obs_tracing.span(
             "serving.sharded_round", track="root",
             tenant=tenant, round=rt.round_id,
         ):
-            missing: List[int] = [
-                p.index for p in self.proxies if not p.alive
-            ]
-            live_top = [
-                i
-                for i, (_k, _h, _p, cover) in enumerate(self.top)
-                if any(self.proxies[s].alive for s in cover)
-            ]
-            # encode on THIS thread: the frames carry the round span's
-            # trace context into every child process
-            frames = {}
-            for i in live_top:
-                kind = self.top[i][0]
-                op = SHARD_CLOSE if kind == "shard" else MERGE_CLOSE
-                frames[i] = wire.encode(
-                    {"kind": op, "tenant": tenant, "round": rt.round_id},
-                    precision="off",
-                )
-
-            def barrier(i: int) -> dict:
-                sock = self._top_sock(i)
-                sock.settimeout(self._close_timeout)
-                sock.sendall(frames[i])
-                return recv_frame(sock)
-
-            futures = {
-                self._pool.submit(barrier, i): i for i in live_top
-            }
-            partials: List[PartialFold] = []
-            for fut, i in futures.items():
-                cover = self.top[i][3]
-                try:
-                    reply = fut.result(timeout=self._close_timeout + 5.0)
-                except Exception:  # noqa: BLE001 — timeout / dead child:
-                    # the whole subtree missed the barrier; its socket
-                    # may be mid-frame, reset it
-                    self._reset_top(i)
-                    missing.extend(
-                        s for s in cover if self.proxies[s].alive
-                    )
-                    continue
-                missing.extend(int(s) for s in reply.get("missing", ()))
-                for ev in reply.get("forged", ()):
-                    # one forged FRAME = one count + one evidence
-                    # event, however many leaves it covered (the
-                    # flat-root accounting; discard fans per leaf)
-                    shards = [
-                        int(s)
-                        for s in ev.get("shards", (ev.get("shard"),))
-                        if s is not None
-                    ]
-                    if not shards:
-                        continue
-                    self.co.note_forged(
-                        tenant,
-                        shards,
-                        claimed_digest=str(
-                            ev.get("claimed_digest", "")
-                        ),
-                        measured_digest=str(
-                            ev.get("measured_digest", "")
-                        ),
-                        m=int(ev.get("m", 0)),
-                    )
-                raw = reply.get("partial")
-                if raw is not None:
-                    try:
-                        partials.append(PartialFold.from_wire(raw))
-                    except (ValueError, KeyError, TypeError):
-                        missing.extend(
-                            s for s in cover if self.proxies[s].alive
-                        )
-            missing_set = sorted(set(missing))
-            # a missing-but-ALIVE leaf may have drained its cohort for
-            # a close whose reply never reached us (straggler past the
-            # barrier, merge-node timeout): requeue it explicitly or
-            # its inflight rows strand forever — the shard's event
-            # loop serializes the frames, so the requeue lands AFTER
-            # any still-running close finishes (idempotent when the
-            # leaf drained nothing). The in-process async closer does
-            # the same via its straggler done-callbacks.
-            for s in missing_set:
-                if self.proxies[s].alive:
-                    self.proxies[s].requeue(tenant, rt.round_id)
+            partials, missing_set = self._barrier(tenant, rt.round_id)
+            speculative = self.co.repair_horizon > 0
+            if not speculative:
+                self._requeue_missing(tenant, missing_set, rt.round_id)
             responders = self.spec.n_shards - len(missing_set)
             if responders < self.co.quorum:
                 for p in partials:
                     for s in p.covered:
                         self.proxies[s].requeue(tenant, p.round_id)
+                if speculative:
+                    self._requeue_missing(
+                        tenant, missing_set, rt.round_id
+                    )
                 rt.quorum_failures += 1
                 return None
             if not partials:
+                if speculative:
+                    self._requeue_missing(
+                        tenant, missing_set, rt.round_id
+                    )
                 return None
-            return self.co.merge_partials(
+            res = self.co.merge_partials(
                 tenant, partials, missing=missing_set
             )
+            if res is None and speculative:
+                # no close happened — nothing to repair into; recycle
+                # the stragglers exactly as the classic path
+                self._requeue_missing(tenant, missing_set, rt.round_id)
+            return res
+
+    # -- pipelined close (cross-round overlap) -----------------------------
+
+    def close_round_pipelined(self, tenant: str) -> dict:
+        """The ALWAYS-ON round door: settle the previous round's
+        deferred finish (depth-1 window — this is where backpressure
+        bites), barrier round N on this thread, and if quorum fired
+        hand verify+merge+device-step to the finish pool and return
+        immediately with the next round's admission plane OPEN (shard
+        staleness clocks advance optimistically; the ROOT clock stays
+        at N until the finish lands, so partial round-id checks still
+        pass). Returns ``{"pending": N | None, "prev": <settled round
+        N-1 summary | None>, "round": <admitting round>}``. A window
+        with no admissible close settles and returns with ``pending:
+        None`` — semantics identical to the barrier door."""
+        prev = self._settle(tenant)
+        rt = self.co._roots[tenant]
+        out: dict = {"pending": None, "prev": prev, "round": rt.round_id}
+        sp = obs_tracing.begin_span(
+            "serving.sharded_round", track="root",
+            tenant=tenant, round=rt.round_id, pipelined=True,
+        )
+        kicked = False
+        try:
+            with obs_tracing.context_scope(getattr(sp, "context", None)):
+                partials, missing_set = self._barrier(
+                    tenant, rt.round_id
+                )
+                speculative = self.co.repair_horizon > 0
+                if not speculative:
+                    self._requeue_missing(
+                        tenant, missing_set, rt.round_id
+                    )
+                responders = self.spec.n_shards - len(missing_set)
+                if responders < self.co.quorum:
+                    for p in partials:
+                        for s in p.covered:
+                            self.proxies[s].requeue(tenant, p.round_id)
+                    if speculative:
+                        self._requeue_missing(
+                            tenant, missing_set, rt.round_id
+                        )
+                    rt.quorum_failures += 1
+                    return out
+                if not partials:
+                    if speculative:
+                        self._requeue_missing(
+                            tenant, missing_set, rt.round_id
+                        )
+                    return out
+            # quorum fired: open round N+1's admission/staleness plane
+            # NOW — the shard processes ingest the next round while the
+            # finish below runs on the 1-worker pool; the sync fans in
+            # PARALLEL (the kick is the serialized part of the pipeline,
+            # every sequential round-trip here is unhidden latency)
+            closing = rt.round_id
+            sync_futs = [
+                self._pool.submit(p.sync_round, tenant, closing + 1)
+                for p in self.proxies
+                if p.alive
+            ]
+            for f in sync_futs:
+                f.result(timeout=self._close_timeout + 5.0)
+            entry: dict = {
+                "round": closing,
+                "kicked": time.monotonic(),
+                "done_s": None,
+            }
+            entry["future"] = self._finish_pool.submit(
+                self._deferred_finish,
+                tenant, closing, partials, missing_set, sp, entry,
+            )
+            self._pending[tenant] = entry
+            kicked = True  # span ownership moved to the finish thread
+            out["pending"] = closing
+            out["round"] = closing + 1
+            return out
+        finally:
+            if not kicked:
+                obs_tracing.end_span(sp)
+
+    def _deferred_finish(
+        self,
+        tenant: str,
+        closing: int,
+        partials: List[PartialFold],
+        missing: List[int],
+        sp,
+        entry: dict,
+    ) -> Optional[tuple]:
+        """The overlapped half of a pipelined close: verify +
+        hierarchical merge + finalize + confirm through the proxies,
+        off the control thread. On a failed merge the round is CONSUMED
+        anyway (the shard clocks already advanced optimistically, so
+        the root clock must follow) — the drained rows requeue and fold
+        next round one round staler, the only behavioral divergence
+        from the barrier path and only in the failure case."""
+        try:
+            with obs_tracing.context_scope(getattr(sp, "context", None)):
+                res = self.co.merge_partials(
+                    tenant, partials, missing=missing
+                )
+            if res is None:
+                rt = self.co._roots[tenant]
+                rt.round_id = closing + 1
+                for p in self.proxies:
+                    if p.alive:
+                        p.sync_round(tenant, closing + 1)
+                if self.co.repair_horizon > 0:
+                    self._requeue_missing(tenant, missing, closing)
+            return res
+        finally:
+            entry["done_s"] = time.monotonic()
+            obs_tracing.end_span(sp)
+
+    def _settle(self, tenant: str) -> Optional[dict]:
+        """Wait out the tenant's pending deferred finish (no-op when
+        none): returns the settled round's summary (``closed``/
+        ``digest``/``m``/``overlap_ratio``) and publishes the
+        ``byzpy_round_overlap_ratio`` gauge — the fraction of the
+        finish that ran before anyone had to wait for it, i.e. the
+        wall-clock the pipeline actually hid."""
+        entry = self._pending.pop(tenant, None)
+        if entry is None:
+            return None
+        wait_start = time.monotonic()
+        try:
+            res = entry["future"].result(
+                timeout=self._close_timeout + 30.0
+            )
+        except Exception:  # noqa: BLE001 — a crashed finish must not
+            # wedge the control door; the round's accounting is
+            # whatever the coordinator got to
+            res = None
+        prev: dict = {"closed": None, "round": int(entry["round"])}
+        if res is not None:
+            from ..forensics.evidence import evidence_digest
+
+            closed, rows, vec = res
+            prev["closed"] = int(closed)
+            prev["digest"] = evidence_digest(np.asarray(vec))
+            prev["m"] = int(rows.shape[0])
+        done_s = entry.get("done_s") or wait_start
+        span_s = max(0.0, done_s - entry["kicked"])
+        hidden = max(0.0, min(done_s, wait_start) - entry["kicked"])
+        ratio = 1.0 if span_s <= 0 else max(0.0, min(1.0, hidden / span_s))
+        prev["overlap_ratio"] = round(ratio, 4)
+        if obs_runtime.STATE.enabled and tenant in self._m_overlap:
+            self._m_overlap[tenant].set(ratio)
+        return prev
 
     # -- control plane -----------------------------------------------------
 
@@ -900,6 +1118,17 @@ class _RootServer:
         kind = request.get("kind")
         if kind == "close_round":
             tenant = str(request.get("tenant"))
+            if request.get("pipelined"):
+                with self._lock:
+                    out = self.close_round_pipelined(tenant)
+                return {
+                    "kind": "round",
+                    "closed": None,
+                    "pending": out["pending"],
+                    "prev": out["prev"],
+                    "round": out["round"],
+                    LOSSLESS_REPLY: True,
+                }
             with self._lock:
                 res = self.close_round(tenant)
             resp: dict = {
@@ -918,6 +1147,46 @@ class _RootServer:
                 if request.get("return_rows"):
                     resp["rows"] = np.asarray(rows, np.float32)
                     resp["aggregate"] = np.asarray(vec, np.float32)
+            return resp
+        if kind == "flush_rounds":
+            tenant = str(request.get("tenant"))
+            with self._lock:
+                prev = self._settle(tenant)
+                current = self.co.round_of(tenant)
+            return {
+                "kind": "round",
+                "prev": prev,
+                "round": current,
+                LOSSLESS_REPLY: True,
+            }
+        if kind == "repair_round":
+            tenant = str(request.get("tenant"))
+            with self._lock:
+                self._settle(tenant)
+                try:
+                    partial = PartialFold.from_wire(
+                        request.get("partial")
+                    )
+                except (ValueError, KeyError, TypeError):
+                    return {
+                        "kind": "ack",
+                        "accepted": False,
+                        "reason": "bad_partial",
+                    }
+                res = self.co.repair_round(tenant, partial)
+            resp = {
+                "kind": "round",
+                "closed": None,
+                "round": self.co.round_of(tenant),
+                LOSSLESS_REPLY: True,
+            }
+            if res is not None:
+                from ..forensics.evidence import evidence_digest
+
+                closed, rows, vec = res
+                resp["closed"] = closed
+                resp["digest"] = evidence_digest(np.asarray(vec))
+                resp["m"] = int(rows.shape[0])
             return resp
         if kind == "stats":
             with self._lock:
@@ -983,6 +1252,17 @@ class _RootServer:
         return {"kind": "ack", "accepted": False, "reason": "bad_frame"}
 
     def shutdown(self) -> None:
+        # settle any pending deferred finishes BEFORE tearing sockets
+        # down — a mid-flight confirm fan-out must land (WAL round
+        # records are the audit trail)
+        for tenant in list(self._pending):
+            entry = self._pending.pop(tenant, None)
+            if entry is None:
+                continue
+            try:
+                entry["future"].result(timeout=self._close_timeout + 30.0)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         with self._lock:
             for rt in self.co._roots.values():
                 if rt.durability is not None:
@@ -992,6 +1272,7 @@ class _RootServer:
             for p in self.proxies:
                 p.reset()
         self._pool.shutdown(wait=False)
+        self._finish_pool.shutdown(wait=False)
 
 
 def _root_main(
@@ -1265,6 +1546,25 @@ class Runner:
             }
         )
 
+    def close_round_pipelined(self, tenant: str) -> dict:
+        """Kick one PIPELINED round at the root: the reply returns as
+        soon as the barrier + quorum gate land — round N's verify/
+        merge/device step keeps running at the root while the shards
+        admit round N+1. The reply carries ``pending`` (the round now
+        finishing, or ``None`` when the window had nothing), ``prev``
+        (the PREVIOUS pipelined round's settled summary — closed id,
+        digest, m, overlap_ratio) and ``round`` (the round now
+        admitting). Call :meth:`flush_rounds` to settle the last
+        in-flight round."""
+        return self._control(
+            {"kind": "close_round", "tenant": tenant, "pipelined": True}
+        )
+
+    def flush_rounds(self, tenant: str) -> dict:
+        """Settle the tenant's in-flight pipelined round (no-op when
+        none): the reply's ``prev`` is the settled summary."""
+        return self._control({"kind": "flush_rounds", "tenant": tenant})
+
     def stats(self) -> dict:
         """Root + per-shard accounting (the proxies poll each shard)."""
         return self._control({"kind": "stats"})["stats"]
@@ -1522,6 +1822,7 @@ def _smoke() -> None:
     )
     rng = np.random.default_rng(0)
     ref_agg = CoordinateWiseTrimmedMean(f=1)
+    barrier_digests: List[str] = []
     with Runner(spec) as runner:
         client = RunnerClient("127.0.0.1", runner.shard_ports)
         try:
@@ -1539,6 +1840,7 @@ def _smoke() -> None:
                 )
                 reply = runner.close_round("m0", return_rows=True)
                 assert reply["closed"] == r, reply
+                barrier_digests.append(reply["digest"])
                 rows = np.asarray(reply["rows"])
                 ref = np.asarray(
                     ref_agg.aggregate(
@@ -1551,6 +1853,57 @@ def _smoke() -> None:
             exports = runner.trace_exports()
         finally:
             client.close()
+    # -- pipelined leg: IDENTICAL traffic through the always-on door —
+    # round N+1's frames must be admitted while round N's finish is
+    # still in flight at the root, and every settled digest must match
+    # the barrier door's bit-for-bit
+    rng = np.random.default_rng(0)
+    overlap_admitted = 0
+    pipelined_digests: List[str] = []
+    with Runner(spec) as runner:
+        client = RunnerClient("127.0.0.1", runner.shard_ports)
+        try:
+            def _build(r: int) -> Dict[int, List[bytes]]:
+                frames: Dict[int, List[bytes]] = {0: [], 1: []}
+                for i in range(n_clients):
+                    shard, frame = client.encode_submit(
+                        "m0", f"c{i:03d}", r,
+                        rng.normal(size=dim).astype(np.float32), seq=r,
+                    )
+                    frames[shard].append(frame)
+                return frames
+
+            accepted, rejected = client.submit_many(_build(0))
+            assert accepted == n_clients and rejected == 0
+            for r in range(rounds):
+                reply = runner.close_round_pipelined("m0")
+                assert reply["pending"] == r, reply
+                if r > 0:
+                    prev = reply["prev"]
+                    assert prev and prev["closed"] == r - 1, reply
+                    pipelined_digests.append(prev["digest"])
+                if r + 1 < rounds:
+                    # admission for round N+1 while round N's verify/
+                    # merge/device step runs deferred at the root — the
+                    # acks land BEFORE anything settles round N
+                    accepted, rejected = client.submit_many(
+                        _build(r + 1)
+                    )
+                    assert accepted == n_clients and rejected == 0, (
+                        accepted, rejected,
+                    )
+                    overlap_admitted += accepted
+            tail = runner.flush_rounds("m0")
+            prev = tail["prev"]
+            assert prev and prev["closed"] == rounds - 1, tail
+            pipelined_digests.append(prev["digest"])
+        finally:
+            client.close()
+    assert overlap_admitted > 0, "no frames admitted during overlap"
+    assert pipelined_digests == barrier_digests, (
+        "pipelined close diverged from the barrier door",
+        pipelined_digests, barrier_digests,
+    )
     # one causal tree across processes: a root round span's trace id
     # must appear in at least one shard process's export
     root_traces = {
@@ -1577,6 +1930,8 @@ def _smoke() -> None:
                 "lane": "runner_smoke",
                 "rounds": rounds,
                 "parity": "bit-identical",
+                "pipelined_parity": "bit-identical",
+                "overlap_admitted": overlap_admitted,
                 "stitched_traces": len(root_traces & shard_traces),
                 "wall_s": round(wall, 2),
             }

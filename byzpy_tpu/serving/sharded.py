@@ -806,6 +806,7 @@ class _RootTenant:
         "min_cohort", "seqs", "max_tracked", "quorum_failures",
         "failed_rounds", "quorum_closes", "partitions", "forged",
         "root_duplicates", "durability", "rounds",
+        "speculative_closes", "repairs", "open_repairs",
     )
 
     def __init__(
@@ -852,6 +853,18 @@ class _RootTenant:
         self.forged = 0
         self.root_duplicates = 0
         self.durability = durability
+        #: quorum closes taken SPECULATIVELY (repair horizon armed):
+        #: the round closed without the stragglers, whose late partials
+        #: may still fold as repair deltas within the horizon
+        self.speculative_closes = 0
+        #: late partials folded into already-closed rounds
+        self.repairs = 0
+        #: closed-round repair contexts still inside the horizon:
+        #: ``round_id -> {"inputs": [(shard, merge_input)], "missing":
+        #: set, "digest": str, "m": int}`` — the exact merge inputs the
+        #: close used, so a repair re-merge is bit-identical to the
+        #: barrier close that would have included the late shard
+        self.open_repairs: Dict[int, dict] = {}
 
     def is_folded(self, client: str, seq: Optional[int]) -> bool:
         if seq is None:
@@ -893,11 +906,14 @@ class ShardedCoordinator:
         max_tracked_clients: int = 1 << 16,
         topology: Optional[MergeTopology] = None,
         shards: Optional[Sequence[Any]] = None,
+        repair_horizon_rounds: int = 0,
     ) -> None:
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
         if quorum is not None and not 1 <= quorum <= n_shards:
             raise ValueError(f"quorum must be in [1, {n_shards}]")
+        if repair_horizon_rounds < 0:
+            raise ValueError("repair_horizon_rounds must be >= 0")
         if extras_policy not in ("trust", "verify", "recompute"):
             raise ValueError(
                 "extras_policy must be 'trust', 'verify' or 'recompute' "
@@ -913,6 +929,15 @@ class ShardedCoordinator:
         self.shard_timeout_s = float(shard_timeout_s)
         #: shards required for a close; default = majority
         self.quorum = quorum if quorum is not None else n_shards // 2 + 1
+        #: speculative-close repair horizon, in ROUNDS: 0 keeps the
+        #: classic degraded close (a straggler's rows requeue and fold
+        #: one round staler); N > 0 arms the optimistic close — a
+        #: quorum close leaves the stragglers' drained cohorts in
+        #: flight, and a late partial arriving within N rounds folds
+        #: into the closed round as a WAL-recorded repair delta via
+        #: :meth:`repair_round` (beyond the horizon the rows requeue
+        #: one-round-staler exactly as the classic path)
+        self.repair_horizon = int(repair_horizon_rounds)
         self.extras_policy = extras_policy
         #: merge-tree shape driving the round close (None = flat
         #: two-level; the process runner passes the same object so the
@@ -1018,6 +1043,18 @@ class ShardedCoordinator:
             )
             for cfg in tenants
             for i in range(n_shards)
+        }
+        self._m_speculative = reg.counter(
+            "byzpy_speculative_closes_total",
+            help="quorum closes taken with the repair horizon armed",
+        )
+        self._m_repairs = {
+            cfg.name: reg.counter(
+                "byzpy_round_repairs_total",
+                help="late partials folded into closed rounds as repairs",
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
         }
         self._m_live = reg.gauge(
             "byzpy_shards_live", help="frontend shards currently alive"
@@ -1259,6 +1296,166 @@ class ShardedCoordinator:
         verified, merged, vec, t0 = computed
         return self._finish(rt, verified, merged, vec, list(missing), t0)
 
+    def repair_round(
+        self, tenant: str, partial: PartialFold
+    ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """Fold one LATE partial into an already-closed round within
+        the repair horizon: verify it with the same cross-checks a
+        barrier close runs, re-merge the close's retained inputs with
+        the late input inserted in shard order (bit-identical to the
+        barrier close that would have included it — same
+        :meth:`_merge_input` construction, same shard-order concat),
+        re-finalize at the repaired cohort's bucket, confirm the late
+        shard (its WAL round record + forensics + ``outstanding``
+        release), and append the bit-auditable WAL repair record
+        (old/new/delta aggregate digests + folded pairs, which
+        :func:`audit_sharded_exactly_once` joins against merge evidence
+        so no row can fold twice). Returns ``(round_id, merged_rows,
+        aggregate)`` or ``None`` when the round is outside the horizon
+        (caller requeues one-round-staler as today) or the partial is
+        excluded as forged. ``rt.last_aggregate`` is updated only when
+        the repaired round is still the most recent close — an older
+        repair must not resurrect a superseded broadcast."""
+        rt = self._roots[tenant]
+        r = int(partial.round_id)
+        ctx = rt.open_repairs.get(r)
+        if ctx is None or partial.tenant != tenant:
+            return None
+        covered = partial.covered
+        known = (
+            bool(covered)
+            and len(set(covered)) == len(covered)
+            and partial.shard == covered[0]
+            and all(0 <= s < len(self.shards) for s in covered)
+        )
+        if not known or not set(covered) <= ctx["missing"]:
+            # a repair claiming a shard the close already folded (or a
+            # nonsense cover): protocol violation — reject WITHOUT
+            # touching any real shard's state, exactly the duplicate-
+            # shard rule of the round close
+            rt.forged += 1
+            self._note_event(
+                {
+                    "event": "shard_forged",
+                    "tenant": tenant,
+                    "round": r,
+                    "shard": int(partial.shard),
+                    "reason": (
+                        "unknown_shard" if not known else "repair_not_missing"
+                    ),
+                    "m": partial.m,
+                }
+            )
+            return None
+        checks, measured = self._verify_partial(rt, partial)
+        if checks is None:
+            # forged late partial: digest/ownership/cap cross-checks
+            # failed — the repair horizon is NOT a forensics bypass;
+            # the shard's in-flight rows are discarded with accounting
+            rt.forged += 1
+            for s in covered:
+                if obs_runtime.STATE.enabled:
+                    self._m_forged[(tenant, s)].inc()
+                self.shards[s].discard_inflight(tenant, r)
+            event = {
+                "event": "shard_forged",
+                "tenant": tenant,
+                "round": r,
+                "shard": int(partial.shard),
+                "claimed_digest": partial.digest,
+                "measured_digest": measured,
+                "m": partial.m,
+            }
+            self._note_event(event)
+            if rt.durability is not None:
+                rt.durability.record_evidence(r, event)
+            ctx["missing"] -= set(covered)
+            if not ctx["missing"]:
+                del rt.open_repairs[r]
+            return None
+        folded, dups = checks
+        agg = rt.cfg.aggregator
+        late = (int(partial.shard), self._merge_input(partial, folded, dups))
+        inputs = sorted(ctx["inputs"] + [late], key=lambda e: e[0])
+        new_m = int(ctx["m"]) + len(folded)
+        old_vec = np.asarray(ctx["vec"])
+        with obs_tracing.span(
+            "serving.round.repair", track="root", tenant=tenant,
+            round=r, shard=int(partial.shard), m=new_m,
+        ):
+            merged = agg.fold_merge([inp for _s, inp in inputs])
+            try:
+                with obs_tracing.device_span(
+                    "serving.device_step", track="root", tenant=tenant,
+                    m=new_m, bucket=rt.ladder.bucket_for(new_m),
+                ):
+                    vec = np.asarray(
+                        agg.fold_merge_finalize(
+                            merged, bucket=rt.ladder.bucket_for(new_m)
+                        )
+                    )
+            except Exception:  # noqa: BLE001 — a poisoned repair must
+                # not kill the root: the already-broadcast close
+                # stands, the late rows drop with failed-round account
+                rt.failed_rounds += 1
+                for s in covered:
+                    self.shards[s].account_failed(tenant, r)
+                ctx["missing"] -= set(covered)
+                if not ctx["missing"]:
+                    del rt.open_repairs[r]
+                return None
+        digest = evidence_digest(vec)
+        delta_digest = evidence_digest(vec - old_vec)
+        rt.root_duplicates += len(dups)
+        for j in folded:
+            rt.note_folded(partial.clients[j], partial.seqs[j])
+        for owner, lo, hi in partial.segment_spans():
+            if not 0 <= owner < len(self.shards):
+                continue
+            loc_folded = [j - lo for j in folded if lo <= j < hi]
+            loc_dups = [j - lo for j in dups if lo <= j < hi]
+            self.shards[owner].confirm(
+                tenant, r, loc_folded, loc_dups, digest, vec, None
+            )
+        payload = {
+            "event": "repair",
+            "round": r,
+            "shards": sorted(int(s) for s in covered),
+            "m": new_m,
+            "folded": [
+                [partial.clients[j], partial.seqs[j]] for j in folded
+            ],
+            "duplicates": len(dups),
+            "old_digest": ctx["digest"],
+            "agg_digest": digest,
+            "delta_digest": delta_digest,
+        }
+        if rt.durability is not None:
+            rt.durability.record_repair(r, payload)
+        self._note_event(
+            {
+                "event": "round_repair",
+                "tenant": tenant,
+                "round": r,
+                "shards": sorted(int(s) for s in covered),
+                "m": new_m,
+                "delta_digest": delta_digest,
+            }
+        )
+        rt.repairs += 1
+        if obs_runtime.STATE.enabled:
+            self._m_repairs[tenant].inc()
+        ctx["inputs"] = inputs
+        ctx["missing"] -= set(covered)
+        ctx["digest"] = digest
+        ctx["vec"] = vec
+        ctx["m"] = new_m
+        if not ctx["missing"]:
+            del rt.open_repairs[r]
+        if r == rt.round_id - 1:
+            rt.last_aggregate = vec
+        return r, merged["rows"], vec
+
     def _apply_shard_actions(
         self, tenant: str, actions: Sequence[tuple]
     ) -> None:
@@ -1286,6 +1483,22 @@ class ShardedCoordinator:
                     shard.discard_inflight(tenant, round_id)
                 elif kind == "fail":
                     shard.account_failed(tenant, round_id)
+
+    def _merge_input(
+        self, p: PartialFold, folded: List[int], dups: List[int]
+    ) -> dict:
+        """Build the aggregator ``fold_merge`` input for one verified
+        partial. ONE code path shared by the round close and
+        :meth:`repair_round`: a repair re-merge must feed the merge the
+        exact bits the barrier close would have — a second construction
+        here is a bit-parity bug waiting to happen."""
+        if dups:
+            # rows were dropped: the shipped extras describe the
+            # full row set and no longer apply — recompute at merge
+            return {"rows": p.rows[folded], "m": len(folded)}
+        if self.extras_policy == "recompute" or not p.extras:
+            return {"rows": p.rows, "m": p.m}
+        return {"rows": p.rows, "m": p.m, "extras": p.extras}
 
     def _verify_and_merge(
         self,
@@ -1393,20 +1606,10 @@ class ShardedCoordinator:
                 actions.append(("requeue", p.covered, p.round_id))
             return None
         rt.root_duplicates += sum(len(d) for _, _, d in verified)
-        merge_partials = []
-        for p, folded, dups in verified:
-            if dups:
-                # rows were dropped: the shipped extras describe the
-                # full row set and no longer apply — recompute at merge
-                merge_partials.append(
-                    {"rows": p.rows[folded], "m": len(folded)}
-                )
-            elif self.extras_policy == "recompute" or not p.extras:
-                merge_partials.append({"rows": p.rows, "m": p.m})
-            else:
-                merge_partials.append(
-                    {"rows": p.rows, "m": p.m, "extras": p.extras}
-                )
+        merge_partials = [
+            self._merge_input(p, folded, dups)
+            for p, folded, dups in verified
+        ]
         agg = rt.cfg.aggregator
         with obs_tracing.span(
             "serving.fold_merge", track="root", tenant=tenant,
@@ -1555,10 +1758,46 @@ class ShardedCoordinator:
                         "missing": list(missing),
                     },
                 )
+            if self.repair_horizon > 0:
+                # SPECULATIVE close: retain the exact merge inputs so a
+                # straggler's late partial can fold as a repair delta
+                # whose re-merge is bit-identical to the barrier close
+                # that would have included it. The caller must NOT
+                # requeue the missing shards' drained cohorts — they
+                # stay in flight until repair_round folds them or the
+                # horizon expires them back to the held lists.
+                rt.speculative_closes += 1
+                rt.open_repairs[closed] = {
+                    "inputs": [
+                        (int(p.shard), self._merge_input(p, folded, dups))
+                        for p, folded, dups in verified
+                    ],
+                    "missing": set(int(i) for i in missing),
+                    "digest": digest,
+                    "vec": vec,
+                    "m": m_total,
+                }
+                if obs_runtime.STATE.enabled:
+                    self._m_speculative.inc()
         rt.round_id += 1
         for shard in self.shards:
             if shard.alive:
                 shard.sync_round(tenant, rt.round_id)
+        if rt.open_repairs:
+            # horizon expiry: a closed round that fell out of the
+            # repair window releases its still-missing shards' drained
+            # cohorts back to their held lists — the rows fold in a
+            # later round one-round-staler, exactly the classic
+            # degraded-close account
+            expired = [
+                r for r in rt.open_repairs
+                if r < rt.round_id - self.repair_horizon
+            ]
+            for r in expired:
+                ctx = rt.open_repairs.pop(r)
+                for i in ctx["missing"]:
+                    if 0 <= i < len(self.shards) and self.shards[i].alive:
+                        self.shards[i].requeue(tenant, r)
         if obs_runtime.STATE.enabled:
             self._m_rounds[tenant].inc()
             self._m_merge_s[tenant].observe(self._clock() - t0)
@@ -1793,6 +2032,9 @@ class ShardedCoordinator:
                 "quorum": self.quorum,
                 "quorum_failures": rt.quorum_failures,
                 "quorum_closes": rt.quorum_closes,
+                "speculative_closes": rt.speculative_closes,
+                "repairs": rt.repairs,
+                "open_repairs": len(rt.open_repairs),
                 "partitions": rt.partitions,
                 "forged_partials": rt.forged,
                 "root_duplicates": rt.root_duplicates,
@@ -1831,6 +2073,7 @@ def audit_sharded_exactly_once(
     violations: List[str] = []
     folded_pairs: Dict[Tuple[str, int], int] = {}
     root_rounds = 0
+    root_repairs = 0
     root_dir = os.path.join(directory, "root", tenant)
     if os.path.isdir(root_dir):
         records, _torn = read_wal(root_dir)
@@ -1846,6 +2089,16 @@ def audit_sharded_exactly_once(
                             continue
                         key = (str(client), int(seq))
                         folded_pairs[key] = folded_pairs.get(key, 0) + 1
+            elif rec[0] == "p" and isinstance(rec[2], dict):
+                # speculative-close repair records join the same
+                # exactly-once ledger: a row that folded in a merge AND
+                # a repair (or in two repairs) is a double-fold
+                root_repairs += 1
+                for client, seq in rec[2].get("folded", ()):
+                    if seq is None:
+                        continue
+                    key = (str(client), int(seq))
+                    folded_pairs[key] = folded_pairs.get(key, 0) + 1
     for key, count in folded_pairs.items():
         if count > 1:
             violations.append(
@@ -1893,6 +2146,7 @@ def audit_sharded_exactly_once(
         "accepted": accepted_total,
         "pending": pending_total,
         "root_rounds": root_rounds,
+        "root_repairs": root_repairs,
     }
 
 

@@ -136,6 +136,81 @@ class TestCriticalPath:
         assert [r["round"] for r in summary["rounds"]] == [4, 5]
 
 
+class TestOverlappedAttribution:
+    def test_exclusive_blame_sums_to_union_makespan(self):
+        # round 1's ingest (span r1) starts while round 0's tail is
+        # still closing: wall-clock [80, 100) is claimed by BOTH round
+        # trees.  Exclusive blame must count it once.
+        events = [
+            _ev("serving.round", 0, 100, "r0", round=0, tenant="m0"),
+            _ev("serving.fold", 60, 40, "f0", parent="r0"),
+            _ev("serving.round", 80, 100, "r1", round=1, tenant="m0"),
+            _ev("serving.fold", 140, 40, "f1", parent="r1"),
+        ]
+        summary = cp.summarize_overlapped(events)
+        # union of [0,100) and [80,180) is 180, not 200
+        assert summary["makespan_us"] == pytest.approx(180)
+        assert summary["max_blame_residual"] < 1e-9
+        assert summary["overlap_hidden_us"] == pytest.approx(20)
+        assert summary["overlap_ratio"] == pytest.approx(1 - 180 / 200)
+        # the hidden 20us belongs to round 1's segments (its head ran
+        # under round 0's tail), visible in the per-round rows
+        r1 = summary["rounds"][1]
+        assert r1["overlap_hidden_us"] == pytest.approx(20)
+        assert r1["exclusive_us"] == pytest.approx(100 - 20)
+
+    def test_hidden_column_names_the_hidden_stage(self):
+        # round 1's fold runs ENTIRELY under round 0's span: all of its
+        # blame moves to the overlap_hidden_us column
+        events = [
+            _ev("serving.round", 0, 100, "r0", round=0),
+            _ev("serving.round", 50, 100, "r1", round=1),
+            _ev("serving.fold", 55, 40, "f1", parent="r1"),
+        ]
+        summary = cp.summarize_overlapped(events)
+        table = {
+            (r["stage"], r["shard"]): r for r in summary["stages"]
+        }
+        fold = table[("serving.fold", None)]
+        assert fold["overlap_hidden_us"] == pytest.approx(40)
+        assert fold["blame_us"] == pytest.approx(0)
+        assert summary["max_blame_residual"] < 1e-9
+
+    def test_reduces_to_sequential_summary_without_overlap(self):
+        events = []
+        for r in range(3):
+            events += [
+                _ev("serving.round", r * 200, 100, f"r{r}", round=r),
+                _ev("serving.fold", r * 200 + 10, 50, f"f{r}",
+                    parent=f"r{r}"),
+            ]
+        seq = cp.summarize(events)
+        ovl = cp.summarize_overlapped(events)
+        assert ovl["overlap_hidden_us"] == 0.0
+        assert ovl["overlap_ratio"] == 0.0
+        assert ovl["max_blame_residual"] < 1e-9
+        seq_blame = {
+            (r["stage"], r["shard"]): r["blame_us"]
+            for r in seq["stages"]
+        }
+        ovl_blame = {
+            (r["stage"], r["shard"]): r["blame_us"]
+            for r in ovl["stages"]
+        }
+        assert seq_blame == ovl_blame
+
+    def test_interval_clip_arithmetic(self):
+        covered = []
+        cp._add_interval(covered, 0.0, 10.0)
+        cp._add_interval(covered, 20.0, 30.0)
+        visible, hidden = cp._clip_to_uncovered(5.0, 25.0, covered)
+        assert visible == [(10.0, 20.0)]
+        assert hidden == pytest.approx(10.0)
+        # merge across the gap
+        cp._add_interval(covered, 8.0, 22.0)
+        assert covered == [(0.0, 30.0)]
+
+
 class TestLiveTracerRoundTrip:
     def test_recorded_spans_attribute_offline(self, tmp_path):
         import time

@@ -1,0 +1,462 @@
+"""Always-on rounds (ISSUE 17): cross-round pipelining + speculative
+quorum close.
+
+Contracts under test:
+
+* **frontend pipelining** — ``pipeline_depth=1`` overlaps round N's
+  fold+device step with round N+1's admission window; the published
+  aggregates are BIT-IDENTICAL to the barrier frontend fed the same
+  traffic (round ids, staleness discounts and fold order all match);
+* **speculative close** — a quorum close with the repair horizon armed
+  retains its merge inputs; a straggler's late partial folds through
+  :meth:`repair_round` into an aggregate bit-identical to the barrier
+  close that would have included it; replays and forged late partials
+  are rejected with evidence; beyond the horizon the rows requeue and
+  fold one-round-staler (the classic degraded-close account);
+* **durability** — the WAL repair record joins
+  :func:`audit_sharded_exactly_once`'s ledger: no row folds twice
+  across a close + repair, and the exactly-once audit stays clean
+  through a SIGKILL landed mid-overlap on the process runner.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.aggregators import (
+    CoordinateWiseTrimmedMean,
+    MultiKrum,
+)
+from byzpy_tpu.resilience.durable import DurabilityConfig, read_wal
+from byzpy_tpu.serving import (
+    CreditPolicy,
+    ServingFrontend,
+    ShardedCoordinator,
+    TenantConfig,
+)
+from byzpy_tpu.serving.runner import Runner, RunnerClient, RunnerSpec
+from byzpy_tpu.serving.sharded import (
+    PartialFold,
+    audit_sharded_exactly_once,
+    shard_for,
+)
+from byzpy_tpu.serving.staleness import StalenessPolicy
+
+DIM = 48
+TENANT = "m0"
+
+
+def _tenants(agg=None, **kw):
+    return [
+        TenantConfig(
+            name=TENANT,
+            aggregator=agg or CoordinateWiseTrimmedMean(f=1),
+            dim=DIM,
+            cohort_cap=64,
+            staleness=StalenessPolicy(
+                kind="exponential", gamma=0.5, cutoff=8
+            ),
+            **kw,
+        )
+    ]
+
+
+def _grads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"c{i:03d}": rng.normal(size=DIM).astype(np.float32)
+        for i in range(n)
+    }
+
+
+# ---------------------------------------------------------------------------
+# frontend cross-round pipelining: bit parity with the barrier loop
+# ---------------------------------------------------------------------------
+
+
+def _run_frontend(depth, rounds=4, clients=6):
+    """Drive ``rounds`` identical windows through a frontend at the
+    given pipeline depth; returns the per-round aggregates."""
+
+    async def run():
+        agg = CoordinateWiseTrimmedMean(f=1)
+        captured = []
+        fe = ServingFrontend(
+            [
+                TenantConfig(
+                    name=TENANT,
+                    aggregator=agg,
+                    dim=DIM,
+                    window_s=0.01,
+                    cohort_cap=clients,
+                    min_cohort=clients,
+                    credit=CreditPolicy(rate_per_s=0, burst=100),
+                    staleness=StalenessPolicy(
+                        kind="exponential", gamma=0.5, cutoff=8
+                    ),
+                )
+            ],
+            pipeline_depth=depth,
+            on_round=lambda _t, r, _c, vec: captured.append(
+                (r, np.asarray(vec).copy())
+            ),
+        )
+        await fe.start()
+        rng = np.random.default_rng(1234)
+        for r in range(rounds):
+            for i in range(clients):
+                g = rng.normal(size=DIM).astype(np.float32)
+                ok, reason = fe.submit(TENANT, f"c{i}", r, g)
+                assert ok, reason
+            # size trigger fires at cohort_cap; wait for the close
+            for _ in range(200):
+                if len(captured) > r:
+                    break
+                await asyncio.sleep(0.005)
+        await fe.drain(TENANT)
+        await fe.close()
+        st = fe.stats()[TENANT]
+        return captured, st
+
+    return asyncio.run(run())
+
+
+def test_frontend_pipelined_rounds_bit_identical_to_barrier():
+    barrier, st0 = _run_frontend(0)
+    pipelined, st1 = _run_frontend(1)
+    assert len(barrier) == len(pipelined) == 4
+    for (r0, v0), (r1, v1) in zip(barrier, pipelined):
+        assert r0 == r1
+        np.testing.assert_array_equal(v0, v1)
+    assert st0["rounds"] == st1["rounds"]
+    assert st0["failed_rounds"] == st1["failed_rounds"] == 0
+
+
+def test_frontend_pipeline_depth_validated():
+    with pytest.raises(ValueError):
+        ServingFrontend(_tenants(), pipeline_depth=2)
+    with pytest.raises(ValueError):
+        ServingFrontend(_tenants(), pipeline_depth=-1)
+
+
+# ---------------------------------------------------------------------------
+# speculative quorum close: repair parity, replay, forgery, horizon
+# ---------------------------------------------------------------------------
+
+
+N_SHARDS = 3
+STRAGGLER = 2
+CLIENTS = [f"c{i:04d}" for i in range(18)]
+
+
+def _submit_round(co, r, grads, seqs):
+    for c, g in grads.items():
+        ok, reason = co.submit(TENANT, c, r, g, seq=seqs[c])
+        assert ok, (c, reason)
+        seqs[c] += 1
+
+
+def _speculative_close(co, r):
+    """One degraded close with the straggler's partial held back.
+    The straggler's partial is taken FIRST: the close's confirm fan
+    advances every shard's staleness clock to ``r+1``, and a partial
+    drained after that would carry the wrong round id."""
+    late = co.shards[STRAGGLER].close_partial(TENANT)
+    assert late is not None and late.round_id == r
+    present = [
+        co.shards[s].close_partial(TENANT)
+        for s in range(N_SHARDS)
+        if s != STRAGGLER
+    ]
+    res = co.merge_partials(
+        TENANT, [p for p in present if p is not None],
+        missing=[STRAGGLER],
+    )
+    assert res is not None and res[0] == r
+    return late, res
+
+
+def test_repair_folds_late_partial_bit_identical_to_barrier():
+    agg = MultiKrum(f=2, q=3)
+    co = ShardedCoordinator(
+        _tenants(agg), N_SHARDS, quorum=2, repair_horizon_rounds=2
+    )
+    twin = ShardedCoordinator(_tenants(agg), N_SHARDS)
+    seqs = dict.fromkeys(CLIENTS, 0)
+    twin_seqs = dict.fromkeys(CLIENTS, 0)
+    for r in range(3):
+        grads = _grads(len(CLIENTS), seed=100 + r)
+        grads = dict(zip(CLIENTS, grads.values()))
+        _submit_round(co, r, grads, seqs)
+        _submit_round(twin, r, grads, twin_seqs)
+        ref = twin.close_round_nowait(TENANT)
+        assert ref is not None and ref[0] == r
+        late, spec = _speculative_close(co, r)
+        # the degraded aggregate differs (fewer rows)...
+        rep = co.repair_round(TENANT, late)
+        assert rep is not None and rep[0] == r
+        # ...but the repaired one is bit-identical to the barrier
+        # close that waited for the straggler
+        np.testing.assert_array_equal(rep[2], ref[2])
+        np.testing.assert_array_equal(rep[1], ref[1])
+        # the repaired round is the latest close: the broadcast moves
+        np.testing.assert_array_equal(
+            np.asarray(co._roots[TENANT].last_aggregate), ref[2]
+        )
+    rt = co._roots[TENANT]
+    assert rt.speculative_closes == 3
+    assert rt.repairs == 3
+    assert not rt.open_repairs
+    assert rt.forged == 0
+
+
+def test_repair_replay_rejected_as_exactly_once_duplicate():
+    co = ShardedCoordinator(
+        _tenants(), N_SHARDS, quorum=1, repair_horizon_rounds=2
+    )
+    seqs = dict.fromkeys(CLIENTS, 0)
+    grads = _grads(len(CLIENTS), seed=7)
+    grads = dict(zip(CLIENTS, grads.values()))
+    _submit_round(co, 0, grads, seqs)
+    # TWO stragglers: shard 0 closes alone, 1 and 2 fold as repairs
+    late1 = co.shards[1].close_partial(TENANT)
+    late2 = co.shards[2].close_partial(TENANT)
+    present = co.shards[0].close_partial(TENANT)
+    assert late1 is not None and late2 is not None
+    res = co.merge_partials(TENANT, [present], missing=[1, 2])
+    assert res is not None and res[0] == 0
+    assert co.repair_round(TENANT, late2) is not None
+    rt = co._roots[TENANT]
+    # replay while the round's repair context is STILL OPEN (shard 1
+    # outstanding): the cover is no longer missing — protocol
+    # violation, evidence recorded, nothing folds twice
+    assert co.repair_round(TENANT, late2) is None
+    assert rt.repairs == 1
+    events = [
+        e for e in co.shard_events if e["event"] == "shard_forged"
+    ]
+    assert events and events[-1]["reason"] == "repair_not_missing"
+    # the last straggler retires the context; a replay after THAT is
+    # simply unknown — rejected without shard-state side effects
+    assert co.repair_round(TENANT, late1) is not None
+    assert not rt.open_repairs
+    assert co.repair_round(TENANT, late1) is None
+    assert rt.repairs == 2
+
+
+def test_forged_late_partial_rejected_with_evidence():
+    co = ShardedCoordinator(
+        _tenants(), N_SHARDS, quorum=2, repair_horizon_rounds=2
+    )
+    seqs = dict.fromkeys(CLIENTS, 0)
+    grads = _grads(len(CLIENTS), seed=8)
+    grads = dict(zip(CLIENTS, grads.values()))
+    _submit_round(co, 0, grads, seqs)
+    late, spec = _speculative_close(co, 0)
+    degraded = np.asarray(spec[2]).copy()
+    forged = PartialFold(
+        tenant=late.tenant, round_id=late.round_id, shard=late.shard,
+        rows=np.asarray(late.rows) * 3.0 + 1.0,
+        clients=late.clients, seqs=late.seqs, wal_ids=late.wal_ids,
+        extras=late.extras, digest=late.digest,
+        first_arrival_s=late.first_arrival_s,
+    )
+    assert co.repair_round(TENANT, forged) is None
+    rt = co._roots[TENANT]
+    assert rt.forged == 1
+    assert rt.repairs == 0
+    # the already-broadcast degraded close STANDS
+    np.testing.assert_array_equal(
+        np.asarray(rt.last_aggregate), degraded
+    )
+    events = [
+        e for e in co.shard_events if e["event"] == "shard_forged"
+    ]
+    assert events and events[-1]["shard"] == STRAGGLER
+    assert "claimed_digest" in events[-1]
+    # the forged shard burned its slot: its cover left the repair set
+    assert not rt.open_repairs
+
+
+def test_horizon_expiry_requeues_one_round_staler():
+    co = ShardedCoordinator(
+        _tenants(), N_SHARDS, quorum=2, repair_horizon_rounds=1
+    )
+    seqs = dict.fromkeys(CLIENTS, 0)
+    grads = _grads(len(CLIENTS), seed=9)
+    grads = dict(zip(CLIENTS, grads.values()))
+    straggler_rows = sum(
+        1 for c in CLIENTS if shard_for(c, N_SHARDS) == STRAGGLER
+    )
+    assert straggler_rows > 0
+    _submit_round(co, 0, grads, seqs)
+    late, _spec = _speculative_close(co, 0)
+    rt = co._roots[TENANT]
+    assert 0 in rt.open_repairs
+    # round 1 closes with everyone present; round 0 falls out of the
+    # 1-round horizon and the straggler's drained cohort requeues
+    _submit_round(co, 1, grads, seqs)
+    res = co.close_round_nowait(TENANT)
+    assert res is not None and res[0] == 1
+    assert not rt.open_repairs
+    # the late partial is now unrepairable — classic path takes over
+    assert co.repair_round(TENANT, late) is None
+    assert rt.repairs == 0
+    # round 2: the requeued round-0 rows fold one-round-staler
+    _submit_round(co, 2, grads, seqs)
+    p = co.shards[STRAGGLER].close_partial(TENANT)
+    assert p is not None
+    assert p.m == straggler_rows * 2, (p.m, straggler_rows)
+
+
+def test_wal_repair_record_joins_exactly_once_audit(tmp_path):
+    directory = str(tmp_path / "wal")
+    co = ShardedCoordinator(
+        _tenants(), N_SHARDS, quorum=2, repair_horizon_rounds=2,
+        durability=DurabilityConfig(directory=directory, prune=False),
+    )
+    seqs = dict.fromkeys(CLIENTS, 0)
+    for r in range(2):
+        grads = _grads(len(CLIENTS), seed=20 + r)
+        grads = dict(zip(CLIENTS, grads.values()))
+        _submit_round(co, r, grads, seqs)
+        late, _spec = _speculative_close(co, r)
+        assert co.repair_round(TENANT, late) is not None
+        assert co.repair_round(TENANT, late) is None  # replay
+    audit = audit_sharded_exactly_once(directory, TENANT, N_SHARDS)
+    assert audit["violations"] == [], audit
+    assert audit["root_repairs"] == 2
+    assert audit["root_rounds"] == 2
+    assert audit["pending"] == 0
+    # the repair record is bit-auditable: old/new/delta digests present
+    records, torn = read_wal(os.path.join(directory, "root", TENANT))
+    assert not torn
+    repairs = [rec for rec in records if rec[0] == "p"]
+    assert len(repairs) == 2
+    for rec in repairs:
+        payload = rec[2]
+        assert payload["event"] == "repair"
+        assert payload["old_digest"] != payload["agg_digest"]
+        assert payload["delta_digest"]
+        assert payload["shards"] == [STRAGGLER]
+        assert payload["folded"]
+
+
+# ---------------------------------------------------------------------------
+# process runner: SIGKILL mid-overlap, exactly-once + monotonic rounds
+# ---------------------------------------------------------------------------
+
+
+def _drive_runner_round(client, grads, r, seqs, only_shard=None):
+    frames = {s: [] for s in range(client.n_shards)}
+    sent = []
+    for c, g in grads.items():
+        shard, frame = client.encode_submit(
+            TENANT, c, r, g, seq=seqs[c]
+        )
+        if only_shard is not None and shard != only_shard:
+            continue
+        frames[shard].append(frame)
+        sent.append((c, seqs[c], shard))
+        seqs[c] += 1
+    accepted, rejected = client.submit_many(frames)
+    assert rejected == 0
+    assert accepted == len(sent)
+    return sent
+
+
+def test_runner_sigkill_mid_overlap_exactly_once(tmp_path):
+    """SIGKILL drill against the always-on door: round 1's deferred
+    finish is still in flight (round 2's admission plane already open)
+    when one shard process dies with acked-but-unfolded round-2 rows.
+    The settle degrades, recovery replays the WAL, the ambiguous
+    frames dedup, and the cross-WAL audit shows exactly-once folds
+    with monotonic round ids for BOTH overlapped rounds."""
+    directory = str(tmp_path / "drill")
+    spec = RunnerSpec(
+        tenants=_tenants(),
+        n_shards=2,
+        durability=DurabilityConfig(
+            directory=directory, snapshot_every=2, prune=False
+        ),
+    )
+    grads = _grads(12, seed=20260806)
+    seqs = dict.fromkeys(grads, 0)
+    victim = 1
+    live = 1 - victim
+    with Runner(spec) as runner:
+        client = RunnerClient("127.0.0.1", runner.shard_ports)
+        # round 0: both shards fold (barrier warmup)
+        _drive_runner_round(client, grads, 0, seqs)
+        assert runner.close_round(TENANT)["closed"] == 0
+        # round 1: only the surviving shard's clients submit, so the
+        # in-flight finish owes the victim no confirm — the kill below
+        # races nothing
+        _drive_runner_round(client, grads, 1, seqs, only_shard=live)
+        reply = runner.close_round_pipelined(TENANT)
+        assert reply["pending"] == 1
+        assert reply["round"] == 2
+        # MID-OVERLAP: round 2's admission plane is open while round
+        # 1's verify+merge+confirm runs on the finish thread; land
+        # acked rows on the victim, then SIGKILL it
+        sent = _drive_runner_round(client, grads, 2, seqs)
+        ambiguous = [
+            (c, seq) for c, seq, shard in sent if shard == victim
+        ]
+        assert ambiguous
+        client.close()
+        runner.kill_shard(victim)
+        # settle round 1: the overlapped finish still lands
+        prev = runner.flush_rounds(TENANT)["prev"]
+        assert prev is not None and prev["closed"] == 1
+        # the always-on door quorum-gates with the victim dead
+        reply = runner.close_round_pipelined(TENANT)
+        assert reply["pending"] is None
+        runner.recover_shard(victim)
+        client = RunnerClient("127.0.0.1", runner.shard_ports)
+        # replay the ambiguous frames under their ORIGINAL seqs: the
+        # recovered shard's WAL-rebuilt dedup table absorbs them
+        for c, seq in ambiguous:
+            ack = client.submit(TENANT, c, 2, grads[c], seq=seq)
+            assert ack["accepted"], ack
+            assert ack["reason"] == "duplicate", ack
+        reply = runner.close_round_pipelined(TENANT)
+        assert reply["pending"] == 2
+        prev = runner.flush_rounds(TENANT)["prev"]
+        assert prev is not None and prev["closed"] == 2
+        st = runner.stats()
+        assert st["root"][TENANT]["failed_rounds"] == 0
+        client.close()
+    audit = audit_sharded_exactly_once(directory, TENANT, 2)
+    assert audit["violations"] == [], audit
+    assert audit["pending"] == 0, audit
+    # monotonic round ids across the overlap, in every WAL: the
+    # pipelined door may reorder WORK but never the round ledger
+    root_rounds = [
+        rec[1]
+        for rec in read_wal(os.path.join(directory, "root", TENANT))[0]
+        if rec[0] == "r"
+    ]
+    assert root_rounds == [0, 1, 2]
+    for i in range(2):
+        shard_rounds = [
+            rec[1]
+            for rec in read_wal(
+                os.path.join(directory, f"shard{i}", TENANT)
+            )[0]
+            if rec[0] == "r"
+        ]
+        assert shard_rounds == sorted(shard_rounds)
+        assert len(set(shard_rounds)) == len(shard_rounds)
+    # the victim never saw round 1 (no rows routed there); the live
+    # shard folded in all three rounds
+    live_rounds = [
+        rec[1]
+        for rec in read_wal(
+            os.path.join(directory, f"shard{live}", TENANT)
+        )[0]
+        if rec[0] == "r"
+    ]
+    assert live_rounds == [0, 1, 2]
