@@ -776,6 +776,7 @@ def run_shard(args, out) -> dict:
     # -- parity cell: 2 shards vs one frontend, digest equality ----------
     n_shards = 2
     co = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
+    co_s = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
     fe = ServingFrontend(mk_tenants())
     order = [
         c
@@ -788,8 +789,25 @@ def run_shard(args, out) -> dict:
         for c in clients:
             ok, reason = co.submit("m0", c, r, grads[c], seq=r)
             assert ok, (c, reason)
+            ok, reason = co_s.submit("m0", c, r, grads[c], seq=r)
+            assert ok, (c, reason)
         res = co.close_round_nowait("m0")
         assert res is not None
+        # streaming twin: each partial cross-checked AT ARRIVAL
+        # (reverse arrival order — arrival order must not matter),
+        # then merged with the cached verdicts (ISSUE 18)
+        stream_parts = [
+            co_s.shards[s].close_partial("m0") for s in range(n_shards)
+        ]
+        assert all(p is not None for p in stream_parts)
+        prechecked = {
+            id(p): co_s.check_partial("m0", p, inflight=True)
+            for p in reversed(stream_parts)
+        }
+        res_s = co_s.merge_partials(
+            "m0", stream_parts, prechecked=prechecked
+        )
+        assert res_s is not None, r
         for c in order:
             ok, reason = fe.submit("m0", c, r, grads[c], seq=r)
             assert ok, (c, reason)
@@ -797,6 +815,7 @@ def run_shard(args, out) -> dict:
         assert ref is not None
         sharded_digest = evidence_digest(res[2])
         single_digest = evidence_digest(ref[2])
+        stream_digest = evidence_digest(res_s[2])
         parity_digests.append(
             {"round": r, "sharded": sharded_digest, "single": single_digest}
         )
@@ -804,6 +823,14 @@ def run_shard(args, out) -> dict:
             f"hierarchical fold diverged at round {r}: "
             f"{sharded_digest} != {single_digest}"
         )
+        assert stream_digest == sharded_digest, (
+            f"streaming merge diverged at round {r}: "
+            f"{stream_digest} != {sharded_digest}"
+        )
+    assert co_s.stats()["root"]["m0"]["partial_checks"] == (
+        rounds * n_shards
+    )
+    assert co_s.stats()["root"]["m0"]["partials_inflight"] == 0
 
     # -- compromised-shard cells: each forgery mode vs the root ----------
     forge_rows = {}
@@ -812,15 +839,24 @@ def run_shard(args, out) -> dict:
         co3 = ShardedCoordinator(
             mk_tenants(), n3, quorum=1, extras_policy="verify"
         )
+        co3s = ShardedCoordinator(
+            mk_tenants(), n3, quorum=1, extras_policy="verify"
+        )
         byz = 2
         co3.shards[byz] = CompromisedShard(
             co3.shards[byz], mode=mode, seed=args.seed, n_shards=n3
         )
+        co3s.shards[byz] = CompromisedShard(
+            co3s.shards[byz], mode=mode, seed=args.seed, n_shards=n3
+        )
         honest_clients = [c for c in clients if shard_for(c, n3) != byz]
         ref_co = ShardedCoordinator(mk_tenants(), n3, quorum=1)
+        stream_forged = 0
         for r in range(rounds):
             for c in clients:
                 ok, _ = co3.submit("m0", c, r, grads[c], seq=r)
+                assert ok
+                ok, _ = co3s.submit("m0", c, r, grads[c], seq=r)
                 assert ok
             for c in honest_clients:
                 ok, _ = ref_co.submit("m0", c, r, grads[c], seq=r)
@@ -831,6 +867,27 @@ def run_shard(args, out) -> dict:
             # the forged partial was excluded: the merged aggregate is
             # bit-identical to the honest-shards-only deployment
             assert np.array_equal(res[2], ref[2]), (mode, r)
+            # streaming twin: the forged frame fails its ARRIVAL-time
+            # cross-check, and the cached verdict excludes it at the
+            # close without poisoning the incremental merge state
+            parts = [
+                co3s.shards[s].close_partial("m0") for s in range(n3)
+            ]
+            assert all(p is not None for p in parts)
+            prechecked = {
+                id(p): co3s.check_partial("m0", p, inflight=True)
+                for p in parts
+            }
+            forged_now = sum(
+                1 for ok_chk, _m in prechecked.values() if not ok_chk
+            )
+            assert forged_now == 1, (mode, r, forged_now)
+            stream_forged += forged_now
+            res_s = co3s.merge_partials(
+                "m0", parts, prechecked=prechecked
+            )
+            assert res_s is not None, (mode, r)
+            assert np.array_equal(res_s[2], ref[2]), (mode, r)
         detected = co3.stats()["root"]["m0"]["forged_partials"]
         events = [
             e for e in co3.shard_events if e["event"] == "shard_forged"
@@ -839,11 +896,16 @@ def run_shard(args, out) -> dict:
         assert len(events) == rounds and all(
             e["shard"] == byz for e in events
         ), mode
+        s_detected = co3s.stats()["root"]["m0"]["forged_partials"]
+        assert s_detected == rounds, (mode, s_detected, rounds)
+        assert co3s.stats()["root"]["m0"]["partials_inflight"] == 0
         forge_rows[mode] = {
             "rounds": rounds,
             "forged_detected": detected,
             "evidence_events": len(events),
             "aggregate_parity_vs_honest_only": "bit-identical",
+            "streaming_forged_detected": stream_forged,
+            "streaming_parity_vs_honest_only": "bit-identical",
         }
 
     row = {
@@ -854,6 +916,8 @@ def run_shard(args, out) -> dict:
         "rounds": rounds,
         "parity": "bit-identical",
         "parity_digest_last": parity_digests[-1]["sharded"],
+        "streaming_parity": "bit-identical",
+        "streaming_checks": rounds * n_shards,
         "forgery": forge_rows,
     }
     _emit(row, out)
@@ -962,6 +1026,56 @@ def run_speculative(args, out) -> dict:
         assert st["repairs"] == rounds, st
         assert st["open_repairs"] == 0, st
 
+    # streaming repair (ISSUE 18): the late partial is cross-checked at
+    # ARRIVAL and repair_round reuses the cached verdict — a repair
+    # costs ZERO additional verifies at fold time, and the repaired
+    # aggregate stays bit-identical to the barrier twin
+    rng = np.random.default_rng(args.seed)
+    grads = {c: rng.normal(size=dim).astype(np.float32) for c in clients}
+    co_st = ShardedCoordinator(
+        mk_tenants(), n_shards, quorum=2, repair_horizon_rounds=2
+    )
+    twin_st = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
+    streaming_repair_rounds = 0
+    for r in range(rounds):
+        for c in clients:
+            ok, _ = co_st.submit("m0", c, r, grads[c], seq=r)
+            assert ok
+            ok, _ = twin_st.submit("m0", c, r, grads[c], seq=r)
+            assert ok
+        ref = twin_st.close_round_nowait("m0")
+        assert ref is not None
+        late = co_st.shards[straggler].close_partial("m0")
+        assert late is not None
+        late_chk = co_st.check_partial("m0", late, inflight=True)
+        present = [
+            co_st.shards[s].close_partial("m0")
+            for s in range(n_shards)
+            if s != straggler
+        ]
+        prechecked = {
+            id(p): co_st.check_partial("m0", p, inflight=True)
+            for p in present
+        }
+        res = co_st.merge_partials(
+            "m0", present, missing=[straggler], prechecked=prechecked
+        )
+        assert res is not None, r
+        checks_at_close = co_st.stats()["root"]["m0"]["partial_checks"]
+        rep = co_st.repair_round("m0", late, prechecked=late_chk)
+        assert rep is not None, r
+        assert np.array_equal(rep[2], ref[2]), (
+            f"streaming repair diverged at round {r}: "
+            f"{evidence_digest(rep[2])} != {evidence_digest(ref[2])}"
+        )
+        # the repair consumed the arrival-time verdict: no new verify
+        assert (
+            co_st.stats()["root"]["m0"]["partial_checks"]
+            == checks_at_close
+        ), r
+        streaming_repair_rounds += 1
+    assert co_st.stats()["root"]["m0"]["partials_inflight"] == 0
+
     # forged late arrival: the compromised straggler tampers its rows
     # after the digest — repair_round must exclude it with evidence,
     # and the degraded close's broadcast stands
@@ -1013,6 +1127,9 @@ def run_speculative(args, out) -> dict:
         "seeds": len(seeds),
         "repair_parity_rounds": parity_rounds,
         "repair_parity": "bit-identical",
+        "streaming_repair_rounds": streaming_repair_rounds,
+        "streaming_repair_parity": "bit-identical",
+        "streaming_repair_verify_cost": "arrival-cached",
         "replay_rejected": "all",
         "forged_late_rejected": forged_rejected,
         "evidence_events": len(events),
@@ -1499,6 +1616,14 @@ def main() -> None:
             v["forged_detected"] == v["rounds"]
             for v in shard["forgery"].values()
         ), shard
+        # streaming root merge (ISSUE 18) must not move a single digit
+        # of the lane: arrival-driven verify+fold digest-equal to the
+        # barrier path, forgery detection rate unchanged
+        assert shard["streaming_parity"] == "bit-identical", shard
+        assert all(
+            v["streaming_forged_detected"] == v["rounds"]
+            for v in shard["forgery"].values()
+        ), shard
     if args.smoke and speculative is not None:
         # run_speculative asserts repair parity + replay/forgery
         # rejection internally; pin the headline shape here too
@@ -1506,6 +1631,15 @@ def main() -> None:
         assert speculative["repair_parity_rounds"] > 0, speculative
         assert (
             speculative["forged_late_rejected"] == speculative["rounds"]
+        ), speculative
+        # streaming composes with the speculative close: the repair
+        # reuses the arrival-time verify and stays bit-identical
+        assert (
+            speculative["streaming_repair_parity"] == "bit-identical"
+        ), speculative
+        assert (
+            speculative["streaming_repair_rounds"]
+            == speculative["rounds"]
         ), speculative
     if args.smoke and subint8 is not None:
         assert subint8["shaping_all_flagged"], subint8

@@ -932,6 +932,234 @@ def _run_scale(args) -> dict:
     return row
 
 
+def _run_streamroot(args) -> dict:
+    """Streaming root merge A/B (ISSUE 18): the SAME deterministic
+    traffic through two roots — the BARRIER arm (gather all partials,
+    then verify-ALL + combine + finalize serially after the barrier:
+    the pre-18 door) vs the STREAMING arm (each partial cross-checked
+    via :meth:`ShardedCoordinator.check_partial` the moment it exists
+    — the arrival-time verify rides the shard's own lane, exactly
+    where the runner's proxy reader threads run it — and the close
+    consumes the cached verdicts, leaving only dedup + combine +
+    finalize on the round's critical path).
+
+    Per round and shard count the two arms' published aggregates are
+    asserted BIT-IDENTICAL (array equality, not digest eyeballing).
+    Makespans follow the scale lane's parallel model (max(shard legs)
+    + root close; legs overlap on their own lanes) and the root-merge
+    exclusive blame share is attributed by the same
+    ``observability.critical_path`` methodology that produced the PR 13
+    baseline table (14.4%/29.9%/37.5% at 1/2/4 shards) — so the two
+    tables compare like for like."""
+    from byzpy_tpu import observability as obs
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.observability import critical_path as obs_cp
+    from byzpy_tpu.serving import ShardedCoordinator
+    from byzpy_tpu.serving.sharded import shard_for
+
+    from byzpy_tpu.aggregators import ComparativeGradientElimination
+
+    telemetry_was_on = obs.enabled()
+    obs.enable()
+    rng = np.random.default_rng(7)
+    d = args.scale_dim
+    per_round = args.scale_round_submissions
+    grads = [rng.normal(size=d).astype(np.float32) for _ in range(64)]
+    bodies = [
+        wire.encode(
+            {
+                "kind": "submit", "tenant": "scale", "client": "c000000",
+                "round": 0, "gradient": g, "seq": 0,
+            }
+        )[4:]
+        for g in grads
+    ]
+    identity = [f"c{i:06d}" for i in range(args.scale_clients)]
+    cells = {}
+    for n_shards in args.streamroot_shards:
+        co_b = ShardedCoordinator(
+            [_scale_tenant(args, ComparativeGradientElimination(
+                f=args.byzantine))],
+            n_shards, quorum=1,
+        )
+        co_s = ShardedCoordinator(
+            [_scale_tenant(args, ComparativeGradientElimination(
+                f=args.byzantine))],
+            n_shards, quorum=1,
+        )
+        legs_b_rounds: list = []
+        merges_b: list = []
+        legs_s_rounds: list = []
+        merges_s: list = []
+        digests: list = []
+        for r in range(args.scale_rounds + 1):
+            warmup = r == 0
+            lo = (r * per_round) % max(
+                1, args.scale_clients - per_round + 1
+            )
+            window = identity[lo: lo + per_round]
+            partition = [
+                [c for c in window if shard_for(c, n_shards) == s]
+                for s in range(n_shards)
+            ]
+            gc.collect()
+            gc.disable()
+            try:
+                # -- barrier arm: verify-ALL lives in the root close --
+                legs_b = []
+                parts_b = []
+                for s in range(n_shards):
+                    _acc, leg = _drive_shard_partition(
+                        co_b, s, partition, grads, bodies, r
+                    )
+                    t0 = time.monotonic()
+                    p = co_b.shards[s].close_partial("scale")
+                    leg += time.monotonic() - t0
+                    if p is not None:
+                        parts_b.append(p)
+                    legs_b.append(leg)
+                t0 = time.monotonic()
+                res_b = co_b.merge_partials("scale", parts_b)
+                merge_b = time.monotonic() - t0
+                # -- streaming arm: the arrival-time cross-check rides
+                # the shard's own lane (the reader-thread position);
+                # the close consumes the cached verdicts -------------
+                legs_s = []
+                parts_s = []
+                prechecked = {}
+                for s in range(n_shards):
+                    _acc, leg = _drive_shard_partition(
+                        co_s, s, partition, grads, bodies, r
+                    )
+                    t0 = time.monotonic()
+                    p = co_s.shards[s].close_partial("scale")
+                    if p is not None:
+                        prechecked[id(p)] = co_s.check_partial(
+                            "scale", p, inflight=True
+                        )
+                        parts_s.append(p)
+                    leg += time.monotonic() - t0
+                    legs_s.append(leg)
+                t0 = time.monotonic()
+                res_s = co_s.merge_partials(
+                    "scale", parts_s, prechecked=prechecked
+                )
+                merge_s = time.monotonic() - t0
+            finally:
+                gc.enable()
+            assert res_b is not None and res_s is not None, (n_shards, r)
+            # the bit-identity contract: streaming must not move a bit
+            assert np.array_equal(
+                np.asarray(res_b[2]), np.asarray(res_s[2])
+            ), f"streaming diverged at {n_shards} shards round {r}"
+            if warmup:
+                continue
+            digests.append(evidence_digest(np.asarray(res_s[2])))
+            legs_b_rounds.append(legs_b)
+            merges_b.append(merge_b)
+            legs_s_rounds.append(legs_s)
+            merges_s.append(merge_s)
+        st = co_s.stats()["root"]["scale"]
+        assert st["partials_inflight"] == 0, st
+        cp_b = obs_cp.summarize(
+            _scale_round_trace_events(n_shards, legs_b_rounds, merges_b)
+        )
+        cp_s = obs_cp.summarize(
+            _scale_round_trace_events(n_shards, legs_s_rounds, merges_s)
+        )
+
+        def _share(cp):
+            return next(
+                (
+                    s["share"]
+                    for s in cp["stages"]
+                    if s["stage"] == "serving.fold_merge"
+                ),
+                0.0,
+            )
+
+        share_b, share_s = _share(cp_b), _share(cp_s)
+        mk_b = [
+            max(l) + m for l, m in zip(legs_b_rounds, merges_b, strict=True)
+        ]
+        mk_s = [
+            max(l) + m for l, m in zip(legs_s_rounds, merges_s, strict=True)
+        ]
+        mean_b = float(np.mean(mk_b))
+        mean_s = float(np.mean(mk_s))
+        cells[n_shards] = {
+            "rounds": len(mk_b),
+            "barrier": {
+                "makespan_mean_ms": round(1e3 * mean_b, 2),
+                "root_close_mean_ms": round(
+                    1e3 * float(np.mean(merges_b)), 2
+                ),
+                "root_merge_blame_share": share_b,
+            },
+            "streaming": {
+                "makespan_mean_ms": round(1e3 * mean_s, 2),
+                "root_close_mean_ms": round(
+                    1e3 * float(np.mean(merges_s)), 2
+                ),
+                "root_merge_blame_share": share_s,
+                "partial_checks": st["partial_checks"],
+            },
+            "blame_rel_reduction_pct": round(
+                100.0 * (1.0 - share_s / max(share_b, 1e-9)), 1
+            ),
+            "makespan_reduction_pct": round(
+                100.0 * (1.0 - mean_s / max(mean_b, 1e-9)), 1
+            ),
+            "parity": "bit-identical",
+            "digest_last": digests[-1],
+        }
+    host_cores = os.cpu_count() or 1
+    row = {
+        "lane": "streamroot",
+        "clients": args.scale_clients,
+        "dim": d,
+        "round_submissions": per_round,
+        "rounds": args.scale_rounds,
+        "aggregator": f"cge-f{args.byzantine}",
+        "timing_model": "modeled:max(legs)+merge",
+        "timing_model_note": (
+            "scale-lane methodology (PR 13 blame table): per-shard legs "
+            "measured in isolation and overlapped on their own lanes; "
+            "the STREAMING arm's arrival-time verify is charged to the "
+            "shard's lane (where the runner's reader threads run it), "
+            "the BARRIER arm's verify-all is charged to the root close "
+            "— root_merge_blame_share is the serving.fold_merge "
+            "exclusive share of the modeled makespan in each arm"
+        ),
+        "host_cores": host_cores,
+        "shards": cells,
+        "parity": "bit-identical",
+        "root_merge_blame_share": {
+            "barrier": {
+                n: cells[n]["barrier"]["root_merge_blame_share"]
+                for n in args.streamroot_shards
+            },
+            "streaming": {
+                n: cells[n]["streaming"]["root_merge_blame_share"]
+                for n in args.streamroot_shards
+            },
+        },
+    }
+    top = max(args.streamroot_shards)
+    if top >= 4:
+        # the acceptance bar, asserted in-run (not eyeballed): at 4
+        # shards, >=25% relative reduction in root-merge blame OR >=10%
+        # per-round makespan reduction
+        c = cells[top]
+        assert (
+            c["blame_rel_reduction_pct"] >= 25.0
+            or c["makespan_reduction_pct"] >= 10.0
+        ), c
+    if not telemetry_was_on:
+        obs.disable()
+    return row
+
+
 # ---------------------------------------------------------------------------
 # process runner lane (ISSUE 14: measured multi-process makespans)
 # ---------------------------------------------------------------------------
@@ -1648,6 +1876,25 @@ def _assert_pipeline_smoke(args, row: dict) -> None:
         assert cell["pipelined"]["repairs"] == 0, cell
 
 
+def _assert_streamroot_smoke(args, row: dict) -> None:
+    """The streaming root merge A/B's CI contract: every cell's two
+    arms published bit-identical aggregates (asserted inside
+    :func:`_run_streamroot`; re-checked here so a refactor cannot drop
+    the comparison silently), every shard cross-checked at arrival, and
+    the inflight gauge drained to zero."""
+    assert row["timing_model"].startswith("modeled"), row
+    assert row["parity"] == "bit-identical"
+    for n in args.streamroot_shards:
+        cell = row["shards"][n]
+        assert cell["parity"] == "bit-identical", cell
+        assert cell["rounds"] == args.scale_rounds, cell
+        # every round's every partial was verified at arrival (warmup
+        # round included in the counter)
+        assert cell["streaming"]["partial_checks"] == (
+            (args.scale_rounds + 1) * n
+        ), cell
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=10_000)
@@ -1677,6 +1924,9 @@ def main() -> None:
     ap.add_argument("--pipeline-only", action="store_true",
                     help="run ONLY the pipelined-vs-barrier close "
                          "A/B on the process fleet (ISSUE 17 cells)")
+    ap.add_argument("--streamroot-only", action="store_true",
+                    help="run ONLY the streaming-vs-barrier root merge "
+                         "A/B (ISSUE 18 cells; scale-lane knobs apply)")
     ap.add_argument("--pipeline-pace-ms", type=float, default=60.0,
                     help="client think-time per round in the pipeline "
                          "A/B (both arms; 0 = saturating blast)")
@@ -1695,6 +1945,7 @@ def main() -> None:
 
     args.scale_shards = (1, 2, 4)
     args.runner_shards = (1, 2, 4)
+    args.streamroot_shards = (1, 2, 4)
     if args.processes_only:
         args.processes = True
     if args.smoke:
@@ -1715,6 +1966,7 @@ def main() -> None:
         args.runner_rounds = 3
         args.runner_dim = 64
         args.runner_shards = (1, 2)
+        args.streamroot_shards = (1, 2)
 
     meta = {
         "lane": "meta",
@@ -1724,6 +1976,14 @@ def main() -> None:
         "smoke": bool(args.smoke),
     }
     _emit(meta, args.out)
+
+    if args.streamroot_only:
+        streamroot_row = _run_streamroot(args)
+        _emit(streamroot_row, args.out)
+        if args.smoke:
+            _assert_streamroot_smoke(args, streamroot_row)
+            print("serving streamroot smoke OK")
+        return
 
     if args.pipeline_only:
         pipeline_row = _run_pipeline(args)
@@ -1814,6 +2074,9 @@ def main() -> None:
     scale = _run_scale(args)
     _emit(scale, args.out)
 
+    streamroot = _run_streamroot(args)
+    _emit(streamroot, args.out)
+
     runner_row = None
     if args.processes:
         runner_row = _run_runner(args)
@@ -1897,6 +2160,7 @@ def main() -> None:
         # near-linear (full-scale bar: >=1.7x at 2, >=3x at 4) and the
         # partial-fold frame law within tolerance
         assert scale["parity"] == "bit-identical"
+        _assert_streamroot_smoke(args, streamroot)
         assert scale["speedup_vs_1shard"][2] >= 1.4, scale["speedup_vs_1shard"]
         for n in args.scale_shards:
             w = scale["shards"][n]["wire"]

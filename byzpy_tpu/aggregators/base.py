@@ -534,6 +534,42 @@ class Aggregator(Operator, ABC):
         the base class carries none)."""
         return {}
 
+    # -- incremental (arrival-order) merge accumulator ---------------------
+
+    def fold_merge_begin(self) -> dict:
+        """Open an incremental merge accumulator for a STREAMING root:
+        verified shard partials are parked as they arrive — in any
+        order — and :meth:`fold_merge_finish` concatenates them in
+        canonical shard order. The accumulator exists so an
+        arrival-driven close can absorb each partial the moment its
+        verification lands while keeping the published aggregate
+        BIT-IDENTICAL to the barrier ``fold_merge`` of the same
+        partials sorted by shard (pinned by
+        ``tests/test_streaming_root.py``)."""
+        return {"parked": {}}
+
+    def fold_merge_add(
+        self, state: dict, shard: int, partial: Mapping[str, Any]
+    ) -> None:
+        """Park one verified partial under its (unique) shard key.
+        Arrival order is deliberately irrelevant — the canonical row
+        order is re-established at :meth:`fold_merge_finish`, so an
+        out-of-order arrival never has to wait for its predecessor."""
+        key = int(shard)
+        if key in state["parked"]:
+            raise ValueError(f"shard {key} already parked in this merge")
+        state["parked"][key] = partial
+
+    def fold_merge_finish(self, state: dict) -> dict:
+        """Close the accumulator: merge the parked partials in shard
+        order through :meth:`fold_merge` — the exact call the barrier
+        close makes, so streaming-then-finish is bit-identical to
+        gather-all-then-merge by construction."""
+        parked = state["parked"]
+        if not parked:
+            raise ValueError("fold_merge_finish on an empty accumulator")
+        return self.fold_merge([parked[s] for s in sorted(parked)])
+
     def fold_merge_finalize(
         self, merged: Mapping[str, Any], *, bucket: Optional[int] = None
     ) -> jnp.ndarray:

@@ -469,27 +469,36 @@ class _MergeNode:
                         precision="off",
                     )
                 )
-            tasks = [
-                asyncio.create_task(self._child_close(i, tenant, frames[i]))
-                for i in range(len(self.children))
-            ]
-            partials: List[PartialFold] = []
-            missing: List[int] = []
-            forged: List[dict] = []
-            for i, task in enumerate(tasks):
+            loop = asyncio.get_running_loop()
+
+            async def _close_and_verify(i: int) -> tuple:
+                # STREAMING fan-in: each child's frame is decoded and
+                # verified on the executor the moment it lands, while
+                # the siblings' closes are still in flight — by the
+                # time the slowest child answers, every other child's
+                # verify is already done and only the combine remains
                 try:
-                    reply = await task
+                    reply = await self._child_close(i, tenant, frames[i])
                 except Exception:  # noqa: BLE001 — timeout/reset/late
                     # child: a partition at this level; drop the stream
                     # (it may be mid-frame) and redial next round
                     st = self._streams.pop(i, None)
                     if st is not None:
                         st[1].close()
-                    missing.extend(self._leaves_of(i))
-                    continue
-                p, child_missing, child_forged = self._verify_child(
-                    i, reply
+                    return None, self._leaves_of(i), []
+                return await loop.run_in_executor(
+                    None,
+                    obs_tracing.carry_context(self._verify_child),
+                    i, reply,
                 )
+
+            results = await asyncio.gather(
+                *(_close_and_verify(i) for i in range(len(self.children)))
+            )
+            partials: List[PartialFold] = []
+            missing: List[int] = []
+            forged: List[dict] = []
+            for p, child_missing, child_forged in results:
                 missing.extend(child_missing)
                 forged.extend(child_forged)
                 if p is not None:
@@ -822,13 +831,18 @@ class _RootServer:
 
     def _barrier(
         self, tenant: str, round_id: int
-    ) -> Tuple[List[PartialFold], List[int]]:
+    ) -> Tuple[List[PartialFold], List[int], Dict[int, tuple]]:
         """Fan one round's close to the top tier and collect the
-        replies: returns ``(partials, missing_set)``. No shard-state
-        side effects — requeue/merge policy belongs to the callers
-        (the classic door requeues stragglers immediately; a
-        speculative close leaves them in flight for the repair
-        horizon)."""
+        replies: returns ``(partials, missing_set, prechecked)``.
+        STREAMING verify: each reader thread decodes its child's frame
+        and runs the root's stateless cross-check suite
+        (``check_partial`` — digest recompute, ownership, caps) the
+        moment the frame lands, overlapped with the siblings still in
+        flight — ``prechecked`` maps ``id(partial)`` to the result so
+        the merge runs only the dedup. No shard-state side effects —
+        requeue/merge policy belongs to the callers (the classic door
+        requeues stragglers immediately; a speculative close leaves
+        them in flight for the repair horizon)."""
         missing: List[int] = [
             p.index for p in self.proxies if not p.alive
         ]
@@ -848,20 +862,35 @@ class _RootServer:
                 precision="off",
             )
 
-        def barrier(i: int) -> dict:
+        def barrier(i: int) -> tuple:
             sock = self._top_sock(i)
             sock.settimeout(self._close_timeout)
             sock.sendall(frames[i])
-            return recv_frame(sock)
+            reply = recv_frame(sock)
+            raw = reply.get("partial")
+            if raw is None:
+                return reply, None, None
+            try:
+                p = PartialFold.from_wire(raw)
+            except (ValueError, KeyError, TypeError):
+                return reply, None, "bad_partial"
+            chk = self.co.check_partial(tenant, p, inflight=True)
+            return reply, p, chk
 
         futures = {
-            self._pool.submit(barrier, i): i for i in live_top
+            self._pool.submit(
+                obs_tracing.carry_context(barrier), i
+            ): i
+            for i in live_top
         }
         partials: List[PartialFold] = []
+        prechecked: Dict[int, tuple] = {}
         for fut, i in futures.items():
             cover = self.top[i][3]
             try:
-                reply = fut.result(timeout=self._close_timeout + 5.0)
+                reply, p, chk = fut.result(
+                    timeout=self._close_timeout + 5.0
+                )
             except Exception:  # noqa: BLE001 — timeout / dead child:
                 # the whole subtree missed the barrier; its socket
                 # may be mid-frame, reset it
@@ -893,15 +922,14 @@ class _RootServer:
                     ),
                     m=int(ev.get("m", 0)),
                 )
-            raw = reply.get("partial")
-            if raw is not None:
-                try:
-                    partials.append(PartialFold.from_wire(raw))
-                except (ValueError, KeyError, TypeError):
-                    missing.extend(
-                        s for s in cover if self.proxies[s].alive
-                    )
-        return partials, sorted(set(missing))
+            if chk == "bad_partial":
+                missing.extend(
+                    s for s in cover if self.proxies[s].alive
+                )
+            elif p is not None:
+                partials.append(p)
+                prechecked[id(p)] = chk
+        return partials, sorted(set(missing)), prechecked
 
     def _requeue_missing(
         self, tenant: str, missing: Sequence[int], round_id: int
@@ -932,12 +960,17 @@ class _RootServer:
             "serving.sharded_round", track="root",
             tenant=tenant, round=rt.round_id,
         ):
-            partials, missing_set = self._barrier(tenant, rt.round_id)
+            partials, missing_set, prechecked = self._barrier(
+                tenant, rt.round_id
+            )
             speculative = self.co.repair_horizon > 0
             if not speculative:
                 self._requeue_missing(tenant, missing_set, rt.round_id)
             responders = self.spec.n_shards - len(missing_set)
             if responders < self.co.quorum:
+                if prechecked:
+                    # no merge consumes the arrival checks: unwind
+                    self.co._dec_inflight(len(prechecked))
                 for p in partials:
                     for s in p.covered:
                         self.proxies[s].requeue(tenant, p.round_id)
@@ -954,7 +987,8 @@ class _RootServer:
                     )
                 return None
             res = self.co.merge_partials(
-                tenant, partials, missing=missing_set
+                tenant, partials, missing=missing_set,
+                prechecked=prechecked,
             )
             if res is None and speculative:
                 # no close happened — nothing to repair into; recycle
@@ -986,7 +1020,7 @@ class _RootServer:
         kicked = False
         try:
             with obs_tracing.context_scope(getattr(sp, "context", None)):
-                partials, missing_set = self._barrier(
+                partials, missing_set, prechecked = self._barrier(
                     tenant, rt.round_id
                 )
                 speculative = self.co.repair_horizon > 0
@@ -996,6 +1030,9 @@ class _RootServer:
                     )
                 responders = self.spec.n_shards - len(missing_set)
                 if responders < self.co.quorum:
+                    if prechecked:
+                        # no merge consumes the arrival checks: unwind
+                        self.co._dec_inflight(len(prechecked))
                     for p in partials:
                         for s in p.covered:
                             self.proxies[s].requeue(tenant, p.round_id)
@@ -1031,7 +1068,8 @@ class _RootServer:
             }
             entry["future"] = self._finish_pool.submit(
                 self._deferred_finish,
-                tenant, closing, partials, missing_set, sp, entry,
+                tenant, closing, partials, missing_set, prechecked,
+                sp, entry,
             )
             self._pending[tenant] = entry
             kicked = True  # span ownership moved to the finish thread
@@ -1048,6 +1086,7 @@ class _RootServer:
         closing: int,
         partials: List[PartialFold],
         missing: List[int],
+        prechecked: Dict[int, tuple],
         sp,
         entry: dict,
     ) -> Optional[tuple]:
@@ -1061,7 +1100,8 @@ class _RootServer:
         try:
             with obs_tracing.context_scope(getattr(sp, "context", None)):
                 res = self.co.merge_partials(
-                    tenant, partials, missing=missing
+                    tenant, partials, missing=missing,
+                    prechecked=prechecked,
                 )
             if res is None:
                 rt = self.co._roots[tenant]
@@ -1173,7 +1213,14 @@ class _RootServer:
                         "accepted": False,
                         "reason": "bad_partial",
                     }
-                res = self.co.repair_round(tenant, partial)
+                # arrival-verified once, reused by the repair — a late
+                # frame costs ONE cross-check run end to end
+                chk = self.co.check_partial(
+                    tenant, partial, inflight=True
+                )
+                res = self.co.repair_round(
+                    tenant, partial, prechecked=chk
+                )
             resp = {
                 "kind": "round",
                 "closed": None,
@@ -1850,6 +1897,13 @@ def _smoke() -> None:
                 assert np.array_equal(
                     np.asarray(reply["aggregate"]), ref
                 ), f"runner parity diverged at round {r}"
+            # streaming leg: the frames were verified on the reader
+            # threads the moment they landed (check_partial at arrival)
+            # and every arrival-verified frame was consumed by a close
+            st = runner.stats()["root"]["m0"]
+            assert st["partial_checks"] >= rounds, st
+            assert st["partials_inflight"] == 0, st
+            stream_checks = st["partial_checks"]
             exports = runner.trace_exports()
         finally:
             client.close()
@@ -1897,6 +1951,9 @@ def _smoke() -> None:
             prev = tail["prev"]
             assert prev and prev["closed"] == rounds - 1, tail
             pipelined_digests.append(prev["digest"])
+            st = runner.stats()["root"]["m0"]
+            assert st["partial_checks"] >= rounds, st
+            assert st["partials_inflight"] == 0, st
         finally:
             client.close()
     assert overlap_admitted > 0, "no frames admitted during overlap"
@@ -1931,6 +1988,7 @@ def _smoke() -> None:
                 "rounds": rounds,
                 "parity": "bit-identical",
                 "pipelined_parity": "bit-identical",
+                "streaming_checks": stream_checks,
                 "overlap_admitted": overlap_admitted,
                 "stitched_traces": len(root_traces & shard_traces),
                 "wall_s": round(wall, 2),

@@ -105,6 +105,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import os
+import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -807,6 +808,7 @@ class _RootTenant:
         "failed_rounds", "quorum_closes", "partitions", "forged",
         "root_duplicates", "durability", "rounds",
         "speculative_closes", "repairs", "open_repairs",
+        "partial_checks",
     )
 
     def __init__(
@@ -865,6 +867,9 @@ class _RootTenant:
         #: close used, so a repair re-merge is bit-identical to the
         #: barrier close that would have included the late shard
         self.open_repairs: Dict[int, dict] = {}
+        #: stateless cross-check runs (``check_partial``) — the repair
+        #: satellite's one-verify-per-repair contract pins this counter
+        self.partial_checks = 0
 
     def is_folded(self, client: str, seq: Optional[int]) -> bool:
         if seq is None:
@@ -907,6 +912,7 @@ class ShardedCoordinator:
         topology: Optional[MergeTopology] = None,
         shards: Optional[Sequence[Any]] = None,
         repair_horizon_rounds: int = 0,
+        pipeline_depth: int = 1,
     ) -> None:
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
@@ -914,6 +920,11 @@ class ShardedCoordinator:
             raise ValueError(f"quorum must be in [1, {n_shards}]")
         if repair_horizon_rounds < 0:
             raise ValueError("repair_horizon_rounds must be >= 0")
+        if pipeline_depth not in (0, 1):
+            raise ValueError(
+                "pipeline_depth must be 0 (barrier) or 1 (depth-1 "
+                f"pipelined window), got {pipeline_depth}"
+            )
         if extras_policy not in ("trust", "verify", "recompute"):
             raise ValueError(
                 "extras_policy must be 'trust', 'verify' or 'recompute' "
@@ -992,6 +1003,18 @@ class ShardedCoordinator:
         self._running = False
         self._tasks: list = []
         self._device_lock: Optional[asyncio.Lock] = None
+        #: async-root pipelining: 1 = round N's merge+device step
+        #: settles while round N+1's shard windows admit (the runner
+        #: tier's PR-17 contract, now on the in-process root); 0 keeps
+        #: the barrier-style loop
+        self.pipeline_depth = int(pipeline_depth)
+        #: tenant → the one in-flight deferred close (depth-1 window)
+        self._pending_async: Dict[str, dict] = {}
+        #: arrival-verified partials not yet consumed by a close or
+        #: repair — incremented by ``check_partial(inflight=True)`` on
+        #: proxy reader threads / the executor, hence the lock
+        self._partials_inflight = 0
+        self._inflight_lock = threading.Lock()
         reg = obs_metrics.registry()
         self._m_accepted = {
             (cfg.name, i): reg.counter(
@@ -1056,6 +1079,29 @@ class ShardedCoordinator:
             )
             for cfg in tenants
         }
+        self._m_root_merge_s = {
+            cfg.name: reg.histogram(
+                "byzpy_root_merge_seconds",
+                help="root fold_merge+finalize latency per close/repair",
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
+        self._m_inflight = reg.gauge(
+            "byzpy_root_partials_inflight",
+            help="arrival-verified partials awaiting a root close",
+        )
+        self._m_overlap = {
+            cfg.name: reg.gauge(
+                "byzpy_round_overlap_ratio",
+                help=(
+                    "fraction of the deferred round finish that ran "
+                    "hidden behind next-round ingest"
+                ),
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
         self._m_live = reg.gauge(
             "byzpy_shards_live", help="frontend shards currently alive"
         )
@@ -1102,62 +1148,116 @@ class ShardedCoordinator:
 
     # -- partial verification ---------------------------------------------
 
+    def _inc_inflight(self) -> None:
+        with self._inflight_lock:
+            self._partials_inflight += 1
+            value = self._partials_inflight
+        if obs_runtime.STATE.enabled:
+            self._m_inflight.set(value)
+
+    def _dec_inflight(self, n: int = 1) -> None:
+        with self._inflight_lock:
+            self._partials_inflight = max(0, self._partials_inflight - int(n))
+            value = self._partials_inflight
+        if obs_runtime.STATE.enabled:
+            self._m_inflight.set(value)
+
+    def check_partial(
+        self, tenant: str, p: PartialFold, *, inflight: bool = False
+    ) -> Tuple[bool, str]:
+        """The STATELESS half of the root cross-check suite — shape/dim
+        sanity, the per-leaf row cap over ``segment_spans``, the digest
+        recompute, extras recompute under ``extras_policy='verify'``,
+        and per-row ownership against each segment's leaf shard — as an
+        arrival-time door: it reads no round state, so it can run the
+        moment a partial's frame lands (a proxy reader thread in the
+        process runner, the executor in the async root) instead of
+        after the barrier. Returns ``(ok, measured_digest)``; the pair
+        rides into :meth:`merge_partials` / :meth:`repair_round` as
+        ``prechecked`` so the close runs only the order-sensitive
+        ``(client, seq)`` dedup — which MUST stay at close time:
+        under pipelining a round-N partial can arrive while round
+        N-1's ``note_folded`` updates are still settling.
+        ``inflight=True`` counts the frame into the
+        ``byzpy_root_partials_inflight`` gauge (the close or repair
+        that consumes the precheck decrements)."""
+        rt = self._roots[tenant]
+        agg = rt.cfg.aggregator
+        rt.partial_checks += 1
+        if inflight:
+            self._inc_inflight()
+        with obs_tracing.span(
+            "serving.partial_verify", track="root", tenant=tenant,
+            shard=int(p.shard), round=int(p.round_id), m=int(p.m),
+        ):
+            rows = p.rows
+            spans = p.segment_spans()
+            if (
+                rows.ndim != 2
+                or rows.shape[0] != len(p.clients)
+                or (spans and spans[-1][2] != rows.shape[0])
+                or any(hi - lo > rt.cfg.cohort_cap for _s, lo, hi in spans)
+                or (rows.shape[0] and rows.shape[1] != rt.cfg.dim)
+            ):
+                return False, ""
+            measured = evidence_digest(rows)
+            if measured != p.digest:
+                return False, measured
+            if p.extras and self.extras_policy == "verify":
+                want = agg._partial_extras(np.asarray(rows, np.float32))
+                for key, val in want.items():
+                    got = p.extras.get(key)
+                    # equal_nan: admission deliberately passes non-finite
+                    # VALUES (adversarial payloads are the aggregator's
+                    # job), and a NaN gradient propagates into the extras
+                    # (a NaN Gram entry, a NaN running sum) — the honest
+                    # recompute reproduces the same NaNs, which plain
+                    # array_equal would call a mismatch, branding an
+                    # honest shard forged off one client's NaN row
+                    if got is None or not np.array_equal(
+                        np.asarray(val), np.asarray(got), equal_nan=True
+                    ):
+                        return False, measured
+            for owner, lo, hi in spans:
+                for j in range(lo, hi):
+                    if self.router.shard_for(p.clients[j]) != owner:
+                        # a client this segment's shard does not own:
+                        # sticky routing makes the claim a protocol
+                        # violation — the whole partial is
+                        # untrustworthy (the replay-another-shard
+                        # attack)
+                        return False, measured
+        return True, measured
+
     def _verify_partial(
-        self, rt: _RootTenant, p: PartialFold
+        self,
+        rt: _RootTenant,
+        p: PartialFold,
+        prechecked: Optional[Tuple[bool, str]] = None,
     ) -> Tuple[Optional[Tuple[List[int], List[int]]], str]:
         """Root cross-checks of one shard's partial. Returns
         ``((folded row indices, duplicate row indices), measured_digest)``
         — the first element ``None`` when the whole partial is excluded
         as forged (digest mismatch, field nonsense, row-cap abuse,
-        extras inconsistency under ``extras_policy='verify'``). The
-        measured digest rides back so the evidence event does not hash
-        the same rows a second time. Combined partials from the depth-N
-        merge tree run the same checks PER SEGMENT (ownership against
-        the segment's leaf shard, the row cap per leaf)."""
-        rows = p.rows
-        agg = rt.cfg.aggregator
-        spans = p.segment_spans()
-        if (
-            rows.ndim != 2
-            or rows.shape[0] != len(p.clients)
-            or (spans and spans[-1][2] != rows.shape[0])
-            or any(hi - lo > rt.cfg.cohort_cap for _s, lo, hi in spans)
-            or (rows.shape[0] and rows.shape[1] != rt.cfg.dim)
-        ):
-            return None, ""
-        measured = evidence_digest(rows)
-        if measured != p.digest:
+        extras inconsistency under ``extras_policy='verify'``,
+        cross-shard ownership claims). The measured digest rides back
+        so the evidence event does not hash the same rows a second
+        time. Combined partials from the depth-N merge tree run the
+        same checks PER SEGMENT (ownership against the segment's leaf
+        shard, the row cap per leaf). The stateless suite lives in
+        :meth:`check_partial`; an arrival-verified result arrives as
+        ``prechecked`` and is NOT re-run — only the round-state dedup
+        loop executes at close time."""
+        if prechecked is None:
+            prechecked = self.check_partial(rt.cfg.name, p)
+        ok, measured = prechecked
+        if not ok:
             return None, measured
-        if p.extras and self.extras_policy == "verify":
-            want = agg._partial_extras(np.asarray(rows, np.float32))
-            for key, val in want.items():
-                got = p.extras.get(key)
-                # equal_nan: admission deliberately passes non-finite
-                # VALUES (adversarial payloads are the aggregator's
-                # job), and a NaN gradient propagates into the extras
-                # (a NaN Gram entry, a NaN running sum) — the honest
-                # recompute reproduces the same NaNs, which plain
-                # array_equal would call a mismatch, branding an honest
-                # shard forged off one client's NaN row
-                if got is None or not np.array_equal(
-                    np.asarray(val), np.asarray(got), equal_nan=True
-                ):
-                    return None, measured
         folded: List[int] = []
         dups: List[int] = []
-        span_iter = iter(spans)
-        owner, span_lo, span_hi = next(span_iter)
         for j, (client, seq) in enumerate(
             zip(p.clients, p.seqs, strict=True)
         ):
-            while j >= span_hi:
-                owner, span_lo, span_hi = next(span_iter)
-            if self.router.shard_for(client) != owner:
-                # a client this segment's shard does not own: sticky
-                # routing makes the claim a protocol violation — the
-                # whole partial is untrustworthy (the replay-another-
-                # shard attack)
-                return None, measured
             if rt.is_folded(client, seq):
                 dups.append(j)
             else:
@@ -1279,6 +1379,7 @@ class ShardedCoordinator:
         partials: Sequence[PartialFold],
         *,
         missing: Sequence[int] = (),
+        prechecked: Optional[Dict[int, Tuple[bool, str]]] = None,
     ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
         """The ROOT half of a round close, as a standalone door: verify
         + hierarchical merge + finalize + confirm/broadcast for
@@ -1286,10 +1387,14 @@ class ShardedCoordinator:
         :meth:`close_round_nowait` and the async scheduler both land
         here; a remote-root deployment feeds it
         :func:`decode_partial_fold` results off the wire). ``missing``
-        names shards to account as a partition in this close."""
+        names shards to account as a partition in this close.
+        ``prechecked`` maps ``id(partial)`` to an arrival-time
+        :meth:`check_partial` result — streaming callers verified each
+        frame the moment it landed, so the close skips the stateless
+        suite and runs only the dedup."""
         rt = self._roots[tenant]
         actions: List[tuple] = []
-        computed = self._verify_and_merge(rt, partials, actions)
+        computed = self._verify_and_merge(rt, partials, actions, prechecked)
         self._apply_shard_actions(tenant, actions)
         if computed is None:
             return None
@@ -1297,7 +1402,11 @@ class ShardedCoordinator:
         return self._finish(rt, verified, merged, vec, list(missing), t0)
 
     def repair_round(
-        self, tenant: str, partial: PartialFold
+        self,
+        tenant: str,
+        partial: PartialFold,
+        *,
+        prechecked: Optional[Tuple[bool, str]] = None,
     ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
         """Fold one LATE partial into an already-closed round within
         the repair horizon: verify it with the same cross-checks a
@@ -1315,8 +1424,19 @@ class ShardedCoordinator:
         (caller requeues one-round-staler as today) or the partial is
         excluded as forged. ``rt.last_aggregate`` is updated only when
         the repaired round is still the most recent close — an older
-        repair must not resurrect a superseded broadcast."""
+        repair must not resurrect a superseded broadcast.
+
+        A repair costs ONE verify, not N: the close retained its
+        verified merge inputs (``open_repairs``), so only the late
+        partial is cross-checked — and when the caller verified it at
+        arrival (``prechecked`` from :meth:`check_partial`), the
+        stateless suite is not re-run here either (the
+        ``partial_checks`` counter pins this contract)."""
         rt = self._roots[tenant]
+        if prechecked is not None:
+            # the arrival-verified frame is consumed by this repair,
+            # whatever the outcome — release its inflight slot
+            self._dec_inflight(1)
         r = int(partial.round_id)
         ctx = rt.open_repairs.get(r)
         if ctx is None or partial.tenant != tenant:
@@ -1347,7 +1467,7 @@ class ShardedCoordinator:
                 }
             )
             return None
-        checks, measured = self._verify_partial(rt, partial)
+        checks, measured = self._verify_partial(rt, partial, prechecked)
         if checks is None:
             # forged late partial: digest/ownership/cap cross-checks
             # failed — the repair horizon is NOT a forensics bypass;
@@ -1383,7 +1503,14 @@ class ShardedCoordinator:
             "serving.round.repair", track="root", tenant=tenant,
             round=r, shard=int(partial.shard), m=new_m,
         ):
-            merged = agg.fold_merge([inp for _s, inp in inputs])
+            t_merge = self._clock()
+            # the incremental accumulator keys by shard and closes in
+            # shard order — the exact `sorted` concat the barrier close
+            # would have produced with the late input present
+            acc = agg.fold_merge_begin()
+            for s, inp in inputs:
+                agg.fold_merge_add(acc, s, inp)
+            merged = agg.fold_merge_finish(acc)
             try:
                 with obs_tracing.device_span(
                     "serving.device_step", track="root", tenant=tenant,
@@ -1404,6 +1531,8 @@ class ShardedCoordinator:
                 if not ctx["missing"]:
                     del rt.open_repairs[r]
                 return None
+        if obs_runtime.STATE.enabled:
+            self._m_root_merge_s[tenant].observe(self._clock() - t_merge)
         digest = evidence_digest(vec)
         delta_digest = evidence_digest(vec - old_vec)
         rt.root_duplicates += len(dups)
@@ -1505,6 +1634,7 @@ class ShardedCoordinator:
         rt: _RootTenant,
         partials: Sequence[PartialFold],
         actions: List[tuple],
+        prechecked: Optional[Dict[int, Tuple[bool, str]]] = None,
     ) -> Optional[tuple]:
         """The heavy, loop-free middle of a close: verify every partial
         (forged → excluded + counted + evidence event; stale → requeued
@@ -1517,9 +1647,16 @@ class ShardedCoordinator:
         loop-confined). Returns ``(verified, merged, vec, t0)``;
         ``None`` means no close this window (below the admissibility
         floor, or the finalize failed — accounting described in
-        ``actions``)."""
+        ``actions``). ``prechecked`` carries arrival-time
+        :meth:`check_partial` results keyed by ``id(partial)`` — every
+        entry counted as inflight is consumed by this close (the gauge
+        decrements for all of them, including frames a merge-tree level
+        combined away), and an id-matched entry skips the stateless
+        re-verify."""
         tenant = rt.cfg.name
         t0 = self._clock()
+        if prechecked:
+            self._dec_inflight(len(prechecked))
         verified: List[Tuple[PartialFold, List[int], List[int]]] = []
         seen_shards: set = set()
         for p in sorted(partials, key=lambda p: p.shard):
@@ -1575,7 +1712,8 @@ class ShardedCoordinator:
                         self._m_partitions[(tenant, s)].inc()
                 continue
             seen_shards.update(covered)
-            checks, measured = self._verify_partial(rt, p)
+            pre = prechecked.get(id(p)) if prechecked else None
+            checks, measured = self._verify_partial(rt, p, pre)
             if checks is None:
                 rt.forged += 1
                 actions.append(("discard", covered, p.round_id))
@@ -1611,6 +1749,7 @@ class ShardedCoordinator:
             for p, folded, dups in verified
         ]
         agg = rt.cfg.aggregator
+        t_merge = self._clock()
         with obs_tracing.span(
             "serving.fold_merge", track="root", tenant=tenant,
             round=rt.round_id, shards=len(verified), m=m_total,
@@ -1625,7 +1764,16 @@ class ShardedCoordinator:
                 if p.trace_ctx is not None
             ],
         ):
-            merged = agg.fold_merge(merge_partials)
+            # incremental accumulator, closed in shard order — `verified`
+            # is already shard-sorted, so this is the exact concat
+            # `fold_merge(merge_partials)` produced (bit-identity pinned
+            # by tests/test_streaming_root.py)
+            acc = agg.fold_merge_begin()
+            for (p, _f, _d), inp in zip(
+                verified, merge_partials, strict=True
+            ):
+                agg.fold_merge_add(acc, p.shard, inp)
+            merged = agg.fold_merge_finish(acc)
             try:
                 with obs_tracing.device_span(
                     "serving.device_step", track="root", tenant=tenant,
@@ -1643,6 +1791,8 @@ class ShardedCoordinator:
                 for p, _f, _d in verified:
                     actions.append(("fail", p.covered, rt.round_id))
                 return None
+        if obs_runtime.STATE.enabled:
+            self._m_root_merge_s[tenant].observe(self._clock() - t_merge)
         return verified, merged, vec, t0
 
     def _finish(
@@ -1828,7 +1978,8 @@ class ShardedCoordinator:
 
     async def close(self) -> None:
         """Stop the root scheduler and release shard durable handles
-        (idempotent)."""
+        (idempotent). Pending deferred merges settle BEFORE the shards
+        shut down — a kicked round's WAL records must land."""
         self._running = False
         for task in self._tasks:
             task.cancel()
@@ -1838,6 +1989,15 @@ class ShardedCoordinator:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
         self._tasks = []
+        for entry in list(self._pending_async.values()):
+            task = entry.get("task")
+            if task is not None:
+                try:
+                    await task
+                except Exception:  # noqa: BLE001 — a crashed finish
+                    # must not wedge shutdown
+                    pass
+        self._pending_async.clear()
         for shard in self.shards:
             if shard.alive:
                 shard.shutdown()
@@ -1855,26 +2015,77 @@ class ShardedCoordinator:
                 self.callback_errors += 1
 
     async def _close_async(self, tenant: str) -> Optional[tuple]:
-        """One async barrier close: drain every live shard on the loop
-        (queue access is loop-confined), build partials concurrently on
-        the executor under the straggler timeout, then merge+finalize
-        off-loop under the device lock and finish on the loop (WAL
-        writes stay loop-confined)."""
+        """One async close, ARRIVAL-DRIVEN: the previous window's
+        deferred merge settles FIRST (settle-before-build — the
+        bit-identity proof: the partials this window builds see exactly
+        the post-merge dedup/round state a barrier close would have),
+        then every live shard's drain+build is FUSED with the stateless
+        cross-check suite on the executor, so each partial is verified
+        the moment it exists and only dedup + merge + finalize remain
+        after the barrier. With ``pipeline_depth=1`` (default) a quorum
+        close advances the shard staleness clocks optimistically and
+        kicks the merge+device step to a background task — round N+1's
+        admission windows fill while round N settles (the runner tier's
+        PR-17 contract, on the in-process root). ``pipeline_depth=0``
+        keeps the barrier-style close inline."""
         loop = asyncio.get_running_loop()
         rt = self._roots[tenant]
-        round_span = obs_tracing.span(
+        await self._settle_async(tenant)
+        sp = obs_tracing.begin_span(
             "serving.sharded_round", track="root",
             tenant=tenant, round=rt.round_id,
+            pipelined=bool(self.pipeline_depth),
         )
-        with round_span:
-            return await self._close_async_traced(tenant, loop, rt)
+        kicked = False
+        try:
+            with obs_tracing.context_scope(getattr(sp, "context", None)):
+                prepared = await self._gather_checked_async(
+                    tenant, loop, rt
+                )
+                if prepared is None:
+                    return None
+                partials, prechecked, missing = prepared
+                if self.pipeline_depth == 0:
+                    return await self._merge_async(
+                        tenant, loop, rt, rt.round_id,
+                        partials, prechecked, missing, consume=False,
+                    )
+            # quorum fired: open round N+1's admission/staleness plane
+            # NOW — the ROOT clock stays at N until the deferred merge
+            # lands, so partial round-id checks still pass
+            closing = rt.round_id
+            for shard in self.shards:
+                if shard.alive:
+                    shard.sync_round(tenant, closing + 1)
+            entry: dict = {
+                "round": closing,
+                "kicked": self._clock(),
+                "done_s": None,
+            }
+            entry["task"] = asyncio.create_task(
+                self._deferred_close_async(
+                    tenant, loop, rt, closing,
+                    partials, prechecked, missing, sp, entry,
+                ),
+                name=f"sharded-finish-{tenant}-{closing}",
+            )
+            self._pending_async[tenant] = entry
+            kicked = True  # span ownership moved to the deferred task
+            return None
+        finally:
+            if not kicked:
+                obs_tracing.end_span(sp)
 
-    async def _close_async_traced(
+    async def _gather_checked_async(
         self, tenant: str, loop, rt: _RootTenant
     ) -> Optional[tuple]:
-        """Body of :meth:`_close_async`, running inside the round's
-        trace-root span (executor hops carry the context explicitly —
-        ``run_in_executor`` does not copy contextvars)."""
+        """Drain every live shard on the loop (queue access is
+        loop-confined), then build AND arrival-verify the partials
+        concurrently on the executor under the straggler timeout.
+        Returns ``(partials, prechecked, missing)`` ready for
+        :meth:`_merge_async`, or ``None`` when no close happens this
+        window (below quorum / nothing drained) — with any inflight
+        accounting already unwound."""
         drained: Dict[int, tuple] = {}
         missing: List[int] = []
         responders = 0
@@ -1891,15 +2102,31 @@ class ShardedCoordinator:
                 self.shards[i].requeue(tenant, rt.round_id)
             rt.quorum_failures += 1
             return None
+        # flat root: fuse the stateless cross-check suite onto the
+        # build thread — the partial is verified the moment it exists,
+        # overlapped across shards, leaving only dedup at merge time.
+        # With a merge tree the leaves are combined first and the
+        # COMBINED frames are checked (per segment), exactly the frames
+        # the root will merge.
+        fuse = self.topology is None
+
+        def _build(shard, subs, cohort):
+            p = shard.build_partial(tenant, subs, cohort)
+            chk = (
+                self.check_partial(tenant, p, inflight=True)
+                if fuse else None
+            )
+            return p, chk
+
         futs = {
             loop.run_in_executor(
                 None,
-                obs_tracing.carry_context(self.shards[i].build_partial),
-                tenant, subs, cohort,
+                obs_tracing.carry_context(_build),
+                self.shards[i], subs, cohort,
             ): i
             for i, (subs, cohort) in drained.items()
         }
-        partials: List[PartialFold] = []
+        built: List[Tuple[PartialFold, Optional[Tuple[bool, str]]]] = []
         crashed = 0
         if futs:
             done, pending = await asyncio.wait(
@@ -1908,7 +2135,7 @@ class ShardedCoordinator:
             for fut in done:
                 i = futs[fut]
                 try:
-                    partials.append(fut.result())
+                    built.append(fut.result())
                 except Exception:  # noqa: BLE001 — crashing shard close
                     crashed += 1
                     missing.append(i)
@@ -1916,40 +2143,89 @@ class ShardedCoordinator:
             stragglers = sorted(futs[f] for f in pending)
             missing.extend(stragglers)
             round_id = rt.round_id
-            for fut in pending:
+
+            def _late(f, i, r):
                 # past the barrier: when the late build completes, its
-                # rows return to the shard's held list for next round
+                # rows return to the shard's held list for next round —
+                # and its arrival-verify (if it got that far) is
+                # consumed by no close, so the inflight slot releases
+                try:
+                    p_chk = f.result()
+                except Exception:  # noqa: BLE001
+                    p_chk = None
+                if p_chk is not None and p_chk[1] is not None:
+                    self._dec_inflight(1)
+                self.shards[i].requeue(tenant, r)
+
+            for fut in pending:
                 fut.add_done_callback(
-                    lambda f, i=futs[fut], r=round_id: self.shards[
-                        i
-                    ].requeue(tenant, r)
+                    lambda f, i=futs[fut], r=round_id: _late(f, i, r)
                 )
             # stragglers and crashes ate into the quorum: re-check with
             # the shards that actually answered the barrier
             responders -= len(stragglers) + crashed
             if responders < self.quorum:
-                for p in partials:
+                checked = sum(1 for _p, chk in built if chk is not None)
+                if checked:
+                    self._dec_inflight(checked)
+                for p, _chk in built:
                     self.shards[p.shard].requeue(tenant, p.round_id)
                 rt.quorum_failures += 1
                 return None
-        if not partials:
+        if not built:
             return None
+        partials = [p for p, _chk in built]
+        prechecked: Dict[int, Tuple[bool, str]] = {
+            id(p): chk for p, chk in built if chk is not None
+        }
         if self.topology is not None:
             # internal merge-tree levels off the loop (pure numpy
             # concatenation + extras recompute — the work a pod-level
-            # merge process owns in the runner deployment)
+            # merge process owns in the runner deployment), then the
+            # arrival check runs per COMBINED frame on the executor
             partials = await loop.run_in_executor(
                 None,
                 obs_tracing.carry_context(self.topology.combine),
                 rt.cfg.aggregator, partials,
             )
+
+            def _check_all(ps):
+                return {
+                    id(p): self.check_partial(tenant, p, inflight=True)
+                    for p in ps
+                }
+
+            prechecked = await loop.run_in_executor(
+                None, obs_tracing.carry_context(_check_all), partials
+            )
+        return partials, prechecked, missing
+
+    async def _merge_async(
+        self,
+        tenant: str,
+        loop,
+        rt: _RootTenant,
+        closing: int,
+        partials: List[PartialFold],
+        prechecked: Dict[int, Tuple[bool, str]],
+        missing: List[int],
+        *,
+        consume: bool,
+    ) -> Optional[tuple]:
+        """Merge+finalize off-loop under the device lock, then finish
+        on the loop (WAL writes stay loop-confined). ``consume=True``
+        is the pipelined contract: the shard clocks already advanced
+        optimistically, so a failed merge still consumes the round —
+        the drained rows requeue and fold one round staler, the only
+        behavioral divergence from the barrier path and only in the
+        failure case."""
         assert self._device_lock is not None
         actions: List[tuple] = []
         async with self._device_lock:
             computed = await loop.run_in_executor(
                 None,
                 obs_tracing.carry_context(self._verify_and_merge),
-                rt, partials, actions,
+                rt, partials, actions, prechecked,
             )
         # shard-state side effects (requeues/discards/failure accounting)
         # run HERE, back on the loop — the executor half only described
@@ -1957,9 +2233,76 @@ class ShardedCoordinator:
         # admission path touches concurrently)
         self._apply_shard_actions(tenant, actions)
         if computed is None:
+            if consume:
+                rt.round_id = closing + 1
+                for shard in self.shards:
+                    if shard.alive:
+                        shard.sync_round(tenant, closing + 1)
             return None
         verified, merged, vec, t0 = computed
         return self._finish(rt, verified, merged, vec, missing, t0)
+
+    async def _deferred_close_async(
+        self,
+        tenant: str,
+        loop,
+        rt: _RootTenant,
+        closing: int,
+        partials: List[PartialFold],
+        prechecked: Dict[int, Tuple[bool, str]],
+        missing: List[int],
+        sp,
+        entry: dict,
+    ) -> Optional[tuple]:
+        """The overlapped half of a pipelined async close — round N's
+        verify(dedup-only)+merge+device-step settling while round N+1's
+        shard windows admit."""
+        try:
+            with obs_tracing.context_scope(getattr(sp, "context", None)):
+                return await self._merge_async(
+                    tenant, loop, rt, closing,
+                    partials, prechecked, missing, consume=True,
+                )
+        finally:
+            entry["done_s"] = self._clock()
+            obs_tracing.end_span(sp)
+
+    async def _settle_async(self, tenant: str) -> Optional[dict]:
+        """Await the tenant's pending deferred merge (no-op when none):
+        returns the settled round's summary (``closed``/``digest``/
+        ``m``/``overlap_ratio``) and publishes the
+        ``byzpy_round_overlap_ratio`` gauge — the fraction of the
+        deferred merge that ran before anyone had to wait for it, i.e.
+        the wall-clock the pipeline actually hid."""
+        entry = self._pending_async.pop(tenant, None)
+        if entry is None:
+            return None
+        wait_start = self._clock()
+        try:
+            res = await asyncio.shield(entry["task"])
+        except asyncio.CancelledError:
+            # WE were cancelled mid-settle (shutdown): the deferred
+            # task survives the shield — put it back for close()
+            self._pending_async.setdefault(tenant, entry)
+            raise
+        except Exception:  # noqa: BLE001 — a crashed finish must not
+            # wedge the scheduler; the round's accounting is whatever
+            # the coordinator got to
+            res = None
+        prev: dict = {"closed": None, "round": int(entry["round"])}
+        if res is not None:
+            closed, rows, vec = res
+            prev["closed"] = int(closed)
+            prev["digest"] = evidence_digest(np.asarray(vec))
+            prev["m"] = int(rows.shape[0])
+        done_s = entry.get("done_s") or wait_start
+        span_s = max(0.0, done_s - entry["kicked"])
+        hidden = max(0.0, min(done_s, wait_start) - entry["kicked"])
+        ratio = 1.0 if span_s <= 0 else max(0.0, min(1.0, hidden / span_s))
+        prev["overlap_ratio"] = round(ratio, 4)
+        if obs_runtime.STATE.enabled and tenant in self._m_overlap:
+            self._m_overlap[tenant].set(ratio)
+        return prev
 
     # -- failover ----------------------------------------------------------
 
@@ -2039,6 +2382,9 @@ class ShardedCoordinator:
                 "forged_partials": rt.forged,
                 "root_duplicates": rt.root_duplicates,
                 "failed_rounds": rt.failed_rounds,
+                "partial_checks": rt.partial_checks,
+                "partials_inflight": self._partials_inflight,
+                "pipeline_depth": self.pipeline_depth,
                 "p50_round_latency_s": p50,
                 "p99_round_latency_s": p99,
                 "mean_cohort": (

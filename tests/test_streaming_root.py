@@ -1,0 +1,369 @@
+"""Streaming root merge (ISSUE 18): arrival-driven verify+fold.
+
+Contracts under test:
+
+* **incremental accumulator** — ``fold_merge_begin/add/finish`` parks
+  partials in ANY arrival order and closes in canonical shard order,
+  bit-identical to the one-shot ``fold_merge`` of the shard-sorted
+  list, for every partial-fold aggregator;
+* **arrival-permutation parity** — a close fed arrival-verified
+  partials (``check_partial`` at landing + ``prechecked`` into
+  ``merge_partials``) publishes the SAME bits as the barrier close,
+  for every aggregator × every arrival order of k∈{2,3,4} shards ×
+  quorum and degraded closes × an interleaved forged frame (an
+  early-verified forged partial is excluded without poisoning the
+  incremental state);
+* **repair reuse** — a late partial verified at arrival costs ONE
+  cross-check run end to end (``partial_checks`` pins it); the repair
+  stays bit-identical to the barrier twin and forgery rejection is
+  unchanged;
+* **pipelined async root** — ``pipeline_depth=1`` settles round N's
+  merge+device step while round N+1's windows admit, bit-identical to
+  the ``pipeline_depth=0`` barrier loop fed the same traffic;
+* **inflight accounting** — ``byzpy_root_partials_inflight`` counts
+  arrival-verified frames and drains to zero once a close or repair
+  consumes them.
+"""
+
+import asyncio
+import itertools
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.serving import ShardedCoordinator, TenantConfig
+from byzpy_tpu.serving.sharded import PartialFold
+from byzpy_tpu.serving.staleness import StalenessPolicy
+
+from test_partial_fold import CASES
+
+DIM = 16
+TENANT = "m0"
+CLIENTS = [f"c{i:04d}" for i in range(18)]
+
+MAKERS = [c[0] for c in CASES]
+IDS = [c[1] for c in CASES]
+
+
+def _tenants(agg, **kw):
+    kw.setdefault("min_cohort", 1)
+    return [
+        TenantConfig(
+            name=TENANT,
+            aggregator=agg,
+            dim=DIM,
+            cohort_cap=64,
+            staleness=StalenessPolicy(
+                kind="exponential", gamma=0.5, cutoff=8
+            ),
+            **kw,
+        )
+    ]
+
+
+def _grads(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        c: rng.normal(size=DIM).astype(np.float32) for c in CLIENTS
+    }
+
+
+def _drained_partials(agg, k, seed=0):
+    """Fresh coordinator + one submitted round, drained to one partial
+    per shard (every shard must own at least one client)."""
+    co = ShardedCoordinator(_tenants(agg), k, quorum=1)
+    grads = _grads(seed)
+    for c, g in grads.items():
+        ok, reason = co.submit(TENANT, c, 0, g, seq=0)
+        assert ok, (c, reason)
+    partials = [co.shards[s].close_partial(TENANT) for s in range(k)]
+    assert all(p is not None for p in partials)
+    return co, partials
+
+
+def _forge(p: PartialFold) -> PartialFold:
+    """Tampered rows under the claimed digest — the lazy forgery the
+    digest recompute catches."""
+    return PartialFold(
+        tenant=p.tenant, round_id=p.round_id, shard=p.shard,
+        rows=np.asarray(p.rows) * 3.0 + 1.0,
+        clients=p.clients, seqs=p.seqs, wal_ids=p.wal_ids,
+        extras=p.extras, digest=p.digest,
+        first_arrival_s=p.first_arrival_s,
+    )
+
+
+def _streaming_close(co, arrival, missing=()):
+    """The streaming discipline, explicitly: every partial is
+    arrival-verified the moment it 'lands', then the close consumes
+    the prechecked results and runs only the dedup."""
+    prechecked = {
+        id(p): co.check_partial(TENANT, p, inflight=True)
+        for p in arrival
+    }
+    return co.merge_partials(
+        TENANT, list(arrival), missing=list(missing),
+        prechecked=prechecked,
+    )
+
+
+# ---------------------------------------------------------------------------
+# incremental merge accumulator: arrival order in, shard order out
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+def test_fold_merge_accumulator_bit_identical(make_agg):
+    agg = make_agg()
+    rng = np.random.default_rng(11)
+    rows = rng.normal(size=(12, DIM)).astype(np.float32)
+    bounds = [0, 4, 7, 12]
+    parts = [
+        agg.fold_partial(
+            rows[lo:hi], np.ones(hi - lo, bool)
+        )
+        for lo, hi in zip(bounds, bounds[1:])
+    ]
+    ref = agg.fold_merge(parts)
+    ref_vec = np.asarray(agg.fold_merge_finalize(ref, bucket=16))
+    for order in itertools.permutations(range(len(parts))):
+        acc = agg.fold_merge_begin()
+        for s in order:
+            agg.fold_merge_add(acc, s, parts[s])
+        merged = agg.fold_merge_finish(acc)
+        out = np.asarray(agg.fold_merge_finalize(merged, bucket=16))
+        np.testing.assert_array_equal(out, ref_vec, err_msg=str(order))
+
+
+def test_fold_merge_accumulator_guards():
+    from byzpy_tpu.aggregators import CoordinateWiseMedian
+
+    agg = CoordinateWiseMedian()
+    rows = np.ones((2, DIM), np.float32)
+    part = agg.fold_partial(rows, np.ones(2, bool))
+    acc = agg.fold_merge_begin()
+    agg.fold_merge_add(acc, 0, part)
+    with pytest.raises(ValueError):
+        agg.fold_merge_add(acc, 0, part)  # duplicate shard key
+    empty = agg.fold_merge_begin()
+    with pytest.raises(ValueError):
+        agg.fold_merge_finish(empty)
+
+
+# ---------------------------------------------------------------------------
+# arrival-permutation parity: streaming close == barrier close
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+def test_arrival_permutation_parity(make_agg, k):
+    """Every arrival order × quorum/degraded closes: the streaming
+    aggregate is bit-identical to the barrier twin."""
+    # barrier references (fresh coordinators — merge mutates dedup/
+    # round state, so each close needs its own)
+    co_ref, parts = _drained_partials(make_agg(), k, seed=21)
+    full = co_ref.merge_partials(TENANT, parts)
+    assert full is not None and full[0] == 0
+    co_deg, parts_d = _drained_partials(make_agg(), k, seed=21)
+    degraded = co_deg.merge_partials(
+        TENANT, parts_d[:-1], missing=[k - 1]
+    )
+    assert degraded is not None
+    for order in itertools.permutations(range(k)):
+        # quorum close, this arrival order
+        co, p = _drained_partials(make_agg(), k, seed=21)
+        res = _streaming_close(co, [p[i] for i in order])
+        assert res is not None and res[0] == 0
+        np.testing.assert_array_equal(
+            np.asarray(res[2]), np.asarray(full[2]), err_msg=str(order)
+        )
+        assert co._partials_inflight == 0
+        # degraded close: last shard missing, remaining order permuted
+        co2, p2 = _drained_partials(make_agg(), k, seed=21)
+        arrival = [p2[i] for i in order if i != k - 1]
+        res2 = _streaming_close(co2, arrival, missing=[k - 1])
+        assert res2 is not None
+        np.testing.assert_array_equal(
+            np.asarray(res2[2]), np.asarray(degraded[2]),
+            err_msg=str(order),
+        )
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_arrival_interleaved_forged_partial(k):
+    """An early-verified forged frame (checked at arrival, carried in
+    ``prechecked``) is excluded without poisoning the incremental
+    state: the close equals the honest-shards-only barrier twin, at
+    every interleave position."""
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    make = lambda: CoordinateWiseTrimmedMean(f=1)  # noqa: E731
+    co_ref, parts_ref = _drained_partials(make(), k, seed=33)
+    honest_only = co_ref.merge_partials(
+        TENANT, parts_ref[1:], missing=[0]
+    )
+    assert honest_only is not None
+    for pos in range(k):
+        co, parts = _drained_partials(make(), k, seed=33)
+        forged = _forge(parts[0])
+        arrival = list(parts[1:])
+        arrival.insert(pos % (len(arrival) + 1), forged)
+        res = _streaming_close(co, arrival, missing=[0])
+        assert res is not None
+        np.testing.assert_array_equal(
+            np.asarray(res[2]), np.asarray(honest_only[2]),
+            err_msg=f"pos={pos}",
+        )
+        rt = co._roots[TENANT]
+        assert rt.forged == 1
+        assert co._partials_inflight == 0
+
+
+# ---------------------------------------------------------------------------
+# repair reuse: one verify per late partial, parity unchanged
+# ---------------------------------------------------------------------------
+
+
+def test_repair_reuses_arrival_verify_and_stays_bit_identical():
+    from byzpy_tpu.aggregators import MultiKrum
+
+    make = lambda: MultiKrum(f=2, q=3)  # noqa: E731
+    k = 3
+    co_ref, parts_ref = _drained_partials(make(), k, seed=44)
+    full = co_ref.merge_partials(TENANT, parts_ref)
+    assert full is not None
+    co = ShardedCoordinator(
+        _tenants(make()), k, quorum=2, repair_horizon_rounds=2
+    )
+    for c, g in _grads(44).items():
+        ok, reason = co.submit(TENANT, c, 0, g, seq=0)
+        assert ok, reason
+    late = co.shards[k - 1].close_partial(TENANT)
+    present = [
+        co.shards[s].close_partial(TENANT) for s in range(k - 1)
+    ]
+    res = _streaming_close(co, present, missing=[k - 1])
+    assert res is not None
+    rt = co._roots[TENANT]
+    checks_before = rt.partial_checks
+    chk = co.check_partial(TENANT, late, inflight=True)
+    assert rt.partial_checks == checks_before + 1
+    assert co._partials_inflight == 1
+    rep = co.repair_round(TENANT, late, prechecked=chk)
+    assert rep is not None
+    # the repair re-ran NOTHING: one arrival verify, total
+    assert rt.partial_checks == checks_before + 1
+    assert co._partials_inflight == 0
+    np.testing.assert_array_equal(
+        np.asarray(rep[2]), np.asarray(full[2])
+    )
+
+
+def test_repair_forgery_rejection_unchanged_with_precheck():
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    k = 3
+    co = ShardedCoordinator(
+        _tenants(CoordinateWiseTrimmedMean(f=1)), k, quorum=2,
+        repair_horizon_rounds=2,
+    )
+    for c, g in _grads(55).items():
+        ok, reason = co.submit(TENANT, c, 0, g, seq=0)
+        assert ok, reason
+    late = co.shards[k - 1].close_partial(TENANT)
+    present = [
+        co.shards[s].close_partial(TENANT) for s in range(k - 1)
+    ]
+    res = _streaming_close(co, present, missing=[k - 1])
+    assert res is not None
+    degraded = np.asarray(res[2]).copy()
+    forged = _forge(late)
+    chk = co.check_partial(TENANT, forged, inflight=True)
+    assert chk[0] is False
+    assert co.repair_round(TENANT, forged, prechecked=chk) is None
+    rt = co._roots[TENANT]
+    assert rt.forged == 1
+    assert rt.repairs == 0
+    assert co._partials_inflight == 0
+    np.testing.assert_array_equal(
+        np.asarray(rt.last_aggregate), degraded
+    )
+
+
+# ---------------------------------------------------------------------------
+# pipelined async root: bit parity with the barrier loop
+# ---------------------------------------------------------------------------
+
+
+def _run_async_root(depth, rounds=3):
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    captured = []
+
+    async def run():
+        co = ShardedCoordinator(
+            _tenants(
+                CoordinateWiseTrimmedMean(f=1), window_s=0.02
+            ),
+            2,
+            quorum=1,
+            pipeline_depth=depth,
+            on_round=lambda _t, r, _c, vec: captured.append(
+                (r, np.asarray(vec).copy())
+            ),
+        )
+        await co.start()
+        try:
+            seqs = dict.fromkeys(CLIENTS, 0)
+            for r in range(rounds):
+                grads = _grads(seed=200 + r)
+                for c, g in grads.items():
+                    ok, reason = co.submit(
+                        TENANT, c, r, g, seq=seqs[c]
+                    )
+                    assert ok, (c, reason)
+                    seqs[c] += 1
+                t0 = asyncio.get_event_loop().time()
+                while (
+                    len(captured) <= r
+                    and asyncio.get_event_loop().time() - t0 < 5.0
+                ):
+                    await asyncio.sleep(0.005)
+                assert len(captured) > r
+            return co.stats()["root"][TENANT]
+        finally:
+            await co.close()
+
+    st = asyncio.run(run())
+    return captured, st
+
+
+def test_pipelined_async_root_bit_identical_to_barrier():
+    barrier, st0 = _run_async_root(0)
+    pipelined, st1 = _run_async_root(1)
+    assert len(barrier) == len(pipelined) == 3
+    for (r0, v0), (r1, v1) in zip(barrier, pipelined):
+        assert r0 == r1
+        np.testing.assert_array_equal(v0, v1)
+    assert st0["failed_rounds"] == st1["failed_rounds"] == 0
+    assert st1["pipeline_depth"] == 1
+    # the arrival checks ran (fused onto the build threads) and every
+    # inflight slot was consumed by a close
+    assert st1["partial_checks"] >= 3
+    assert st1["partials_inflight"] == 0
+
+
+def test_pipeline_depth_validated():
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    with pytest.raises(ValueError):
+        ShardedCoordinator(
+            _tenants(CoordinateWiseTrimmedMean(f=1)), 2,
+            pipeline_depth=2,
+        )
+    with pytest.raises(ValueError):
+        ShardedCoordinator(
+            _tenants(CoordinateWiseTrimmedMean(f=1)), 2,
+            pipeline_depth=-1,
+        )
