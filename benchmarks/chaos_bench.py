@@ -777,6 +777,7 @@ def run_shard(args, out) -> dict:
     n_shards = 2
     co = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
     co_s = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
+    co_c = ShardedCoordinator(mk_tenants(), n_shards, quorum=1)
     fe = ServingFrontend(mk_tenants())
     order = [
         c
@@ -790,6 +791,8 @@ def run_shard(args, out) -> dict:
             ok, reason = co.submit("m0", c, r, grads[c], seq=r)
             assert ok, (c, reason)
             ok, reason = co_s.submit("m0", c, r, grads[c], seq=r)
+            assert ok, (c, reason)
+            ok, reason = co_c.submit("m0", c, r, grads[c], seq=r)
             assert ok, (c, reason)
         res = co.close_round_nowait("m0")
         assert res is not None
@@ -808,6 +811,22 @@ def run_shard(args, out) -> dict:
             "m0", stream_parts, prechecked=prechecked
         )
         assert res_s is not None, r
+        # close-path twin (ISSUE 19): check + STAGE at arrival (dedup
+        # verdict parked, cross-Gram blocks computed on the 'reader'
+        # side), the close promotes — digest-identical, reverse order
+        cp_parts = [
+            co_c.shards[s].close_partial("m0") for s in range(n_shards)
+        ]
+        assert all(p is not None for p in cp_parts)
+        cp_pre = {}
+        for p in reversed(cp_parts):
+            chk = co_c.check_partial("m0", p, inflight=True)
+            cp_pre[id(p)] = chk
+            assert chk[0] and co_c.stage_partial("m0", p, chk)
+        res_c = co_c.merge_partials(
+            "m0", cp_parts, prechecked=cp_pre
+        )
+        assert res_c is not None, r
         for c in order:
             ok, reason = fe.submit("m0", c, r, grads[c], seq=r)
             assert ok, (c, reason)
@@ -827,10 +846,28 @@ def run_shard(args, out) -> dict:
             f"streaming merge diverged at round {r}: "
             f"{stream_digest} != {sharded_digest}"
         )
+        closepath_digest = evidence_digest(res_c[2])
+        assert closepath_digest == sharded_digest, (
+            f"close-path merge diverged at round {r}: "
+            f"{closepath_digest} != {sharded_digest}"
+        )
     assert co_s.stats()["root"]["m0"]["partial_checks"] == (
         rounds * n_shards
     )
     assert co_s.stats()["root"]["m0"]["partials_inflight"] == 0
+    # close-path accounting at the combinatorial floor: every close
+    # consumed the arrival-staged accumulator, the cross-Gram blocks
+    # are exactly rounds·k·(k−1)/2, and no shard's shipped Gram was
+    # ever recomputed (zero redundant extras recomputes, counter-pinned)
+    cp_st = co_c.stats()["root"]["m0"]
+    assert cp_st["staged_closes"] == rounds, cp_st
+    assert cp_st["dedup_promoted"] == rounds * n_shards, cp_st
+    assert cp_st["dedup_restaged"] == 0, cp_st
+    assert cp_st["gram_cross_blocks"] == (
+        rounds * n_shards * (n_shards - 1) // 2
+    ), cp_st
+    assert cp_st["partial_transforms"] == 0, cp_st
+    assert cp_st["partials_inflight"] == 0, cp_st
 
     # -- compromised-shard cells: each forgery mode vs the root ----------
     forge_rows = {}
@@ -918,6 +955,10 @@ def run_shard(args, out) -> dict:
         "parity_digest_last": parity_digests[-1]["sharded"],
         "streaming_parity": "bit-identical",
         "streaming_checks": rounds * n_shards,
+        "closepath_parity": "bit-identical",
+        "closepath_staged_closes": cp_st["staged_closes"],
+        "closepath_gram_cross_blocks": cp_st["gram_cross_blocks"],
+        "closepath_partial_transforms": cp_st["partial_transforms"],
         "forgery": forge_rows,
     }
     _emit(row, out)
@@ -1057,6 +1098,12 @@ def run_speculative(args, out) -> dict:
             id(p): co_st.check_partial("m0", p, inflight=True)
             for p in present
         }
+        # close-path: the present partials stage at arrival (verdict +
+        # fold + cross-Gram accumulation); the late straggler does NOT
+        # stage — it repairs after the degraded close, exactly as before
+        for p in present:
+            chk = prechecked[id(p)]
+            assert chk[0] and co_st.stage_partial("m0", p, chk), r
         res = co_st.merge_partials(
             "m0", present, missing=[straggler], prechecked=prechecked
         )
@@ -1074,7 +1121,21 @@ def run_speculative(args, out) -> dict:
             == checks_at_close
         ), r
         streaming_repair_rounds += 1
-    assert co_st.stats()["root"]["m0"]["partials_inflight"] == 0
+    st_cp = co_st.stats()["root"]["m0"]
+    assert st_cp["partials_inflight"] == 0
+    # close-path pins: every degraded close consumed its staged
+    # accumulator (verdicts promoted, zero restages), and the round's
+    # Gram work is exactly the irreducible block set — one cross block
+    # per staged close (2 present shards) plus the repair's re-merge
+    # (C(3,2) blocks over present+late), with ZERO redundant diagonal
+    # transforms (every partial shipped its Gram; nothing recomputed)
+    assert st_cp["staged_closes"] == rounds, st_cp
+    assert st_cp["dedup_promoted"] == rounds * (n_shards - 1), st_cp
+    assert st_cp["dedup_restaged"] == 0, st_cp
+    assert st_cp["partial_transforms"] == 0, st_cp
+    assert st_cp["gram_cross_blocks"] == rounds * (
+        1 + n_shards * (n_shards - 1) // 2
+    ), st_cp
 
     # forged late arrival: the compromised straggler tampers its rows
     # after the digest — repair_round must exclude it with evidence,
@@ -1130,6 +1191,9 @@ def run_speculative(args, out) -> dict:
         "streaming_repair_rounds": streaming_repair_rounds,
         "streaming_repair_parity": "bit-identical",
         "streaming_repair_verify_cost": "arrival-cached",
+        "closepath_staged_closes": st_cp["staged_closes"],
+        "closepath_partial_transforms": st_cp["partial_transforms"],
+        "closepath_gram_cross_blocks": st_cp["gram_cross_blocks"],
         "replay_rejected": "all",
         "forged_late_rejected": forged_rejected,
         "evidence_events": len(events),

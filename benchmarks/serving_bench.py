@@ -1160,6 +1160,377 @@ def _run_streamroot(args) -> dict:
     return row
 
 
+def _run_closepath(args) -> dict:
+    """Close-path paydown A/B (ISSUE 19): the SAME deterministic
+    traffic through two roots — the STREAMING arm (PR 18: arrival-time
+    ``check_partial``, but dedup + the whole incremental merge
+    accumulator still run inside the close) vs the CLOSE-PATH arm
+    (PR 19: ``stage_partial`` at arrival parks the dedup verdict AND
+    runs the per-partial merge transform on the shard's own lane; the
+    close promotes staged verdicts, runs the cheap shard-order
+    placement, and finalizes off-path with the donated masked program,
+    computing the merged score view while the device program flies).
+
+    The headline cells run CGE with the scale-lane knobs — EXACTLY the
+    PR 18 streamroot construction, so the 4-shard root-merge exclusive
+    blame compares like for like against that table's 31.1% streaming
+    baseline. A second section runs the Gram family (Multi-Krum) at a
+    bounded cohort and pins the cross-Gram arrival-assembly
+    accounting: k partials per close cost exactly k·(k−1)/2 cross
+    blocks, zero shipped-Gram recomputes (``partial_transforms``), and
+    the assembly rides the shard lanes instead of the close. Per round
+    and cell the two arms' aggregates are asserted BIT-IDENTICAL."""
+    from byzpy_tpu import observability as obs
+    from byzpy_tpu.forensics.evidence import evidence_digest
+    from byzpy_tpu.observability import critical_path as obs_cp
+    from byzpy_tpu.serving import ShardedCoordinator
+    from byzpy_tpu.serving.sharded import shard_for
+
+    from byzpy_tpu.aggregators import (
+        ComparativeGradientElimination,
+        MultiKrum,
+    )
+
+    telemetry_was_on = obs.enabled()
+    obs.enable()
+    rng = np.random.default_rng(7)
+    d = args.scale_dim
+    per_round = args.scale_round_submissions
+    f = args.byzantine
+    grads = [rng.normal(size=d).astype(np.float32) for _ in range(64)]
+    bodies = [
+        wire.encode(
+            {
+                "kind": "submit", "tenant": "scale", "client": "c000000",
+                "round": 0, "gradient": g, "seq": 0,
+            }
+        )[4:]
+        for g in grads
+    ]
+    identity = [f"c{i:06d}" for i in range(args.scale_clients)]
+    cells = {}
+    for n_shards in args.closepath_shards:
+        co_s = ShardedCoordinator(
+            [_scale_tenant(args, ComparativeGradientElimination(f=f))],
+            n_shards, quorum=1,
+        )
+        co_c = ShardedCoordinator(
+            [_scale_tenant(args, ComparativeGradientElimination(f=f))],
+            n_shards, quorum=1,
+        )
+        legs_s_rounds: list = []
+        merges_s: list = []
+        legs_c_rounds: list = []
+        merges_c: list = []
+        digests: list = []
+        for r in range(args.scale_rounds + 1):
+            warmup = r == 0
+            lo = (r * per_round) % max(
+                1, args.scale_clients - per_round + 1
+            )
+            window = identity[lo: lo + per_round]
+            partition = [
+                [c for c in window if shard_for(c, n_shards) == s]
+                for s in range(n_shards)
+            ]
+            gc.collect()
+            gc.disable()
+            try:
+                # -- streaming arm (PR 18): arrival check on the shard
+                # lane; dedup + full merge accumulator in the close ---
+                legs_s = []
+                parts_s = []
+                prechecked_s = {}
+                for s in range(n_shards):
+                    _acc, leg = _drive_shard_partition(
+                        co_s, s, partition, grads, bodies, r
+                    )
+                    t0 = time.monotonic()
+                    p = co_s.shards[s].close_partial("scale")
+                    if p is not None:
+                        prechecked_s[id(p)] = co_s.check_partial(
+                            "scale", p, inflight=True
+                        )
+                        parts_s.append(p)
+                    leg += time.monotonic() - t0
+                    legs_s.append(leg)
+                t0 = time.monotonic()
+                res_s = co_s.merge_partials(
+                    "scale", parts_s, prechecked=prechecked_s
+                )
+                merge_s = time.monotonic() - t0
+                # -- close-path arm (PR 19): check + STAGE on the
+                # shard lane (dedup verdict + cross-Gram transform at
+                # arrival); the close promotes and finalizes off-path
+                legs_c = []
+                parts_c = []
+                prechecked_c = {}
+                for s in range(n_shards):
+                    _acc, leg = _drive_shard_partition(
+                        co_c, s, partition, grads, bodies, r
+                    )
+                    t0 = time.monotonic()
+                    p = co_c.shards[s].close_partial("scale")
+                    if p is not None:
+                        chk = co_c.check_partial(
+                            "scale", p, inflight=True
+                        )
+                        prechecked_c[id(p)] = chk
+                        if chk[0]:
+                            co_c.stage_partial("scale", p, chk)
+                        parts_c.append(p)
+                    leg += time.monotonic() - t0
+                    legs_c.append(leg)
+                t0 = time.monotonic()
+                res_c = co_c.merge_partials(
+                    "scale", parts_c, prechecked=prechecked_c
+                )
+                merge_c = time.monotonic() - t0
+            finally:
+                gc.enable()
+            assert res_s is not None and res_c is not None, (n_shards, r)
+            # the bit-identity contract: staging must not move a bit
+            assert np.array_equal(
+                np.asarray(res_s[2]), np.asarray(res_c[2])
+            ), f"close-path diverged at {n_shards} shards round {r}"
+            if warmup:
+                continue
+            digests.append(evidence_digest(np.asarray(res_c[2])))
+            legs_s_rounds.append(legs_s)
+            merges_s.append(merge_s)
+            legs_c_rounds.append(legs_c)
+            merges_c.append(merge_c)
+        st = co_c.stats()["root"]["scale"]
+        rounds_total = args.scale_rounds + 1
+        # the paydown actually ran: every close consumed the arrival-
+        # staged accumulator, every staged verdict promoted, none
+        # flipped, and no shard's shipped extras were ever recomputed
+        assert st["partials_inflight"] == 0, st
+        assert st["staged_closes"] == rounds_total, st
+        assert st["dedup_restaged"] == 0, st
+        assert st["partial_transforms"] == 0, st
+        cp_s = obs_cp.summarize(
+            _scale_round_trace_events(n_shards, legs_s_rounds, merges_s)
+        )
+        cp_c = obs_cp.summarize(
+            _scale_round_trace_events(n_shards, legs_c_rounds, merges_c)
+        )
+
+        def _share(cp):
+            return next(
+                (
+                    s["share"]
+                    for s in cp["stages"]
+                    if s["stage"] == "serving.fold_merge"
+                ),
+                0.0,
+            )
+
+        share_s, share_c = _share(cp_s), _share(cp_c)
+        mk_s = [
+            max(l) + m for l, m in zip(legs_s_rounds, merges_s, strict=True)
+        ]
+        mk_c = [
+            max(l) + m for l, m in zip(legs_c_rounds, merges_c, strict=True)
+        ]
+        mean_s = float(np.mean(mk_s))
+        mean_c = float(np.mean(mk_c))
+        cells[n_shards] = {
+            "rounds": len(mk_s),
+            "streaming": {
+                "makespan_mean_ms": round(1e3 * mean_s, 2),
+                "root_close_mean_ms": round(
+                    1e3 * float(np.mean(merges_s)), 2
+                ),
+                "root_merge_blame_share": share_s,
+            },
+            "closepath": {
+                "makespan_mean_ms": round(1e3 * mean_c, 2),
+                "root_close_mean_ms": round(
+                    1e3 * float(np.mean(merges_c)), 2
+                ),
+                "root_merge_blame_share": share_c,
+                "staged_closes": st["staged_closes"],
+                "dedup_staged": st["dedup_staged"],
+                "dedup_promoted": st["dedup_promoted"],
+                "dedup_restaged": st["dedup_restaged"],
+                "partial_transforms": st["partial_transforms"],
+            },
+            "blame_rel_reduction_pct": round(
+                100.0 * (1.0 - share_c / max(share_s, 1e-9)), 1
+            ),
+            "makespan_reduction_pct": round(
+                100.0 * (1.0 - mean_c / max(mean_s, 1e-9)), 1
+            ),
+            "parity": "bit-identical",
+            "digest_last": digests[-1],
+        }
+    # -- Gram-family section: Multi-Krum at a bounded cohort (the Gram
+    # is O(m²) — unboundable at the scale lane's row counts), arrival
+    # assembly vs close assembly, counter-pinned ----------------------
+    gram_per_round = min(per_round, 1536)
+    gram_rounds = args.scale_rounds
+    gram_cells = {}
+    for n_shards in args.closepath_shards:
+        co_gs = ShardedCoordinator(
+            [_scale_tenant(args, MultiKrum(f=f, q=f + 1))],
+            n_shards, quorum=1,
+        )
+        co_gc = ShardedCoordinator(
+            [_scale_tenant(args, MultiKrum(f=f, q=f + 1))],
+            n_shards, quorum=1,
+        )
+        stage_s_close: list = []
+        stage_c_arrival: list = []
+        merges_gs: list = []
+        merges_gc: list = []
+        for r in range(gram_rounds + 1):
+            warmup = r == 0
+            lo = (r * gram_per_round) % max(
+                1, args.scale_clients - gram_per_round + 1
+            )
+            window = identity[lo: lo + gram_per_round]
+            partition = [
+                [c for c in window if shard_for(c, n_shards) == s]
+                for s in range(n_shards)
+            ]
+            gc.collect()
+            gc.disable()
+            try:
+                parts_s, pre_s = [], {}
+                for s in range(n_shards):
+                    _drive_shard_partition(
+                        co_gs, s, partition, grads, bodies, r
+                    )
+                    p = co_gs.shards[s].close_partial("scale")
+                    if p is not None:
+                        pre_s[id(p)] = co_gs.check_partial(
+                            "scale", p, inflight=True
+                        )
+                        parts_s.append(p)
+                t0 = time.monotonic()
+                res_gs = co_gs.merge_partials(
+                    "scale", parts_s, prechecked=pre_s
+                )
+                merge_gs = time.monotonic() - t0
+                parts_c, pre_c = [], {}
+                arrival_c = 0.0
+                for s in range(n_shards):
+                    _drive_shard_partition(
+                        co_gc, s, partition, grads, bodies, r
+                    )
+                    p = co_gc.shards[s].close_partial("scale")
+                    if p is not None:
+                        chk = co_gc.check_partial(
+                            "scale", p, inflight=True
+                        )
+                        pre_c[id(p)] = chk
+                        t0 = time.monotonic()
+                        if chk[0]:
+                            co_gc.stage_partial("scale", p, chk)
+                        arrival_c += time.monotonic() - t0
+                        parts_c.append(p)
+                t0 = time.monotonic()
+                res_gc = co_gc.merge_partials(
+                    "scale", parts_c, prechecked=pre_c
+                )
+                merge_gc = time.monotonic() - t0
+            finally:
+                gc.enable()
+            assert res_gs is not None and res_gc is not None
+            assert np.array_equal(
+                np.asarray(res_gs[2]), np.asarray(res_gc[2])
+            ), f"gram close-path diverged at {n_shards} shards round {r}"
+            if warmup:
+                continue
+            merges_gs.append(merge_gs)
+            merges_gc.append(merge_gc)
+            stage_s_close.append(merge_gs)
+            stage_c_arrival.append(arrival_c)
+        gst = co_gc.stats()["root"]["scale"]
+        rounds_total = gram_rounds + 1
+        # the cross-Gram accounting at its combinatorial floor: every
+        # close k·(k−1)/2 cross blocks, no shipped-Gram recomputes
+        assert gst["staged_closes"] == rounds_total, gst
+        assert gst["partial_transforms"] == 0, gst
+        assert gst["gram_cross_blocks"] == (
+            rounds_total * n_shards * (n_shards - 1) // 2
+        ), gst
+        assert gst["dedup_restaged"] == 0, gst
+        gram_cells[n_shards] = {
+            "rounds": gram_rounds,
+            "close_arm_root_close_mean_ms": round(
+                1e3 * float(np.mean(merges_gs)), 2
+            ),
+            "arrival_arm_root_close_mean_ms": round(
+                1e3 * float(np.mean(merges_gc)), 2
+            ),
+            "arrival_arm_stage_mean_ms": round(
+                1e3 * float(np.mean(stage_c_arrival)), 2
+            ),
+            "root_close_reduction_pct": round(
+                100.0 * (
+                    1.0 - float(np.mean(merges_gc))
+                    / max(float(np.mean(merges_gs)), 1e-9)
+                ), 1
+            ),
+            "gram_cross_blocks": gst["gram_cross_blocks"],
+            "partial_transforms": gst["partial_transforms"],
+            "staged_closes": gst["staged_closes"],
+            "parity": "bit-identical",
+        }
+    host_cores = os.cpu_count() or 1
+    row = {
+        "lane": "closepath",
+        "clients": args.scale_clients,
+        "dim": d,
+        "round_submissions": per_round,
+        "rounds": args.scale_rounds,
+        "aggregator": f"cge-f{f}",
+        "timing_model": "modeled:max(legs)+merge",
+        "timing_model_note": (
+            "scale-lane methodology (PR 13/18 blame tables): per-shard "
+            "legs measured in isolation and overlapped on their own "
+            "lanes; BOTH arms charge the arrival-time verify to the "
+            "shard's lane, and the CLOSE-PATH arm additionally charges "
+            "stage_partial (dedup staging + the per-partial cross-Gram "
+            "transform) there — root_merge_blame_share is the "
+            "serving.fold_merge exclusive share of the modeled "
+            "makespan in each arm"
+        ),
+        "host_cores": host_cores,
+        "shards": cells,
+        "gram": {
+            "aggregator": f"multi-krum-f{f}-q{f + 1}",
+            "round_submissions": gram_per_round,
+            "shards": gram_cells,
+        },
+        "parity": "bit-identical",
+        "root_merge_blame_share": {
+            "streaming": {
+                n: cells[n]["streaming"]["root_merge_blame_share"]
+                for n in args.closepath_shards
+            },
+            "closepath": {
+                n: cells[n]["closepath"]["root_merge_blame_share"]
+                for n in args.closepath_shards
+            },
+        },
+    }
+    top = max(args.closepath_shards)
+    if top >= 4:
+        # the acceptance bar, asserted in-run: at 4 shards the
+        # close-path arm's root-merge exclusive blame must land
+        # strictly below the PR 18 streaming baseline (31.1%) AND the
+        # per-round makespan must improve on the streaming arm
+        c = cells[top]
+        assert c["closepath"]["root_merge_blame_share"] < 0.311, c
+        assert c["makespan_reduction_pct"] > 0.0, c
+    if not telemetry_was_on:
+        obs.disable()
+    return row
+
+
 # ---------------------------------------------------------------------------
 # process runner lane (ISSUE 14: measured multi-process makespans)
 # ---------------------------------------------------------------------------
@@ -1895,6 +2266,32 @@ def _assert_streamroot_smoke(args, row: dict) -> None:
         ), cell
 
 
+def _assert_closepath_smoke(args, row: dict) -> None:
+    """The close-path paydown A/B's CI contract: every cell's two arms
+    published bit-identical aggregates, every close consumed the
+    arrival-staged accumulator, and the extras-work counters sit at
+    the combinatorial floor (zero redundant recomputes)."""
+    assert row["timing_model"].startswith("modeled"), row
+    assert row["parity"] == "bit-identical"
+    rounds_total = args.scale_rounds + 1
+    for n in args.closepath_shards:
+        cell = row["shards"][n]
+        assert cell["parity"] == "bit-identical", cell
+        assert cell["rounds"] == args.scale_rounds, cell
+        cp = cell["closepath"]
+        assert cp["staged_closes"] == rounds_total, cell
+        assert cp["partial_transforms"] == 0, cell
+        assert cp["dedup_restaged"] == 0, cell
+        assert cp["dedup_promoted"] >= rounds_total * n, cell
+        g = row["gram"]["shards"][n]
+        assert g["parity"] == "bit-identical", g
+        assert g["staged_closes"] == rounds_total, g
+        assert g["partial_transforms"] == 0, g
+        assert g["gram_cross_blocks"] == (
+            rounds_total * n * (n - 1) // 2
+        ), g
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", type=int, default=10_000)
@@ -1927,6 +2324,11 @@ def main() -> None:
     ap.add_argument("--streamroot-only", action="store_true",
                     help="run ONLY the streaming-vs-barrier root merge "
                          "A/B (ISSUE 18 cells; scale-lane knobs apply)")
+    ap.add_argument("--closepath-only", action="store_true",
+                    help="run ONLY the close-path paydown A/B "
+                         "(ISSUE 19 cells: staged dedup + arrival "
+                         "cross-Gram + off-path finalize vs the PR-18 "
+                         "streaming close; scale-lane knobs apply)")
     ap.add_argument("--pipeline-pace-ms", type=float, default=60.0,
                     help="client think-time per round in the pipeline "
                          "A/B (both arms; 0 = saturating blast)")
@@ -1946,6 +2348,7 @@ def main() -> None:
     args.scale_shards = (1, 2, 4)
     args.runner_shards = (1, 2, 4)
     args.streamroot_shards = (1, 2, 4)
+    args.closepath_shards = (1, 2, 4)
     if args.processes_only:
         args.processes = True
     if args.smoke:
@@ -1967,6 +2370,7 @@ def main() -> None:
         args.runner_dim = 64
         args.runner_shards = (1, 2)
         args.streamroot_shards = (1, 2)
+        args.closepath_shards = (1, 2)
 
     meta = {
         "lane": "meta",
@@ -1983,6 +2387,14 @@ def main() -> None:
         if args.smoke:
             _assert_streamroot_smoke(args, streamroot_row)
             print("serving streamroot smoke OK")
+        return
+
+    if args.closepath_only:
+        closepath_row = _run_closepath(args)
+        _emit(closepath_row, args.out)
+        if args.smoke:
+            _assert_closepath_smoke(args, closepath_row)
+            print("serving closepath smoke OK")
         return
 
     if args.pipeline_only:
@@ -2076,6 +2488,8 @@ def main() -> None:
 
     streamroot = _run_streamroot(args)
     _emit(streamroot, args.out)
+    closepath = _run_closepath(args)
+    _emit(closepath, args.out)
 
     runner_row = None
     if args.processes:
@@ -2161,6 +2575,7 @@ def main() -> None:
         # partial-fold frame law within tolerance
         assert scale["parity"] == "bit-identical"
         _assert_streamroot_smoke(args, streamroot)
+        _assert_closepath_smoke(args, closepath)
         assert scale["speedup_vs_1shard"][2] >= 1.4, scale["speedup_vs_1shard"]
         for n in args.scale_shards:
             w = scale["shards"][n]["wire"]

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from abc import ABC, abstractmethod
 from functools import partial
-from typing import Any, Callable, Mapping, Optional, Sequence
+from typing import Any, Callable, Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -300,6 +300,28 @@ class Aggregator(Operator, ABC):
             self._masked_jit_cache = fn
         return fn
 
+    def _masked_jitted_donated(self) -> Callable:
+        """The masked program as a PERSISTENT donated-buffer jit: the
+        padded ``(bucket, d)`` matrix argument is donated, so a root
+        that finalizes every round at a small set of ladder bucket
+        shapes reuses one device allocation per bucket instead of
+        paying an alloc + copy per close (jit's shape-keyed cache IS
+        the per-bucket program table). Donation is an accelerator
+        feature — on the CPU backend XLA ignores donations (with a
+        warning), so this resolves to the plain :meth:`_masked_jitted`
+        program there: same bits either way, the donated path only
+        changes buffer reuse."""
+        fn = getattr(self, "_masked_donated_jit_cache", None)
+        if fn is None:
+            if jax.default_backend() == "cpu":
+                fn = self._masked_jitted()
+            else:
+                fn = jax.jit(
+                    self._aggregate_matrix_masked, donate_argnums=(0,)
+                )
+            self._masked_donated_jit_cache = fn
+        return fn
+
     def aggregate_masked(self, matrix: Any, valid: Any) -> jnp.ndarray:
         """Exact aggregate of the VALID rows of an already-padded
         ``(n, d)`` matrix, at the padded shape — the batch door into the
@@ -534,6 +556,48 @@ class Aggregator(Operator, ABC):
         the base class carries none)."""
         return {}
 
+    # -- combined-frame extras (merge-tree internal nodes) -----------------
+
+    def combined_extras(
+        self,
+        children: Sequence[Tuple[Tuple[Tuple[int, int, int], ...], Any,
+                                 Optional[Mapping[str, Any]]]],
+    ) -> dict:
+        """Extras for a COMBINED partial (a merge-tree internal node)
+        from its children's ``(leaf segment spans, rows, extras)``
+        triples, in shard order. The default is the full recompute over
+        the concatenated rows — exactly what ``combine_partials`` did
+        before the incremental assembly landed, and exactly what the
+        default :meth:`segmented_extras_reference` recomputes, so the
+        parent's ``extras_policy='verify'`` cross-check stays an exact
+        bit comparison. Families whose extras admit cheaper blockwise
+        assembly (Multi-Krum's Gram) override BOTH methods with the
+        same block program (:func:`ops.robust.gram_block`) — the
+        block-contraction contract."""
+        import numpy as np
+
+        if not any(e for _sp, _r, e in children):
+            return {}
+        rows = np.concatenate(
+            [np.asarray(r, np.float32) for _sp, r, _e in children], axis=0
+        )
+        return self._partial_extras(rows)
+
+    def segmented_extras_reference(
+        self, rows: Any, spans: Sequence[Tuple[int, int, int]]
+    ) -> dict:
+        """The VERIFIER's recompute program for a segmented (combined)
+        frame's extras — the other half of the block-contraction
+        contract: whatever block structure :meth:`combined_extras`
+        assembled, this method must reproduce from the frame's rows and
+        ``(shard, row_lo, row_hi)`` spans with the SAME per-block dot
+        program, so ``extras_policy='verify'`` compares exact bits.
+        Default: the flat :meth:`_partial_extras` recompute (matches
+        the default :meth:`combined_extras`)."""
+        import numpy as np
+
+        return self._partial_extras(np.asarray(rows, np.float32))
+
     # -- incremental (arrival-order) merge accumulator ---------------------
 
     def fold_merge_begin(self) -> dict:
@@ -554,7 +618,18 @@ class Aggregator(Operator, ABC):
         """Park one verified partial under its (unique) shard key.
         Arrival order is deliberately irrelevant — the canonical row
         order is re-established at :meth:`fold_merge_finish`, so an
-        out-of-order arrival never has to wait for its predecessor."""
+        out-of-order arrival never has to wait for its predecessor.
+
+        This is also the accumulator's ARRIVAL-TRANSFORM hook: a family
+        whose extras merge needs per-partial heavy work (Multi-Krum's
+        cross-Gram blocks against the partials already parked) does it
+        HERE, on the arrival thread, so :meth:`fold_merge_finish` keeps
+        only the cheap sorted-shard-order reduction — the close-path
+        paydown. Overrides count their work into the state
+        (``cross_blocks``/``transforms``) and surface it as
+        ``merged["merge_stats"]`` at finish, which the sharded root
+        folds into its ``gram_cross_blocks``/``partial_transforms``
+        counters (the zero-redundant-recompute assert reads them)."""
         key = int(shard)
         if key in state["parked"]:
             raise ValueError(f"shard {key} already parked in this merge")
@@ -571,7 +646,11 @@ class Aggregator(Operator, ABC):
         return self.fold_merge([parked[s] for s in sorted(parked)])
 
     def fold_merge_finalize(
-        self, merged: Mapping[str, Any], *, bucket: Optional[int] = None
+        self,
+        merged: Mapping[str, Any],
+        *,
+        bucket: Optional[int] = None,
+        donate: bool = False,
     ) -> jnp.ndarray:
         """Finalize a merged root fold to the ``(d,)`` aggregate —
         BIT-IDENTICAL (f32, finite cohorts) to the single-frontend
@@ -591,7 +670,17 @@ class Aggregator(Operator, ABC):
         without producing NaN first), and the masked program is invoked
         directly — the same per-aggregator jit cache and bit semantics
         as :meth:`aggregate_masked`, minus its full padded-matrix
-        ``isfinite`` rescan."""
+        ``isfinite`` rescan.
+
+        ``donate=True`` runs the OFF-PATH finalize variant: the same
+        masked program through the persistent donated-buffer jit
+        (:meth:`_masked_jitted_donated`, keyed by bucket shape), and
+        the call returns the UNMATERIALIZED device array the moment the
+        program is dispatched — the root kicks the device step the
+        instant the last partial settles and overlaps its host-side
+        score view with the device work, materializing (``np.asarray``)
+        only when the digest needs the bits. Bit-identical to the
+        synchronous path: same program, same inputs."""
         import numpy as np
 
         rows = np.ascontiguousarray(np.asarray(merged["rows"], np.float32))
@@ -613,9 +702,18 @@ class Aggregator(Operator, ABC):
         else:
             padded = rows
             valid = np.ones((m,), bool)
-        return self._masked_jitted()(
-            jnp.asarray(padded), jnp.asarray(valid)
-        )
+        fn = self._masked_jitted_donated() if donate else self._masked_jitted()
+        return fn(jnp.asarray(padded), jnp.asarray(valid))
+
+    #: True when :meth:`merged_score_view` reads ONLY the merged fold
+    #: state (rows + published extras) whenever extras are present —
+    #: i.e. it never needs the round ``aggregate``. The root's
+    #: off-path finalize overlaps the host score pass with the device
+    #: program ONLY for such families (the view runs between the
+    #: device dispatch and its materialization; a view that wants the
+    #: aggregate would force the materialization first and the overlap
+    #: would be a lie).
+    merged_view_from_extras: bool = False
 
     def merged_score_view(
         self, merged: Mapping[str, Any], *, aggregate: Any = None

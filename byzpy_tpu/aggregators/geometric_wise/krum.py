@@ -160,42 +160,236 @@ class MultiKrum(RowScoredAggregator, Aggregator):
 
     # -- hierarchical partial fold (sharded serving tier) -----------------
 
+    #: the merged score view reads the assembled Gram, never the round
+    #: aggregate — the root's off-path finalize overlaps it with the
+    #: device program
+    merged_view_from_extras = True
+
     def _partial_extras(self, rows) -> dict:
         """One shard's local Gram block over its discounted rows — the
-        streaming Gram accumulation's sharded form. The root reuses it
+        streaming Gram accumulation's sharded form, through the
+        CANONICAL block contraction (:func:`ops.robust.gram_block`: the
+        block-contraction contract's one dot program, so every
+        downstream verifier compares exact bits). The root reuses it
         as the diagonal block of the merged cohort's full Gram; only
         the cross-shard blocks remain to compute at merge. An
         adversarial NaN/inf row yields NaN Gram entries — advisory
         only: the merged finalize reads rows, not extras, and routes
         non-finite cohorts to the exact path."""
-        with np.errstate(invalid="ignore", over="ignore"):
-            return {"gram": (rows @ rows.T).astype(np.float32)}
+        return {"gram": robust.gram_block(rows, rows)}
 
     def _merge_extras(self, extras_list, partials) -> dict:
         """Assemble the merged cohort's ``(m, m)`` Gram: shard-local
         blocks dropped onto the diagonal (recomputed when a shard
         shipped none — the summary is deterministic), cross-shard
-        blocks via one matmul per shard pair (the irreducible
-        remainder: cross inner products need both shards' rows)."""
+        blocks via one :func:`ops.robust.gram_block` per shard pair
+        (the irreducible remainder: cross inner products need both
+        shards' rows). The incremental accumulator
+        (:meth:`fold_merge_add`) computes the SAME blocks at arrival —
+        same function, same operands, so streaming-then-finish and
+        this barrier path publish bit-identical Grams."""
         mats = [np.asarray(p["rows"], np.float32) for p in partials]
         sizes = [m.shape[0] for m in mats]
         offs = np.cumsum([0] + sizes)
         total = int(offs[-1])
         gram = np.zeros((total, total), np.float32)
-        with np.errstate(invalid="ignore", over="ignore"):
-            for i, mi in enumerate(mats):
-                e = extras_list[i]
-                block = (
-                    np.asarray(e["gram"], np.float32)
-                    if e and "gram" in e
-                    else (mi @ mi.T).astype(np.float32)
-                )
-                gram[offs[i]:offs[i + 1], offs[i]:offs[i + 1]] = block
-                for j in range(i + 1, len(mats)):
-                    cross = (mi @ mats[j].T).astype(np.float32)
-                    gram[offs[i]:offs[i + 1], offs[j]:offs[j + 1]] = cross
-                    gram[offs[j]:offs[j + 1], offs[i]:offs[i + 1]] = cross.T
+        for i, mi in enumerate(mats):
+            e = extras_list[i]
+            block = (
+                np.asarray(e["gram"], np.float32)
+                if e and "gram" in e
+                else robust.gram_block(mi, mi)
+            )
+            gram[offs[i]:offs[i + 1], offs[i]:offs[i + 1]] = block
+            for j in range(i + 1, len(mats)):
+                cross = robust.gram_block(mi, mats[j])
+                gram[offs[i]:offs[i + 1], offs[j]:offs[j + 1]] = cross
+                gram[offs[j]:offs[j + 1], offs[i]:offs[i + 1]] = cross.T
         return {"gram": gram}
+
+    def combined_extras(self, children) -> dict:
+        """Blockwise extras for a merge-tree COMBINED frame: each
+        child's shipped Gram drops onto its diagonal region verbatim
+        (it is itself the leaf-blockwise assembly, by induction down
+        the tree), and only the CROSS blocks between children are
+        computed — one :func:`ops.robust.gram_block` per LEAF-segment
+        pair, O(m_i·m_j·d), replacing the old full O(m²·d) recompute
+        at every tree level. Leaf granularity is load-bearing: the
+        parent's ``extras_policy='verify'`` check recomputes per leaf
+        pair (:meth:`segmented_extras_reference`), and a single big
+        cross matmul would only match those blocks to matmul
+        tolerance."""
+        if not any(e for _sp, _r, e in children):
+            return {}
+        prepared = []  # (rows_f32, local spans, shipped gram or None)
+        total = 0
+        for spans, rows, extras in children:
+            rows = np.asarray(rows, np.float32)
+            shipped = None
+            if extras and "gram" in extras:
+                shipped = np.asarray(extras["gram"], np.float32)
+            prepared.append((rows, tuple(spans), shipped))
+            total += int(rows.shape[0])
+        gram = np.zeros((total, total), np.float32)
+        off = 0
+        offsets = []
+        for rows, spans, shipped in prepared:
+            m = int(rows.shape[0])
+            offsets.append(off)
+            if shipped is not None:
+                gram[off:off + m, off:off + m] = shipped
+            else:
+                # child shipped no Gram: recompute its diagonal region
+                # leaf-blockwise — the verifier's granularity
+                for i, (_sa, la, ha) in enumerate(spans):
+                    for _sb, lb, hb in spans[i:]:
+                        blk = robust.gram_block(rows[la:ha], rows[lb:hb])
+                        gram[off + la:off + ha, off + lb:off + hb] = blk
+                        if lb != la:
+                            gram[off + lb:off + hb, off + la:off + ha] = (
+                                blk.T
+                            )
+            off += m
+        for i, (rows_i, spans_i, _si) in enumerate(prepared):
+            for j in range(i + 1, len(prepared)):
+                rows_j, spans_j, _sj = prepared[j]
+                for _sa, la, ha in spans_i:
+                    for _sb, lb, hb in spans_j:
+                        blk = robust.gram_block(
+                            rows_i[la:ha], rows_j[lb:hb]
+                        )
+                        gram[
+                            offsets[i] + la:offsets[i] + ha,
+                            offsets[j] + lb:offsets[j] + hb,
+                        ] = blk
+                        gram[
+                            offsets[j] + lb:offsets[j] + hb,
+                            offsets[i] + la:offsets[i] + ha,
+                        ] = blk.T
+        return {"gram": gram}
+
+    def segmented_extras_reference(self, rows, spans) -> dict:
+        """The verifier's half of the block-contraction contract: the
+        Gram of a segmented frame recomputed PER LEAF-SEGMENT PAIR with
+        the same :func:`ops.robust.gram_block` the assembly used — an
+        honest combined frame matches to the exact bit (pinned by
+        ``tests/test_closepath.py``); >0 ulp of drift is a forged
+        frame, not tolerance."""
+        rows = np.asarray(rows, np.float32)
+        spans = tuple(spans)
+        if len(spans) <= 1:
+            return self._partial_extras(rows)
+        total = int(rows.shape[0])
+        gram = np.zeros((total, total), np.float32)
+        for i, (_sa, la, ha) in enumerate(spans):
+            for _sb, lb, hb in spans[i:]:
+                blk = robust.gram_block(rows[la:ha], rows[lb:hb])
+                gram[la:ha, lb:hb] = blk
+                if lb != la:
+                    gram[lb:hb, la:ha] = blk.T
+        return {"gram": gram}
+
+    # -- incremental merge accumulator: cross blocks at arrival -----------
+
+    def fold_merge_begin(self) -> dict:
+        state = super().fold_merge_begin()
+        state.update(
+            diag={}, cross={}, any_extras=False,
+            cross_blocks=0, transforms=0,
+        )
+        return state
+
+    def fold_merge_add(self, state, shard, partial) -> None:
+        """Park the partial AND do its heavy merge work now, on the
+        arrival thread: its diagonal block (shipped, or recomputed —
+        counted as a ``transform``) and the cross-Gram blocks against
+        every partial already parked (O(m_i·m_j·d) each, counted as
+        ``cross_blocks``). By the time the LAST partial lands the full
+        Gram exists in blocks; :meth:`fold_merge_finish` only places
+        them — the close's critical path keeps the concat and the
+        placement, not the matmuls."""
+        super().fold_merge_add(state, shard, partial)
+        if partial.get("extras") and "gram" in partial["extras"]:
+            state["diag"][int(shard)] = np.asarray(
+                partial["extras"]["gram"], np.float32
+            )
+            state["any_extras"] = True
+        # Gram blocks only exist when the merged fold will carry extras
+        # at all (the base fold_merge gate: any partial shipped some);
+        # once that is known, keep the block set complete on every add
+        if state["any_extras"]:
+            self._complete_blocks(state)
+
+    def _complete_blocks(self, state) -> None:
+        """Compute every missing diagonal/cross block for the parked
+        set, in canonical (ascending-shard) orientation. Incremental in
+        steady state — after partial k arrives only its k-1 new cross
+        blocks are missing; idempotent at finish."""
+        parked = state["parked"]
+        for key, inp in parked.items():
+            if key not in state["diag"]:
+                rows = np.asarray(inp["rows"], np.float32)
+                state["diag"][key] = robust.gram_block(rows, rows)
+                state["transforms"] += 1
+        keys = sorted(parked)
+        for i, a in enumerate(keys):
+            for b in keys[i + 1:]:
+                if (a, b) not in state["cross"]:
+                    state["cross"][(a, b)] = robust.gram_block(
+                        np.asarray(parked[a]["rows"], np.float32),
+                        np.asarray(parked[b]["rows"], np.float32),
+                    )
+                    state["cross_blocks"] += 1
+
+    def fold_merge_finish(self, state) -> dict:
+        """Close the accumulator: sorted-shard-order row concat (the
+        exact barrier concat) plus pure PLACEMENT of the blocks
+        computed at arrival — zero matmuls here. Bit-identical to
+        ``fold_merge`` → :meth:`_merge_extras` of the same partials by
+        construction: same :func:`ops.robust.gram_block` calls on the
+        same operands, same orientation. ``merged["merge_stats"]``
+        carries the accumulated block counts for the root's
+        zero-redundant-recompute accounting."""
+        parked = state["parked"]
+        if not parked:
+            raise ValueError("fold_merge_finish on an empty accumulator")
+        if not state["any_extras"]:
+            merged = self.fold_merge([parked[s] for s in sorted(parked)])
+            merged["merge_stats"] = {
+                "cross_blocks": state["cross_blocks"],
+                "transforms": state["transforms"],
+            }
+            return merged
+        self._complete_blocks(state)
+        keys = sorted(parked)
+        mats = [np.asarray(parked[s]["rows"], np.float32) for s in keys]
+        dims = {m.shape[1] for m in mats if m.ndim == 2}
+        if len(dims) > 1:
+            raise ValueError(
+                f"partials disagree on gradient dimension: {sorted(dims)}"
+            )
+        rows = np.concatenate(mats, axis=0)
+        offs = np.cumsum([0] + [m.shape[0] for m in mats])
+        total = int(offs[-1])
+        gram = np.zeros((total, total), np.float32)
+        for i, a in enumerate(keys):
+            gram[offs[i]:offs[i + 1], offs[i]:offs[i + 1]] = (
+                state["diag"][a]
+            )
+            for j in range(i + 1, len(keys)):
+                blk = state["cross"][(a, keys[j])]
+                gram[offs[i]:offs[i + 1], offs[j]:offs[j + 1]] = blk
+                gram[offs[j]:offs[j + 1], offs[i]:offs[i + 1]] = blk.T
+        return {
+            "rows": rows,
+            "m": total,
+            "offsets": [int(o) for o in offs[:-1]],
+            "extras": {"gram": gram},
+            "merge_stats": {
+                "cross_blocks": state["cross_blocks"],
+                "transforms": state["transforms"],
+            },
+        }
 
     def merged_score_view(self, merged, *, aggregate=None):
         """Krum-distance scores straight from the merged Gram (pairwise

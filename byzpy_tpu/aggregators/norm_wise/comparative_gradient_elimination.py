@@ -107,6 +107,11 @@ class ComparativeGradientElimination(RowScoredAggregator, Aggregator):
 
     # -- hierarchical partial fold (sharded serving tier) -----------------
 
+    #: the merged score view reads the merged norm vector, never the
+    #: round aggregate — eligible for the root's off-path finalize
+    #: overlap (score pass during the device program's flight)
+    merged_view_from_extras = True
+
     def _partial_extras(self, rows) -> dict:
         """Per-row squared norms of one shard's discounted rows — CGE's
         whole streaming state; norms are row-local, so the sharded fold

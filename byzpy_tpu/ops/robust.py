@@ -1231,6 +1231,32 @@ def gram_fold_update(
     return buffer, gram
 
 
+def gram_block(a, b):
+    """The canonical HOST-side Gram block contraction of the sharded
+    tier's block-contraction contract: ``(a @ b.T)`` as float32 over
+    float32 contiguous operands, under the NaN/overflow-tolerant
+    errstate the family extras use. Every producer and verifier of a
+    partial fold's Gram extras — the shard's local diagonal block
+    (``MultiKrum._partial_extras``), the merge tree's cross-block
+    assembly (``combine_partials`` → ``Aggregator.combined_extras``),
+    the root's incremental merge accumulator
+    (``MultiKrum.fold_merge_add``), and the ``extras_policy='verify'``
+    recompute (``Aggregator.segmented_extras_reference``) — MUST call
+    this one function on the same row bits: a Gram entry is then the
+    same dot program on both sides, so the cross-check is EXACT bit
+    equality, not "matmul tolerance" (a full-matrix sgemm and a
+    blocked sgemm may legally disagree in the last ulp because kernel
+    selection depends on operand shape). Contiguity is normalized here
+    so a verifier reading a sliced view of a concatenated frame feeds
+    BLAS the same layout the producer did."""
+    import numpy as np
+
+    ac = np.ascontiguousarray(np.asarray(a, np.float32))
+    bc = np.ascontiguousarray(np.asarray(b, np.float32))
+    with np.errstate(invalid="ignore", over="ignore"):
+        return (ac @ bc.T).astype(np.float32)
+
+
 def krum_scores_from_gram(gram: Array, *, f: int) -> Array:
     """Krum score per node from a precomputed ``(n, n)`` Gram matrix —
     the finalize step of the incremental Gram fold, where each arriving
@@ -1686,6 +1712,7 @@ __all__ = [
     "extremes_fold_update_donated",
     "fold_add_donated",
     "gram_fold_update",
+    "gram_block",
     "trimmed_mean_from_extremes",
     "krum_scores_from_gram",
     "multi_krum_from_gram",

@@ -875,6 +875,11 @@ class _RootServer:
             except (ValueError, KeyError, TypeError):
                 return reply, None, "bad_partial"
             chk = self.co.check_partial(tenant, p, inflight=True)
+            if chk[0]:
+                # close-path paydown: stage the dedup verdict + merge
+                # input on this reader thread while siblings are still
+                # in flight — the root close just promotes
+                self.co.stage_partial(tenant, p, chk)
             return reply, p, chk
 
         futures = {
@@ -1903,6 +1908,14 @@ def _smoke() -> None:
             st = runner.stats()["root"]["m0"]
             assert st["partial_checks"] >= rounds, st
             assert st["partials_inflight"] == 0, st
+            # close-path paydown: every frame's dedup verdict staged on
+            # its reader thread, every close settled off the staged
+            # accumulator, zero redundant per-partial transforms
+            assert st["dedup_staged"] >= 2 * rounds, st
+            assert st["dedup_promoted"] >= 2 * rounds, st
+            assert st["dedup_restaged"] == 0, st
+            assert st["staged_closes"] == rounds, st
+            assert st["partial_transforms"] == 0, st
             stream_checks = st["partial_checks"]
             exports = runner.trace_exports()
         finally:
@@ -1954,6 +1967,11 @@ def _smoke() -> None:
             st = runner.stats()["root"]["m0"]
             assert st["partial_checks"] >= rounds, st
             assert st["partials_inflight"] == 0, st
+            # pipelined door: staging survives the cross-round overlap
+            # (epoch revalidation, never a verdict flip on this traffic)
+            assert st["dedup_restaged"] == 0, st
+            assert st["staged_closes"] == rounds, st
+            assert st["partial_transforms"] == 0, st
         finally:
             client.close()
     assert overlap_admitted > 0, "no frames admitted during overlap"

@@ -358,15 +358,20 @@ def combine_partials(
     would have produced — the bit-parity contract is preserved by
     construction at any tree depth), identities concatenate alongside,
     ``segments`` records which leaf shard owns each row block, and the
-    family extras are RECOMPUTED from the combined rows
-    (``Aggregator._partial_extras`` is a deterministic function of the
-    rows, so the combined frame is indistinguishable from a single
-    larger shard's — the parent's ``extras_policy="verify"``
-    cross-check holds unchanged, where forwarding ``_merge_extras``
-    output would not: e.g. the assembled Multi-Krum cross-Gram blocks
-    reproduce the direct recompute only to matmul tolerance). The
-    digest is refreshed over the combined row bits; ``shard`` is the
-    lowest covered leaf (stable sort key at the parent)."""
+    family extras are assembled INCREMENTALLY
+    (``Aggregator.combined_extras``): each child's shipped extras land
+    verbatim and only the CROSS blocks between children are computed —
+    O(m_i·m_j·d) per pair instead of the old full O(m²·d) recompute at
+    every tree level. The parent's ``extras_policy="verify"``
+    cross-check holds EXACTLY under the block-contraction contract:
+    assembly and verifier (``Aggregator.segmented_extras_reference``)
+    run the same per-leaf-pair dot program
+    (:func:`ops.robust.gram_block`), so parity is bit equality, not
+    matmul tolerance — and a child that shipped FORGED extras now
+    produces a combined frame the parent's verify excludes (the old
+    full recompute silently laundered it). The digest is refreshed
+    over the combined row bits; ``shard`` is the lowest covered leaf
+    (stable sort key at the parent)."""
     if not partials:
         raise ValueError("combine_partials needs at least one partial")
     ordered = sorted(partials, key=lambda p: p.shard)
@@ -402,9 +407,30 @@ def combine_partials(
             if p.trace_ctx is not None
         ],
     ) as combine_span:
-        extras = aggregator._partial_extras(rows) if any(
-            p.extras for p in ordered
-        ) else {}
+        children = [
+            (p.segment_spans(), p.rows, p.extras or None) for p in ordered
+        ]
+        n_leaves = [len(sp) for sp, _r, _e in children]
+        with obs_tracing.span(
+            "serving.gram_assemble",
+            track="merge",
+            tenant=ordered[0].tenant,
+            round=ordered[0].round_id,
+            children=len(ordered),
+            # cross blocks this assembly computes (leaf-pair granular,
+            # across children only) and diagonal regions it must
+            # recompute because a child shipped no extras — the
+            # tree-level zero-redundant-recompute account
+            cross_blocks=sum(
+                a * b
+                for i, a in enumerate(n_leaves)
+                for b in n_leaves[i + 1:]
+            ),
+            transforms=sum(
+                1 for _sp, _r, e in children if not e
+            ) if any(e for _sp, _r, e in children) else 0,
+        ):
+            extras = aggregator.combined_extras(children)
         return PartialFold(
             tenant=ordered[0].tenant,
             round_id=ordered[0].round_id,
@@ -808,7 +834,9 @@ class _RootTenant:
         "failed_rounds", "quorum_closes", "partitions", "forged",
         "root_duplicates", "durability", "rounds",
         "speculative_closes", "repairs", "open_repairs",
-        "partial_checks",
+        "partial_checks", "dedup_lock", "dedup_epoch", "staging",
+        "dedup_staged", "dedup_promoted", "dedup_restaged",
+        "staged_closes", "gram_cross_blocks", "partial_transforms",
     )
 
     def __init__(
@@ -870,6 +898,46 @@ class _RootTenant:
         #: stateless cross-check runs (``check_partial``) — the repair
         #: satellite's one-verify-per-repair contract pins this counter
         self.partial_checks = 0
+        #: guards ``seqs``/``dedup_epoch``/``staging`` — arrival-time
+        #: dedup staging reads the fold table on reader threads / the
+        #: async executor while ``_finish`` settles it on the loop or
+        #: control thread
+        self.dedup_lock = threading.Lock()
+        #: bumped once per settle (the ``note_folded`` batch of a close
+        #: or repair): a staged verdict tagged with an older epoch may
+        #: have been invalidated by the settle and is revalidated with
+        #: the cheap dict-lookup loop at promotion — verdicts are
+        #: therefore always account-identical to close-time dedup, at
+        #: any ``pipeline_depth``
+        self.dedup_epoch = 0
+        #: round-keyed dedup STAGING tables (arrival-time close-path):
+        #: ``round_id -> {"lock", "entries": {id(p): {"partial",
+        #: "folded", "dups", "epoch", "input", "valid"}}, "acc",
+        #: "acc_ok", "acc_shards"}`` — populated by ``stage_partial``
+        #: as each checked frame lands; the close pops its round's
+        #: table and just PROMOTES the staged verdicts (and consumes
+        #: the pre-assembled merge accumulator when every entry
+        #: matches)
+        self.staging: Dict[int, dict] = {}
+        #: arrival-staged dedup verdicts / settle-time promotions /
+        #: verdicts that CHANGED between staging and settle (a
+        #: duplicate folded by an interleaved close — the rare path
+        #: that rebuilds that shard's merge input)
+        self.dedup_staged = 0
+        self.dedup_promoted = 0
+        self.dedup_restaged = 0
+        #: closes that consumed an arrival-populated merge accumulator
+        #: wholesale (the staged fast path, vs the close-time rebuild)
+        self.staged_closes = 0
+        #: extras-assembly accounting from ``merged["merge_stats"]``:
+        #: cross-Gram blocks computed and per-partial diagonal
+        #: recomputes — k verified partials must cost EXACTLY
+        #: k·(k−1)/2 cross blocks per close and zero transforms when
+        #: every shard shipped its extras (the
+        #: zero-redundant-recompute assert in runner ``--smoke`` and
+        #: the chaos ``shard`` lane)
+        self.gram_cross_blocks = 0
+        self.partial_transforms = 0
 
     def is_folded(self, client: str, seq: Optional[int]) -> bool:
         if seq is None:
@@ -1087,6 +1155,36 @@ class ShardedCoordinator:
             )
             for cfg in tenants
         }
+        self._m_finalize_s = {
+            cfg.name: reg.histogram(
+                "byzpy_root_finalize_seconds",
+                help=(
+                    "off-path root finalize latency (persistent masked "
+                    "program dispatch + materialization, donated input)"
+                ),
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
+        self._m_dedup_staged = {
+            cfg.name: reg.counter(
+                "byzpy_dedup_staged_total",
+                help="dedup verdicts staged at arrival time",
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
+        self._m_dedup_restaged = {
+            cfg.name: reg.counter(
+                "byzpy_dedup_restaged_total",
+                help=(
+                    "staged dedup verdicts invalidated at promotion "
+                    "(an intervening settle moved the verdict)"
+                ),
+                labels={"tenant": cfg.name},
+            )
+            for cfg in tenants
+        }
         self._m_inflight = reg.gauge(
             "byzpy_root_partials_inflight",
             help="arrival-verified partials awaiting a root close",
@@ -1175,9 +1273,12 @@ class ShardedCoordinator:
         after the barrier. Returns ``(ok, measured_digest)``; the pair
         rides into :meth:`merge_partials` / :meth:`repair_round` as
         ``prechecked`` so the close runs only the order-sensitive
-        ``(client, seq)`` dedup — which MUST stay at close time:
-        under pipelining a round-N partial can arrive while round
-        N-1's ``note_folded`` updates are still settling.
+        ``(client, seq)`` dedup — which :meth:`stage_partial` also
+        moves to arrival as an epoch-tagged STAGED verdict (under
+        pipelining a round-N partial can arrive while round N-1's
+        ``note_folded`` updates are still settling, so the close
+        revalidates any verdict staged under an older dedup epoch —
+        bit- and account-identical either way).
         ``inflight=True`` counts the frame into the
         ``byzpy_root_partials_inflight`` gauge (the close or repair
         that consumes the precheck decrements)."""
@@ -1204,7 +1305,18 @@ class ShardedCoordinator:
             if measured != p.digest:
                 return False, measured
             if p.extras and self.extras_policy == "verify":
-                want = agg._partial_extras(np.asarray(rows, np.float32))
+                # the block-contraction contract: a SEGMENTED (combined)
+                # frame's extras were assembled per leaf-segment pair,
+                # so the recompute must run the same per-pair dot
+                # program — exact bit comparison, not matmul tolerance
+                if p.segments is not None:
+                    want = agg.segmented_extras_reference(
+                        np.asarray(rows, np.float32), spans
+                    )
+                else:
+                    want = agg._partial_extras(
+                        np.asarray(rows, np.float32)
+                    )
                 for key, val in want.items():
                     got = p.extras.get(key)
                     # equal_nan: admission deliberately passes non-finite
@@ -1229,11 +1341,119 @@ class ShardedCoordinator:
                         return False, measured
         return True, measured
 
+    def stage_partial(
+        self,
+        tenant: str,
+        p: PartialFold,
+        prechecked: Optional[Tuple[bool, str]] = None,
+    ) -> bool:
+        """The ARRIVAL-TIME close-path door (pairs with
+        :meth:`check_partial`): stage one checked partial's dedup
+        verdict and absorb it into the round's merge accumulator the
+        moment its frame lands — on a proxy reader thread or the async
+        executor — so the settle half of :meth:`_verify_and_merge`
+        just promotes.
+
+        Two pieces move off the close here. (1) **Dedup staging**: the
+        ``(client, seq)`` loop runs now against the root fold table,
+        tagged with the current ``dedup_epoch``; if a settle intervenes
+        before this round closes (pipelining), promotion revalidates
+        with the same cheap dict loop — the verdict the close accounts
+        is identical at any ``pipeline_depth``. (2) **Arrival merge
+        transform**: the staged verdict's merge input feeds
+        ``fold_merge_add``, whose family override does the per-partial
+        heavy work (Multi-Krum's cross-Gram blocks against the
+        partials already parked) under the ``serving.gram_assemble``
+        span — by the last arrival the accumulator holds the full
+        block set and finish is placement only.
+
+        Returns ``True`` when the frame was staged; ``False`` when it
+        was refused (failed precheck, wrong tenant, a round outside
+        the staging window, a duplicate shard claim — the close then
+        handles the frame through the classic path, bit-identically).
+        Purely an optimization door: never a verdict authority (the
+        close re-derives anything stale) and never required — callers
+        that skip it get PR-18 behavior unchanged."""
+        rt = self._roots[tenant]
+        if prechecked is not None and not prechecked[0]:
+            return False
+        if p.tenant != tenant:
+            return False
+        r = int(p.round_id)
+        agg = rt.cfg.aggregator
+        with rt.dedup_lock:
+            # staging window: the open round and the pipeline's next
+            # window. Older rounds are already closed (a late frame is
+            # repair_round's business); far-future rounds would grow
+            # the table unboundedly off a forged round id.
+            if not rt.round_id <= r <= rt.round_id + 1:
+                return False
+            for stale in [k for k in rt.staging if k < rt.round_id]:
+                del rt.staging[stale]
+            ctx = rt.staging.get(r)
+            if ctx is None:
+                ctx = {
+                    "lock": threading.Lock(),
+                    "entries": {},
+                    "acc": None,
+                    "acc_ok": True,
+                    "acc_shards": set(),
+                }
+                rt.staging[r] = ctx
+            if id(p) in ctx["entries"]:
+                return False
+            folded: List[int] = []
+            dups: List[int] = []
+            for j, (client, seq) in enumerate(
+                zip(p.clients, p.seqs, strict=True)
+            ):
+                if rt.is_folded(client, seq):
+                    dups.append(j)
+                else:
+                    folded.append(j)
+            entry = {
+                "partial": p,
+                "folded": folded,
+                "dups": dups,
+                "epoch": rt.dedup_epoch,
+                "valid": True,
+            }
+            ctx["entries"][id(p)] = entry
+            rt.dedup_staged += 1
+        if obs_runtime.STATE.enabled:
+            self._m_dedup_staged[tenant].inc()
+        entry["input"] = inp = self._merge_input(p, folded, dups)
+        with ctx["lock"]:
+            shard = int(p.shard)
+            if not ctx["acc_ok"] or shard in ctx["acc_shards"]:
+                # a second frame claiming a shard this window already
+                # staged: the close's duplicate-shard rule decides —
+                # drop the accumulator fast path, keep the verdicts
+                ctx["acc_ok"] = False
+                return False
+            if ctx["acc"] is None:
+                ctx["acc"] = agg.fold_merge_begin()
+            with obs_tracing.span(
+                "serving.gram_assemble", track="root", tenant=tenant,
+                round=r, shard=shard, m=int(p.m),
+                parked=len(ctx["acc_shards"]),
+            ):
+                try:
+                    agg.fold_merge_add(ctx["acc"], shard, inp)
+                except Exception:  # noqa: BLE001 — an accumulator the
+                    # family refuses (dim mismatch, duplicate key race)
+                    # only costs the fast path, never the close
+                    ctx["acc_ok"] = False
+                    return False
+            ctx["acc_shards"].add(shard)
+        return True
+
     def _verify_partial(
         self,
         rt: _RootTenant,
         p: PartialFold,
         prechecked: Optional[Tuple[bool, str]] = None,
+        staged: Optional[dict] = None,
     ) -> Tuple[Optional[Tuple[List[int], List[int]]], str]:
         """Root cross-checks of one shard's partial. Returns
         ``((folded row indices, duplicate row indices), measured_digest)``
@@ -1246,22 +1466,52 @@ class ShardedCoordinator:
         same checks PER SEGMENT (ownership against the segment's leaf
         shard, the row cap per leaf). The stateless suite lives in
         :meth:`check_partial`; an arrival-verified result arrives as
-        ``prechecked`` and is NOT re-run — only the round-state dedup
-        loop executes at close time."""
+        ``prechecked`` and is NOT re-run. ``staged`` is this frame's
+        :meth:`stage_partial` entry when the arrival path also staged
+        the dedup verdict: a verdict staged under the CURRENT
+        ``dedup_epoch`` promotes without touching the fold table; one
+        staged under an older epoch (a settle intervened — pipelining)
+        is revalidated with the same cheap loop, and if the verdict
+        moved the stale entry is invalidated (``dedup_restaged``) so
+        the close's accumulator fast path stands down. Either way the
+        verdict the close accounts is bit- and account-identical to
+        the classic loop at any ``pipeline_depth``."""
         if prechecked is None:
             prechecked = self.check_partial(rt.cfg.name, p)
         ok, measured = prechecked
         if not ok:
             return None, measured
-        folded: List[int] = []
-        dups: List[int] = []
-        for j, (client, seq) in enumerate(
-            zip(p.clients, p.seqs, strict=True)
-        ):
-            if rt.is_folded(client, seq):
-                dups.append(j)
-            else:
-                folded.append(j)
+        with rt.dedup_lock:
+            if (
+                staged is not None
+                and staged.get("partial") is p
+                and staged["epoch"] == rt.dedup_epoch
+            ):
+                rt.dedup_promoted += 1
+                return (staged["folded"], staged["dups"]), measured
+            folded: List[int] = []
+            dups: List[int] = []
+            for j, (client, seq) in enumerate(
+                zip(p.clients, p.seqs, strict=True)
+            ):
+                if rt.is_folded(client, seq):
+                    dups.append(j)
+                else:
+                    folded.append(j)
+            if staged is not None and staged.get("partial") is p:
+                if (
+                    staged["folded"] == folded
+                    and staged["dups"] == dups
+                ):
+                    # stale epoch, same verdict: the staged merge
+                    # input is still the bit-exact one — refresh
+                    staged["epoch"] = rt.dedup_epoch
+                    rt.dedup_promoted += 1
+                else:
+                    staged["valid"] = False
+                    rt.dedup_restaged += 1
+                    if obs_runtime.STATE.enabled:
+                        self._m_dedup_restaged[rt.cfg.name].inc()
         return (folded, dups), measured
 
     def _note_event(self, event: dict) -> None:
@@ -1398,8 +1648,10 @@ class ShardedCoordinator:
         self._apply_shard_actions(tenant, actions)
         if computed is None:
             return None
-        verified, merged, vec, t0 = computed
-        return self._finish(rt, verified, merged, vec, list(missing), t0)
+        verified, merged, vec, t0, view = computed
+        return self._finish(
+            rt, verified, merged, vec, list(missing), t0, view
+        )
 
     def repair_round(
         self,
@@ -1511,6 +1763,10 @@ class ShardedCoordinator:
             for s, inp in inputs:
                 agg.fold_merge_add(acc, s, inp)
             merged = agg.fold_merge_finish(acc)
+            ms = merged.get("merge_stats") or {}
+            rt.gram_cross_blocks += int(ms.get("cross_blocks", 0))
+            rt.partial_transforms += int(ms.get("transforms", 0))
+            t_fin = self._clock()
             try:
                 with obs_tracing.device_span(
                     "serving.device_step", track="root", tenant=tenant,
@@ -1518,7 +1774,9 @@ class ShardedCoordinator:
                 ):
                     vec = np.asarray(
                         agg.fold_merge_finalize(
-                            merged, bucket=rt.ladder.bucket_for(new_m)
+                            merged,
+                            bucket=rt.ladder.bucket_for(new_m),
+                            donate=True,
                         )
                     )
             except Exception:  # noqa: BLE001 — a poisoned repair must
@@ -1532,12 +1790,17 @@ class ShardedCoordinator:
                     del rt.open_repairs[r]
                 return None
         if obs_runtime.STATE.enabled:
+            self._m_finalize_s[tenant].observe(self._clock() - t_fin)
             self._m_root_merge_s[tenant].observe(self._clock() - t_merge)
         digest = evidence_digest(vec)
         delta_digest = evidence_digest(vec - old_vec)
         rt.root_duplicates += len(dups)
-        for j in folded:
-            rt.note_folded(partial.clients[j], partial.seqs[j])
+        with rt.dedup_lock:
+            for j in folded:
+                rt.note_folded(partial.clients[j], partial.seqs[j])
+            # a repair is a settle too: staged verdicts that predate it
+            # must revalidate (the repaired pairs are now folded)
+            rt.dedup_epoch += 1
         for owner, lo, hi in partial.segment_spans():
             if not 0 <= owner < len(self.shards):
                 continue
@@ -1652,11 +1915,24 @@ class ShardedCoordinator:
         entry counted as inflight is consumed by this close (the gauge
         decrements for all of them, including frames a merge-tree level
         combined away), and an id-matched entry skips the stateless
-        re-verify."""
+        re-verify. When the arrival path also ran :meth:`stage_partial`
+        this close becomes the PAID-DOWN settle: staged dedup verdicts
+        promote (epoch-checked), and if every verified frame's merge
+        input is already parked in the staged accumulator the per-
+        partial ``fold_merge_add`` loop — the heavy half of the merge —
+        is skipped entirely and only the cheap shard-order
+        ``fold_merge_finish`` placement runs. Any mismatch (requeued or
+        forged frame, duplicate shard claim, verdict moved under
+        pipelining) falls back to the classic bit-identical rebuild."""
         tenant = rt.cfg.name
         t0 = self._clock()
         if prechecked:
             self._dec_inflight(len(prechecked))
+        with rt.dedup_lock:
+            ctx = rt.staging.pop(rt.round_id, None)
+            for stale in [k for k in rt.staging if k < rt.round_id]:
+                del rt.staging[stale]
+        staged_entries = ctx["entries"] if ctx is not None else {}
         verified: List[Tuple[PartialFold, List[int], List[int]]] = []
         seen_shards: set = set()
         for p in sorted(partials, key=lambda p: p.shard):
@@ -1713,7 +1989,9 @@ class ShardedCoordinator:
                 continue
             seen_shards.update(covered)
             pre = prechecked.get(id(p)) if prechecked else None
-            checks, measured = self._verify_partial(rt, p, pre)
+            checks, measured = self._verify_partial(
+                rt, p, pre, staged=staged_entries.get(id(p))
+            )
             if checks is None:
                 rt.forged += 1
                 actions.append(("discard", covered, p.round_id))
@@ -1744,11 +2022,32 @@ class ShardedCoordinator:
                 actions.append(("requeue", p.covered, p.round_id))
             return None
         rt.root_duplicates += sum(len(d) for _, _, d in verified)
-        merge_partials = [
-            self._merge_input(p, folded, dups)
-            for p, folded, dups in verified
-        ]
         agg = rt.cfg.aggregator
+        # staged-accumulator fast path: valid ONLY when the staging
+        # table covers exactly this close's verified set — same frames
+        # (by identity), every staged verdict still valid under the
+        # current epoch, no duplicate-shard poisoning, and the
+        # accumulator parked precisely the verified shards. Anything
+        # else (a requeued frame, a forged sibling, a verdict that
+        # moved) rebuilds classically — bit-identical either way.
+        use_staged = (
+            ctx is not None
+            and ctx["acc"] is not None
+            and ctx["acc_ok"]
+            and all(e["valid"] for e in staged_entries.values())
+            and {id(p) for p, _f, _d in verified}
+            == set(staged_entries)
+            and ctx["acc_shards"]
+            == {int(p.shard) for p, _f, _d in verified}
+        )
+        merge_partials = (
+            None
+            if use_staged
+            else [
+                self._merge_input(p, folded, dups)
+                for p, folded, dups in verified
+            ]
+        )
         t_merge = self._clock()
         with obs_tracing.span(
             "serving.fold_merge", track="root", tenant=tenant,
@@ -1764,26 +2063,60 @@ class ShardedCoordinator:
                 if p.trace_ctx is not None
             ],
         ):
-            # incremental accumulator, closed in shard order — `verified`
-            # is already shard-sorted, so this is the exact concat
-            # `fold_merge(merge_partials)` produced (bit-identity pinned
-            # by tests/test_streaming_root.py)
-            acc = agg.fold_merge_begin()
-            for (p, _f, _d), inp in zip(
-                verified, merge_partials, strict=True
-            ):
-                agg.fold_merge_add(acc, p.shard, inp)
-            merged = agg.fold_merge_finish(acc)
+            if use_staged:
+                # the arrival path already parked every merge input
+                # (and ran the per-partial transforms — Multi-Krum's
+                # cross-Gram blocks) as each frame landed: finish is
+                # the cheap sorted-shard-order placement only
+                merged = agg.fold_merge_finish(ctx["acc"])
+                rt.staged_closes += 1
+            else:
+                # incremental accumulator, closed in shard order —
+                # `verified` is already shard-sorted, so this is the
+                # exact concat `fold_merge(merge_partials)` produced
+                # (bit-identity pinned by tests/test_streaming_root.py)
+                acc = agg.fold_merge_begin()
+                for (p, _f, _d), inp in zip(
+                    verified, merge_partials, strict=True
+                ):
+                    agg.fold_merge_add(acc, p.shard, inp)
+                merged = agg.fold_merge_finish(acc)
+            ms = merged.get("merge_stats") or {}
+            rt.gram_cross_blocks += int(ms.get("cross_blocks", 0))
+            rt.partial_transforms += int(ms.get("transforms", 0))
+            t_fin = self._clock()
+            view = _UNSET = object()
             try:
                 with obs_tracing.device_span(
                     "serving.device_step", track="root", tenant=tenant,
                     m=m_total, bucket=rt.ladder.bucket_for(m_total),
                 ):
-                    vec = np.asarray(
-                        agg.fold_merge_finalize(
-                            merged, bucket=rt.ladder.bucket_for(m_total)
-                        )
+                    # OFF-PATH finalize: the masked program is a
+                    # persistent jitted computation with a donated
+                    # input buffer keyed by the bucket shape; JAX's
+                    # async dispatch returns an unmaterialized handle,
+                    # so the host computes the merged score view (for
+                    # families whose view reads only the merged fold
+                    # state) WHILE the device program is in flight,
+                    # then blocks on materialization
+                    handle = agg.fold_merge_finalize(
+                        merged,
+                        bucket=rt.ladder.bucket_for(m_total),
+                        donate=True,
                     )
+                    if (
+                        getattr(agg, "merged_view_from_extras", False)
+                        and merged.get("extras")
+                    ):
+                        try:
+                            view = agg.merged_score_view(
+                                merged, aggregate=None
+                            )
+                        except Exception:  # noqa: BLE001 — forensics
+                            # input, never a round participant
+                            view = None
+                            self.callback_errors += 1
+                    vec = np.asarray(handle)
             except Exception:  # noqa: BLE001 — a poisoned merged cohort
                 # must not kill the root: the round fails with per-shard
                 # accounting, serving continues
@@ -1792,8 +2125,11 @@ class ShardedCoordinator:
                     actions.append(("fail", p.covered, rt.round_id))
                 return None
         if obs_runtime.STATE.enabled:
+            self._m_finalize_s[tenant].observe(self._clock() - t_fin)
             self._m_root_merge_s[tenant].observe(self._clock() - t_merge)
-        return verified, merged, vec, t0
+        return verified, merged, vec, t0, (
+            None if view is _UNSET else view
+        )
 
     def _finish(
         self,
@@ -1803,26 +2139,36 @@ class ShardedCoordinator:
         vec: np.ndarray,
         missing: Sequence[int],
         t0: float,
+        view: Optional[dict] = None,
     ) -> Tuple[int, np.ndarray, np.ndarray]:
         """Bookkeeping half of a successful close (loop-side on the
         async path): root dedup update, root WAL merge evidence, shard
-        confirmations + forensics fan-out, stats, round advance."""
+        confirmations + forensics fan-out, stats, round advance.
+        ``view`` carries a merged score view the off-path finalize
+        already computed during the device program's flight; ``None``
+        computes it here (families whose view needs the aggregate)."""
         tenant = rt.cfg.name
         digest = evidence_digest(vec)
-        view = None
-        try:
-            view = rt.cfg.aggregator.merged_score_view(
-                merged, aggregate=vec
-            )
-        except Exception:  # noqa: BLE001 — the score view is forensics
-            # input, never a round participant
-            self.callback_errors += 1
+        if view is None:
+            try:
+                view = rt.cfg.aggregator.merged_score_view(
+                    merged, aggregate=vec
+                )
+            except Exception:  # noqa: BLE001 — the score view is
+                # forensics input, never a round participant
+                self.callback_errors += 1
         offsets = list(merged.get("offsets", []))
         m_total = int(merged["m"])
         closed = rt.round_id
+        with rt.dedup_lock:
+            for p, folded, _d in verified:
+                for j in folded:
+                    rt.note_folded(p.clients[j], p.seqs[j])
+            # ONE settle per close: verdicts staged for the next window
+            # before this batch landed are now epoch-stale and will
+            # revalidate at promotion (bit-identical either way)
+            rt.dedup_epoch += 1
         for idx, (p, folded, dups) in enumerate(verified):
-            for j in folded:
-                rt.note_folded(p.clients[j], p.seqs[j])
             # confirmation (WAL round record, forensics fan-out, stats)
             # goes to each LEAF shard whose rows rode this frame — a
             # merge-tree partial fans back per segment, with the row
@@ -2116,6 +2462,12 @@ class ShardedCoordinator:
                 self.check_partial(tenant, p, inflight=True)
                 if fuse else None
             )
+            if chk is not None and chk[0]:
+                # close-path paydown: stage the dedup verdict and park
+                # the merge input (per-partial heavy transform included)
+                # the moment this frame passes its arrival check — the
+                # close's settle half just promotes
+                self.stage_partial(tenant, p, chk)
             return p, chk
 
         futs = {
@@ -2190,10 +2542,13 @@ class ShardedCoordinator:
             )
 
             def _check_all(ps):
-                return {
-                    id(p): self.check_partial(tenant, p, inflight=True)
-                    for p in ps
-                }
+                out = {}
+                for p in ps:
+                    chk = self.check_partial(tenant, p, inflight=True)
+                    out[id(p)] = chk
+                    if chk[0]:
+                        self.stage_partial(tenant, p, chk)
+                return out
 
             prechecked = await loop.run_in_executor(
                 None, obs_tracing.carry_context(_check_all), partials
@@ -2239,8 +2594,8 @@ class ShardedCoordinator:
                     if shard.alive:
                         shard.sync_round(tenant, closing + 1)
             return None
-        verified, merged, vec, t0 = computed
-        return self._finish(rt, verified, merged, vec, missing, t0)
+        verified, merged, vec, t0, view = computed
+        return self._finish(rt, verified, merged, vec, missing, t0, view)
 
     async def _deferred_close_async(
         self,
@@ -2385,6 +2740,12 @@ class ShardedCoordinator:
                 "partial_checks": rt.partial_checks,
                 "partials_inflight": self._partials_inflight,
                 "pipeline_depth": self.pipeline_depth,
+                "dedup_staged": rt.dedup_staged,
+                "dedup_promoted": rt.dedup_promoted,
+                "dedup_restaged": rt.dedup_restaged,
+                "staged_closes": rt.staged_closes,
+                "gram_cross_blocks": rt.gram_cross_blocks,
+                "partial_transforms": rt.partial_transforms,
                 "p50_round_latency_s": p50,
                 "p99_round_latency_s": p99,
                 "mean_cohort": (

@@ -1,0 +1,483 @@
+"""Close-path paydown (ISSUE 19): arrival-time dedup staging,
+incremental cross-Gram assembly, off-path finalize.
+
+Contracts under test:
+
+* **staged close parity** — a close whose frames were checked AND
+  staged at arrival (``stage_partial``: dedup verdict + merge input
+  parked on the reader thread) publishes the SAME bits as the barrier
+  close, for every partial-fold aggregator × arrival orders × quorum
+  and degraded closes, with the ``staged_closes``/``dedup_promoted``
+  counters proving the fast path actually ran;
+* **cross-Gram accounting** — a Multi-Krum close of k staged partials
+  costs EXACTLY k·(k−1)/2 cross blocks and ZERO per-partial diagonal
+  recomputes when every shard shipped extras (``gram_cross_blocks`` /
+  ``partial_transforms`` pin it — the "no redundant extras recompute"
+  acceptance);
+* **0-ulp extras-verify** — ``combined_extras`` (the merge tree's
+  incremental assembly) is BIT-equal to ``segmented_extras_reference``
+  (the ``extras_policy='verify'`` recompute), and a single-ulp nudge
+  anywhere in a combined frame's shipped Gram fails the check loudly;
+* **epoch revalidation** — a verdict staged while an earlier round was
+  still pending is revalidated after that round settles: duplicates
+  staged as fresh flip to duplicates (``dedup_restaged``), the staged
+  accumulator stands down, and no row folds twice;
+* **SIGKILL drill** — staged-but-unsettled state is VOLATILE by
+  design: after a shard dies mid-window and recovers from its WAL, the
+  stale staging entries are discarded (id mismatch → classic rebuild)
+  and the replayed rows fold exactly once (cross-WAL audit clean).
+"""
+
+import itertools
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.serving import ShardedCoordinator, TenantConfig
+from byzpy_tpu.serving.sharded import (
+    PartialFold,
+    audit_sharded_exactly_once,
+    combine_partials,
+    shard_for,
+)
+from byzpy_tpu.serving.staleness import StalenessPolicy
+from byzpy_tpu.forensics.evidence import evidence_digest
+from byzpy_tpu.resilience.durable import DurabilityConfig
+
+from test_partial_fold import CASES
+
+DIM = 16
+TENANT = "m0"
+CLIENTS = [f"c{i:04d}" for i in range(18)]
+
+MAKERS = [c[0] for c in CASES]
+IDS = [c[1] for c in CASES]
+
+
+def _tenants(agg, **kw):
+    kw.setdefault("min_cohort", 1)
+    return [
+        TenantConfig(
+            name=TENANT,
+            aggregator=agg,
+            dim=DIM,
+            cohort_cap=64,
+            staleness=StalenessPolicy(
+                kind="exponential", gamma=0.5, cutoff=8
+            ),
+            **kw,
+        )
+    ]
+
+
+def _grads(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        c: rng.normal(size=DIM).astype(np.float32) for c in CLIENTS
+    }
+
+
+def _drained_partials(agg, k, seed=0, **co_kw):
+    co = ShardedCoordinator(_tenants(agg), k, quorum=1, **co_kw)
+    for c, g in _grads(seed).items():
+        ok, reason = co.submit(TENANT, c, 0, g, seq=0)
+        assert ok, (c, reason)
+    partials = [co.shards[s].close_partial(TENANT) for s in range(k)]
+    assert all(p is not None for p in partials)
+    return co, partials
+
+
+def _staged_close(co, arrival, missing=()):
+    """The full close-path discipline: check + STAGE each frame the
+    moment it 'lands', then the close consumes the prechecked results
+    and promotes the staged verdicts/accumulator."""
+    prechecked = {}
+    for p in arrival:
+        chk = co.check_partial(TENANT, p, inflight=True)
+        prechecked[id(p)] = chk
+        if chk[0]:
+            assert co.stage_partial(TENANT, p, chk)
+    return co.merge_partials(
+        TENANT, list(arrival), missing=list(missing),
+        prechecked=prechecked,
+    )
+
+
+# ---------------------------------------------------------------------------
+# staged close: bit parity with the barrier twin, every family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+@pytest.mark.parametrize("make_agg", MAKERS, ids=IDS)
+def test_staged_close_bit_identical(make_agg, k):
+    co_ref, parts = _drained_partials(make_agg(), k, seed=21)
+    full = co_ref.merge_partials(TENANT, parts)
+    assert full is not None and full[0] == 0
+    co_deg, parts_d = _drained_partials(make_agg(), k, seed=21)
+    degraded = co_deg.merge_partials(
+        TENANT, parts_d[:-1], missing=[k - 1]
+    )
+    assert degraded is not None
+    for order in itertools.permutations(range(k)):
+        co, p = _drained_partials(make_agg(), k, seed=21)
+        res = _staged_close(co, [p[i] for i in order])
+        assert res is not None and res[0] == 0
+        np.testing.assert_array_equal(
+            np.asarray(res[2]), np.asarray(full[2]), err_msg=str(order)
+        )
+        st = co.stats()["root"][TENANT]
+        # the fast path actually ran: every verdict promoted from the
+        # staging table, the close consumed the arrival accumulator
+        assert st["dedup_staged"] == k, st
+        assert st["dedup_promoted"] == k, st
+        assert st["dedup_restaged"] == 0, st
+        assert st["staged_closes"] == 1, st
+        assert st["partials_inflight"] == 0, st
+        # degraded close through the same door
+        co2, p2 = _drained_partials(make_agg(), k, seed=21)
+        arrival = [p2[i] for i in order if i != k - 1]
+        res2 = _staged_close(co2, arrival, missing=[k - 1])
+        assert res2 is not None
+        np.testing.assert_array_equal(
+            np.asarray(res2[2]), np.asarray(degraded[2]),
+            err_msg=str(order),
+        )
+        assert co2.stats()["root"][TENANT]["staged_closes"] == 1
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_staged_close_gram_accounting(k):
+    """k Multi-Krum partials with shipped extras: EXACTLY k·(k−1)/2
+    cross blocks, zero diagonal recomputes — at the close and again
+    through ``stats()`` (the runner/chaos counter-pin contract)."""
+    from byzpy_tpu.aggregators import MultiKrum
+
+    co, parts = _drained_partials(MultiKrum(f=2, q=3), k, seed=7)
+    assert all(p.extras for p in parts)
+    res = _staged_close(co, list(reversed(parts)))
+    assert res is not None
+    st = co.stats()["root"][TENANT]
+    assert st["gram_cross_blocks"] == k * (k - 1) // 2, st
+    assert st["partial_transforms"] == 0, st
+    assert st["staged_closes"] == 1, st
+
+
+def test_staged_close_with_forged_sibling_falls_back():
+    """A forged frame staged alongside honest ones: the close excludes
+    it, the staged accumulator stands down (id-set mismatch), and the
+    result still equals the honest-only barrier twin."""
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    k = 3
+    make = lambda: CoordinateWiseTrimmedMean(f=1)  # noqa: E731
+    co_ref, parts_ref = _drained_partials(make(), k, seed=33)
+    honest_only = co_ref.merge_partials(
+        TENANT, parts_ref[1:], missing=[0]
+    )
+    assert honest_only is not None
+    co, parts = _drained_partials(make(), k, seed=33)
+    forged = PartialFold(
+        tenant=parts[0].tenant, round_id=parts[0].round_id,
+        shard=parts[0].shard,
+        rows=np.asarray(parts[0].rows) * 3.0 + 1.0,
+        clients=parts[0].clients, seqs=parts[0].seqs,
+        wal_ids=parts[0].wal_ids, extras=parts[0].extras,
+        digest=parts[0].digest,
+        first_arrival_s=parts[0].first_arrival_s,
+    )
+    res = _staged_close(co, [forged, *parts[1:]], missing=[0])
+    assert res is not None
+    np.testing.assert_array_equal(
+        np.asarray(res[2]), np.asarray(honest_only[2])
+    )
+    st = co.stats()["root"][TENANT]
+    assert st["forged_partials"] == 1, st
+    # the forged frame failed its arrival check, so only the honest
+    # frames staged — but the accumulator covers all k-1 honest shards
+    # and the close still consumed it
+    assert st["dedup_staged"] == k - 1, st
+    assert st["staged_closes"] == 1, st
+    assert st["partials_inflight"] == 0, st
+
+
+# ---------------------------------------------------------------------------
+# 0-ulp extras-verify: incremental assembly == verifier recompute
+# ---------------------------------------------------------------------------
+
+
+def test_combined_extras_bit_equal_to_segmented_reference():
+    """The block-contraction contract, pinned at 0 ulp: the merge
+    tree's incremental cross-Gram assembly and the
+    ``extras_policy='verify'`` reference recompute produce the SAME
+    BITS — `np.array_equal`, not allclose. Any drift (a different
+    contraction order, a transposed gemm, a dtype excursion) must fail
+    this test loudly."""
+    from byzpy_tpu.aggregators import MultiKrum
+
+    agg = MultiKrum(f=2, q=3)
+    _co, parts = _drained_partials(agg, 3, seed=9)
+    combined = combine_partials(agg, parts)
+    spans = combined.segment_spans()
+    assert len(spans) == 3
+    rows = np.asarray(combined.rows, np.float32)
+    want = agg.segmented_extras_reference(rows, spans)
+    assert set(want) == {"gram"} == set(combined.extras)
+    assert np.array_equal(
+        np.asarray(combined.extras["gram"]),
+        np.asarray(want["gram"]),
+    ), "combined_extras drifted from the verify reference (>0 ulp)"
+    # the assembly really was incremental: shipped child diagonals
+    # land verbatim in the combined Gram
+    off = 0
+    for p in parts:
+        m = int(p.m)
+        assert np.array_equal(
+            np.asarray(combined.extras["gram"])[off:off + m, off:off + m],
+            np.asarray(p.extras["gram"]),
+        )
+        off += m
+
+
+def test_combined_extras_one_ulp_tamper_fails_verify():
+    """One ulp of drift anywhere in a combined frame's shipped Gram is
+    a forgery under ``extras_policy='verify'`` — exact equality is the
+    contract, not matmul tolerance."""
+    from byzpy_tpu.aggregators import MultiKrum
+
+    agg = MultiKrum(f=2, q=3)
+    co, parts = _drained_partials(
+        agg, 3, seed=9, extras_policy="verify"
+    )
+    combined = combine_partials(agg, parts)
+    ok, _ = co.check_partial(TENANT, combined)
+    assert ok, "honest combined frame must pass the verify recompute"
+    gram = np.asarray(combined.extras["gram"]).copy()
+    # nudge one CROSS block entry by exactly one ulp
+    i, j = 0, gram.shape[1] - 1
+    gram[i, j] = np.nextafter(
+        gram[i, j], np.float32(np.inf), dtype=np.float32
+    )
+    tampered = PartialFold(
+        tenant=combined.tenant, round_id=combined.round_id,
+        shard=combined.shard, rows=combined.rows,
+        clients=combined.clients, seqs=combined.seqs,
+        wal_ids=combined.wal_ids, extras={"gram": gram},
+        digest=combined.digest,
+        first_arrival_s=combined.first_arrival_s,
+        segments=combined.segments,
+    )
+    ok2, _ = co.check_partial(TENANT, tampered)
+    assert ok2 is False, "1-ulp Gram tamper must fail extras verify"
+
+
+def test_staged_merge_extras_bit_equal_to_barrier():
+    """The staged accumulator's merged Gram (cross blocks computed at
+    arrival, placement at finish) is bit-equal to the one-shot
+    ``fold_merge`` of the same shard-sorted partials."""
+    from byzpy_tpu.aggregators import MultiKrum
+
+    agg = MultiKrum(f=2, q=3)
+    _co, parts = _drained_partials(agg, 4, seed=13)
+    inputs = [
+        {"rows": np.asarray(p.rows), "m": int(p.m), "extras": p.extras}
+        for p in parts
+    ]
+    ref = agg.fold_merge(inputs)
+    for order in itertools.permutations(range(4)):
+        acc = agg.fold_merge_begin()
+        for s in order:
+            agg.fold_merge_add(acc, s, inputs[s])
+        merged = agg.fold_merge_finish(acc)
+        assert np.array_equal(
+            np.asarray(merged["extras"]["gram"]),
+            np.asarray(ref["extras"]["gram"]),
+        ), f"arrival order {order} moved the merged Gram bits"
+        ms = merged["merge_stats"]
+        assert ms == {"cross_blocks": 6, "transforms": 0}, ms
+
+
+# ---------------------------------------------------------------------------
+# epoch revalidation: pipelined staging across a settle
+# ---------------------------------------------------------------------------
+
+
+def test_stale_staged_duplicate_revalidates_and_never_double_folds():
+    """Round N+1's frame staged while round N pends, claiming pairs
+    round N then folds: promotion revalidates the stale-epoch verdict,
+    flips the rows to duplicates (``dedup_restaged``), stands the
+    staged accumulator down, and the fold table never sees a pair
+    twice."""
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    co, parts = _drained_partials(CoordinateWiseTrimmedMean(f=1), 1, seed=5)
+    (p0,) = parts
+    chk0 = co.check_partial(TENANT, p0, inflight=True)
+    assert chk0[0] and co.stage_partial(TENANT, p0, chk0)
+    # round 1's window, arriving EARLY (while round 0 pends): a frame
+    # re-claiming round 0's exact (client, seq) pairs
+    replay = PartialFold(
+        tenant=p0.tenant, round_id=1, shard=0,
+        rows=p0.rows, clients=p0.clients, seqs=p0.seqs,
+        wal_ids=p0.wal_ids, extras=p0.extras,
+        digest=evidence_digest(np.asarray(p0.rows)),
+        first_arrival_s=p0.first_arrival_s,
+    )
+    chk1 = co.check_partial(TENANT, replay, inflight=True)
+    assert chk1[0] and co.stage_partial(TENANT, replay, chk1)
+    rt = co._roots[TENANT]
+    epoch_before = rt.dedup_epoch
+    # settle round 0: the staged pairs fold, the epoch advances
+    res0 = co.merge_partials(
+        TENANT, [p0], prechecked={id(p0): chk0}
+    )
+    assert res0 is not None and res0[0] == 0
+    assert rt.dedup_epoch == epoch_before + 1
+    assert rt.staged_closes == 1
+    # close round 1: the staged verdict is epoch-stale and WRONG now —
+    # revalidation flips every row to a duplicate, the close holds the
+    # window open (nothing admissible), and nothing folds twice
+    res1 = co.merge_partials(
+        TENANT, [replay], prechecked={id(replay): chk1}
+    )
+    assert res1 is None
+    assert rt.dedup_restaged == 1, "stale verdict must be invalidated"
+    assert rt.staged_closes == 1, "poisoned accumulator must not close"
+    assert co._partials_inflight == 0
+    # the authority is intact: every pair folded exactly once
+    for c, s in zip(p0.clients, p0.seqs, strict=True):
+        assert rt.is_folded(c, s)
+    assert rt.round_id == 1
+
+
+def test_fresh_pairs_staged_across_settle_promote_cleanly():
+    """The benign pipelined case: round N+1's frame carries FRESH
+    pairs, staged while round N pends — after N settles the stale
+    epoch revalidates to the SAME verdict, the entry refreshes, and
+    round N+1 closes off the staged accumulator (no restage)."""
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    co, parts = _drained_partials(CoordinateWiseTrimmedMean(f=1), 1, seed=6)
+    (p0,) = parts
+    chk0 = co.check_partial(TENANT, p0, inflight=True)
+    assert chk0[0] and co.stage_partial(TENANT, p0, chk0)
+    rng = np.random.default_rng(66)
+    rows1 = rng.normal(size=(len(CLIENTS), DIM)).astype(np.float32)
+    nxt = PartialFold(
+        tenant=p0.tenant, round_id=1, shard=0,
+        rows=rows1, clients=p0.clients,
+        seqs=[s + 1 for s in p0.seqs],
+        wal_ids=p0.wal_ids, extras=None,
+        digest=evidence_digest(rows1),
+        first_arrival_s=p0.first_arrival_s,
+    )
+    chk1 = co.check_partial(TENANT, nxt, inflight=True)
+    assert chk1[0] and co.stage_partial(TENANT, nxt, chk1)
+    res0 = co.merge_partials(TENANT, [p0], prechecked={id(p0): chk0})
+    assert res0 is not None
+    res1 = co.merge_partials(
+        TENANT, [nxt], prechecked={id(nxt): chk1}
+    )
+    assert res1 is not None and res1[0] == 1
+    rt = co._roots[TENANT]
+    assert rt.dedup_restaged == 0
+    assert rt.dedup_promoted == 2
+    assert rt.staged_closes == 2, "fresh-pair staging must survive settles"
+    assert co._partials_inflight == 0
+
+
+def test_duplicate_resubmission_acked_while_round_pends():
+    """A client re-sending ``(client, seq)`` into the next window
+    while the pair's round is staged-but-unsettled: the shard acks
+    ``duplicate`` (exactly-once to the client) and neither the shard
+    queue nor the root staging table grows."""
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    co, parts = _drained_partials(CoordinateWiseTrimmedMean(f=1), 2, seed=8)
+    for p in parts:
+        chk = co.check_partial(TENANT, p, inflight=True)
+        assert chk[0] and co.stage_partial(TENANT, p, chk)
+    rt = co._roots[TENANT]
+    staged_before = rt.dedup_staged
+    c = CLIENTS[0]
+    ok, reason = co.submit(
+        TENANT, c, 1, np.ones(DIM, np.float32), seq=0
+    )
+    assert (ok, reason) == (True, "duplicate")
+    home = co.shards[shard_for(c, 2)]
+    assert home.frontend.stats()[TENANT]["queue_depth"] == 0
+    assert rt.dedup_staged == staged_before
+    assert not rt.is_folded(c, 0), "ack must not touch the fold table"
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL drill: staged-but-unsettled state is volatile by design
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_mid_stage_rebuilds_from_wal_exactly_once():
+    """Shard dies AFTER its round-1 frame was checked + staged but
+    BEFORE the round settled (no WAL round record): recovery replays
+    the accepts as pending, the stale staging entries are discarded
+    (fresh partial ids → classic rebuild), the rows fold exactly once,
+    and the cross-WAL audit is clean."""
+    from byzpy_tpu.aggregators import CoordinateWiseTrimmedMean
+
+    grads = _grads(44)
+    with tempfile.TemporaryDirectory() as tmp:
+        co = ShardedCoordinator(
+            _tenants(CoordinateWiseTrimmedMean(f=1)), 2, quorum=1,
+            durability=DurabilityConfig(directory=tmp),
+        )
+        for c, g in grads.items():
+            ok, _ = co.submit(TENANT, c, 0, g, seq=0)
+            assert ok
+        parts = [co.shards[s].close_partial(TENANT) for s in range(2)]
+        res0 = _staged_close(co, parts)
+        assert res0 is not None
+        # round 1: both shards drain + check + stage, then shard 1 is
+        # SIGKILLed before the settle
+        for c, g in grads.items():
+            ok, _ = co.submit(TENANT, c, 1, g, seq=1)
+            assert ok
+        parts1 = [co.shards[s].close_partial(TENANT) for s in range(2)]
+        for p in parts1:
+            chk = co.check_partial(TENANT, p, inflight=True)
+            assert chk[0] and co.stage_partial(TENANT, p, chk)
+        rt = co._roots[TENANT]
+        assert 1 in rt.staging and len(rt.staging[1]["entries"]) == 2
+        co.kill_shard(1)
+        # the frames' inflight slots are consumed by NO close (the
+        # round never settles as staged) — release them as the async
+        # straggler path would
+        co._dec_inflight(2)
+        shard1 = co.recover_shard(1)
+        own = [c for c in CLIENTS if shard_for(c, 2) == 1]
+        assert shard1.frontend.stats()[TENANT]["queue_depth"] == len(own)
+        # next close: shard 0's replayed + shard 1's recovered rows
+        # fold exactly once through the CLASSIC path (the stale staged
+        # entries reference dead partial objects and must not match)
+        co.shards[0].requeue(TENANT, 1)
+        parts1b = [co.shards[s].close_partial(TENANT) for s in range(2)]
+        assert all(p is not None for p in parts1b)
+        prechecked = {}
+        for p in parts1b:
+            chk = co.check_partial(TENANT, p, inflight=True)
+            prechecked[id(p)] = chk
+            # staging is REFUSED: the dead frames' stale entries still
+            # claim these shards, so the accumulator fast path stands
+            # down and the close rebuilds classically
+            assert co.stage_partial(TENANT, p, chk) is False
+        res1 = co.merge_partials(TENANT, parts1b, prechecked=prechecked)
+        assert res1 is not None and res1[0] == 1
+        assert res1[1].shape[0] == len(CLIENTS)
+        assert rt.dedup_restaged == 0
+        assert not rt.staging, "settled rounds must prune their staging"
+        audit = audit_sharded_exactly_once(tmp, TENANT, 2)
+        assert audit["violations"] == []
+        # accepted-then-lost is impossible: every accept is folded,
+        # dropped-with-accounting, or pending — and both rounds' rows
+        # folded exactly once
+        assert audit["folded"] == 2 * len(CLIENTS)
