@@ -62,6 +62,13 @@ Four lanes, each emitting JSON rows (stdout + ``--out`` JSONL):
   per-aggregator × attack precision-floor table (Byzantine tolerance
   over wire-quantization error, int8 → fp8 → fp8_e5m2 → s4).
 
+* ``sanitize`` — the runtime invariant sanitizer
+  (``byzpy_tpu.analysis.sanitize``, ISSUE 20) as a pure observer: one
+  serving-engine cell runs hooks-off then hooks-on; the sanitized run
+  must record zero violations, exercise the exactly-once fold audit
+  (nonzero counters), and keep the event-trace digest bit-identical
+  to the unsanitized twin.
+
 ``--smoke`` shrinks everything for CI and asserts the contracts (zero
 harness-crashed cells, cell replay determinism, swarm liveness, zero
 recovery-invariant violations). ``--lanes`` selects a subset (e.g.
@@ -1386,6 +1393,58 @@ def _subint8_floor_rows(args, out) -> list:
     return rows
 
 
+def run_sanitize(args, out) -> dict:
+    """Runtime-sanitizer lane (ISSUE 20): one serving-engine cell runs
+    twice — ``byzpy_tpu.analysis.sanitize`` hooks off, then on — and
+    the sanitized run must (a) record ZERO invariant violations, (b)
+    actually exercise the exactly-once fold audit (nonzero counters —
+    a leg that never audited proves nothing), and (c) leave the
+    event-trace digest and final error bit-identical to the
+    unsanitized twin: the sanitizer is a pure observer, like the
+    forensics plane before it."""
+    from byzpy_tpu.analysis import sanitize
+
+    cell = Scenario(
+        name="sanitize-parity",
+        seed=args.seed,
+        n_clients=args.clients_grid,
+        n_byzantine=args.byzantine,
+        dim=args.dim,
+        rounds=args.rounds,
+        aggregator="trimmed_mean",
+        aggregator_params={"f": 3},
+        attack=AttackSpec(name="influence_ascent"),
+        engine="serving",
+    )
+    plain = ChaosHarness(cell).run()
+    was_enabled = sanitize.enabled()
+    sanitize.enable()
+    sanitize.reset()
+    try:
+        sanitized = ChaosHarness(cell).run()
+        violations = sanitize.violations()
+        counters = sanitize.counters()
+    finally:
+        if not was_enabled:
+            sanitize.disable()
+        sanitize.reset()
+    row = {
+        "lane": "sanitize",
+        "engine": cell.engine,
+        "rounds": sanitized.rounds_completed,
+        "digest_parity": (
+            sanitized.trace.digest() == plain.trace.digest()
+            and sanitized.final_error == plain.final_error
+        ),
+        "violations": violations,
+        "folds_audited": counters["folds_audited"],
+        "loop_ticks": counters["loop_ticks"],
+        "drain_checks": counters["drain_checks"],
+    }
+    _emit(row, out)
+    return row
+
+
 def run_subint8(args, out) -> dict:
     """Adversarial-residual lane (ISSUE 15): the residual-shaping
     attacker — an encoder-controlling client steering its own sub-int8
@@ -1539,7 +1598,7 @@ def main() -> None:
         "--lanes", type=str,
         default=(
             "grid,adaptive,serving,swarm,recovery,forensics,ragged,shard,"
-            "speculative,subint8"
+            "speculative,subint8,sanitize"
         ),
         help="comma-separated lane subset",
     )
@@ -1591,6 +1650,7 @@ def main() -> None:
         run_speculative(args, args.out) if "speculative" in lanes else None
     )
     subint8 = run_subint8(args, args.out) if "subint8" in lanes else None
+    sanitized = run_sanitize(args, args.out) if "sanitize" in lanes else None
 
     crashed = [r for r in grid if r.get("harness_crashed")]
     headline = {
@@ -1640,6 +1700,9 @@ def main() -> None:
         ),
         "subint8_floor_by_aggregator": (
             subint8["floor_by_aggregator"] if subint8 else None
+        ),
+        "sanitize_digest_parity": (
+            sanitized["digest_parity"] if sanitized else None
         ),
     }
     _emit(headline, args.out)
@@ -1710,6 +1773,12 @@ def main() -> None:
         assert subint8["residual_shaping_fired"], subint8
         assert subint8["fp_within_bound"], subint8
         assert subint8["int8_floor_clean"], subint8
+    if args.smoke and sanitized is not None:
+        # the sanitizer is a pure observer with teeth: bit-identical
+        # digests, zero violations, and the audits really ran
+        assert sanitized["digest_parity"], sanitized
+        assert sanitized["violations"] == [], sanitized
+        assert sanitized["folds_audited"] > 0, sanitized
     if args.smoke and forensics is not None:
         assert forensics["adaptive_all_flagged"], forensics
         assert forensics["adaptive_within_budget"], forensics

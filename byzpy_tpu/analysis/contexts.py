@@ -1,0 +1,270 @@
+"""Execution-context classification for byzlint's concurrency rules.
+
+PR 19's staging race survived review because nothing *named* the fact
+that ``_finish`` settles the fold table on the event loop while proxy
+reader threads write it concurrently. This module recovers that fact
+statically: a per-module call graph labels every function with the
+execution contexts its body can run under —
+
+* ``event-loop`` — ``async def`` bodies and loop callbacks registered
+  via ``add_reader`` / ``call_soon`` / ``call_later``: everything here
+  shares one asyncio loop.
+* ``thread`` — ``threading.Thread(target=...)`` targets: a dedicated
+  OS thread.
+* ``executor`` — ``loop.run_in_executor`` / ``pool.submit`` targets:
+  some worker thread from a pool.
+* ``traced`` — jit / shard_map / pmap / pallas bodies (reusing the
+  discovery in :mod:`.astutils`).
+
+Labels propagate transitively to *sync* callees resolvable within the
+module (bare names to unique local defs, ``self.method`` within the
+enclosing class) — a helper called from both an async method and a
+reader-thread target carries both labels, which is exactly the fact
+``THREAD-SHARED`` needs. Resolution is deliberately conservative:
+ambiguous names get no edge, unresolved targets get no seed, and an
+unlabeled function produces no findings — precision over completeness,
+like every other byzlint pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutils import FunctionNode, last_component, qualname, traced_functions
+from .core import ModuleInfo
+
+EVENT_LOOP = "event-loop"
+THREAD = "thread"
+EXECUTOR = "executor"
+TRACED = "traced"
+
+#: the labels that mean "concurrent with the others" — two of these on
+#: one attribute's writers is a data race unless a common guard exists
+CONCURRENT_LABELS = frozenset({EVENT_LOOP, THREAD, EXECUTOR})
+
+#: loop-callback registrars → positional index of the callback argument
+LOOP_CALLBACK_ARG: Dict[str, int] = {
+    "add_reader": 1,
+    "add_writer": 1,
+    "call_soon": 0,
+    "call_soon_threadsafe": 0,
+    "call_later": 1,
+    "call_at": 1,
+}
+
+#: receiver-name hints for ``.submit()`` worker pools (kept narrow so an
+#: unrelated ``.submit`` method never seeds a context)
+SUBMIT_RECEIVER_HINTS = ("pool", "executor", "exec", "workers")
+
+
+@dataclass
+class FnInfo:
+    """One function definition plus its classification."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    name: str
+    #: nearest enclosing class through the def-nesting chain (what
+    #: ``self`` binds to inside this body), or ``None`` at module level
+    class_name: Optional[str]
+    labels: Set[str] = field(default_factory=set)
+    #: id(FnInfo.node) of statically-resolved same-module callees
+    callees: Set[int] = field(default_factory=set)
+
+
+@dataclass
+class ContextMap:
+    """Per-module function→context classification (pass-0 artifact)."""
+
+    #: id(function node) → its info record
+    fns: Dict[int, FnInfo] = field(default_factory=dict)
+    #: id(any AST node) → the FnInfo owning it (nearest enclosing def,
+    #: nested-def subtrees belong to the nested def)
+    owner: Dict[int, FnInfo] = field(default_factory=dict)
+
+    def labels_of(self, node: ast.AST) -> Set[str]:
+        """Context labels of a function node (empty when unknown)."""
+        info = self.fns.get(id(node))
+        return set(info.labels) if info is not None else set()
+
+    def owner_of(self, node: ast.AST) -> Optional[FnInfo]:
+        """The function whose body directly contains ``node``."""
+        return self.owner.get(id(node))
+
+
+def receiver_text(expr: ast.AST) -> str:
+    """Lower-cased dotted text of an attribute-chain receiver — good
+    enough for hint matching (``self._finish_pool`` → ``self._finish_pool``,
+    a call link contributes its callee text)."""
+    parts: List[str] = []
+    cur = expr
+    while True:
+        if isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        elif isinstance(cur, ast.Call):
+            cur = cur.func
+        elif isinstance(cur, ast.Subscript):
+            cur = cur.value
+        elif isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            break
+        else:
+            break
+    return ".".join(reversed(parts)).lower()
+
+
+def _unwrap_callable(expr: ast.AST) -> ast.AST:
+    """Strip ``partial(f, ...)`` / ``carry_context(f)``-style wrappers
+    down to the wrapped callable expression."""
+    while isinstance(expr, ast.Call) and expr.args:
+        expr = expr.args[0]
+    return expr
+
+
+def build_context_map(mod: ModuleInfo) -> ContextMap:
+    """Classify every function in ``mod`` (see module docstring)."""
+    cmap = ContextMap()
+    by_name: Dict[str, List[FnInfo]] = {}
+    by_method: Dict[Tuple[str, str], List[FnInfo]] = {}
+
+    def collect(body, class_name: Optional[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, FunctionNode):
+                info = FnInfo(stmt, stmt.name, class_name)
+                cmap.fns[id(stmt)] = info
+                if class_name is None:
+                    by_name.setdefault(stmt.name, []).append(info)
+                else:
+                    by_method.setdefault(
+                        (class_name, stmt.name), []
+                    ).append(info)
+                # a nested def's `self` still binds to the method's class
+                collect(stmt.body, class_name)
+            elif isinstance(stmt, ast.ClassDef):
+                collect(stmt.body, stmt.name)
+            else:
+                for node in ast.walk(stmt):
+                    if isinstance(node, FunctionNode):
+                        # defs hiding inside compound statements (an
+                        # `if:` guard, a `with:` block) — same scoping
+                        info = FnInfo(node, node.name, class_name)
+                        if id(node) not in cmap.fns:
+                            cmap.fns[id(node)] = info
+                            collect(node.body, class_name)
+
+    collect(mod.tree.body, None)
+
+    # ownership: every node belongs to its nearest enclosing def. A
+    # nested def's lineno is strictly greater than its encloser's, so
+    # walking defs in source order and overwriting lets the innermost
+    # claim on each subtree win.
+    ordered = sorted(
+        cmap.fns.values(), key=lambda i: getattr(i.node, "lineno", 0)
+    )
+    for info in ordered:
+        for node in ast.walk(info.node):
+            if node is not info.node and id(node) not in cmap.fns:
+                cmap.owner[id(node)] = info
+
+    def resolve(expr: ast.AST, site: Optional[FnInfo]) -> Optional[FnInfo]:
+        """Unique in-module resolution of a callable expression."""
+        expr = _unwrap_callable(expr)
+        if isinstance(expr, ast.Name):
+            cands = by_name.get(expr.id, [])
+            return cands[0] if len(cands) == 1 else None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")
+            and site is not None
+            and site.class_name is not None
+        ):
+            cands = by_method.get((site.class_name, expr.attr), [])
+            return cands[0] if len(cands) == 1 else None
+        return None
+
+    # --- seeds -----------------------------------------------------------
+    for info in cmap.fns.values():
+        if isinstance(info.node, ast.AsyncFunctionDef):
+            info.labels.add(EVENT_LOOP)
+    for traced in traced_functions(mod.tree, mod.imports):
+        info = cmap.fns.get(id(traced.node))
+        if info is not None:
+            info.labels.add(TRACED)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        site = cmap.owner.get(id(node))
+        func = node.func
+        if last_component(qualname(func, mod.imports)) == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = resolve(kw.value, site)
+                    if target is not None:
+                        target.labels.add(THREAD)
+        if not isinstance(func, ast.Attribute):
+            continue
+        attr = func.attr
+        if attr == "run_in_executor" and len(node.args) >= 2:
+            target = resolve(node.args[1], site)
+            if target is not None:
+                target.labels.add(EXECUTOR)
+        elif attr == "submit" and node.args:
+            recv = receiver_text(func.value)
+            if any(h in recv for h in SUBMIT_RECEIVER_HINTS):
+                target = resolve(node.args[0], site)
+                if target is not None:
+                    target.labels.add(EXECUTOR)
+        elif attr in LOOP_CALLBACK_ARG:
+            pos = LOOP_CALLBACK_ARG[attr]
+            if len(node.args) > pos:
+                target = resolve(node.args[pos], site)
+                if target is not None:
+                    target.labels.add(EVENT_LOOP)
+
+    # --- call-graph edges -------------------------------------------------
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        site = cmap.owner.get(id(node))
+        if site is None:
+            continue
+        callee = resolve(node.func, site)
+        if callee is not None and callee is not site:
+            site.callees.add(id(callee.node))
+
+    # --- transitive propagation (sync callees inherit concurrency) -------
+    changed = True
+    while changed:
+        changed = False
+        for info in cmap.fns.values():
+            carry = info.labels & CONCURRENT_LABELS
+            if not carry:
+                continue
+            for cid in info.callees:
+                callee = cmap.fns[cid]
+                if isinstance(callee.node, ast.AsyncFunctionDef):
+                    continue  # scheduling, not a sync call-through
+                before = len(callee.labels)
+                callee.labels |= carry
+                if len(callee.labels) != before:
+                    changed = True
+    return cmap
+
+
+__all__ = [
+    "CONCURRENT_LABELS",
+    "ContextMap",
+    "EVENT_LOOP",
+    "EXECUTOR",
+    "FnInfo",
+    "LOOP_CALLBACK_ARG",
+    "SUBMIT_RECEIVER_HINTS",
+    "THREAD",
+    "TRACED",
+    "build_context_map",
+    "receiver_text",
+]

@@ -24,6 +24,20 @@ incident behind each one):
 * ``PYTREE-REG`` — an instance of a scanned-tree class passed into a
   collective without pytree registration (jax would treat it as a leaf
   and fail — or silently close over it as a constant).
+* ``THREAD-SHARED`` — a ``self.*`` attribute written from two distinct
+  execution contexts (event loop / reader thread / executor, per the
+  :mod:`.contexts` classifier) with no common lock guard: the PR 19
+  arrival-time staging race, as a rule.
+* ``ACK-ORDER`` — in a function that both appends to a durability/WAL
+  object and sends on a writer, every path must append *before* it
+  sends: an ack is a durable promise (the PR 9 double-fold replay).
+* ``PARITY-PURITY`` — functions reachable from the digest-parity set
+  (``_agg_digest``, ``fold_merge_*``, ``combine_partials``,
+  ``gram_block``, trace digests) must not call clocks/RNG or iterate
+  bare sets into folded bytes (the PR 7 np.mean digest drift, class of).
+* ``METRIC-CONTRACT`` — every metric registration and span label must
+  appear, with a matching type, in ``byzpy_tpu/observability/catalog.py``
+  (single source of truth; the docs tables are checked against it).
 
 Rules are deliberately *precise over complete*: each stays silent when
 static resolution fails rather than guessing, so a finding is worth
@@ -49,7 +63,15 @@ from .astutils import (
     traced_functions,
     _local_defs,
 )
+from .contexts import (
+    CONCURRENT_LABELS,
+    ContextMap,
+    FnInfo,
+    build_context_map,
+    receiver_text,
+)
 from .core import Finding, ModuleInfo
+from ..observability import catalog
 
 TRACE_DISPATCH = "TRACE-DISPATCH"
 DONATION = "DONATION"
@@ -57,6 +79,10 @@ AXIS_BINDING = "AXIS-BINDING"
 HOST_SYNC = "HOST-SYNC"
 ASYNC_BLOCKING = "ASYNC-BLOCKING"
 PYTREE_REG = "PYTREE-REG"
+THREAD_SHARED = "THREAD-SHARED"
+ACK_ORDER = "ACK-ORDER"
+PARITY_PURITY = "PARITY-PURITY"
+METRIC_CONTRACT = "METRIC-CONTRACT"
 
 #: collective name → positional index of the axis-name argument
 COLLECTIVE_AXIS_ARG: Dict[str, int] = {
@@ -115,6 +141,9 @@ class ScanContext:
     ``PYTREE-REG`` needs the whole scanned tree: a class is defined in
     one module (``QuantizedBlocks`` in ``parallel/quantization.py``) and
     flowed through a collective in another (``parallel/collectives.py``).
+    The concurrency rules (``THREAD-SHARED`` / ``PARITY-PURITY``) share
+    one execution-context classification per module, built here so the
+    per-module call graph is computed once, not once per rule.
     """
 
     #: every class name defined anywhere in the scanned tree
@@ -122,6 +151,8 @@ class ScanContext:
     #: subset registered as pytrees (decorator, registration call,
     #: NamedTuple base, or flax.struct dataclass)
     registered_pytrees: Set[str] = field(default_factory=set)
+    #: module relpath → execution-context classification (contexts.py)
+    contexts: Dict[str, ContextMap] = field(default_factory=dict)
 
     @staticmethod
     def build(modules: Sequence[ModuleInfo]) -> "ScanContext":
@@ -161,6 +192,8 @@ class ScanContext:
                         and isinstance(node.args[0], ast.Name)
                     ):
                         ctx.registered_pytrees.add(node.args[0].id)
+        for mod in modules:
+            ctx.contexts[mod.relpath] = build_context_map(mod)
         return ctx
 
 
@@ -929,6 +962,569 @@ class PytreeRegRule(Rule):
         return None
 
 
+# ---------------------------------------------------------------------------
+# THREAD-SHARED
+# ---------------------------------------------------------------------------
+
+#: receiver-name hints that make a ``with`` context manager count as a
+#: lock guard (identity = the full dotted receiver text)
+LOCK_NAME_HINTS = ("lock", "mutex", "sem")
+
+#: methods that run before the object is published to other contexts
+CONSTRUCTOR_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _self_root_attr(expr: ast.AST) -> Optional[str]:
+    """The attribute directly on ``self`` at the root of a store target
+    (``self.a`` / ``self.a[k]`` / ``self.a.b`` all root at ``a``) —
+    container/field mutation counts as writing the root attribute."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    if isinstance(expr, (ast.Attribute, ast.Subscript)):
+        return _self_root_attr(expr.value)
+    return None
+
+
+def _lock_guard_name(expr: ast.AST) -> Optional[str]:
+    """Guard identity of a ``with`` item when it looks like a lock."""
+    text = receiver_text(expr)
+    if any(h in text for h in LOCK_NAME_HINTS):
+        return text
+    return None
+
+
+class ThreadSharedRule(Rule):
+    """Cross-context ``self.*`` writes need a common lock guard."""
+
+    id = THREAD_SHARED
+    summary = (
+        "a self.* attribute written from two execution contexts (event "
+        "loop / reader thread / executor) needs a common lock guard"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: ScanContext) -> Iterator[Finding]:
+        """Group ``self.*`` stores per class/attribute with the writing
+        method's context labels (per the :mod:`.contexts` classifier) and
+        the lock guards lexically held at the store. Flag attributes
+        written from ≥2 distinct concurrent contexts when no single lock
+        covers every write."""
+        cmap = ctx.contexts.get(mod.relpath)
+        if cmap is None:
+            return
+        # class → attr → [(anchor, labels, guards)]
+        writes: Dict[str, Dict[str, List[Tuple[ast.AST, Set[str], Set[str]]]]]
+        writes = {}
+        for info in cmap.fns.values():
+            if info.class_name is None or info.name in CONSTRUCTOR_METHODS:
+                continue
+            labels = info.labels & CONCURRENT_LABELS
+            if not labels:
+                continue
+            for attr, anchor, guards in self._stores(info.node):
+                writes.setdefault(info.class_name, {}).setdefault(
+                    attr, []
+                ).append((anchor, labels, guards))
+        for cls in sorted(writes):
+            for attr, sites in sorted(writes[cls].items()):
+                contexts: Set[str] = set()
+                for _, labels, _ in sites:
+                    contexts |= labels
+                if len(contexts) < 2:
+                    continue
+                common = set(sites[0][2])
+                for _, _, guards in sites[1:]:
+                    common &= guards
+                if common:
+                    continue
+                anchor = min(
+                    (a for a, _, _ in sites),
+                    key=lambda n: (n.lineno, n.col_offset),
+                )
+                ctx_desc = "/".join(sorted(contexts))
+                yield self.finding(
+                    mod,
+                    anchor,
+                    f"{cls}.{attr} is written from {ctx_desc} contexts "
+                    "with no common lock — serialize every write under "
+                    "one `with self.<lock>:`, or confine mutation to a "
+                    "single context via an epoch-stamped handoff (the "
+                    "PR 19 staging split)",
+                )
+
+    @staticmethod
+    def _stores(
+        fn: ast.AST,
+    ) -> Iterator[Tuple[str, ast.AST, Set[str]]]:
+        """``(attr, anchor, lock-guards-held)`` for every ``self.*``
+        store lexically in ``fn``'s own body (nested defs are their own
+        functions and classified separately)."""
+
+        def targets_of(stmt: ast.stmt) -> List[ast.AST]:
+            if isinstance(stmt, ast.Assign):
+                return list(stmt.targets)
+            if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                return [stmt.target]
+            if isinstance(stmt, ast.Delete):
+                return list(stmt.targets)
+            return []
+
+        def scan(
+            stmts: Sequence[ast.stmt], guards: Set[str]
+        ) -> Iterator[Tuple[str, ast.AST, Set[str]]]:
+            for stmt in stmts:
+                if isinstance(stmt, FunctionNode):
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    held = set(guards)
+                    for item in stmt.items:
+                        g = _lock_guard_name(item.context_expr)
+                        if g is not None:
+                            held.add(g)
+                    yield from scan(stmt.body, held)
+                    continue
+                for tgt in targets_of(stmt):
+                    attr = _self_root_attr(tgt)
+                    if attr is not None:
+                        yield attr, tgt, set(guards)
+                for sub, _ in _sub_blocks(stmt):
+                    yield from scan(sub, guards)
+
+        yield from scan(getattr(fn, "body", []), set())
+
+
+# ---------------------------------------------------------------------------
+# ACK-ORDER
+# ---------------------------------------------------------------------------
+
+#: writer-ish method names that emit an ack/reply toward a client
+SEND_ATTRS = {"write", "sendall", "send", "send_bytes"}
+SEND_RECEIVER_HINTS = (
+    "writer", "sock", "conn", "transport", "stream", "wfile", "chan",
+)
+#: durability-object hints: appends on these are WAL records
+WAL_RECEIVER_HINTS = ("durability", "wal", "journal")
+
+
+def _ackish_name(name: str) -> bool:
+    """Callable names that mean "emit the ack" (kept to word matches so
+    ``pack``/``callback``/``track`` never count)."""
+    low = name.lower()
+    return (
+        low == "ack"
+        or low.endswith("_ack")
+        or low.startswith("ack_")
+        or "send_ack" in low
+    )
+
+
+class AckOrderRule(Rule):
+    """The WAL append must dominate the ack on every path."""
+
+    id = ACK_ORDER
+    summary = (
+        "in a function that both appends to a durability/WAL object and "
+        "sends on a writer, the append must come before the send on "
+        "every path — an ack is a durable promise"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: ScanContext) -> Iterator[Finding]:
+        """Flow-sensitive single pass per function: track "a send has
+        happened on this path" through branches (union on merge, return/
+        raise kills the path) and flag any WAL append reached with a
+        send already behind it. Runs only on functions containing both
+        event kinds — everything else is out of contract."""
+        for node in ast.walk(mod.tree):
+            if isinstance(node, FunctionNode):
+                yield from self._check_fn(mod, node)
+
+    def _check_fn(
+        self, mod: ModuleInfo, fn: ast.AST
+    ) -> Iterator[Finding]:
+        kinds = {
+            self._event_kind(n, mod)
+            for n in self._own_nodes(fn)
+            if isinstance(n, ast.Call)
+        }
+        if not ({"send", "append"} <= kinds):
+            return
+        out: List[Finding] = []
+        self._flow(mod, fn.body, False, out)
+        yield from out
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+        """Nodes in ``fn``'s own scope (nested def subtrees excluded)."""
+        skip: Set[int] = set()
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(node, (*FunctionNode, ast.Lambda)):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        for node in ast.walk(fn):
+            if id(node) not in skip:
+                yield node
+
+    def _event_kind(self, call: ast.Call, mod: ModuleInfo) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            recv = receiver_text(func.value)
+            if func.attr in SEND_ATTRS and any(
+                h in recv for h in SEND_RECEIVER_HINTS
+            ):
+                return "send"
+            if (
+                func.attr.startswith("record_") or func.attr == "append"
+            ) and any(h in recv for h in WAL_RECEIVER_HINTS):
+                return "append"
+            if _ackish_name(func.attr):
+                return "send"
+        elif isinstance(func, ast.Name) and _ackish_name(func.id):
+            return "send"
+        return None
+
+    def _header_events(
+        self,
+        mod: ModuleInfo,
+        stmt: ast.stmt,
+        sent: bool,
+        out: List[Finding],
+    ) -> bool:
+        """Process the events of one statement's own expressions (its
+        sub-blocks and nested defs excluded), in source order."""
+        skip: Set[int] = set()
+        for blk, _ in _sub_blocks(stmt):
+            for s in blk:
+                for n in ast.walk(s):
+                    skip.add(id(n))
+        for n in ast.walk(stmt):
+            if isinstance(n, (*FunctionNode, ast.Lambda)):
+                for sub in ast.walk(n):
+                    skip.add(id(sub))
+        events: List[Tuple[int, int, str, ast.Call]] = []
+        for n in ast.walk(stmt):
+            if id(n) in skip or not isinstance(n, ast.Call):
+                continue
+            kind = self._event_kind(n, mod)
+            if kind is not None:
+                events.append((n.lineno, n.col_offset, kind, n))
+        for _, _, kind, n in sorted(events, key=lambda e: (e[0], e[1])):
+            if kind == "send":
+                sent = True
+            elif sent:
+                out.append(
+                    self.finding(
+                        mod,
+                        n,
+                        "durable append reached with an ack/send already "
+                        "emitted on this path — the WAL append must "
+                        "dominate the ack (a crash between them replays "
+                        "an un-promised submission: the PR 9 double-fold "
+                        "incident)",
+                    )
+                )
+        return sent
+
+    def _flow(
+        self,
+        mod: ModuleInfo,
+        stmts: Sequence[ast.stmt],
+        sent: bool,
+        out: List[Finding],
+    ) -> Tuple[bool, bool]:
+        """Returns ``(sent_at_exit, path_alive)``."""
+        alive = True
+        for stmt in stmts:
+            if isinstance(stmt, FunctionNode):
+                continue
+            sent = self._header_events(mod, stmt, sent, out)
+            if isinstance(
+                stmt, (ast.Return, ast.Raise, ast.Break, ast.Continue)
+            ):
+                return sent, False
+            if isinstance(stmt, ast.If):
+                s_a, a_a = self._flow(mod, stmt.body, sent, out)
+                s_b, a_b = self._flow(mod, stmt.orelse, sent, out)
+                alive = a_a or a_b
+                sent = (a_a and s_a) or (a_b and s_b)
+                if not alive:
+                    return sent, False
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                s_body, _ = self._flow(mod, stmt.body, sent, out)
+                # zero-iteration exit is always possible; break/return
+                # subtleties are deliberately ignored (one pass, no
+                # loop-carry — precision over completeness)
+                sent = sent or s_body
+                s_else, a_else = self._flow(mod, stmt.orelse, sent, out)
+                if stmt.orelse and a_else:
+                    sent = s_else
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                sent, alive = self._flow(mod, stmt.body, sent, out)
+                if not alive:
+                    return sent, False
+            elif isinstance(stmt, ast.Try):
+                s_body, a_body = self._flow(mod, stmt.body, sent, out)
+                exits: List[bool] = []
+                if a_body:
+                    if stmt.orelse:
+                        s_else, a_else = self._flow(
+                            mod, stmt.orelse, s_body, out
+                        )
+                        if a_else:
+                            exits.append(s_else)
+                    else:
+                        exits.append(s_body)
+                for handler in stmt.handlers:
+                    # an exception can fire before any send in the body:
+                    # handlers start from the entry state
+                    s_h, a_h = self._flow(mod, handler.body, sent, out)
+                    if a_h:
+                        exits.append(s_h)
+                alive = bool(exits)
+                sent = any(exits)
+                s_fin, a_fin = self._flow(mod, stmt.finalbody, sent, out)
+                if stmt.finalbody:
+                    sent, alive = s_fin, alive and a_fin
+                if not alive:
+                    return sent, False
+            elif isinstance(stmt, ast.Match):
+                exits = []
+                for case in stmt.cases:
+                    s_c, a_c = self._flow(mod, case.body, sent, out)
+                    if a_c:
+                        exits.append(s_c)
+                # no exhaustiveness check: fall-through keeps entry state
+                sent = sent or any(exits)
+        return sent, alive
+
+
+# ---------------------------------------------------------------------------
+# PARITY-PURITY
+# ---------------------------------------------------------------------------
+
+#: functions on the digest-parity contract by exact name
+PARITY_ROOT_NAMES = {"combine_partials", "gram_block"}
+
+#: nondeterminism sources by qualified-name prefix
+IMPURE_CALL_PREFIXES = (
+    "time.",
+    "random.",
+    "numpy.random.",
+    "secrets.",
+    "uuid.",
+)
+IMPURE_CALL_EXACT = {"os.urandom"}
+
+
+def _is_parity_root(name: str) -> bool:
+    """Whether a function name puts it on the digest-parity contract."""
+    return (
+        name in PARITY_ROOT_NAMES
+        or "digest" in name
+        or name.startswith("fold_merge")
+    )
+
+
+class ParityPurityRule(Rule):
+    """No clocks/RNG/set-iteration in digest-parity code."""
+
+    id = PARITY_PURITY
+    summary = (
+        "functions reachable from the digest-parity set (fold_merge_*, "
+        "combine_partials, gram_block, *digest*) must not call clocks/"
+        "RNG or iterate bare sets into folded bytes"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: ScanContext) -> Iterator[Finding]:
+        """Close the module-local call graph over the parity roots, then
+        flag nondeterminism inside every reachable function: clock/RNG
+        calls by qualified name, and ``for``/comprehension iteration
+        over bare set expressions (``sorted(...)`` launders the order)."""
+        cmap = ctx.contexts.get(mod.relpath)
+        if cmap is None:
+            return
+        reach: Dict[int, str] = {}
+        queue: List[FnInfo] = []
+        for info in cmap.fns.values():
+            if _is_parity_root(info.name):
+                reach[id(info.node)] = info.name
+                queue.append(info)
+        while queue:
+            info = queue.pop()
+            for cid in info.callees:
+                if cid not in reach:
+                    reach[cid] = reach[id(info.node)]
+                    queue.append(cmap.fns[cid])
+        for info in sorted(
+            cmap.fns.values(), key=lambda i: getattr(i.node, "lineno", 0)
+        ):
+            root = reach.get(id(info.node))
+            if root is None:
+                continue
+            yield from self._scan_fn(mod, cmap, info, root)
+
+    def _scan_fn(
+        self, mod: ModuleInfo, cmap: ContextMap, info: FnInfo, root: str
+    ) -> Iterator[Finding]:
+        via = "" if root == info.name else f" (parity-reachable from {root!r})"
+        for node in ast.walk(info.node):
+            if node is not info.node and cmap.owner.get(id(node)) is not info:
+                continue  # nested defs are classified on their own
+            if isinstance(node, ast.Call):
+                fq = qualname(node.func, mod.imports)
+                if fq is not None and (
+                    fq in IMPURE_CALL_EXACT
+                    or fq.startswith(IMPURE_CALL_PREFIXES)
+                ):
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"{fq} inside {info.name!r}{via} — digest-parity "
+                        "code must be bit-deterministic; hoist clocks/RNG "
+                        "to the caller (the PR 7 digest-drift class)",
+                    )
+            iters: List[ast.AST] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(
+                node,
+                (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp),
+            ):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                if self._bare_set(it, mod):
+                    yield self.finding(
+                        mod,
+                        it,
+                        f"iterating a bare set inside {info.name!r}{via} — "
+                        "set order is nondeterministic across processes; "
+                        "wrap it in sorted(...) before it reaches folded "
+                        "bytes",
+                    )
+
+    @staticmethod
+    def _bare_set(expr: ast.AST, mod: ModuleInfo) -> bool:
+        if isinstance(expr, ast.Set) or isinstance(expr, ast.SetComp):
+            return True
+        if isinstance(expr, ast.Call):
+            return last_component(qualname(expr.func, mod.imports)) in (
+                "set",
+                "frozenset",
+            )
+        return False
+
+
+# ---------------------------------------------------------------------------
+# METRIC-CONTRACT
+# ---------------------------------------------------------------------------
+
+#: MetricsRegistry factory method names → instrument type
+METRIC_FACTORY_ATTRS = {"counter", "gauge", "histogram"}
+#: receiver hints for registry objects (``reg``, ``registry()``,
+#: ``self._metrics``) — an unrelated ``.counter()`` never matches
+METRIC_RECEIVER_HINTS = ("reg", "metric")
+#: tracing entry points that take a span/instant label
+SPAN_CALL_NAMES = {"span", "device_span", "begin_span", "instant"}
+SPAN_RECEIVER_HINTS = ("tracing", "tracer", "trace")
+
+
+class MetricContractRule(Rule):
+    """Metric and span names must match the observability catalog."""
+
+    id = METRIC_CONTRACT
+    summary = (
+        "every Counter/Gauge/Histogram registration and span() label "
+        "must appear, with a matching type, in "
+        "byzpy_tpu/observability/catalog.py (and the docs tables)"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: ScanContext) -> Iterator[Finding]:
+        """Check the literal first argument of registry factory calls
+        and tracing span/instant calls against the catalog. Computed
+        names stay silent unless a declared dynamic prefix covers them —
+        a new dynamic family must be catalogued as a prefix."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._literal_name(node)
+            if name is None:
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in METRIC_FACTORY_ATTRS
+            ):
+                recv = receiver_text(func.value)
+                if any(h in recv for h in METRIC_RECEIVER_HINTS):
+                    yield from self._check_metric(mod, node, func.attr, name)
+                continue
+            fq = qualname(func, mod.imports) or ""
+            last = last_component(fq) or (
+                func.attr if isinstance(func, ast.Attribute) else ""
+            )
+            if last not in SPAN_CALL_NAMES:
+                continue
+            is_tracing = any(
+                fq.endswith("tracing." + s) for s in SPAN_CALL_NAMES
+            ) or (
+                isinstance(func, ast.Attribute)
+                and any(
+                    h in receiver_text(func.value)
+                    for h in SPAN_RECEIVER_HINTS
+                )
+            )
+            if is_tracing:
+                yield from self._check_span(mod, node, name)
+
+    @staticmethod
+    def _literal_name(call: ast.Call) -> Optional[str]:
+        expr: Optional[ast.AST] = call.args[0] if call.args else None
+        if expr is None:
+            for kw in call.keywords:
+                if kw.arg == "name":
+                    expr = kw.value
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        return None
+
+    def _check_metric(
+        self, mod: ModuleInfo, node: ast.Call, kind: str, name: str
+    ) -> Iterator[Finding]:
+        want = catalog.METRICS.get(name)
+        if want is None:
+            if name.startswith(catalog.METRIC_PREFIXES):
+                return
+            yield self.finding(
+                mod,
+                node,
+                f"metric {name!r} is not in the observability catalog — "
+                "add it to byzpy_tpu/observability/catalog.py and the "
+                "docs/observability.md table",
+            )
+        elif want != kind:
+            yield self.finding(
+                mod,
+                node,
+                f"metric {name!r} registered as a {kind} but catalogued "
+                f"as a {want} — one name, one type",
+            )
+
+    def _check_span(
+        self, mod: ModuleInfo, node: ast.Call, name: str
+    ) -> Iterator[Finding]:
+        if name in catalog.SPANS or name.startswith(catalog.SPAN_PREFIXES):
+            return
+        yield self.finding(
+            mod,
+            node,
+            f"span label {name!r} is not in the observability catalog — "
+            "add it to byzpy_tpu/observability/catalog.py and the "
+            "docs/observability.md span taxonomy",
+        )
+
+
 #: the shipped rule set, in reporting order
 ALL_RULES: Tuple[Rule, ...] = (
     TraceDispatchRule(),
@@ -937,12 +1533,18 @@ ALL_RULES: Tuple[Rule, ...] = (
     HostSyncRule(),
     AsyncBlockingRule(),
     PytreeRegRule(),
+    ThreadSharedRule(),
+    AckOrderRule(),
+    ParityPurityRule(),
+    MetricContractRule(),
 )
 
 __all__ = [
+    "ACK_ORDER",
     "ALL_RULES",
     "ASYNC_BLOCKING",
     "AXIS_BINDING",
+    "AckOrderRule",
     "AsyncBlockingRule",
     "AxisBindingRule",
     "COLLECTIVE_AXIS_ARG",
@@ -950,10 +1552,16 @@ __all__ = [
     "DonationRule",
     "HOST_SYNC",
     "HostSyncRule",
+    "METRIC_CONTRACT",
+    "MetricContractRule",
+    "PARITY_PURITY",
     "PYTREE_REG",
+    "ParityPurityRule",
     "PytreeRegRule",
     "Rule",
     "ScanContext",
+    "THREAD_SHARED",
     "TRACE_DISPATCH",
+    "ThreadSharedRule",
     "TraceDispatchRule",
 ]

@@ -229,28 +229,46 @@ def _np_blockwise_encode(
     return codes, scales, finite
 
 
-def _np_blockwise_decode(
-    codes: np.ndarray, scales: np.ndarray, block: int, shape, dtype, mode: str
-) -> np.ndarray:
-    """Inverse of :func:`_np_blockwise_encode` (lossy)."""
+def _code_values_f32(codes: np.ndarray, mode: str) -> np.ndarray:
+    """Decoded f32 code values BEFORE the per-block scale multiply —
+    the expensive half of a blockwise decode (the fp8 bit-pattern cast
+    alone is ~57 % of that mode's decode; ``ROUND19_NOTES.md``), shared
+    by dequantization and the pre-decode inflation forensics so
+    :func:`decode_with_stats` converts each frame's codes exactly once.
+    Per-frame analogue of :func:`_rows_code_values`."""
     if mode == "int8":
-        return _np_dequantize(codes, scales, block, shape, dtype)
-    nb = scales.size
-    n = 1
-    for s in shape:
-        n *= s
+        return codes.astype(np.float32)
     if mode == "s4":
         nib = np.empty(codes.size * 2, np.uint8)
         nib[0::2] = codes & np.uint8(0xF)
         nib[1::2] = codes >> 4
-        flat = nib.astype(np.float32) - 8.0
-    else:
-        flat = codes.view(_ml_f8_dtype(mode)).astype(np.float32)
-        pad = nb * block - flat.size
-        if pad:
-            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
-    out = (flat.reshape(nb, block) * scales[:, None]).ravel()[:n]
+        return nib.astype(np.float32) - 8.0
+    return codes.view(_ml_f8_dtype(mode)).astype(np.float32)
+
+
+def _dequant_values(
+    values: np.ndarray, scales: np.ndarray, block: int, shape, dtype
+) -> np.ndarray:
+    """The cheap tail of a blockwise decode: pad the f32 code values to
+    whole blocks, apply the per-block scales, trim and reshape."""
+    nb = scales.size
+    n = 1
+    for s in shape:
+        n *= s
+    pad = nb * block - values.size
+    if pad > 0:
+        values = np.concatenate([values, np.zeros(pad, np.float32)])
+    out = (values.reshape(nb, block) * scales[:, None]).ravel()[:n]
     return out.astype(dtype).reshape(shape)
+
+
+def _np_blockwise_decode(
+    codes: np.ndarray, scales: np.ndarray, block: int, shape, dtype, mode: str
+) -> np.ndarray:
+    """Inverse of :func:`_np_blockwise_encode` (lossy)."""
+    return _dequant_values(
+        _code_values_f32(codes, mode), scales, block, shape, dtype
+    )
 
 
 def _np_to_bf16(arr: np.ndarray) -> Tuple[np.ndarray, bool]:
@@ -401,7 +419,9 @@ def decompress_payload(obj: Any) -> Any:
     return _map_payload_leaves(leaf, obj)
 
 
-def frame_inflation(qwa: QuantizedWireArray) -> Optional[float]:
+def frame_inflation(
+    qwa: QuantizedWireArray, *, _values: Optional[np.ndarray] = None
+) -> Optional[float]:
     """PRE-decode per-block inflation ratio of one blockwise frame:
     ``max over nonzero blocks of qmax / max|code|``.
 
@@ -414,22 +434,25 @@ def frame_inflation(qwa: QuantizedWireArray) -> Optional[float]:
     invisible post-decode but shows pre-decode as max|code| well under
     qmax. Computed from the codes alone (no dequantization, no scale
     trust); ``None`` for non-blockwise frames (bf16 carries no scale
-    header to shape). All-zero payloads report 1.0."""
+    header to shape). All-zero payloads report 1.0. ``_values`` lets
+    the fused stats+decode walk hand in the frame's already-converted
+    :func:`_code_values_f32` instead of converting again."""
     if qwa.mode not in BLOCKWISE_WIRE_MODES or qwa.scales is None:
         return None
     qmax = _WIRE_QMAX[qwa.mode]
     block = qwa.block
+    vals = (
+        _values
+        if _values is not None
+        else _code_values_f32(qwa.codes, qwa.mode)
+    )
     if qwa.mode == "s4":
-        nib = np.empty(qwa.codes.size * 2, np.uint8)
-        nib[0::2] = qwa.codes & np.uint8(0xF)
-        nib[1::2] = qwa.codes >> 4
-        # nibble 0 is outside the honest encoder's [-7, 7] codomain;
-        # clamp so a hostile -8 cannot fake EXTRA magnitude
-        mags = np.minimum(np.abs(nib.astype(np.float32) - 8.0), qmax)
+        # nibble 0 decodes to -8, outside the honest encoder's [-7, 7]
+        # codomain; clamp so a hostile -8 cannot fake EXTRA magnitude
+        mags = np.minimum(np.abs(vals), qmax)
     elif qwa.mode == "int8":
-        mags = np.abs(qwa.codes.astype(np.float32))
+        mags = np.abs(vals)
     else:
-        vals = qwa.codes.view(_ml_f8_dtype(qwa.mode)).astype(np.float32)
         mags = np.minimum(np.abs(np.where(np.isfinite(vals), vals, qmax)), qmax)
     n = mags.size
     nb = qwa.scales.size
@@ -467,6 +490,36 @@ def payload_block_stats(obj: Any) -> Optional[dict]:
     if worst is None:
         return None
     return {"max_inflation": worst, "frames": frames}
+
+
+def _decompress_with_stats(raw: Any) -> Tuple[Any, Optional[dict]]:
+    """:func:`payload_block_stats` + :func:`decompress_payload` in ONE
+    pytree walk, with each blockwise frame's codes→f32 conversion done
+    once and shared between the inflation forensics and the
+    dequantization (the per-frame door previously ran it twice under
+    ``decode_with_stats`` — ~57 % of an fp8 decode; byte parity with
+    the two-pass shape is pinned by ``tests/test_quantized_wire.py``)."""
+    worst: Optional[float] = None
+    frames = 0
+
+    def leaf(x: Any) -> Any:
+        nonlocal worst, frames
+        if not isinstance(x, QuantizedWireArray):
+            return x
+        if x.mode == "bf16":
+            return _np_from_bf16(x.codes, x.shape, x.dtype)
+        values = _code_values_f32(x.codes, x.mode)
+        infl = frame_inflation(x, _values=values)
+        if infl is not None:
+            frames += 1
+            worst = infl if worst is None else max(worst, infl)
+        return _dequant_values(values, x.scales, x.block, x.shape, x.dtype)
+
+    obj = _map_payload_leaves(leaf, raw)
+    stats = (
+        None if worst is None else {"max_inflation": worst, "frames": frames}
+    )
+    return obj, stats
 
 
 _MAG_LUT: dict = {}
@@ -723,8 +776,10 @@ def _decode_impl(body: bytes, *, want_stats: bool) -> Tuple[Any, Optional[dict]]
                 "or tampered/unsigned frame"
             )
     raw = cloudpickle.loads(body)
-    stats = payload_block_stats(raw) if want_stats else None
-    obj = decompress_payload(raw)
+    if want_stats:
+        obj, stats = _decompress_with_stats(raw)
+    else:
+        obj, stats = decompress_payload(raw), None
     if type(obj) is dict and TRACE_CTX_KEY in obj:
         ctx = obj.pop(TRACE_CTX_KEY)
         if _obs_runtime.STATE.enabled:

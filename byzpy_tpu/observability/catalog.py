@@ -1,0 +1,131 @@
+"""The observability catalog: every metric and span name, typed.
+
+Single source of truth for the telemetry namespace. The tables in
+``docs/observability.md`` were the original source (this module was
+generated from them once, PR 20); from here on the *catalog* is
+authoritative — the byzlint ``METRIC-CONTRACT`` rule statically checks
+every ``Counter``/``Gauge``/``Histogram`` registration and ``span()``
+label in the tree against it, and ``tests/test_observability_catalog``
+cross-checks the docs tables so prose and code cannot drift.
+
+Adding an instrument is therefore a three-line change: register it at
+the call site, add its name here with its type, and row it into
+``docs/observability.md``. A name missing from any of the three fails
+CI (byzlint exit 1 / docs-parity test).
+
+Pure data, stdlib only — the linter imports this on machines with no
+accelerator runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Tuple
+
+#: metric name → instrument type ("counter" | "gauge" | "histogram").
+#: One name, one type — enforced statically here and at runtime by
+#: :class:`~byzpy_tpu.observability.metrics.MetricsRegistry`.
+METRICS: Dict[str, str] = {
+    "byzpy_anomaly_flags_total": "counter",
+    "byzpy_checkpoint_save_seconds": "histogram",
+    "byzpy_client_excluded_total": "counter",
+    "byzpy_client_quarantines_total": "counter",
+    "byzpy_client_readmits_total": "counter",
+    "byzpy_dedup_restaged_total": "counter",
+    "byzpy_dedup_staged_total": "counter",
+    "byzpy_ingress_batch_size": "histogram",
+    "byzpy_jit_compiles_total": "counter",
+    "byzpy_overlap_ingest_lag_seconds": "histogram",
+    "byzpy_p2p_rounds_total": "counter",
+    "byzpy_ps_liveness_probes_total": "counter",
+    "byzpy_ps_round_seconds": "histogram",
+    "byzpy_ps_rounds_total": "counter",
+    "byzpy_quarantined_clients": "gauge",
+    "byzpy_recoveries_total": "counter",
+    "byzpy_retry_exhausted_total": "counter",
+    "byzpy_retry_total": "counter",
+    "byzpy_root_finalize_seconds": "histogram",
+    "byzpy_root_merge_seconds": "histogram",
+    "byzpy_root_partials_inflight": "gauge",
+    "byzpy_round_overlap_ratio": "gauge",
+    "byzpy_round_repairs_total": "counter",
+    "byzpy_serving_bad_frames_total": "counter",
+    "byzpy_serving_callback_errors_total": "counter",
+    "byzpy_serving_cohort_size": "histogram",
+    "byzpy_serving_failed_rounds_total": "counter",
+    "byzpy_serving_ingress_bytes_total": "counter",
+    "byzpy_serving_malformed_requests_total": "counter",
+    "byzpy_serving_outstanding": "gauge",
+    "byzpy_serving_quarantines_total": "counter",
+    "byzpy_serving_queue_depth": "gauge",
+    "byzpy_serving_ragged_recompile_warnings_total": "counter",
+    "byzpy_serving_recompile_warnings_total": "counter",
+    "byzpy_serving_round_latency_seconds": "histogram",
+    "byzpy_serving_rounds_total": "counter",
+    "byzpy_serving_submissions_total": "counter",
+    "byzpy_serving_submit_frames_total": "counter",
+    "byzpy_serving_tenant_dim": "gauge",
+    "byzpy_serving_unknown_tenant_total": "counter",
+    "byzpy_shard_accepted_total": "counter",
+    "byzpy_shard_forged_folds_total": "counter",
+    "byzpy_shard_merge_seconds": "histogram",
+    "byzpy_shard_partitions_total": "counter",
+    "byzpy_shard_quorum_closes_total": "counter",
+    "byzpy_shard_rounds_total": "counter",
+    "byzpy_shards_live": "gauge",
+    "byzpy_slo_breached": "gauge",
+    "byzpy_slo_breaches_total": "counter",
+    "byzpy_slo_burn_rate": "gauge",
+    "byzpy_slo_objective_target": "gauge",
+    "byzpy_slo_short_burn_rate": "gauge",
+    "byzpy_snapshot_failures_total": "counter",
+    "byzpy_speculative_closes_total": "counter",
+    "byzpy_step_seconds": "histogram",
+    "byzpy_trust_score": "gauge",
+    "byzpy_wal_records_total": "counter",
+    "byzpy_wire_bytes_total": "counter",
+    "byzpy_wire_frames_total": "counter",
+    "byzpy_wire_info": "gauge",
+}
+
+#: dynamic metric families: a literal name starting with one of these
+#: prefixes is catalogued as a family (``byzpy_logged_<key>`` gauges
+#: from ``MetricsLogger``)
+METRIC_PREFIXES: Tuple[str, ...] = ("byzpy_logged_",)
+
+#: every static span/instant label
+SPANS: FrozenSet[str] = frozenset(
+    {
+        "p2p.aggregate",
+        "p2p.round",
+        "ps.aggregate",
+        "ps.broadcast",
+        "ps.fold",
+        "ps.fold_finalize",
+        "ps.gather",
+        "ps.round",
+        "serving.admission",
+        "serving.broadcast",
+        "serving.bucket_pad",
+        "serving.client.submit",
+        "serving.cohort_close",
+        "serving.device_step",
+        "serving.fold",
+        "serving.fold_merge",
+        "serving.gram_assemble",
+        "serving.ingress.decode",
+        "serving.merge_close",
+        "serving.merge_combine",
+        "serving.partial_verify",
+        "serving.round",
+        "serving.round.repair",
+        "serving.shard_close",
+        "serving.sharded_round",
+        "slo.breach",
+        "spmd.device_step",
+    }
+)
+
+#: dynamic span families (``chaos.<kind>`` event-trace mirror instants)
+SPAN_PREFIXES: Tuple[str, ...] = ("chaos.",)
+
+__all__ = ["METRICS", "METRIC_PREFIXES", "SPANS", "SPAN_PREFIXES"]

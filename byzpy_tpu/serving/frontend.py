@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis import sanitize
 from ..engine.actor import wire
 from ..forensics.evidence import evidence_digest
 from ..forensics.plane import ForensicsConfig, ForensicsPlane
@@ -1268,6 +1269,13 @@ class ServingFrontend:
         t.outstanding -= cohort.m
         t.round_done.set()
         self._maybe_snapshot(t)
+        if sanitize.enabled():
+            # exactly-once fold audit: both close paths (async scheduler
+            # and close_round_nowait) funnel through here, so a repeated
+            # round id or a twice-folded idempotency key IS a double fold
+            sanitize.audit_fold(
+                t.cfg.name, closed, [(s.client, s.seq) for s in subs]
+            )
         if obs_runtime.STATE.enabled:
             t.telemetry.rounds.inc()
             t.telemetry.latency_s.observe(latency_s)
@@ -1475,6 +1483,13 @@ class ServingFrontend:
                 )
 
         while self._running:
+            # stall watchdog: a gap far beyond the admission window
+            # means a blocking call rode this loop (threshold generous —
+            # collect legitimately waits the full window, folds overlap)
+            sanitize.loop_tick(
+                f"serving.tenant_loop.{t.cfg.name}",
+                threshold_s=max(30.0, 10.0 * t.cfg.window_s),
+            )
             more = await t.queue.collect(
                 t.cfg.cohort_cap - len(held), t.cfg.window_s
             )

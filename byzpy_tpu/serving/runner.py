@@ -91,6 +91,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis import sanitize
 from ..engine.actor import wire
 from ..engine.actor.transports.tcp import dial_policy
 from ..observability import metrics as obs_metrics
@@ -1380,6 +1381,9 @@ def _root_main(
         max_workers=8, thread_name_prefix="root-ctl"
     ) as ctl:
         while not root._stop:
+            # accept times out every 0.5 s, so a 30 s tick gap means the
+            # control plane itself wedged (not an idle fabric)
+            sanitize.loop_tick("runner.root_accept", threshold_s=30.0)
             try:
                 sock, _addr = server.accept()
             except socket.timeout:

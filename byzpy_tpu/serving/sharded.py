@@ -113,6 +113,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis import sanitize
 from ..engine.actor import wire
 from ..forensics.evidence import evidence_digest
 from ..observability import metrics as obs_metrics
@@ -1065,8 +1066,13 @@ class ShardedCoordinator:
             for name, rt in self._roots.items():
                 shard.sync_round(name, rt.round_id)
         #: shard events the audit trail sees even without durability
-        #: (forged folds, partitions, quorum closes) — bounded tail
+        #: (forged folds, partitions, quorum closes) — bounded tail.
+        #: Appended from the loop AND the executor-side merge/verify
+        #: helpers, as is ``callback_errors`` — ``_stats_lock``
+        #: serializes both (the trim in ``_note_event`` is a
+        #: read-modify-write; `+=` on the counter is too)
         self.shard_events: List[dict] = []
+        self._stats_lock = threading.Lock()
         self._tenant_cfgs = list(tenants)
         self._running = False
         self._tasks: list = []
@@ -1514,10 +1520,15 @@ class ShardedCoordinator:
                         self._m_dedup_restaged[rt.cfg.name].inc()
         return (folded, dups), measured
 
+    def _note_callback_error(self) -> None:
+        with self._stats_lock:
+            self.callback_errors += 1
+
     def _note_event(self, event: dict) -> None:
-        self.shard_events.append(event)
-        if len(self.shard_events) > 1024:
-            del self.shard_events[:512]
+        with self._stats_lock:
+            self.shard_events.append(event)
+            if len(self.shard_events) > 1024:
+                del self.shard_events[:512]
 
     def note_forged(
         self,
@@ -2115,7 +2126,7 @@ class ShardedCoordinator:
                         except Exception:  # noqa: BLE001 — forensics
                             # input, never a round participant
                             view = None
-                            self.callback_errors += 1
+                            self._note_callback_error()
                     vec = np.asarray(handle)
             except Exception:  # noqa: BLE001 — a poisoned merged cohort
                 # must not kill the root: the round fails with per-shard
@@ -2156,7 +2167,7 @@ class ShardedCoordinator:
                 )
             except Exception:  # noqa: BLE001 — the score view is
                 # forensics input, never a round participant
-                self.callback_errors += 1
+                self._note_callback_error()
         offsets = list(merged.get("offsets", []))
         m_total = int(merged["m"])
         closed = rt.round_id
@@ -2303,7 +2314,7 @@ class ShardedCoordinator:
             try:
                 self._on_round(tenant, closed, merged, vec)
             except Exception:  # noqa: BLE001 — observer bug, counted
-                self.callback_errors += 1
+                self._note_callback_error()
         return closed, merged["rows"], vec
 
     # -- async root scheduler ---------------------------------------------
@@ -2350,15 +2361,24 @@ class ShardedCoordinator:
         for rt in self._roots.values():
             if rt.durability is not None:
                 rt.durability.close()
+        # quiescence invariant: every staged partial was merged or
+        # repaired away — a nonzero residue is a lost decrement
+        sanitize.check_drained(
+            "byzpy_root_partials_inflight", self._partials_inflight
+        )
 
     async def _root_loop(self, cfg: TenantConfig) -> None:
         while self._running:
+            sanitize.loop_tick(
+                f"serving.root_loop.{cfg.name}",
+                threshold_s=max(30.0, 10.0 * cfg.window_s),
+            )
             await asyncio.sleep(cfg.window_s)
             try:
                 await self._close_async(cfg.name)
             except Exception:  # noqa: BLE001 — a failed window must not
                 # kill the root scheduler
-                self.callback_errors += 1
+                self._note_callback_error()
 
     async def _close_async(self, tenant: str) -> Optional[tuple]:
         """One async close, ARRIVAL-DRIVEN: the previous window's

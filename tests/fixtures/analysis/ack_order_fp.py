@@ -1,0 +1,42 @@
+"""byzlint fixture: ACK-ORDER false-positive guards.
+
+The module contract done right — append-before-ack on every path —
+plus the shapes the flow pass must not over-flag: dead paths after a
+returning send, events split across functions, and the documented
+one-pass loop treatment (no loop-carry: precision over completeness).
+"""
+
+
+class Frontend:
+    def handle_submit(self, writer, sub):
+        # the PR 9 fix: the accept record lands BEFORE the ack returns
+        self.durability.record_accept(sub.client, sub.seq)
+        writer.write(b"ok")
+
+    def handle_reject(self, writer, sub, full):
+        if full:
+            writer.write(b"rejected")  # no promise made — nothing owed
+            return
+        self.durability.record_accept(sub.client, sub.seq)
+        writer.write(b"ok")
+
+    def handle_guarded(self, writer, sub):
+        try:
+            self.durability.record_accept(sub.client, sub.seq)
+        except OSError:
+            writer.write(b"error")
+            return
+        writer.write(b"ok")
+
+    def drain(self, writer, subs):
+        for sub in subs:
+            # per-item append→send inside one iteration: in order
+            self.durability.record_accept(sub.client, sub.seq)
+            writer.write(b"ok")
+
+    def append_only(self, sub):
+        self.durability.record_accept(sub.client, sub.seq)
+
+
+def send_only(writer, replies):
+    writer.write(b"".join(replies))

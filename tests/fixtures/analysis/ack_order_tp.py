@@ -1,0 +1,33 @@
+"""byzlint fixture: ACK-ORDER true positives (never imported).
+
+Minimized PR 9 incident: the ack left the process before the WAL
+append that makes it a durable promise — a crash between the two
+replayed the submission into a double fold on recovery.
+"""
+
+
+class Frontend:
+    def handle_submit(self, writer, sub):
+        writer.write(b"ok")  # ack first...
+        # finding: ...then the append that was supposed to back it
+        self.durability.record_accept(sub.client, sub.seq)
+
+    def handle_branchy(self, writer, sub, fast):
+        if fast:
+            writer.write(b"ok")
+        else:
+            self.prepare(sub)
+        # finding: the fast path acked before this append
+        self.durability.record_accept(sub.client, sub.seq)
+
+    def prepare(self, sub):
+        return sub
+
+
+def helper_ack_first(wal, conn, record):
+    send_ack(conn, b"ok")
+    wal.append(record)  # finding: bare-function ack preceded the append
+
+
+def send_ack(conn, payload):
+    return conn, payload

@@ -1,0 +1,38 @@
+"""byzlint fixture: METRIC-CONTRACT false-positive guards.
+
+Catalogued names with matching types, declared dynamic families, and
+the shapes the rule must resolve to nothing: computed names (silent by
+design) and non-registry ``.counter``/``.span`` lookalikes.
+"""
+
+import re
+
+from byzpy_tpu.observability import tracing
+
+
+def register(reg, tenant):
+    rounds = reg.counter("byzpy_serving_rounds_total", help="catalogued")
+    depth = reg.gauge("byzpy_serving_queue_depth", help="catalogued")
+    logged = reg.gauge("byzpy_logged_loss", help="dynamic family")
+    # computed names can't be checked statically — silent by design
+    custom = reg.counter(f"byzpy_{tenant}_total", help="computed")
+    return rounds, depth, logged, custom
+
+
+def run_round(payload, kind):
+    with tracing.span("serving.round", tenant="t0", round=1):
+        tracing.instant(f"chaos.{kind}", vt=0.0)  # computed: silent
+        tracing.instant("chaos.drop", vt=0.0)  # declared prefix family
+        return payload
+
+
+def lookalikes(text):
+    match = re.match(r"(a)(b)", text)
+    span = match.span(1)  # re.Match.span is not a tracing span
+    parser = _FieldParser()
+    return span, parser.counter("fields")  # non-registry receiver
+
+
+class _FieldParser:
+    def counter(self, name):
+        return name

@@ -1,0 +1,35 @@
+"""byzlint fixture: PARITY-PURITY false-positive guards.
+
+Determinism done right inside the parity set — ``sorted(...)``
+launders set order — and nondeterminism that is fine because it never
+reaches parity-pinned code.
+"""
+
+import time
+
+
+def combine_partials(parts):
+    total = 0.0
+    for digest in sorted({p for p in parts}):  # sorted: order is pinned
+        total += len(digest)
+    for p in parts:  # list iteration keeps arrival order
+        total += 1.0
+    return total
+
+
+def evidence_digest(vec):
+    return sum(vec)
+
+
+def observe_latency(metrics_sink):
+    # clocks are fine outside the parity set
+    metrics_sink.observe(time.monotonic())
+
+
+def _timer_helper():
+    return time.perf_counter()
+
+
+def report_stats(sink):
+    # _timer_helper is only ever called from non-parity code
+    sink.push(_timer_helper())

@@ -1,0 +1,34 @@
+"""byzlint fixture: PARITY-PURITY true positives (never imported).
+
+The PR 7 class of bug: nondeterminism inside functions on the
+digest-parity contract — a clock read, an RNG draw, and bare-set
+iteration order leaking into folded bytes.
+"""
+
+import random
+import time
+
+import numpy as np
+
+
+def fold_merge_add(acc, row):
+    acc["stamp"] = time.monotonic()  # finding: clock in a parity fold
+    acc["rows"].append(row)
+    return acc
+
+
+def combine_partials(parts):
+    jitter = random.random()  # finding: RNG in a parity combine
+    total = 0.0
+    for digest in {p for p in parts}:  # finding: bare-set iteration
+        total += len(digest)
+    return total + jitter
+
+
+def evidence_digest(vec):
+    return _score_helper(vec)
+
+
+def _score_helper(vec):
+    # finding: parity-reachable from evidence_digest
+    return vec + np.random.normal()

@@ -1,0 +1,71 @@
+"""byzlint fixture: THREAD-SHARED false-positive guards.
+
+The sanctioned patterns: every cross-context write under one common
+lock, single-context confinement (the PR 19 epoch-stamped handoff
+settles on the loop only), and construction-time initialization.
+"""
+
+import threading
+
+
+class LockedCoordinator:
+    """Every cross-context write serialized under the same lock."""
+
+    def __init__(self):
+        self.staging = {}
+        self._stats_lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._reader_loop, daemon=True).start()
+
+    def _reader_loop(self):
+        with self._stats_lock:
+            self.staging["k"] = "verdict"
+
+    async def _finish(self):
+        with self._stats_lock:
+            self.staging = {}
+
+
+class ConfinedCoordinator:
+    """Single-context confinement: only the loop ever writes; the
+    reader thread hands work over via a queue (reads don't count)."""
+
+    def __init__(self):
+        self.staging = {}
+        self.pending = []
+
+    def start(self):
+        threading.Thread(target=self._reader_loop, daemon=True).start()
+
+    def _reader_loop(self):
+        while self.staging:  # read-only on the thread side
+            pass
+
+    async def _finish(self, key, verdict):
+        self.staging[key] = verdict
+        self.staging = dict(self.staging)
+
+
+class InitOnlyState:
+    """__init__ writes happen before the object is published."""
+
+    def __init__(self):
+        self.table = {}
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        self.table["k"] = 1  # the only post-publication writer
+
+
+class TwoLoopMethods:
+    """Two writers, both on the event loop: one context, no race."""
+
+    def __init__(self):
+        self.rounds = 0
+
+    async def close(self):
+        self.rounds += 1
+
+    async def repair(self):
+        self.rounds += 1

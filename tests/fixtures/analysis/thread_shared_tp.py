@@ -1,0 +1,48 @@
+"""byzlint fixture: THREAD-SHARED true positives (never imported).
+
+Minimized PR 19 incident: the root's arrival-time dedup staging table
+was written by proxy reader threads while the loop-side close settled
+it — no common lock, so staged verdicts vanished mid-settle.
+"""
+
+import threading
+
+
+class RootCoordinator:
+    def __init__(self):
+        self.staging = {}
+        self.callback_errors = 0
+        self._reader = None
+
+    def start(self):
+        self._reader = threading.Thread(
+            target=self._reader_loop, daemon=True
+        )
+        self._reader.start()
+
+    def _recv(self):
+        return object()
+
+    def _reader_loop(self):
+        while True:
+            partial = self._recv()
+            if partial is None:
+                self._on_observer_error()
+                continue
+            # finding: thread-side write, loop-side settle, no lock
+            self.staging[partial] = "verdict"
+
+    def _on_observer_error(self):
+        # called from the reader loop too — lost-update increment
+        self.callback_errors += 1
+
+    async def _finish(self, closed):
+        try:
+            for key in closed:
+                self._publish(key)
+        except Exception:  # noqa: BLE001 — observer bug, counted
+            self.callback_errors += 1  # finding: `+=` from two contexts
+        self.staging = {}  # settles the table on the event loop
+
+    def _publish(self, key):
+        return key

@@ -15,12 +15,16 @@ import pytest
 
 from byzpy_tpu.analysis import UNUSED_IGNORE, scan_paths
 from byzpy_tpu.analysis.rules import (
+    ACK_ORDER,
     ALL_RULES,
     ASYNC_BLOCKING,
     AXIS_BINDING,
     DONATION,
     HOST_SYNC,
+    METRIC_CONTRACT,
+    PARITY_PURITY,
     PYTREE_REG,
+    THREAD_SHARED,
     TRACE_DISPATCH,
 )
 
@@ -46,6 +50,10 @@ RULE_FIXTURES = {
     HOST_SYNC: ("host_sync_tp.py", "host_sync_fp.py", 3),
     ASYNC_BLOCKING: ("async_blocking_tp.py", "async_blocking_fp.py", 5),
     PYTREE_REG: ("pytree_reg_tp.py", "pytree_reg_fp.py", 2),
+    THREAD_SHARED: ("thread_shared_tp.py", "thread_shared_fp.py", 2),
+    ACK_ORDER: ("ack_order_tp.py", "ack_order_fp.py", 3),
+    PARITY_PURITY: ("parity_purity_tp.py", "parity_purity_fp.py", 4),
+    METRIC_CONTRACT: ("metric_contract_tp.py", "metric_contract_fp.py", 3),
 }
 
 

@@ -1,0 +1,140 @@
+"""Runtime invariant sanitizer (``byzpy_tpu.analysis.sanitize``).
+
+The dynamic half of byzlint: hook-level teeth (the stall watchdog
+fires on a deliberate block, the drain check trips on a leaked
+partial, the fold audit catches a double fold) plus the wiring — a
+real :class:`ServingFrontend` round close drives the exactly-once
+audit, and a clean run records nothing. Digest parity of a sanitized
+chaos run is pinned by the chaos bench's ``sanitize`` lane.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from byzpy_tpu.analysis import sanitize
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_on():
+    """Each test gets a fresh, ENABLED sanitizer and leaves the
+    process-wide singleton the way it found it."""
+    was = sanitize.enabled()
+    sanitize.enable()
+    sanitize.reset()
+    yield
+    sanitize.reset()
+    if not was:
+        sanitize.disable()
+
+
+def test_stall_watchdog_fires_on_deliberate_block():
+    sanitize.loop_tick("t.loop", threshold_s=0.05)
+    time.sleep(0.12)  # the blocking call the static rule couldn't see
+    sanitize.loop_tick("t.loop", threshold_s=0.05)
+    (violation,) = sanitize.violations()
+    assert "loop-stall[t.loop]" in violation
+    assert sanitize.counters()["loop_ticks"] == 2
+    with pytest.raises(AssertionError, match="loop-stall"):
+        sanitize.assert_clean()
+
+
+def test_ticks_within_threshold_stay_clean():
+    for _ in range(5):
+        sanitize.loop_tick("t.loop", threshold_s=10.0)
+    # independent loops do not share watchdog marks
+    sanitize.loop_tick("t.other", threshold_s=1e-9)
+    assert sanitize.violations() == []
+    sanitize.assert_clean()
+
+
+def test_drain_check_trips_on_leaked_partial():
+    sanitize.check_drained("byzpy_root_partials_inflight", 0)
+    assert sanitize.violations() == []
+    sanitize.check_drained("byzpy_root_partials_inflight", 3)
+    (violation,) = sanitize.violations()
+    assert "leak[byzpy_root_partials_inflight]" in violation
+    assert "3 still in flight" in violation
+    assert sanitize.counters()["drain_checks"] == 2
+
+
+def test_fold_audit_catches_double_fold_and_skips_legacy_seq():
+    sanitize.audit_fold("m0", 0, [("a", 1), ("b", None)])
+    sanitize.audit_fold("m0", 1, [("a", 2), ("b", None)])
+    # seq=None (legacy clients) never dedups across rounds
+    assert sanitize.violations() == []
+    # replaying a round id = the PR 9 double-fold shape
+    sanitize.audit_fold("m0", 1, [("c", 9)])
+    # an idempotency key folding twice is its own violation
+    sanitize.audit_fold("m0", 2, [("a", 2)])
+    found = sanitize.violations()
+    assert len(found) == 2
+    assert "round 1 closed after round 1" in found[0]
+    assert "(a, seq=2) folded twice" in found[1]
+    # tenants are independent streams
+    sanitize.audit_fold("m1", 0, [("a", 2)])
+    assert len(sanitize.violations()) == 2
+
+
+def test_disabled_hooks_are_inert():
+    sanitize.disable()
+    sanitize.loop_tick("t.loop", threshold_s=0.0)
+    sanitize.audit_fold("m0", 0, [("a", 1)])
+    sanitize.audit_fold("m0", 0, [("a", 1)])
+    sanitize.check_drained("x", 99)
+    assert sanitize.violations() == []
+    assert all(v == 0 for v in sanitize.counters().values())
+
+
+def test_env_flag_enables_at_construction(monkeypatch):
+    from byzpy_tpu.analysis.sanitize import _Sanitizer
+
+    monkeypatch.setenv("BYZPY_TPU_SANITIZE", "1")
+    assert _Sanitizer().enabled
+    monkeypatch.setenv("BYZPY_TPU_SANITIZE", "0")
+    assert not _Sanitizer().enabled
+    monkeypatch.delenv("BYZPY_TPU_SANITIZE")
+    assert not _Sanitizer().enabled
+
+
+def test_frontend_round_close_drives_the_fold_audit():
+    """The wiring, not just the API: a real round close through
+    ``close_round_nowait`` funnels into ``audit_fold`` with the
+    cohort's (client, seq) keys, and a clean close records nothing."""
+    from byzpy_tpu.aggregators import CoordinateWiseMedian
+    from byzpy_tpu.serving import ServingFrontend, TenantConfig
+
+    fe = ServingFrontend(
+        [
+            TenantConfig(
+                name="m0",
+                aggregator=CoordinateWiseMedian(),
+                dim=4,
+                window_s=0.02,
+                cohort_cap=8,
+            )
+        ]
+    )
+    rng = np.random.default_rng(0)
+    for i, cid in enumerate(("a", "b", "c")):
+        ok, reason = fe.submit(
+            "m0", cid, 0,
+            rng.normal(size=4).astype(np.float32), seq=100 + i,
+        )
+        assert ok, reason
+    assert fe.close_round_nowait("m0") is not None
+    counters = sanitize.counters()
+    assert counters["folds_audited"] == 1
+    assert sanitize.violations() == []
+    # a second round with FRESH seqs is still exactly-once
+    for i, cid in enumerate(("a", "b", "c")):
+        assert fe.submit(
+            "m0", cid, 1,
+            rng.normal(size=4).astype(np.float32), seq=200 + i,
+        )[0]
+    assert fe.close_round_nowait("m0") is not None
+    assert sanitize.counters()["folds_audited"] == 2
+    sanitize.assert_clean()

@@ -1,0 +1,100 @@
+"""docs/observability.md ↔ observability/catalog.py parity.
+
+The catalog is the machine-readable single source of truth the
+byzlint ``METRIC-CONTRACT`` rule checks code against; the docs tables
+are its human rendering. This test parses every metric and span row
+out of the markdown and pins BOTH directions: a docs row naming an
+uncatalogued instrument is drift, and a catalogued instrument with no
+docs row is an undocumented instrument. Metric types must match
+cell-for-cell (one name, one type).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from byzpy_tpu.observability import catalog
+
+DOCS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "observability.md",
+)
+
+_TYPES = ("counter", "gauge", "histogram")
+
+
+def _doc_tables():
+    """Parse the markdown tables: ``(metrics, metric_prefixes, spans,
+    span_prefixes)``. Metric rows may carry several backticked names
+    per cell with one shared type or a slash-separated type per name;
+    ``<...>`` placeholders declare prefix families."""
+    with open(DOCS, encoding="utf-8") as fh:
+        text = fh.read()
+    metrics, metric_prefixes = {}, set()
+    spans, span_prefixes = set(), set()
+    for line in text.splitlines():
+        if not line.startswith("| `"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        names = re.findall(r"`([a-zA-Z0-9_.<>]+)`", cells[0])
+        if not names:
+            continue
+        types = [t.strip() for t in cells[1].split("/")] if len(cells) > 1 else []
+        if all(t in _TYPES for t in types) and types:
+            # a metric row: one shared type, or one type per name
+            assert len(types) in (1, len(names)), f"ragged metric row: {line}"
+            for i, name in enumerate(names):
+                t = types[i] if len(types) == len(names) else types[0]
+                if "<" in name:
+                    metric_prefixes.add(name.split("<", 1)[0])
+                else:
+                    assert metrics.get(name, t) == t, (
+                        f"{name} documented under two types"
+                    )
+                    metrics[name] = t
+            continue
+        for name in names:
+            # span rows: dotted labels only (skip config/code lookalikes)
+            if "." not in name or name.startswith("byzpy_"):
+                continue
+            if "<" in name:
+                span_prefixes.add(name.split("<", 1)[0])
+            else:
+                spans.add(name)
+    return metrics, metric_prefixes, spans, span_prefixes
+
+
+def test_catalog_is_well_formed():
+    assert catalog.METRICS, "empty metric catalog"
+    assert catalog.SPANS, "empty span catalog"
+    for name, mtype in catalog.METRICS.items():
+        assert name.startswith("byzpy_"), name
+        assert mtype in _TYPES, (name, mtype)
+    for prefix in catalog.METRIC_PREFIXES:
+        assert prefix.startswith("byzpy_"), prefix
+
+
+def test_docs_metric_tables_match_catalog_both_ways():
+    metrics, prefixes, _spans, _sp = _doc_tables()
+    assert metrics, "no metric rows parsed from docs/observability.md"
+    mismatched = {
+        n: (t, catalog.METRICS.get(n))
+        for n, t in metrics.items()
+        if catalog.METRICS.get(n) != t
+    }
+    assert not mismatched, f"docs rows drifting from catalog: {mismatched}"
+    undocumented = sorted(set(catalog.METRICS) - set(metrics))
+    assert not undocumented, f"catalogued but not in docs: {undocumented}"
+    assert prefixes == set(catalog.METRIC_PREFIXES)
+
+
+def test_docs_span_table_matches_catalog_both_ways():
+    _m, _p, spans, span_prefixes = _doc_tables()
+    assert spans, "no span rows parsed from docs/observability.md"
+    unknown = sorted(spans - set(catalog.SPANS))
+    assert not unknown, f"docs span rows drifting from catalog: {unknown}"
+    undocumented = sorted(set(catalog.SPANS) - spans)
+    assert not undocumented, f"catalogued but not in docs: {undocumented}"
+    assert span_prefixes == set(catalog.SPAN_PREFIXES)

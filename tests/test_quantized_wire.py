@@ -256,3 +256,46 @@ def test_ipc_default_stays_lossless(grads):
         np.testing.assert_array_equal(out["g"], grads)
     finally:
         ipc.cleanup_handles(handles)
+
+
+# ---------------------------------------------------------------------------
+# fused stats+decode door (one codes->f32 conversion per frame)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "mode", ["int8", "fp8", "fp8_e5m2", "s4", "bf16", "off"]
+)
+def test_decode_with_stats_byte_parity_with_two_pass(monkeypatch, grads, mode):
+    """``decode_with_stats`` fuses the pre-decode inflation pass and the
+    dequantization into one walk sharing each frame's codes->f32
+    conversion; the payload must stay BYTE-identical to the separate
+    ``payload_block_stats`` + ``decompress_payload`` passes, and the
+    stats dict must match field-for-field (None off the blockwise
+    fabrics — lossless and bf16 frames carry no scale header)."""
+    import cloudpickle
+
+    monkeypatch.delenv("BYZPY_TPU_WIRE_KEY", raising=False)
+    if mode == "off":
+        monkeypatch.delenv("BYZPY_TPU_WIRE_PRECISION", raising=False)
+    else:
+        monkeypatch.setenv("BYZPY_TPU_WIRE_PRECISION", mode)
+    # "h" sits above WIRE_QUANT_MIN_SIZE at a non-multiple of the block
+    # size, so the padded-tail path is exercised alongside "g"
+    payload = {"g": grads, "h": grads[:1281].copy(), "round": 7}
+    body = _body(wire.encode(payload))
+    raw = cloudpickle.loads(body)
+    expected_stats = wire.payload_block_stats(raw)
+    expected = wire.decompress_payload(raw)
+    out, stats = wire.decode_with_stats(body)
+    assert stats == expected_stats
+    if mode in wire.BLOCKWISE_WIRE_MODES:
+        assert stats is not None and stats["frames"] == 2
+    else:
+        assert stats is None
+    for key in ("g", "h"):
+        assert out[key].dtype == expected[key].dtype
+        assert out[key].shape == expected[key].shape
+        np.testing.assert_array_equal(out[key], expected[key])
+        assert out[key].tobytes() == expected[key].tobytes()
+    assert out["round"] == 7
